@@ -1,0 +1,48 @@
+"""tensorlink_tpu — TPU-native peer-to-peer distributed ML framework.
+
+A ground-up re-design of the capabilities of tensorlink (reference:
+/root/reference, a pure-Python PyTorch/CUDA P2P platform) for TPU hardware:
+
+- Models are functional JAX programs with named-axis parameters and GSPMD
+  ``PartitionSpec`` sharding (reference: per-worker ``nn.Module`` fragments,
+  ml/graphing.py + ml/injector.py).
+- Intra-slice communication lowers to XLA collectives over ICI; only
+  cross-host / WAN coordination rides the asyncio P2P mesh (reference: raw-TCP
+  tensor transport everywhere, p2p/connection.py).
+- Inference is an XLA-compiled prefill/decode pair with a sharded, donated KV
+  cache (reference: HF ``generate()`` eager loop, ml/worker.py:359).
+- Training uses ``jax.grad`` through sharded programs + optax with sharded
+  optimizer state (reference: torch autograd replay + optimizer RPC fan-out,
+  ml/optim.py).
+
+Public API (mirrors the reference's ``tensorlink`` package surface):
+    DistributedModel, UserNode, WorkerNode, ValidatorNode
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "DistributedModel": "tensorlink_tpu.ml.module",
+    "create_distributed_optimizer": "tensorlink_tpu.ml.optim",
+    "UserNode": "tensorlink_tpu.nodes.runners",
+    "WorkerNode": "tensorlink_tpu.nodes.runners",
+    "ValidatorNode": "tensorlink_tpu.nodes.runners",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        try:
+            mod = importlib.import_module(_LAZY[name])
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"'tensorlink_tpu.{name}' is not available: {e}"
+            ) from e
+        return getattr(mod, name)
+    raise AttributeError(f"module 'tensorlink_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -1,0 +1,7 @@
+"""Core kit: config, logging, serialization, shared memory, identity.
+
+Reference parity: tensorlink's layered config (nodes/nodes.py:16-77,
+bin/config.json, .tensorlink.env), tagged colored logging
+(p2p/smart_node.py:499-530), pickle-free tensor serialization
+(ml/utils.py:569-660), and shared-memory IPC (nodes/shared_memory.py).
+"""

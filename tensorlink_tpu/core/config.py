@@ -1,0 +1,220 @@
+"""Layered configuration system.
+
+Mirrors the reference's four config layers (SURVEY §5; reference
+nodes/nodes.py:16-77, bin/run_node.py:213-246, .tensorlink.env,
+tensorlink/config/config.json) as one coherent scheme:
+
+1. Role config dataclasses (programmatic API) — :class:`NodeConfig` and
+   subclasses.
+2. Operator ``config.json`` — node type / mode / endpoint / ml caps.
+3. Environment file (``.tensorlink_tpu.env``) — keys, persisted port
+   assignments, chain overrides.
+4. Packaged defaults — seed validators, default models, contract addresses.
+
+Unlike the reference there is also a first-class ``MeshConfig`` describing the
+TPU topology the node contributes (axis names/sizes, dtype policy) — on TPU the
+unit of capacity is a slice of a device mesh, not "GPU bytes".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+DEFAULT_ENV_FILE = ".tensorlink_tpu.env"
+
+# Packaged defaults (reference: tensorlink/config/config.json + models.json).
+DEFAULT_CONFIG: dict[str, Any] = {
+    "seed_validators": [],  # [(host, port), ...]
+    "default_models": ["Qwen/Qwen3-8B"],
+    "free_job_max_time": 3600.0,  # reference validator_thread.py:19
+    "max_wait_time": 150.0,  # reference ml/module.py:58
+    "worker_recruit_timeout": 3.0,  # reference validator_thread.py:871
+    "job_request_timeout": 120.0,  # reference user_thread.py:406
+    "api": {
+        "max_concurrent": 100,  # reference api/node.py:537
+        "stream_token_timeout": 30.0,
+        "request_timeout": 300.0,
+    },
+}
+
+
+@dataclass
+class MLConfig:
+    """ML-engine knobs (reference config.json "ml" block, run_node.py:228-246)."""
+
+    max_memory_gb: float | None = None  # cap on HBM the node offers
+    max_module_bytes: float | None = None  # force sharding below this size
+    trusted: bool = False  # reference: pickle mode. Here: may run user jax code
+    dtype: str = "bfloat16"
+    max_seq_len: int = 4096
+    # TPU-specific: padding buckets to bound XLA recompilation (SURVEY §7.3.5)
+    seq_buckets: tuple[int, ...] = (128, 512, 1024, 2048, 4096)
+    batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
+
+
+@dataclass
+class MeshConfig:
+    """Shape of the device mesh a node runs over.
+
+    Axis names follow the scaling-book convention: data / fsdp / tensor /
+    expert / sequence / stage. ``axis_sizes`` of -1 means "all remaining local
+    devices".
+    """
+
+    axes: tuple[str, ...] = ("data", "tensor")
+    axis_sizes: tuple[int, ...] = (1, -1)
+    platform: str | None = None  # None = jax default; "cpu" for tests
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dict(zip(self.axes, self.axis_sizes))
+        rem = n_devices
+        wildcard = None
+        for ax, s in sizes.items():
+            if s == -1:
+                if wildcard is not None:
+                    raise ValueError("only one mesh axis may be -1")
+                wildcard = ax
+            else:
+                if rem % s != 0:
+                    raise ValueError(
+                        f"axis {ax}={s} does not divide device count {rem}"
+                    )
+                rem //= s
+        if wildcard is not None:
+            sizes[wildcard] = rem
+        elif rem != 1:
+            raise ValueError(
+                f"mesh {sizes} does not use all {n_devices} devices"
+            )
+        return sizes
+
+
+@dataclass
+class NodeConfig:
+    """Base node configuration (reference BaseNodeConfig, nodes/nodes.py:16-45)."""
+
+    role: str = "node"
+    host: str = "0.0.0.0"
+    port: int | None = None  # None = ephemeral / persisted in env file
+    debug: bool = True
+    debug_level: int = 20  # logging level; 5 = VERBOSE
+    local_test: bool = False  # force 127.0.0.1, no UPnP (reference smart_node.py:230)
+    upnp: bool = False
+    off_chain: bool = True  # reference: on_chain flag inverted; off-chain default
+    endpoint: bool = False  # serve the HTTP API (validators)
+    endpoint_host: str = "127.0.0.1"
+    endpoint_port: int = 64747  # reference test endpoint port
+    seed_validators: list[tuple[str, int]] = field(default_factory=list)
+    key_dir: str = "keys"
+    log_dir: str = "logs"
+    env_file: str = DEFAULT_ENV_FILE
+    ml: MLConfig = field(default_factory=MLConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    utilization: bool = True  # offer capacity (workers)
+    duplicate: str = ""  # role suffix for same-host multi-node tests
+
+    def effective_host(self) -> str:
+        return "127.0.0.1" if self.local_test else self.host
+
+
+@dataclass
+class WorkerConfig(NodeConfig):
+    role: str = "worker"
+    mining: bool = False  # reference: miner subprocess mgmt (run_node.py:135-194)
+
+
+@dataclass
+class ValidatorConfig(NodeConfig):
+    role: str = "validator"
+    endpoint: bool = True
+
+
+@dataclass
+class UserConfig(NodeConfig):
+    role: str = "user"
+
+
+def _coerce(cls, data: dict[str, Any]):
+    """Build a dataclass from a dict, recursing into nested dataclass fields
+    and ignoring unknown keys (operator config files may carry extras)."""
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        ftype = hints.get(f.name, f.type)
+        if dataclasses.is_dataclass(ftype) and isinstance(v, dict):
+            v = _coerce(ftype, v)
+        elif f.name == "seed_validators":
+            v = [tuple(x) for x in v]
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+ROLE_CONFIGS = {
+    "worker": WorkerConfig,
+    "validator": ValidatorConfig,
+    "user": UserConfig,
+}
+
+
+def load_config(path: str | Path) -> NodeConfig:
+    """Load an operator config.json (reference bin/run_node.py:213-246)."""
+    raw = json.loads(Path(path).read_text())
+    role = raw.get("role", raw.get("node", {}).get("type", "worker"))
+    cls = ROLE_CONFIGS.get(role, NodeConfig)
+    flat = dict(raw)
+    flat.update(raw.get("node", {}))
+    flat["role"] = role
+    # Reference mode mapping (run_node.py:60-76): local / upnp / on_chain
+    mode = flat.pop("mode", None)
+    if mode == "local":
+        flat.update(local_test=True, upnp=False, off_chain=True)
+    elif mode == "upnp":
+        flat.update(local_test=False, upnp=True, off_chain=True)
+    elif mode == "on_chain":
+        flat.update(local_test=False, upnp=True, off_chain=False)
+    return _coerce(cls, flat)
+
+
+class EnvFile:
+    """Tiny KEY=VALUE env file with persisted port assignments keyed by node
+    id (reference .tensorlink.env, smart_node.py:84,1166-1198)."""
+
+    def __init__(self, path: str | Path = DEFAULT_ENV_FILE):
+        self.path = Path(path)
+
+    def read(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if self.path.exists():
+            for line in self.path.read_text().splitlines():
+                line = line.strip()
+                if line and not line.startswith("#") and "=" in line:
+                    k, _, v = line.partition("=")
+                    out[k.strip()] = v.strip()
+        return out
+
+    def get(self, key: str, default: str | None = None) -> str | None:
+        return self.read().get(key, os.environ.get(key, default))
+
+    def set(self, key: str, value: str) -> None:
+        data = self.read()
+        data[key] = value
+        self.path.write_text(
+            "".join(f"{k}={v}\n" for k, v in sorted(data.items()))
+        )
+
+    def port_for(self, node_id: str, default: int | None = None) -> int | None:
+        v = self.get(f"PORT_{node_id[:16]}")
+        return int(v) if v is not None else default
+
+    def save_port(self, node_id: str, port: int) -> None:
+        self.set(f"PORT_{node_id[:16]}", str(port))

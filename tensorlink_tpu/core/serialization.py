@@ -1,0 +1,191 @@
+"""Pickle-free structured array serialization.
+
+Re-specification of the reference's safe tensor wire format
+(ml/utils.py:569-660: JSON structure skeleton + safetensors blob, handling
+Tensor/dict/list/tuple/DynamicCache/ModelOutput with *no pickle*), designed
+for JAX arrays and a single contiguous frame:
+
+    MAGIC "TLTS" | version u8 | header_len u32le | header JSON | payload
+
+The header carries the container tree with ``{"__arr__": i}`` placeholders and
+an array table (dtype, shape, offset, nbytes). The payload is the raw
+little-endian array bytes, 64-byte aligned so a receiver can map them
+zero-copy into jax/numpy. bfloat16 and fp8 ride on ``ml_dtypes``.
+
+Custom structured objects (KV caches, model outputs) register with
+:func:`register_struct` — symmetric named encode/decode, never code execution.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; gives numpy bfloat16/fp8 dtypes
+    import ml_dtypes
+
+    _EXTRA_DTYPES = {
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+        "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+    }
+except ImportError:  # pragma: no cover
+    _EXTRA_DTYPES = {}
+
+MAGIC = b"TLTS"
+VERSION = 1
+_ALIGN = 64
+
+# name -> (to_tree, from_tree); to_tree returns a JSON-able tree possibly
+# containing arrays, from_tree reconstructs the object.
+_STRUCTS: dict[str, tuple[Callable[[Any], Any], Callable[[Any], Any]]] = {}
+_STRUCT_TYPES: dict[type, str] = {}
+
+
+def register_struct(name: str, cls: type, to_tree, from_tree) -> None:
+    _STRUCTS[name] = (to_tree, from_tree)
+    _STRUCT_TYPES[cls] = name
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    for name, d in _EXTRA_DTYPES.items():
+        if dt == d:
+            return name
+    return dt.name
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name in _EXTRA_DTYPES:
+        return _EXTRA_DTYPES[name]
+    return np.dtype(name)
+
+
+def _is_array(x: Any) -> bool:
+    if isinstance(x, np.ndarray):
+        return True
+    # jax.Array without importing jax at module load (network proc must not
+    # import jax — same reason the reference keeps torch out of its network
+    # process, SURVEY §1).
+    return type(x).__module__.startswith("jax") and hasattr(x, "__array__")
+
+
+def encode(obj: Any) -> bytes:
+    """Serialize a nested container of arrays/scalars into one frame."""
+    arrays: list[np.ndarray] = []
+    table: list[dict[str, Any]] = []
+
+    def walk(x: Any) -> Any:
+        if _is_array(x):
+            a = np.asarray(x)
+            if not a.flags.c_contiguous:
+                a = np.ascontiguousarray(a)
+            idx = len(arrays)
+            arrays.append(a)
+            table.append({"dtype": _dtype_name(a.dtype), "shape": list(a.shape)})
+            return {"__arr__": idx}
+        if isinstance(x, (np.generic,)):
+            return walk(np.asarray(x))
+        if isinstance(x, bytes):
+            return {"__bytes__": x.hex()}
+        if isinstance(x, dict):
+            return {"__dict__": [[walk(k), walk(v)] for k, v in x.items()]}
+        if isinstance(x, tuple):
+            return {"__tuple__": [walk(v) for v in x]}
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        if x is None or isinstance(x, (bool, int, str)):
+            return x
+        if isinstance(x, float):
+            return x
+        name = _STRUCT_TYPES.get(type(x))
+        if name is not None:
+            return {"__struct__": name, "tree": walk(_STRUCTS[name][0](x))}
+        raise TypeError(
+            f"cannot serialize {type(x).__name__} without register_struct()"
+        )
+
+    tree = walk(obj)
+    offset = 0
+    for a, meta in zip(arrays, table):
+        offset = (offset + _ALIGN - 1) // _ALIGN * _ALIGN
+        meta["offset"] = offset
+        meta["nbytes"] = a.nbytes
+        offset += a.nbytes
+
+    header = json.dumps({"tree": tree, "arrays": table}).encode()
+    parts = [MAGIC, bytes([VERSION]), len(header).to_bytes(4, "little"), header]
+    pos = 0
+    for a, meta in zip(arrays, table):
+        pad = meta["offset"] - pos
+        if pad:
+            parts.append(b"\x00" * pad)
+        parts.append(a.tobytes())
+        pos = meta["offset"] + a.nbytes
+    return b"".join(parts)
+
+
+def decode(data: bytes | memoryview, *, copy: bool = False) -> Any:
+    """Inverse of :func:`encode`. Arrays come back as numpy views over the
+    input buffer (zero-copy) unless ``copy=True``."""
+    mv = memoryview(data)
+    if len(mv) < 9:
+        raise ValueError(f"truncated TLTS frame: {len(mv)} bytes")
+    if bytes(mv[:4]) != MAGIC:
+        raise ValueError("bad magic: not a TLTS frame")
+    if mv[4] != VERSION:
+        raise ValueError(f"unsupported TLTS version {mv[4]}")
+    hlen = int.from_bytes(mv[5:9], "little")
+    if 9 + hlen > len(mv):
+        raise ValueError("truncated TLTS frame: header exceeds buffer")
+    header = json.loads(bytes(mv[9 : 9 + hlen]).decode())
+    payload = mv[9 + hlen :]
+
+    def get_array(i: int) -> np.ndarray:
+        meta = header["arrays"][i]
+        dt = _dtype_from_name(meta["dtype"])
+        if meta["offset"] + meta["nbytes"] > len(payload):
+            raise ValueError(
+                f"truncated TLTS frame: array {i} needs bytes up to "
+                f"{meta['offset'] + meta['nbytes']}, payload has {len(payload)}"
+            )
+        raw = payload[meta["offset"] : meta["offset"] + meta["nbytes"]]
+        a = np.frombuffer(raw, dtype=dt).reshape(meta["shape"])
+        return a.copy() if copy else a
+
+    def walk(x: Any) -> Any:
+        if isinstance(x, dict):
+            if "__arr__" in x:
+                return get_array(x["__arr__"])
+            if "__bytes__" in x:
+                return bytes.fromhex(x["__bytes__"])
+            if "__dict__" in x:
+                return {walk(k): walk(v) for k, v in x["__dict__"]}
+            if "__tuple__" in x:
+                return tuple(walk(v) for v in x["__tuple__"])
+            if "__struct__" in x:
+                name = x["__struct__"]
+                if name not in _STRUCTS:
+                    raise ValueError(f"unknown struct {name!r}")
+                return _STRUCTS[name][1](walk(x["tree"]))
+            raise ValueError(f"malformed node: {list(x)[:3]}")
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        return x
+
+    return walk(header["tree"])
+
+
+def encode_to_file(obj: Any, path) -> int:
+    """Spill large frames to disk (reference connection.py:110-128 spills
+    >20 MB buffers to tmp files). Returns bytes written."""
+    data = encode(obj)
+    with open(path, "wb") as f:
+        f.write(data)
+    return len(data)
+
+
+def decode_from_file(path) -> Any:
+    with open(path, "rb") as f:
+        return decode(f.read(), copy=True)

@@ -1,0 +1,107 @@
+"""RSA node identity.
+
+Reference parity: crypto/rsa.py — per-role RSA-2048 keypair persisted under
+``keys/<role>/``, node id = sha256(public key) (smart_node.py:258-259), OAEP
+encrypt/decrypt used for the handshake random-number proof
+(rsa.py:66,112,130,149). This implementation adds PSS sign/verify, which the
+handshake (p2p/handshake.py) uses instead of the reference's
+decrypt-the-random-number proof — same capability, standard construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from pathlib import Path
+
+from cryptography.hazmat.primitives import hashes, serialization as cser
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+_KEY_SIZE = 2048
+_OAEP = padding.OAEP(
+    mgf=padding.MGF1(algorithm=hashes.SHA256()),
+    algorithm=hashes.SHA256(),
+    label=None,
+)
+_PSS = padding.PSS(
+    mgf=padding.MGF1(hashes.SHA256()),
+    salt_length=padding.PSS.MAX_LENGTH,
+)
+
+
+def node_id_from_public_key(pub_pem: bytes) -> str:
+    """64-hex node id (reference smart_node.py:258-259)."""
+    return hashlib.sha256(pub_pem).hexdigest()
+
+
+@dataclass
+class NodeIdentity:
+    private_key: rsa.RSAPrivateKey
+    public_pem: bytes
+    node_id: str
+
+    def sign(self, data: bytes) -> bytes:
+        return self.private_key.sign(data, _PSS, hashes.SHA256())
+
+    def decrypt(self, data: bytes) -> bytes:
+        return self.private_key.decrypt(data, _OAEP)
+
+
+def load_or_create_identity(role: str, key_dir: str | Path = "keys") -> NodeIdentity:
+    """Load ``keys/<role>/private.pem`` or generate it (reference rsa.py:9-33)."""
+    d = Path(key_dir) / role
+    d.mkdir(parents=True, exist_ok=True)
+    priv_path = d / "private.pem"
+    pub_path = d / "public.pem"
+    if priv_path.exists():
+        priv = cser.load_pem_private_key(priv_path.read_bytes(), password=None)
+    else:
+        priv = rsa.generate_private_key(public_exponent=65537, key_size=_KEY_SIZE)
+        priv_path.touch(mode=0o600)
+        priv_path.write_bytes(
+            priv.private_bytes(
+                cser.Encoding.PEM,
+                cser.PrivateFormat.PKCS8,
+                cser.NoEncryption(),
+            )
+        )
+    pub_pem = priv.public_key().public_bytes(
+        cser.Encoding.PEM, cser.PublicFormat.SubjectPublicKeyInfo
+    )
+    if not pub_path.exists():
+        pub_path.write_bytes(pub_pem)
+    return NodeIdentity(priv, pub_pem, node_id_from_public_key(pub_pem))
+
+
+def _load_pub(pub_pem: bytes):
+    return cser.load_pem_public_key(pub_pem)
+
+
+def encrypt(pub_pem: bytes, data: bytes) -> bytes:
+    return _load_pub(pub_pem).encrypt(data, _OAEP)
+
+
+def decrypt(identity: NodeIdentity, data: bytes) -> bytes:
+    return identity.decrypt(data)
+
+
+def sign(identity: NodeIdentity, data: bytes) -> bytes:
+    return identity.sign(data)
+
+
+def verify(pub_pem: bytes, signature: bytes, data: bytes) -> bool:
+    try:
+        _load_pub(pub_pem).verify(signature, data, _PSS, hashes.SHA256())
+        return True
+    except Exception:
+        return False
+
+
+def authenticate_public_key(pub_pem: bytes) -> bool:
+    """Well-formedness check (reference rsa.py:66): parseable RSA key of the
+    expected size."""
+    try:
+        key = _load_pub(pub_pem)
+        return isinstance(key, rsa.RSAPublicKey) and key.key_size >= 2048
+    except Exception:
+        return False

@@ -1,0 +1,36 @@
+"""Test fixtures.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count) — the TPU-native analogue of the
+reference's strategy of spinning up real multi-process node groups on
+localhost (reference tests/conftest.py:25-161). Real-socket node-group
+fixtures live in tests/p2p fixtures below; sharding/mesh tests use the
+virtual devices.
+"""
+
+import os
+
+# Must be set before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must run before jax is first imported"
+    return devs
+
+
+@pytest.fixture()
+def tmp_keys(tmp_path):
+    return tmp_path / "keys"

@@ -1,0 +1,80 @@
+"""Config / logging / identity unit tests."""
+
+import json
+
+from tensorlink_tpu.core.config import (
+    EnvFile,
+    MeshConfig,
+    UserConfig,
+    ValidatorConfig,
+    WorkerConfig,
+    load_config,
+)
+from tensorlink_tpu.crypto import (
+    authenticate_public_key,
+    encrypt,
+    load_or_create_identity,
+    node_id_from_public_key,
+    sign,
+    verify,
+)
+
+
+def test_mesh_resolve():
+    m = MeshConfig(axes=("data", "tensor"), axis_sizes=(2, -1))
+    assert m.resolve(8) == {"data": 2, "tensor": 4}
+    assert MeshConfig(axes=("tensor",), axis_sizes=(-1,)).resolve(8) == {
+        "tensor": 8
+    }
+
+
+def test_config_json_mode_mapping(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(
+        json.dumps(
+            {
+                "role": "worker",
+                "mode": "local",
+                "ml": {"max_memory_gb": 0.4, "max_module_bytes": 1e6},
+                "seed_validators": [["127.0.0.1", 5029]],
+            }
+        )
+    )
+    cfg = load_config(p)
+    assert isinstance(cfg, WorkerConfig)
+    assert cfg.local_test and not cfg.upnp and cfg.off_chain
+    assert cfg.ml.max_memory_gb == 0.4
+    assert cfg.seed_validators == [("127.0.0.1", 5029)]
+    assert cfg.effective_host() == "127.0.0.1"
+
+
+def test_role_defaults():
+    assert ValidatorConfig().endpoint is True
+    assert UserConfig().role == "user"
+
+
+def test_env_file_ports(tmp_path):
+    env = EnvFile(tmp_path / ".env")
+    env.set("PUBLIC_KEY", "abc")
+    env.save_port("deadbeef" * 8, 41234)
+    assert env.get("PUBLIC_KEY") == "abc"
+    assert env.port_for("deadbeef" * 8) == 41234
+    assert env.port_for("f" * 64, default=7) == 7
+
+
+def test_identity_persist_sign_encrypt(tmp_path):
+    ident = load_or_create_identity("worker", tmp_path)
+    again = load_or_create_identity("worker", tmp_path)
+    assert ident.node_id == again.node_id == node_id_from_public_key(ident.public_pem)
+    assert len(ident.node_id) == 64
+
+    msg = b"challenge-1234"
+    sig = sign(ident, msg)
+    assert verify(ident.public_pem, sig, msg)
+    assert not verify(ident.public_pem, sig, b"other")
+
+    ct = encrypt(ident.public_pem, b"secret")
+    assert ident.decrypt(ct) == b"secret"
+
+    assert authenticate_public_key(ident.public_pem)
+    assert not authenticate_public_key(b"not a key")
