@@ -1,0 +1,7 @@
+"""Compiled execution engine: checkpoint IO, generation, training steps.
+
+The TPU-native replacement for the reference's eager worker execution
+(ml/worker.py): models run as cached, jit-compiled programs (prefill, decode,
+train-step) over sharded arrays; checkpoints stream from safetensors shards
+directly into the sharded parameter tree.
+"""

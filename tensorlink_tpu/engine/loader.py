@@ -1,0 +1,222 @@
+"""HF safetensors checkpoint ⇄ stacked JAX parameter tree.
+
+Reference parity: the worker's selective shard reads — it loads only the
+tensors for its assigned layers straight from safetensors files
+(ml/worker.py:542-638 ``_load_grouped_layer_weights`` remaps
+``model.layers.N.*`` → local indices). Here the same idea is TPU-shaped: each
+tensor is read from its shard file, per-layer tensors are stacked into the
+``[L, ...]`` scan layout, ``~T`` entries are transposed from torch's
+``[out, in]``, and the result is placed with a ``NamedSharding`` when a mesh
+is given. ``layer_range`` restricts IO to a pipeline stage's slice.
+
+Also provides the inverse (:func:`export_hf`) for parameter download /
+checkpoint parity (reference ml/module.py:577-650).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from safetensors import safe_open
+from safetensors.numpy import save_file
+
+from ..models.base import ModelConfig
+from ..models.registry import config_from_hf, hf_name_map, hf_prefix
+
+
+class CheckpointReader:
+    """Random access over a (possibly sharded) safetensors checkpoint dir."""
+
+    def __init__(self, ckpt_dir: str | Path):
+        self.dir = Path(ckpt_dir)
+        index_path = self.dir / "model.safetensors.index.json"
+        self._name_to_file: dict[str, str] = {}
+        if index_path.exists():
+            index = json.loads(index_path.read_text())
+            self._name_to_file = dict(index["weight_map"])
+        else:
+            files = sorted(self.dir.glob("*.safetensors"))
+            if not files:
+                raise FileNotFoundError(f"no safetensors files in {self.dir}")
+            for fp in files:
+                with safe_open(fp, framework="np") as f:
+                    for name in f.keys():
+                        self._name_to_file[name] = fp.name
+        self._handles: dict[str, Any] = {}
+
+    def names(self) -> list[str]:
+        return list(self._name_to_file)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._name_to_file
+
+    def get(self, name: str) -> np.ndarray:
+        fname = self._name_to_file[name]
+        if fname not in self._handles:
+            self._handles[fname] = safe_open(self.dir / fname, framework="np")
+        return self._handles[fname].get_tensor(name)
+
+    def config(self) -> dict:
+        return json.loads((self.dir / "config.json").read_text())
+
+
+def _resolve(reader: CheckpointReader, template: str, prefix: str, **fmt) -> np.ndarray:
+    """Fetch one tensor, honoring the ``~T`` transpose marker and the fact
+    that HF checkpoints are inconsistent about the backbone prefix (e.g. tied
+    lm_head may exist at top level or not at all)."""
+    transpose = template.startswith("~T ")
+    name = template[3:] if transpose else template
+    top_level = name.startswith("^")
+    name = (name[1:] if top_level else name).format(**fmt)
+    candidates = (name, prefix + name) if top_level else (prefix + name, name)
+    for candidate in candidates:
+        if candidate in reader:
+            t = reader.get(candidate)
+            return t.T if transpose else t
+    raise KeyError(f"tensor {candidates[0]!r} not in checkpoint")
+
+
+def load_params(
+    ckpt_dir: str | Path,
+    cfg: ModelConfig | None = None,
+    *,
+    layer_range: tuple[int, int] | None = None,
+    mesh: jax.sharding.Mesh | None = None,
+    specs: dict | None = None,
+    dtype=None,
+) -> tuple[ModelConfig, dict]:
+    """Load a checkpoint into the stacked parameter tree.
+
+    ``layer_range=(lo, hi)`` loads only layers ``lo..hi-1`` (a pipeline
+    stage's slice) — IO is restricted to exactly those tensors.
+    Returns ``(cfg, params)``.
+    """
+    reader = CheckpointReader(ckpt_dir)
+    if cfg is None:
+        cfg = config_from_hf(reader.config())
+    dt = dtype or cfg.dtype
+    cfg = cfg.with_(dtype=dt)  # activations follow the loaded param dtype
+    prefix = hf_prefix(cfg)
+    nmap = hf_name_map(cfg)
+    lo, hi = layer_range or (0, cfg.n_layers)
+
+    def fetch(template, **fmt) -> np.ndarray:
+        if isinstance(template, tuple):
+            rule, tmpl = template
+            if rule.startswith("split3"):
+                part = int(rule.split(".")[1])
+                full = _resolve(reader, tmpl, prefix, **fmt)
+                return np.split(full, 3, axis=-1)[part]
+            if rule == "stackE":
+                return np.stack(
+                    [
+                        _resolve(reader, tmpl, prefix, e=e, **fmt)
+                        for e in range(cfg.n_experts)
+                    ]
+                )
+            raise ValueError(f"unknown fetch rule {rule}")
+        return _resolve(reader, template, prefix, **fmt)
+
+    def to_jax(a: np.ndarray, path: str) -> jax.Array:
+        a = a.astype(dt) if a.dtype != dt else a
+        if mesh is not None and specs is not None:
+            spec = specs
+            for part in path.split("."):
+                spec = spec[part]
+            return jax.device_put(a, jax.sharding.NamedSharding(mesh, spec))
+        return jnp.asarray(a)
+
+    params: dict[str, Any] = {"embed": {}, "layers": {}, "final_norm": {}}
+    for path, template in nmap.items():
+        parts = path.split(".")
+        if parts[0] == "layers":
+            stacked = np.stack([fetch(template, i=i) for i in range(lo, hi)])
+            node = params["layers"].setdefault(parts[1], {})
+            if len(parts) == 3:
+                node[parts[2]] = to_jax(stacked, path)
+            else:  # layers.<p> (no leaf name) does not occur
+                raise AssertionError(path)
+        elif path == "lm_head":
+            params["lm_head"] = to_jax(fetch(template), path)
+        else:
+            node = params
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = to_jax(fetch(template), path)
+    return cfg, params
+
+
+def export_hf(
+    cfg: ModelConfig,
+    params: dict,
+    out_dir: str | Path,
+    *,
+    hf_config: dict | None = None,
+    max_shard_bytes: int = 4 * 1024**3,
+) -> Path:
+    """Write params back out as an HF-layout safetensors checkpoint —
+    parameter-download capability parity (reference module.py:577-650 pulls
+    state dicts from workers into ``models/<name>/``)."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    prefix = hf_prefix(cfg)
+    nmap = hf_name_map(cfg)
+    host = jax.device_get(params)
+
+    tensors: dict[str, np.ndarray] = {}
+    fused: dict[str, list] = {}
+    for path, template in nmap.items():
+        parts = path.split(".")
+        node = host
+        for p in parts:
+            node = node[p]
+        arr = np.asarray(node)
+
+        def emit(template, a, **fmt):
+            transpose = template.startswith("~T ")
+            name = template[3:] if transpose else template
+            top_level = name.startswith("^")
+            name = (name[1:] if top_level else name).format(**fmt)
+            full = name if top_level else prefix + name
+            tensors[full] = np.ascontiguousarray(a.T if transpose else a)
+
+        if parts[0] == "layers":
+            for i in range(arr.shape[0]):
+                a = arr[i]
+                if isinstance(template, tuple):
+                    rule, tmpl = template
+                    if rule.startswith("split3"):
+                        # collect the three slices, emit fused once complete
+                        key = tmpl.format(i=i)
+                        fused.setdefault(key, [None, None, None])[
+                            int(rule.split(".")[1])
+                        ] = a
+                        continue
+                    if rule == "stackE":
+                        for e in range(arr.shape[1]):
+                            emit(tmpl, a[e], i=i, e=e)
+                        continue
+                emit(template, a, i=i)
+        else:
+            if isinstance(template, tuple):
+                raise AssertionError(path)
+            emit(template, arr)
+    for name, chunks in fused.items():
+        tensors[prefix + name] = np.ascontiguousarray(
+            np.concatenate(chunks, axis=-1)
+        )
+
+    save_file(tensors, out / "model.safetensors")
+    if hf_config is not None:
+        (out / "config.json").write_text(json.dumps(hf_config, indent=2))
+    return out
+
+
+def estimate_params_bytes(cfg: ModelConfig, dtype_bytes: int = 2) -> int:
+    return cfg.param_count() * dtype_bytes

@@ -1,0 +1,24 @@
+"""TPU-native model zoo.
+
+Where the reference wraps arbitrary HF PyTorch modules and ships them to
+workers (ml/module.py, ml/injector.py), this framework owns its model
+definitions: one functional decoder-only transformer core
+(:mod:`.transformer`) whose per-family behavior is pure configuration
+(:mod:`.base`), with stacked layer parameters scanned by ``lax.scan`` so XLA
+compiles one block program regardless of depth. HF checkpoints are mapped
+onto this scheme by :mod:`tensorlink_tpu.engine.loader`.
+"""
+
+from .base import KVCache, ModelConfig
+from .registry import config_from_hf, config_presets
+from .transformer import forward, init_params, partition_specs
+
+__all__ = [
+    "KVCache",
+    "ModelConfig",
+    "config_from_hf",
+    "config_presets",
+    "forward",
+    "init_params",
+    "partition_specs",
+]

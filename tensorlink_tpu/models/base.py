@@ -1,0 +1,138 @@
+"""Model configuration and KV cache structures.
+
+The reference treats a model as an opaque ``nn.Module`` tree to be split by
+memory (ml/graphing.py:202); here a model is data: a :class:`ModelConfig`
+plus a parameter pytree. The KV cache is an explicit, donated pytree —
+the TPU-native replacement for HF ``DynamicCache`` objects the reference
+serializes over the wire (ml/utils.py:569-660).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import serialization
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for the unified decoder-only core.
+
+    Families covered (reference supports any HF causal LM via module
+    offloading; we cover the families its tests/docs/baseline actually use —
+    gpt2, Llama, Qwen2/2.5, Qwen3, Mistral, Mixtral, SmolLM — via config):
+
+    - ``pos="learned"``, ``mlp="fused"``, ``norm="layernorm"`` → GPT-2.
+    - ``pos="rope"``, ``mlp="gated"``, ``norm="rmsnorm"`` → Llama-family.
+    - ``qk_norm=True`` → Qwen3.
+    - ``n_experts>0`` → Mixtral-style sparse MoE.
+    """
+
+    family: str = "llama"
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    head_dim: int = 128
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    norm_eps: float = 1e-6
+    act: str = "silu"  # "silu" | "gelu" (tanh-approx, GPT-2's gelu_new)
+    pos: str = "rope"  # "rope" | "learned"
+    rope_theta: float = 10000.0
+    attn_bias: bool = False  # GPT-2 / Qwen2 have qkv biases
+    mlp_bias: bool = False
+    mlp: str = "gated"  # "gated" (gate*up) | "fused" (up->act->down)
+    norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    qk_norm: bool = False  # Qwen3 per-head-dim RMSNorm on q and k
+    tie_embeddings: bool = False
+    attn_scale: float | None = None  # None → 1/sqrt(head_dim)
+    # MoE (Mixtral): 0 experts = dense
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    # sliding-window attention (Mistral); None = full causal
+    sliding_window: int | None = None
+    dtype: Any = jnp.bfloat16
+    # Logit soft-capping (Gemma-style); None = off
+    logit_cap: float | None = None
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def moe(self) -> bool:
+        return self.n_experts > 0
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+    def param_count(self) -> int:
+        """Analytic parameter count (used by the sharding planner's memory
+        estimator — TPU analogue of reference ml/utils.py:36-124)."""
+        d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
+        if self.moe:
+            mlp = self.n_experts * 3 * d * f + d * self.n_experts
+        elif self.mlp == "gated":
+            mlp = 3 * d * f
+        else:
+            mlp = 2 * d * f
+        norms = 2 * d * (2 if self.norm == "layernorm" else 1)
+        emb = v * d + (0 if self.tie_embeddings else v * d)
+        pos = self.max_seq_len * d if self.pos == "learned" else 0
+        return L * (attn + mlp + norms) + emb + pos + d
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    """Per-model decode cache: ``k``/``v`` are ``[L, B, S_max, n_kv, hd]``,
+    ``length`` is the number of valid positions per batch row ``[B]``.
+
+    Stored stacked over layers so the decode ``lax.scan`` indexes its layer
+    slice, and donated into the decode step so XLA updates it in place.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # int32 [B]
+
+    @classmethod
+    def init(cls, cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=None):
+        S = max_len or cfg.max_seq_len
+        dt = dtype or cfg.dtype
+        shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, dt),
+            v=jnp.zeros(shape, dt),
+            length=jnp.zeros((batch,), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+# Wire format support: KV caches cross the P2P boundary when a job migrates
+# between workers (reference ships DynamicCache, ml/utils.py:587-603).
+serialization.register_struct(
+    "tensorlink.KVCache",
+    KVCache,
+    lambda c: {"k": c.k, "v": c.v, "length": c.length},
+    lambda t: KVCache(
+        k=jnp.asarray(np.asarray(t["k"])),
+        v=jnp.asarray(np.asarray(t["v"])),
+        length=jnp.asarray(np.asarray(t["length"])),
+    ),
+)

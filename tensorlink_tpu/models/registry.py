@@ -1,0 +1,314 @@
+"""HF architecture registry: transformers config → :class:`ModelConfig`.
+
+The reference accepts any HF causal LM and splits its module tree by memory
+(ml/graphing.py); here each supported family declares how its HF config maps
+onto the unified core and how its checkpoint tensor names map onto our
+parameter tree (consumed by engine/loader.py). Families cover everything the
+reference's tests, docs, and BASELINE configs exercise: gpt2 / SmolLM (llama)
+/ Qwen2.5 / Qwen3 / Llama-3 / Mistral / Mixtral.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from .base import ModelConfig
+
+_FAMILY_BUILDERS: dict[str, Callable[[dict], ModelConfig]] = {}
+
+
+def register_family(model_type: str):
+    def deco(fn):
+        _FAMILY_BUILDERS[model_type] = fn
+        return fn
+
+    return deco
+
+
+def config_from_hf(hf_config: Any, dtype=jnp.bfloat16) -> ModelConfig:
+    """Build a ModelConfig from a ``transformers`` config object or dict."""
+    d = hf_config if isinstance(hf_config, dict) else hf_config.to_dict()
+    mt = d.get("model_type")
+    if mt not in _FAMILY_BUILDERS:
+        raise ValueError(
+            f"unsupported model_type {mt!r}; supported: {sorted(_FAMILY_BUILDERS)}"
+        )
+    return _FAMILY_BUILDERS[mt](d).with_(dtype=dtype)
+
+
+@register_family("gpt2")
+def _gpt2(d: dict) -> ModelConfig:
+    n_embd = d["n_embd"]
+    return ModelConfig(
+        family="gpt2",
+        vocab_size=d["vocab_size"],
+        d_model=n_embd,
+        n_layers=d["n_layer"],
+        n_heads=d["n_head"],
+        n_kv_heads=d["n_head"],
+        head_dim=n_embd // d["n_head"],
+        d_ff=d.get("n_inner") or 4 * n_embd,
+        max_seq_len=d["n_positions"],
+        norm_eps=d.get("layer_norm_epsilon", 1e-5),
+        act="gelu",
+        pos="learned",
+        attn_bias=True,
+        mlp="fused",
+        norm="layernorm",
+        tie_embeddings=True,
+    )
+
+
+def _llama_like(d: dict, **overrides) -> ModelConfig:
+    n_heads = d["num_attention_heads"]
+    head_dim = d.get("head_dim") or d["hidden_size"] // n_heads
+    kw: dict[str, Any] = dict(
+        family="llama",
+        vocab_size=d["vocab_size"],
+        d_model=d["hidden_size"],
+        n_layers=d["num_hidden_layers"],
+        n_heads=n_heads,
+        n_kv_heads=d.get("num_key_value_heads") or n_heads,
+        head_dim=head_dim,
+        d_ff=d["intermediate_size"],
+        max_seq_len=d.get("max_position_embeddings", 4096),
+        norm_eps=d.get("rms_norm_eps", 1e-6),
+        act="silu",
+        pos="rope",
+        rope_theta=d.get("rope_theta", 10000.0),
+        mlp="gated",
+        norm="rmsnorm",
+        tie_embeddings=d.get("tie_word_embeddings", False),
+        attn_bias=d.get("attention_bias", False),
+        mlp_bias=d.get("mlp_bias", False),
+    )
+    kw.update(overrides)
+    return ModelConfig(**kw)
+
+
+@register_family("llama")
+def _llama(d: dict) -> ModelConfig:
+    return _llama_like(d)
+
+
+@register_family("mistral")
+def _mistral(d: dict) -> ModelConfig:
+    return _llama_like(
+        d, family="mistral", sliding_window=d.get("sliding_window")
+    )
+
+
+@register_family("qwen2")
+def _qwen2(d: dict) -> ModelConfig:
+    # Qwen2/2.5: llama core + qkv biases
+    return _llama_like(d, family="qwen2", attn_bias=True)
+
+
+@register_family("qwen3")
+def _qwen3(d: dict) -> ModelConfig:
+    # Qwen3: llama core + per-head q/k RMSNorm, no biases
+    return _llama_like(d, family="qwen3", qk_norm=True, attn_bias=False)
+
+
+@register_family("mixtral")
+def _mixtral(d: dict) -> ModelConfig:
+    return _llama_like(
+        d,
+        family="mixtral",
+        n_experts=d["num_local_experts"],
+        n_experts_per_tok=d["num_experts_per_tok"],
+        sliding_window=d.get("sliding_window"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint tensor-name mapping (engine/loader.py)
+# ---------------------------------------------------------------------------
+# Our tree path -> HF tensor name template ({i} = layer). "~T" marks weights
+# stored transposed in HF (torch Linear stores [out, in]; we use [in, out]).
+# GPT-2's Conv1D already stores [in, out] (no ~T) and fuses qkv (split rule).
+
+
+def hf_name_map(cfg: ModelConfig) -> dict[str, Any]:
+    if cfg.family == "gpt2":
+        return {
+            "embed.tok": "wte.weight",
+            "embed.pos": "wpe.weight",
+            "layers.ln1.scale": "h.{i}.ln_1.weight",
+            "layers.ln1.bias": "h.{i}.ln_1.bias",
+            "layers.attn.wq": ("split3.0", "h.{i}.attn.c_attn.weight"),
+            "layers.attn.wk": ("split3.1", "h.{i}.attn.c_attn.weight"),
+            "layers.attn.wv": ("split3.2", "h.{i}.attn.c_attn.weight"),
+            "layers.attn.bq": ("split3.0", "h.{i}.attn.c_attn.bias"),
+            "layers.attn.bk": ("split3.1", "h.{i}.attn.c_attn.bias"),
+            "layers.attn.bv": ("split3.2", "h.{i}.attn.c_attn.bias"),
+            "layers.attn.wo": "h.{i}.attn.c_proj.weight",
+            "layers.attn.bo": "h.{i}.attn.c_proj.bias",
+            "layers.ln2.scale": "h.{i}.ln_2.weight",
+            "layers.ln2.bias": "h.{i}.ln_2.bias",
+            "layers.mlp.w_up": "h.{i}.mlp.c_fc.weight",
+            "layers.mlp.b_up": "h.{i}.mlp.c_fc.bias",
+            "layers.mlp.w_down": "h.{i}.mlp.c_proj.weight",
+            "layers.mlp.b_down": "h.{i}.mlp.c_proj.bias",
+            "final_norm.scale": "ln_f.weight",
+            "final_norm.bias": "ln_f.bias",
+        }
+
+    m = {
+        "embed.tok": "embed_tokens.weight",
+        "layers.ln1.scale": "layers.{i}.input_layernorm.weight",
+        "layers.attn.wq": "~T layers.{i}.self_attn.q_proj.weight",
+        "layers.attn.wk": "~T layers.{i}.self_attn.k_proj.weight",
+        "layers.attn.wv": "~T layers.{i}.self_attn.v_proj.weight",
+        "layers.attn.wo": "~T layers.{i}.self_attn.o_proj.weight",
+        "layers.ln2.scale": "layers.{i}.post_attention_layernorm.weight",
+        "final_norm.scale": "norm.weight",
+    }
+    if cfg.attn_bias:
+        m |= {
+            "layers.attn.bq": "layers.{i}.self_attn.q_proj.bias",
+            "layers.attn.bk": "layers.{i}.self_attn.k_proj.bias",
+            "layers.attn.bv": "layers.{i}.self_attn.v_proj.bias",
+        }
+    if cfg.qk_norm:
+        m |= {
+            "layers.attn.q_norm": "layers.{i}.self_attn.q_norm.weight",
+            "layers.attn.k_norm": "layers.{i}.self_attn.k_norm.weight",
+        }
+    if cfg.moe:
+        m |= {
+            "layers.mlp.router": "~T layers.{i}.block_sparse_moe.gate.weight",
+            "layers.mlp.w_gate": (
+                "stackE",
+                "~T layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+            ),
+            "layers.mlp.w_down": (
+                "stackE",
+                "~T layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+            ),
+            "layers.mlp.w_up": (
+                "stackE",
+                "~T layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+            ),
+        }
+    else:
+        m |= {
+            "layers.mlp.w_gate": "~T layers.{i}.mlp.gate_proj.weight",
+            "layers.mlp.w_up": "~T layers.{i}.mlp.up_proj.weight",
+            "layers.mlp.w_down": "~T layers.{i}.mlp.down_proj.weight",
+        }
+        if cfg.mlp_bias:
+            m |= {
+                "layers.mlp.b_gate": "layers.{i}.mlp.gate_proj.bias",
+                "layers.mlp.b_up": "layers.{i}.mlp.up_proj.bias",
+                "layers.mlp.b_down": "layers.{i}.mlp.down_proj.bias",
+            }
+    if not cfg.tie_embeddings:
+        m["lm_head"] = "~T ^lm_head.weight"  # ^ = top-level, outside prefix
+    return m
+
+
+# Prefix inside the checkpoint for the backbone tensors, e.g. HF llama stores
+# "model.layers.0...." and "lm_head.weight" at top level.
+def hf_prefix(cfg: ModelConfig) -> str:
+    if cfg.family == "gpt2":
+        return "transformer."
+    return "model."
+
+
+def config_presets() -> dict[str, ModelConfig]:
+    """Named presets for tests/benchmarks (no network access needed)."""
+    return {
+        "gpt2-small": ModelConfig(
+            family="gpt2",
+            vocab_size=50257,
+            d_model=768,
+            n_layers=12,
+            n_heads=12,
+            n_kv_heads=12,
+            head_dim=64,
+            d_ff=3072,
+            max_seq_len=1024,
+            norm_eps=1e-5,
+            act="gelu",
+            pos="learned",
+            attn_bias=True,
+            mlp="fused",
+            norm="layernorm",
+            tie_embeddings=True,
+        ),
+        "qwen3-8b": ModelConfig(
+            family="qwen3",
+            vocab_size=151936,
+            d_model=4096,
+            n_layers=36,
+            n_heads=32,
+            n_kv_heads=8,
+            head_dim=128,
+            d_ff=12288,
+            max_seq_len=40960,
+            norm_eps=1e-6,
+            rope_theta=1e6,
+            qk_norm=True,
+            tie_embeddings=False,
+        ),
+        "qwen3-1p7b": ModelConfig(
+            family="qwen3",
+            vocab_size=151936,
+            d_model=2048,
+            n_layers=28,
+            n_heads=16,
+            n_kv_heads=8,
+            head_dim=128,
+            d_ff=6144,
+            max_seq_len=40960,
+            norm_eps=1e-6,
+            rope_theta=1e6,
+            qk_norm=True,
+            tie_embeddings=True,
+        ),
+        "qwen2p5-7b": ModelConfig(
+            family="qwen2",
+            vocab_size=152064,
+            d_model=3584,
+            n_layers=28,
+            n_heads=28,
+            n_kv_heads=4,
+            head_dim=128,
+            d_ff=18944,
+            max_seq_len=32768,
+            norm_eps=1e-6,
+            rope_theta=1e6,
+            attn_bias=True,
+        ),
+        "llama3-70b": ModelConfig(
+            family="llama",
+            vocab_size=128256,
+            d_model=8192,
+            n_layers=80,
+            n_heads=64,
+            n_kv_heads=8,
+            head_dim=128,
+            d_ff=28672,
+            max_seq_len=8192,
+            norm_eps=1e-5,
+            rope_theta=5e5,
+        ),
+        "mixtral-8x7b": ModelConfig(
+            family="mixtral",
+            vocab_size=32000,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            head_dim=128,
+            d_ff=14336,
+            max_seq_len=32768,
+            norm_eps=1e-5,
+            rope_theta=1e6,
+            n_experts=8,
+            n_experts_per_tok=2,
+        ),
+    }

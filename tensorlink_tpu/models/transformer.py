@@ -1,0 +1,484 @@
+"""Unified decoder-only transformer core (functional, scan-over-layers).
+
+TPU-first design notes (vs. the reference's eager per-``nn.Module`` execution,
+ml/worker.py:297-357):
+
+- Parameters are stacked over layers (leading ``L`` axis) and the block is run
+  under ``lax.scan`` — XLA compiles ONE block program regardless of depth, and
+  the KV cache rides the scan as per-layer xs/ys so decode updates it in place
+  (donated).
+- Attention is grouped-query by construction: queries are reshaped to
+  ``[B, T, n_kv, group, hd]`` and contracted against un-repeated KV, so GQA
+  never materializes repeated KV heads in HBM.
+- Softmax/norm statistics run in float32 while weights/activations stay in
+  bfloat16 (MXU-native).
+- All shapes are static; masks are position-index arithmetic, not Python
+  control flow, so one compiled program serves any padding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import KVCache, ModelConfig
+
+P = jax.sharding.PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
+    """Random-init parameter pytree (shapes double as the loader's schema)."""
+    dt = dtype or cfg.dtype
+    d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+    L, V = cfg.n_layers, cfg.vocab_size
+    keys = iter(jax.random.split(key, 32))
+
+    def dense(k, *shape, scale=None):
+        s = scale if scale is not None else shape[-2] ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(dt)
+
+    def norm_p(with_bias: bool, *shape):
+        p = {"scale": jnp.ones(shape, dt)}
+        if with_bias:
+            p["bias"] = jnp.zeros(shape, dt)
+        return p
+
+    ln_bias = cfg.norm == "layernorm"
+    attn = {
+        "wq": dense(next(keys), L, d, cfg.q_dim),
+        "wk": dense(next(keys), L, d, cfg.kv_dim),
+        "wv": dense(next(keys), L, d, cfg.kv_dim),
+        "wo": dense(next(keys), L, cfg.q_dim, d),
+    }
+    if cfg.attn_bias:
+        attn |= {
+            "bq": jnp.zeros((L, cfg.q_dim), dt),
+            "bk": jnp.zeros((L, cfg.kv_dim), dt),
+            "bv": jnp.zeros((L, cfg.kv_dim), dt),
+        }
+    if cfg.family == "gpt2":
+        attn["bo"] = jnp.zeros((L, d), dt)
+    if cfg.qk_norm:
+        attn |= {"q_norm": jnp.ones((L, hd), dt), "k_norm": jnp.ones((L, hd), dt)}
+
+    if cfg.moe:
+        E = cfg.n_experts
+        mlp = {
+            "router": dense(next(keys), L, d, E),
+            "w_gate": dense(next(keys), L, E, d, f),
+            "w_up": dense(next(keys), L, E, d, f),
+            "w_down": dense(next(keys), L, E, f, d, scale=f**-0.5),
+        }
+    elif cfg.mlp == "gated":
+        mlp = {
+            "w_gate": dense(next(keys), L, d, f),
+            "w_up": dense(next(keys), L, d, f),
+            "w_down": dense(next(keys), L, f, d, scale=f**-0.5),
+        }
+        if cfg.mlp_bias:
+            mlp |= {
+                "b_gate": jnp.zeros((L, f), dt),
+                "b_up": jnp.zeros((L, f), dt),
+                "b_down": jnp.zeros((L, d), dt),
+            }
+    else:  # fused (GPT-2): up -> act -> down, with biases
+        mlp = {
+            "w_up": dense(next(keys), L, d, f),
+            "b_up": jnp.zeros((L, f), dt),
+            "w_down": dense(next(keys), L, f, d, scale=f**-0.5),
+            "b_down": jnp.zeros((L, d), dt),
+        }
+
+    params = {
+        "embed": {"tok": dense(next(keys), V, d, scale=0.02)},
+        "layers": {
+            "ln1": norm_p(ln_bias, L, d),
+            "attn": attn,
+            "ln2": norm_p(ln_bias, L, d),
+            "mlp": mlp,
+        },
+        "final_norm": norm_p(ln_bias, d),
+    }
+    if cfg.pos == "learned":
+        params["embed"]["pos"] = dense(next(keys), cfg.max_seq_len, d, scale=0.02)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(keys), d, V)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def _norm(x: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    if cfg.norm == "layernorm":
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        out = (xf - mu) * lax.rsqrt(var + cfg.norm_eps)
+        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    else:
+        var = (xf**2).mean(-1, keepdims=True)
+        out = xf * lax.rsqrt(var + cfg.norm_eps) * p["scale"].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rms_head_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """Qwen3 per-head RMSNorm over head_dim."""
+    xf = x.astype(jnp.float32)
+    out = xf * lax.rsqrt((xf**2).mean(-1, keepdims=True) + eps)
+    return (out * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables ``[B, T, head_dim]`` in the HF half-split convention
+    (rotate_half): frequencies repeat over the two halves."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, T, half]
+    ang = jnp.concatenate([ang, ang], axis=-1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, T, H, hd]; cos/sin: [B, T, hd] (HF rotate_half convention)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    xf = x.astype(jnp.float32)
+    out = xf * cos[..., None, :] + rotated.astype(jnp.float32) * sin[..., None, :]
+    return out.astype(x.dtype)
+
+
+def _act(x: jax.Array, name: str) -> jax.Array:
+    if name == "silu":
+        return jax.nn.silu(x)
+    return jax.nn.gelu(x, approximate=True)  # GPT-2 gelu_new
+
+
+def attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k: jax.Array,  # [B, S, Hkv, hd]
+    v: jax.Array,  # [B, S, Hkv, hd]
+    mask_bias: jax.Array,  # [B, 1, 1, T, S] float32 additive
+    scale: float,
+) -> jax.Array:
+    """Grouped-query attention without materializing repeated KV."""
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, T, Hkv, G, hd)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * scale + mask_bias
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", w, v)
+    return out.reshape(B, T, Hq, hd)
+
+
+def _mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
+    if cfg.moe:
+        return _moe_mlp(h, p, cfg)
+    if cfg.mlp == "gated":
+        g = h @ p["w_gate"]
+        u = h @ p["w_up"]
+        if "b_gate" in p:
+            g = g + p["b_gate"]
+            u = u + p["b_up"]
+        out = _act(g, cfg.act) * u @ p["w_down"]
+        if "b_down" in p:
+            out = out + p["b_down"]
+        return out
+    out = _act(h @ p["w_up"] + p["b_up"], cfg.act) @ p["w_down"] + p["b_down"]
+    return out
+
+
+def _moe_mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
+    """Mixtral-style top-k sparse MoE, dense-dispatch formulation.
+
+    Every expert sees every token and results are combined with the (sparse)
+    top-k routing weights — numerically identical to gather-based routing and
+    XLA/GSPMD-friendly (expert axis shards cleanly). The capacity-based
+    all-to-all dispatch for large scale lives in
+    tensorlink_tpu/parallel/expert.py.
+    """
+    B, T, d = h.shape
+    E, K = cfg.n_experts, cfg.n_experts_per_tok
+    router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, T, E]
+    topw, topi = lax.top_k(router_logits, K)
+    topw = jax.nn.softmax(topw, axis=-1)  # normalize over selected experts
+    gates = jnp.zeros_like(router_logits).at[
+        jnp.arange(B)[:, None, None],
+        jnp.arange(T)[None, :, None],
+        topi,
+    ].set(topw)  # [B, T, E] sparse weights
+    g = jnp.einsum("btd,edf->btef", h, p["w_gate"])
+    u = jnp.einsum("btd,edf->btef", h, p["w_up"])
+    y = jnp.einsum("btef,efd->bted", _act(g, cfg.act) * u, p["w_down"])
+    return jnp.einsum("bted,bte->btd", y, gates.astype(h.dtype))
+
+
+def _block(
+    x: jax.Array,
+    lp: dict,
+    cfg: ModelConfig,
+    cos: jax.Array | None,
+    sin: jax.Array | None,
+    mask_bias: jax.Array,
+    cache_k: jax.Array | None,  # [B, S, Hkv, hd] this layer's cache
+    cache_v: jax.Array | None,
+    write_at: jax.Array | None,  # [B] int32 write offsets
+):
+    B, T, _ = x.shape
+    h = _norm(x, lp["ln1"], cfg)
+    ap = lp["attn"]
+    q = h @ ap["wq"]
+    k = h @ ap["wk"]
+    v = h @ ap["wv"]
+    if "bq" in ap:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
+        k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
+    if cos is not None:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+    if cache_k is not None:
+        upd = jax.vmap(
+            lambda c, u, o: lax.dynamic_update_slice(c, u, (o, 0, 0))
+        )
+        cache_k = upd(cache_k, k.astype(cache_k.dtype), write_at)
+        cache_v = upd(cache_v, v.astype(cache_v.dtype), write_at)
+        k_all, v_all = cache_k, cache_v
+    else:
+        k_all, v_all = k, v
+
+    scale = cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
+    attn_out = attention(q, k_all.astype(q.dtype), v_all.astype(q.dtype), mask_bias, scale)
+    attn_out = attn_out.reshape(B, T, cfg.q_dim) @ ap["wo"]
+    if "bo" in ap:
+        attn_out = attn_out + ap["bo"]
+    x = x + attn_out
+
+    h2 = _norm(x, lp["ln2"], cfg)
+    x = x + _mlp(h2, lp["mlp"], cfg)
+    return x, cache_k, cache_v
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _mask_bias(
+    q_pos: jax.Array,  # [B, T] absolute query positions
+    kv_len: int,
+    valid_kv: jax.Array,  # [B, S] bool — which kv slots hold real tokens
+    sliding_window: int | None,
+) -> jax.Array:
+    """Additive float32 mask ``[B, 1, 1, T, S]``: causal (+ window) over
+    absolute positions; padding handled via ``valid_kv``."""
+    kv_idx = jnp.arange(kv_len)[None, None, :]  # [1, 1, S]
+    qp = q_pos[:, :, None]  # [B, T, 1]
+    ok = kv_idx <= qp
+    if sliding_window is not None:
+        ok &= kv_idx > qp - sliding_window
+    ok &= valid_kv[:, None, :]
+    return jnp.where(ok, 0.0, -jnp.inf).astype(jnp.float32)[:, None, None]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "remat", "return_hidden", "collect_aux"),
+)
+def forward(
+    params: dict,
+    tokens: jax.Array,  # int32 [B, T]
+    cfg: ModelConfig,
+    cache: KVCache | None = None,
+    attn_mask: jax.Array | None = None,  # bool [B, T] valid-token mask
+    positions: jax.Array | None = None,  # int32 [B, T] absolute positions
+    remat: bool = False,
+    return_hidden: bool = False,
+    collect_aux: bool = False,
+):
+    """Full forward. Returns ``(logits, new_cache)``.
+
+    - Training / no-cache: causal self-attention over the sequence.
+    - Prefill: pass a fresh ``cache``; keys/values land at positions
+      ``cache.length + arange(T)`` per row.
+    - Decode: same call with ``T=1`` — one compiled program per (B, T) bucket
+      (recompile policy: engine/compile_cache.py).
+    """
+    B, T = tokens.shape
+    if attn_mask is None:
+        attn_mask = jnp.ones((B, T), bool)
+    if cache is not None:
+        offset = cache.length
+    else:
+        offset = jnp.zeros((B,), jnp.int32)
+    if positions is None:
+        positions = offset[:, None] + jnp.arange(T)[None, :]
+
+    x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    if cache is not None:
+        S = cache.max_len
+        kv_idx = jnp.arange(S)[None, :]
+        new_len = offset + attn_mask.sum(-1).astype(jnp.int32)
+        valid_kv = kv_idx < new_len[:, None]
+    else:
+        valid_kv = attn_mask
+        S = T
+    bias = _mask_bias(positions, S, valid_kv, cfg.sliding_window)
+
+    block = _block
+    if remat:
+        block = jax.checkpoint(
+            _block, policy=jax.checkpoint_policies.nothing_saveable, static_argnums=(2,)
+        )
+
+    if cache is not None:
+
+        def scan_fn(carry, xs):
+            lp, ck, cv = xs
+            y, ck, cv = block(carry, lp, cfg, cos, sin, bias, ck, cv, offset)
+            return y, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(
+            scan_fn, x, (params["layers"], cache.k, cache.v)
+        )
+        new_cache = KVCache(k=new_k, v=new_v, length=offset + attn_mask.sum(-1).astype(jnp.int32))
+    else:
+
+        def scan_fn(carry, lp):
+            y, _, _ = block(carry, lp, cfg, cos, sin, bias, None, None, None)
+            return y, None
+
+        x, _ = lax.scan(scan_fn, x, params["layers"])
+        new_cache = None
+
+    x = _norm(x, params["final_norm"], cfg)
+    if return_hidden:
+        return x, new_cache
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tok"].T.astype(cfg.dtype)
+    else:
+        logits = x @ params["lm_head"]
+    if cfg.logit_cap is not None:
+        logits = cfg.logit_cap * jnp.tanh(logits / cfg.logit_cap)
+    return logits, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Sharding
+# ---------------------------------------------------------------------------
+
+
+def partition_specs(
+    cfg: ModelConfig,
+    *,
+    tensor_axis: str | None = "tensor",
+    expert_axis: str | None = None,
+    fsdp_axis: str | None = None,
+) -> dict:
+    """Megatron-style PartitionSpec pytree matching :func:`init_params`.
+
+    The TPU replacement for the reference's per-worker module assignment
+    (ml/graphing.py:730-761): sharding is declared per-parameter and GSPMD
+    inserts the collectives. qkv/gate/up shard their output dim on
+    ``tensor_axis`` (column-parallel); wo/down shard their input dim
+    (row-parallel) so each pair needs one psum. ``fsdp_axis`` additionally
+    shards the remaining large dim (ZeRO-3 style). Experts shard on
+    ``expert_axis``.
+    """
+    t, e, fs = tensor_axis, expert_axis, fsdp_axis
+
+    def spec(*names):
+        return P(*names)
+
+    ln = {"scale": spec(None, None)}
+    if cfg.norm == "layernorm":
+        ln["bias"] = spec(None, None)
+    attn = {
+        "wq": spec(None, fs, t),
+        "wk": spec(None, fs, t),
+        "wv": spec(None, fs, t),
+        "wo": spec(None, t, fs),
+    }
+    if cfg.attn_bias:
+        attn |= {"bq": spec(None, t), "bk": spec(None, t), "bv": spec(None, t)}
+    if cfg.family == "gpt2":
+        attn["bo"] = spec(None, None)
+    if cfg.qk_norm:
+        attn |= {"q_norm": spec(None, None), "k_norm": spec(None, None)}
+
+    if cfg.moe:
+        mlp = {
+            "router": spec(None, None, None),
+            "w_gate": spec(None, e, fs, t),
+            "w_up": spec(None, e, fs, t),
+            "w_down": spec(None, e, t, fs),
+        }
+    elif cfg.mlp == "gated":
+        mlp = {
+            "w_gate": spec(None, fs, t),
+            "w_up": spec(None, fs, t),
+            "w_down": spec(None, t, fs),
+        }
+        if cfg.mlp_bias:
+            mlp |= {
+                "b_gate": spec(None, t),
+                "b_up": spec(None, t),
+                "b_down": spec(None, None),
+            }
+    else:
+        mlp = {
+            "w_up": spec(None, fs, t),
+            "b_up": spec(None, t),
+            "w_down": spec(None, t, fs),
+            "b_down": spec(None, None),
+        }
+
+    specs = {
+        "embed": {"tok": spec(t, fs)},
+        "layers": {"ln1": ln, "attn": attn, "ln2": dict(ln), "mlp": mlp},
+        "final_norm": {"scale": spec(None)}
+        | ({"bias": spec(None)} if cfg.norm == "layernorm" else {}),
+    }
+    if cfg.pos == "learned":
+        specs["embed"]["pos"] = spec(None, fs)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = spec(fs, t)
+    return specs
+
+
+def cache_specs(cfg: ModelConfig, *, data_axis="data", tensor_axis="tensor"):
+    """KV cache sharding: batch on data, kv heads on tensor (when they
+    divide; the planner degrades to replicated heads otherwise)."""
+    return KVCache(
+        k=P(None, data_axis, None, tensor_axis, None),
+        v=P(None, data_axis, None, tensor_axis, None),
+        length=P(data_axis),
+    )

@@ -1,0 +1,24 @@
+"""Parallelism layer: mesh management, sharding planner, collectives.
+
+Capability map (SURVEY §2.2) onto TPU idioms:
+
+- inter-layer model parallelism (reference's core feature, ml/graphing.py) →
+  GSPMD PartitionSpecs + pipeline stage plan (:mod:`.planner`)
+- pipeline micro-batching (threads, ml/module.py:374) → compiled 1F1B-style
+  schedule with ``ppermute`` stage handoff (:mod:`.pipeline`)
+- data parallelism (vestigial in reference) → first-class ``data`` mesh axis
+- tensor parallelism (absent in reference) → megatron column/row specs
+- sequence/context parallelism (absent) → ring attention (:mod:`.ring`)
+- expert parallelism (absent) → capacity-based all-to-all (:mod:`.expert`)
+"""
+
+from .mesh import MeshPlan, build_mesh, local_mesh
+from .planner import ShardingPlan, plan_sharding
+
+__all__ = [
+    "MeshPlan",
+    "ShardingPlan",
+    "build_mesh",
+    "local_mesh",
+    "plan_sharding",
+]

@@ -1,0 +1,309 @@
+"""Sharding planner — the TPU-native ModelParser.
+
+The reference's ModelParser walks an ``nn.Module`` tree and assigns whole
+submodules to workers by GPU bytes (ml/graphing.py:202-761, decision order
+host-load → offload → recurse, consecutive layers merged into
+``offloaded_group`` entries). Here the same capability is planned in terms of
+TPU meshes:
+
+- memory model re-derived for HBM (params + grads + optimizer state +
+  activations-under-remat + KV cache, ×1.1 fragmentation overhead;
+  reference constants: adam 2×fp32, activation ×4/×7, ×1.2 —
+  ml/utils.py:36-124),
+- a worker is a mesh slice, not a byte bucket: within a worker, GSPMD
+  PartitionSpecs shard tensors (TP/FSDP/DP) and XLA inserts collectives,
+- across workers, the model splits into pipeline *stages* by contiguous layer
+  ranges (the analogue of ``model.layers.0-N`` groups,
+  graphing.py:64-128), capped at 6 fragments like the reference
+  (ml/validator.py:427-430),
+- tied embeddings pin input+output embedding to the same (first) stage —
+  known from config here, no ``data_ptr()`` forensics needed
+  (graphing.py:400-414).
+
+The emitted :class:`ShardingPlan` is JSON-serializable — it is the job
+"distribution config" stored in the DHT and shipped to workers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+from ..models.base import ModelConfig
+from ..models.transformer import cache_specs, partition_specs
+
+MAX_STAGES = 6  # reference ml/validator.py:427-430
+_DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2, "float8_e4m3fn": 1}
+
+
+def _dtype_bytes(dtype) -> int:
+    name = getattr(dtype, "__name__", None) or str(dtype)
+    for k, v in _DTYPE_BYTES.items():
+        if k in name:
+            return v
+    return 2
+
+
+@dataclass
+class WorkerCapacity:
+    """What a worker advertises (reference STATS-RESPONSE carries
+    available_gpu_memory, worker_thread.py:245-268; here the mesh shape
+    matters too)."""
+
+    node_id: str
+    hbm_bytes: float
+    n_devices: int = 1
+    # per-device ICI connectivity implies which axes are cheap; workers on one
+    # slice report the same slice_id so the planner knows TP/FSDP stay on ICI
+    slice_id: str = ""
+
+
+@dataclass
+class MemoryEstimate:
+    params: int
+    grads: int
+    optimizer: int
+    activations: int
+    kv_cache: int
+    total: int
+
+    @classmethod
+    def build(
+        cls,
+        cfg: ModelConfig,
+        *,
+        batch: int,
+        seq_len: int,
+        training: bool,
+        optimizer: str = "adamw",
+    ) -> "MemoryEstimate":
+        pb = _dtype_bytes(cfg.dtype)
+        n = cfg.param_count()
+        params = n * pb
+        grads = n * pb if training else 0
+        # adam: m+v in fp32 (reference ml/utils.py:75-78); sgd: 0
+        opt = 2 * n * 4 if (training and optimizer.startswith("adam")) else 0
+        if training:
+            # under remat we keep one residual per layer boundary plus the
+            # per-layer recompute working set (~4 live d_model tensors)
+            act = batch * seq_len * cfg.d_model * pb * (cfg.n_layers + 8)
+        else:
+            act = batch * seq_len * cfg.d_model * pb * 4
+        kv = (
+            2
+            * cfg.n_layers
+            * batch
+            * seq_len
+            * cfg.n_kv_heads
+            * cfg.head_dim
+            * pb
+            if not training
+            else 0
+        )
+        total = int((params + grads + opt + act + kv) * 1.1)
+        return cls(params, grads, opt, int(act), int(kv), total)
+
+
+@dataclass
+class StagePlan:
+    """One pipeline stage: a contiguous layer range on one worker's mesh."""
+
+    worker_id: str
+    layer_lo: int
+    layer_hi: int
+    first: bool  # holds token (+pos) embedding
+    last: bool  # holds final norm + lm_head (tied → also first==last stage 0)
+    mesh_axes: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def layer_range(self) -> tuple[int, int]:
+        return (self.layer_lo, self.layer_hi)
+
+
+@dataclass
+class ShardingPlan:
+    model_name: str
+    stages: list[StagePlan]
+    n_micro: int
+    batch: int
+    seq_len: int
+    training: bool
+    estimate: MemoryEstimate
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def stage_for(self, worker_id: str) -> StagePlan | None:
+        for s in self.stages:
+            if s.worker_id == worker_id:
+                return s
+        return None
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ShardingPlan":
+        return cls(
+            model_name=d["model_name"],
+            stages=[StagePlan(**s) for s in d["stages"]],
+            n_micro=d["n_micro"],
+            batch=d["batch"],
+            seq_len=d["seq_len"],
+            training=d["training"],
+            estimate=MemoryEstimate(**d["estimate"]),
+        )
+
+
+class AssignmentError(RuntimeError):
+    """No worker set can host the job (reference graphing.py:640-650)."""
+
+
+def _mesh_axes_for(cfg: ModelConfig, cap: WorkerCapacity, training: bool) -> dict[str, int]:
+    """Within one worker: choose TP degree that divides both heads and
+    devices; remaining devices go to fsdp (training) or data (serving)."""
+    n = cap.n_devices
+    tp = 1
+    for cand in (8, 4, 2, 1):
+        if cand <= n and cfg.n_kv_heads % cand == 0 and cfg.n_heads % cand == 0 and n % cand == 0:
+            tp = cand
+            break
+    rest = n // tp
+    if training:
+        return {"fsdp": rest, "tensor": tp}
+    return {"data": rest, "tensor": tp}
+
+
+def plan_sharding(
+    cfg: ModelConfig,
+    workers: list[WorkerCapacity],
+    *,
+    model_name: str = "",
+    batch: int = 1,
+    seq_len: int = 2048,
+    training: bool = False,
+    n_micro: int | None = None,
+) -> ShardingPlan:
+    """Assign the model to workers.
+
+    Single-worker fit is preferred (whole model, one mesh, zero cross-node
+    traffic). Otherwise layers split into contiguous stages proportional to
+    worker capacity — best-fit ordering, largest worker first (reference
+    best-fit prefers the previous worker, graphing.py:730-761; contiguity is
+    what matters on TPU since stage boundaries are the only cross-node hops).
+    """
+    if not workers:
+        raise AssignmentError("no workers available")
+    est = MemoryEstimate.build(
+        cfg, batch=batch, seq_len=seq_len, training=training
+    )
+    ranked = sorted(workers, key=lambda w: -w.hbm_bytes)
+
+    # 1) whole-model fit on the single best worker
+    best = ranked[0]
+    if est.total <= best.hbm_bytes:
+        stage = StagePlan(
+            worker_id=best.node_id,
+            layer_lo=0,
+            layer_hi=cfg.n_layers,
+            first=True,
+            last=True,
+            mesh_axes=_mesh_axes_for(cfg, best, training),
+        )
+        return ShardingPlan(
+            model_name=model_name,
+            stages=[stage],
+            n_micro=n_micro or 1,
+            batch=batch,
+            seq_len=seq_len,
+            training=training,
+            estimate=est,
+        )
+
+    # 2) pipeline split: per-layer cost + embedding/head overheads
+    pb = _dtype_bytes(cfg.dtype)
+    per_layer = (est.total - 2 * cfg.vocab_size * cfg.d_model * pb) / max(
+        cfg.n_layers, 1
+    )
+    emb_bytes = cfg.vocab_size * cfg.d_model * pb * (1 if cfg.tie_embeddings else 2)
+
+    chosen: list[WorkerCapacity] = []
+    cap_layers: list[int] = []
+    remaining = cfg.n_layers
+    for i, w in enumerate(ranked[:MAX_STAGES]):
+        budget = w.hbm_bytes
+        if i == 0:
+            budget -= emb_bytes  # embeddings (tied → head too) pin to stage 0
+        fit = int(budget // per_layer)
+        if fit <= 0:
+            continue
+        take = min(fit, remaining)
+        chosen.append(w)
+        cap_layers.append(take)
+        remaining -= take
+        if remaining == 0:
+            break
+    if remaining > 0:
+        raise AssignmentError(
+            f"model needs {est.total / 1e9:.1f} GB; "
+            f"{len(workers)} workers (≤{MAX_STAGES} stages) cannot host it"
+        )
+
+    stages = []
+    lo = 0
+    for i, (w, n_l) in enumerate(zip(chosen, cap_layers)):
+        stages.append(
+            StagePlan(
+                worker_id=w.node_id,
+                layer_lo=lo,
+                layer_hi=lo + n_l,
+                first=i == 0,
+                last=i == len(chosen) - 1,
+                mesh_axes=_mesh_axes_for(cfg, w, training),
+            )
+        )
+        lo += n_l
+    # tied embeddings: lm_head reuses the stage-0 embedding → last stage must
+    # ship its hidden back to stage 0 for logits; planner marks stage 0 last
+    # as well in that case (the executor handles the hop).
+    if cfg.tie_embeddings and len(stages) > 1:
+        stages[-1].last = False
+        stages[0].last = True
+
+    micro = n_micro or max(2 * len(stages), 1) if len(stages) > 1 else (n_micro or 1)
+    return ShardingPlan(
+        model_name=model_name,
+        stages=stages,
+        n_micro=micro,
+        batch=batch,
+        seq_len=seq_len,
+        training=training,
+        estimate=est,
+    )
+
+
+def stage_param_specs(cfg: ModelConfig, stage: StagePlan) -> dict:
+    """PartitionSpec tree for one stage's params given its mesh axes."""
+    tp = "tensor" if stage.mesh_axes.get("tensor", 1) > 1 else None
+    fs = "fsdp" if stage.mesh_axes.get("fsdp", 1) > 1 else None
+    ep = "expert" if stage.mesh_axes.get("expert", 1) > 1 else None
+    specs = partition_specs(cfg, tensor_axis=tp, expert_axis=ep, fsdp_axis=fs)
+    if not stage.first:
+        specs["embed"].pop("pos", None)
+        if not (stage.last and cfg.tie_embeddings):
+            specs.pop("embed", None)
+    if not stage.last:
+        specs.pop("final_norm", None)
+        specs.pop("lm_head", None)
+    return specs
+
+
+def stage_cache_specs(cfg: ModelConfig, stage: StagePlan):
+    dp = "data" if stage.mesh_axes.get("data", 1) > 1 else None
+    tp = (
+        "tensor"
+        if stage.mesh_axes.get("tensor", 1) > 1
+        and cfg.n_kv_heads % stage.mesh_axes["tensor"] == 0
+        else None
+    )
+    return cache_specs(cfg, data_axis=dp, tensor_axis=tp)

@@ -10,14 +10,23 @@ virtual devices.
 
 import os
 
-# Must be set before jax initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU. The environment pins JAX_PLATFORMS=axon (real TPU via tunnel)
+# and the axon plugin imports jax at interpreter start, so a plain env
+# setdefault is not enough: override the env (for spawned subprocesses) AND
+# update the already-imported config (for this process).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -27,7 +36,10 @@ def cpu_devices():
     import jax
 
     devs = jax.devices("cpu")
-    assert len(devs) >= 8, "conftest must run before jax is first imported"
+    assert len(devs) >= 8, (
+        "expected 8 virtual CPU devices; XLA_FLAGS was likely preset without "
+        "--xla_force_host_platform_device_count=8"
+    )
     return devs
 
 
