@@ -1,0 +1,95 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Measures single-chip decode throughput (tokens/sec/chip) for the flagship
+Qwen3-family model via the fully-compiled decode loop
+(engine/generate.py::_decode_loop — the whole token loop on device).
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` reports
+the fraction of the HBM-bandwidth roofline achieved: a B=1 decode step must
+stream all parameter + KV bytes per token, so
+``roofline_tokens/s = HBM_BW / (param_bytes + kv_bytes_per_token·len)``.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.engine.sampling import SamplingParams
+    from tensorlink_tpu.models import init_params
+    from tensorlink_tpu.models.registry import config_presets
+
+    if on_tpu:
+        cfg = config_presets()["qwen3-1p7b"].with_(dtype=jnp.bfloat16)
+        batch, prompt_len, gen_tokens = 1, 128, 512
+        hbm_bw = 819e9  # v5e ~819 GB/s
+    else:  # CPU fallback so the harness always emits a line
+        from tensorlink_tpu.models import ModelConfig
+
+        cfg = config_presets()["qwen3-1p7b"].with_(
+            dtype=jnp.float32, n_layers=2, d_model=256, d_ff=512,
+            n_heads=4, n_kv_heads=2, head_dim=64, vocab_size=1024,
+        )
+        batch, prompt_len, gen_tokens = 1, 32, 64
+        hbm_bw = 50e9
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = GenerationEngine(
+        cfg,
+        params,
+        seq_buckets=(prompt_len, prompt_len + gen_tokens),
+        batch_buckets=(batch,),
+        max_seq_len=prompt_len + gen_tokens,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(batch)
+    ]
+    greedy = SamplingParams.make()
+
+    # warmup with the SAME max_new_tokens: _decode_loop's n_steps is a static
+    # jit arg, so a different step count would compile a different program
+    # and the timed run would pay compilation.
+    r = eng.generate_compiled(prompts, max_new_tokens=gen_tokens, sampling=greedy)
+
+    t0 = time.perf_counter()
+    r = eng.generate_compiled(prompts, max_new_tokens=gen_tokens, sampling=greedy)
+    dt = time.perf_counter() - t0
+    n_tokens = sum(len(s) for s in r.sequences)
+    toks_per_s = n_tokens / dt
+
+    pbytes = cfg.param_count() * (2 if cfg.dtype == jnp.bfloat16 else 4)
+    kv_per_tok = (
+        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+        * (2 if cfg.dtype == jnp.bfloat16 else 4)
+    )
+    avg_len = prompt_len + gen_tokens / 2
+    roofline = hbm_bw / (pbytes + kv_per_tok * avg_len)
+    print(
+        json.dumps(
+            {
+                "metric": f"decode tokens/sec/chip (qwen3-1.7b-class bf16, B={batch}, "
+                f"prompt {prompt_len}, {'tpu' if on_tpu else 'cpu-fallback'})",
+                "value": round(toks_per_s, 2),
+                "unit": "tokens/s",
+                "vs_baseline": round(toks_per_s / roofline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a line
+        print(json.dumps({"metric": "bench-error", "value": 0, "unit": str(e)[:200], "vs_baseline": 0}))
+        sys.exit(1)

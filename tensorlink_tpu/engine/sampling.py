@@ -1,0 +1,70 @@
+"""Jittable token sampling.
+
+The reference delegates sampling to HF ``generate()`` kwargs
+(temperature/top-p/top-k normalized in ml/formatter.py:7-117); here sampling
+is a pure function compiled into the decode program so the token loop never
+leaves the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SamplingParams:
+    """Dynamic sampling knobs — pytree leaves so one compiled program serves
+    every request (no recompile per temperature change)."""
+
+    temperature: jax.Array  # f32 scalar; <=0 → greedy
+    top_k: jax.Array  # int32 scalar; 0 → disabled
+    top_p: jax.Array  # f32 scalar; >=1 → disabled
+
+    @classmethod
+    def make(cls, temperature=0.0, top_k=0, top_p=1.0) -> "SamplingParams":
+        return cls(
+            temperature=jnp.float32(temperature),
+            top_k=jnp.int32(top_k),
+            top_p=jnp.float32(top_p),
+        )
+
+
+def sample(
+    logits: jax.Array,  # [B, V] float
+    key: jax.Array,
+    p: SamplingParams,
+) -> jax.Array:
+    """Temperature / top-k / top-p sampling, greedy when temperature<=0.
+
+    Fully vectorized: filters are masks over the sorted distribution, so the
+    same program handles any (k, p) at runtime.
+    """
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+
+    def sampled(_):
+        scaled = logits / jnp.maximum(p.temperature, 1e-6)
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        ranks = jnp.arange(V)[None, :]
+        # top-k: keep ranks < k (k==0 → keep all)
+        k = jnp.where(p.top_k > 0, p.top_k, V)
+        keep = ranks < k
+        # top-p: keep the smallest prefix with cumulative prob >= p
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep &= (cum - probs) < p.top_p
+        masked = jnp.where(keep, sorted_logits, -jnp.inf)
+        choice = jax.random.categorical(key, masked, axis=-1)  # [B]
+        return jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0]
+
+    def greedy(_):
+        return logits.argmax(-1)
+
+    return jax.lax.cond(p.temperature > 0.0, sampled, greedy, None).astype(
+        jnp.int32
+    )

@@ -1,0 +1,181 @@
+"""Compiled training: loss, train-step factory, micro-batch accumulation.
+
+The reference's training path replays torch autograd per offloaded module and
+fans out optimizer RPCs (ml/module.py:414-524, ml/optim.py:81-205). Here a
+training job inside one mesh is ONE compiled program: forward + backward +
+optax update, parameters/grads/optimizer state all sharded by GSPMD, gradient
+all-reduce riding ICI (psum over data/fsdp axes inserted by the compiler).
+Micro-batching is a ``lax.scan`` gradient accumulation inside the program —
+the compiled analogue of the reference's micro-batch threads
+(module.py:374-399).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.base import ModelConfig
+from ..models.transformer import forward
+
+
+def causal_lm_loss(
+    params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, T]
+    loss_mask: jax.Array | None = None,  # [B, T] — True where next-token counts
+    remat: bool = True,
+):
+    """Next-token cross-entropy in fp32. Returns (loss, aux)."""
+    logits, _ = forward(params, tokens, cfg, remat=remat)
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    mask = (
+        loss_mask[:, 1:]
+        if loss_mask is not None
+        else jnp.ones_like(targets, dtype=bool)
+    )
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    n = jnp.maximum(mask.sum(), 1)
+    loss = nll.sum() / n
+    return loss, {"loss": loss, "n_tokens": n}
+
+
+def make_optimizer(
+    name: str = "adamw",
+    lr: float | optax.Schedule = 1e-4,
+    *,
+    weight_decay: float = 0.0,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float | None = 1.0,
+    **kw,
+) -> optax.GradientTransformation:
+    """optax chain mirroring the reference's optimizer spec ser/de surface
+    (ml/utils.py:870-887 maps a name + kwargs)."""
+    if name in ("adamw", "adam"):
+        opt = optax.adamw(lr, b1=b1, b2=b2, weight_decay=weight_decay, **kw)
+    elif name == "sgd":
+        opt = optax.sgd(lr, **kw)
+    elif name == "adafactor":
+        opt = optax.adafactor(lr, **kw)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if grad_clip:
+        opt = optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+    return opt
+
+
+@dataclass
+class TrainStep:
+    """Bundle of compiled step + optimizer for a model on a mesh."""
+
+    step_fn: Callable  # (params, opt_state, batch) -> (params, opt_state, metrics)
+    optimizer: optax.GradientTransformation
+
+    def init_state(self, params):
+        return self.optimizer.init(params)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    *,
+    n_micro: int = 1,
+    remat: bool = True,
+    loss_fn: Callable | None = None,
+    donate: bool = True,
+) -> TrainStep:
+    """Build the compiled train step.
+
+    ``n_micro > 1`` splits the batch inside the program and accumulates
+    gradients with ``lax.scan`` (sequential — bounds activation memory the
+    same way the reference's micro-batch pipeline does, without threads).
+    """
+    loss_fn = loss_fn or causal_lm_loss
+
+    def compute_grads(params, tokens, loss_mask):
+        grad_fn = jax.value_and_grad(
+            lambda p: loss_fn(p, cfg, tokens, loss_mask, remat=remat),
+            has_aux=True,
+        )
+        (loss, aux), grads = grad_fn(params)
+        return loss, aux, grads
+
+    def step(params, opt_state, batch):
+        tokens = batch["tokens"]
+        loss_mask = batch.get("loss_mask")
+        if n_micro > 1:
+            B = tokens.shape[0]
+            if B % n_micro != 0:
+                raise ValueError(
+                    f"batch {B} not divisible by n_micro={n_micro}"
+                )
+            mb = B // n_micro
+            toks = tokens[: mb * n_micro].reshape(n_micro, mb, -1)
+            lm = (
+                loss_mask[: mb * n_micro].reshape(n_micro, mb, -1)
+                if loss_mask is not None
+                else None
+            )
+
+            def scan_fn(acc, xs):
+                t = xs[0]
+                m = xs[1] if lm is not None else None
+                loss, _aux, grads = compute_grads(params, t, m)
+                acc_grads, acc_loss = acc
+                acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
+                return (acc_grads, acc_loss + loss), None
+
+            zero = jax.tree.map(jnp.zeros_like, params)
+            xs = (toks, lm) if lm is not None else (toks,)
+            (grads, loss_sum), _ = jax.lax.scan(
+                scan_fn, (zero, jnp.float32(0.0)), xs
+            )
+            grads = jax.tree.map(lambda g: g / n_micro, grads)
+            loss = loss_sum / n_micro
+        else:
+            loss, _aux, grads = compute_grads(params, tokens, loss_mask)
+
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    donate_args = (0, 1) if donate else ()
+    return TrainStep(
+        step_fn=jax.jit(step, donate_argnums=donate_args), optimizer=optimizer
+    )
+
+
+def optimizer_state_specs(
+    optimizer: optax.GradientTransformation, params, param_specs
+):
+    """PartitionSpec pytree for the optax state: any sub-tree that mirrors
+    the param tree (adam moments, momentum buffers) shards like the params;
+    scalars (step counts) replicate. The reference keeps optimizer state on
+    each worker next to its modules (ml/optim.py init fan-out) — same
+    locality, but declared to the compiler instead of managed by RPC."""
+    from jax.sharding import PartitionSpec as P
+
+    state_shapes = jax.eval_shape(optimizer.init, params)
+    pdef = jax.tree.structure(params)
+
+    def is_param_tree(node):
+        try:
+            return jax.tree.structure(node) == pdef
+        except Exception:
+            return False
+
+    return jax.tree.map(
+        lambda node: param_specs if is_param_tree(node) else P(),
+        state_shapes,
+        is_leaf=is_param_tree,
+    )

@@ -1,0 +1,170 @@
+"""Generation engine + training step tests (tiny configs, CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.models import ModelConfig, init_params
+from tensorlink_tpu.engine.generate import GenerationEngine, _bucket
+from tensorlink_tpu.engine.sampling import SamplingParams, sample
+from tensorlink_tpu.engine.training import (
+    causal_lm_loss,
+    make_optimizer,
+    make_train_step,
+    optimizer_state_specs,
+)
+
+TINY = ModelConfig(
+    family="qwen3",
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=8,
+    d_ff=64,
+    max_seq_len=128,
+    qk_norm=True,
+    tie_embeddings=True,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    return TINY, params
+
+
+def test_bucketing():
+    assert _bucket(3, (4, 8)) == 4
+    assert _bucket(4, (4, 8)) == 4
+    with pytest.raises(ValueError):
+        _bucket(9, (4, 8))
+
+
+def test_sampling_greedy_and_filters():
+    logits = jnp.asarray([[0.0, 5.0, 1.0, -1.0]])
+    key = jax.random.PRNGKey(0)
+    assert int(sample(logits, key, SamplingParams.make())[0]) == 1
+    # top_k=1 always picks argmax even at high temperature
+    p = SamplingParams.make(temperature=5.0, top_k=1)
+    for s in range(5):
+        assert int(sample(logits, jax.random.PRNGKey(s), p)[0]) == 1
+    # top_p tiny keeps only the head of the distribution
+    p = SamplingParams.make(temperature=1.0, top_p=1e-6)
+    assert int(sample(logits, key, p)[0]) == 1
+
+
+def test_host_vs_compiled_greedy_equal(tiny_model):
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16, 32), batch_buckets=(1, 2), max_seq_len=32
+    )
+    prompts = [[1, 2, 3, 4, 5], [7, 8]]
+    r1 = eng.generate(prompts, max_new_tokens=8)
+    r2 = eng.generate_compiled(prompts, max_new_tokens=8)
+    assert r1.sequences == r2.sequences
+    assert r1.prompt_lens == [5, 2]
+
+
+def test_streaming_callback(tiny_model):
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16,), batch_buckets=(1,), max_seq_len=16
+    )
+    got = []
+    r = eng.generate([[1, 2, 3]], max_new_tokens=5, stream_cb=lambda t: got.append(t))
+    assert len(got) == len(r.sequences[0])
+    assert [g[0] for g in got] == r.sequences[0]
+
+
+def test_eos_stops(tiny_model):
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16,), batch_buckets=(1,), max_seq_len=16
+    )
+    base = eng.generate([[1, 2, 3]], max_new_tokens=8)
+    first = base.sequences[0][0]
+    r = eng.generate([[1, 2, 3]], max_new_tokens=8, eos_ids=[first])
+    assert r.sequences[0] == [first]
+    assert r.finished[0]
+
+
+def test_train_step_reduces_loss(tiny_model):
+    cfg, params = tiny_model
+    opt = make_optimizer("adamw", lr=5e-3)
+    ts = make_train_step(cfg, opt, remat=True, donate=False)
+    state = ts.init_state(params)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(rng.integers(0, 64, (4, 16)).astype(np.int32))}
+    losses = []
+    p = params
+    for _ in range(16):
+        p, state, m = ts.step_fn(p, state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_microbatch_grad_accum_matches_full(tiny_model):
+    cfg, params = tiny_model
+    opt = make_optimizer("sgd", lr=1e-2, grad_clip=None)
+    full = make_train_step(cfg, opt, n_micro=1, donate=False)
+    micro = make_train_step(cfg, opt, n_micro=2, donate=False)
+    rng = np.random.default_rng(1)
+    batch = {"tokens": jnp.asarray(rng.integers(0, 64, (4, 16)).astype(np.int32))}
+    s1 = full.init_state(params)
+    s2 = micro.init_state(params)
+    p1, _, m1 = full.step_fn(params, s1, batch)
+    p2, _, m2 = micro.step_fn(params, s2, batch)
+    # micro losses average per-micro means; with equal micro sizes and no
+    # padding both paths see the same tokens — params should be very close
+    d = jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), p1, p2
+    )
+    assert max(jax.tree.leaves(d)) < 1e-5
+
+
+def test_loss_mask(tiny_model):
+    cfg, params = tiny_model
+    toks = jnp.asarray(np.arange(32, dtype=np.int32).reshape(2, 16) % 64)
+    mask_all = jnp.ones((2, 16), bool)
+    half = mask_all.at[:, 8:].set(False)
+    l1, _ = causal_lm_loss(params, cfg, toks, mask_all, remat=False)
+    l2, _ = causal_lm_loss(params, cfg, toks, half, remat=False)
+    assert not np.isclose(float(l1), float(l2))
+
+
+def test_optimizer_state_specs(tiny_model):
+    from jax.sharding import PartitionSpec as P
+
+    cfg, params = tiny_model
+    opt = make_optimizer("adamw", lr=1e-3)
+    pspecs = jax.tree.map(lambda _: P(None), params)
+    sspecs = optimizer_state_specs(opt, params, pspecs)
+    state = opt.init(params)
+    # every state leaf must have a spec leaf in the same structure
+    jax.tree.map(lambda leaf, spec: None, state, sspecs)
+
+
+def test_full_cache_boundary_parity(tiny_model):
+    """Prompt exactly filling the cache: both APIs return empty sequences."""
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16,), batch_buckets=(1,), max_seq_len=16
+    )
+    prompt = [list(range(1, 17))]
+    r1 = eng.generate(prompt, max_new_tokens=4)
+    r2 = eng.generate_compiled(prompt, max_new_tokens=4)
+    assert r1.sequences == r2.sequences == [[]]
+
+
+def test_microbatch_divisibility_error(tiny_model):
+    cfg, params = tiny_model
+    opt = make_optimizer("sgd", lr=1e-2, grad_clip=None)
+    ts = make_train_step(cfg, opt, n_micro=3, donate=False)
+    batch = {"tokens": jnp.zeros((4, 8), jnp.int32)}
+    with pytest.raises(ValueError, match="divisible"):
+        ts.step_fn(params, ts.init_state(params), batch)
