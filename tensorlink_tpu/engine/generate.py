@@ -126,6 +126,10 @@ class GenerationEngine:
         self.cache_specs = cache_specs
         self.max_seq_len = max_seq_len or min(cfg.max_seq_len, seq_buckets[-1])
         self.seq_buckets = tuple(b for b in seq_buckets if b <= self.max_seq_len)
+        if not self.seq_buckets:
+            # every configured bucket exceeds max_seq_len — fall back to the
+            # single bucket that exactly covers it
+            self.seq_buckets = (self.max_seq_len,)
         self.batch_buckets = tuple(batch_buckets)
         self.cache_dtype = cache_dtype or cfg.dtype
 

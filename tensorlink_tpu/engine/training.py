@@ -126,21 +126,29 @@ def make_train_step(
                 else None
             )
 
+            # Accumulate token-weighted: each micro loss is a per-token mean,
+            # so scale its grads back to sums and divide once by the total
+            # token count — the result matches the n_micro=1 step even when
+            # loss masks make micro-batches unevenly populated.
             def scan_fn(acc, xs):
                 t = xs[0]
                 m = xs[1] if lm is not None else None
-                loss, _aux, grads = compute_grads(params, t, m)
-                acc_grads, acc_loss = acc
-                acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
-                return (acc_grads, acc_loss + loss), None
+                loss, aux, grads = compute_grads(params, t, m)
+                n_tok = aux["n_tokens"].astype(jnp.float32)
+                acc_grads, acc_nll, acc_tok = acc
+                acc_grads = jax.tree.map(
+                    lambda a, g: a + g * n_tok, acc_grads, grads
+                )
+                return (acc_grads, acc_nll + loss * n_tok, acc_tok + n_tok), None
 
             zero = jax.tree.map(jnp.zeros_like, params)
             xs = (toks, lm) if lm is not None else (toks,)
-            (grads, loss_sum), _ = jax.lax.scan(
-                scan_fn, (zero, jnp.float32(0.0)), xs
+            (grads, nll_sum, tok_sum), _ = jax.lax.scan(
+                scan_fn, (zero, jnp.float32(0.0), jnp.float32(0.0)), xs
             )
-            grads = jax.tree.map(lambda g: g / n_micro, grads)
-            loss = loss_sum / n_micro
+            tok_sum = jnp.maximum(tok_sum, 1.0)
+            grads = jax.tree.map(lambda g: g / tok_sum, grads)
+            loss = nll_sum / tok_sum
         else:
             loss, _aux, grads = compute_grads(params, tokens, loss_mask)
 

@@ -1,0 +1,144 @@
+"""Kademlia-style DHT: XOR-distance buckets, local store, validator routing.
+
+Capability match for the reference's DHT (p2p/dht.py): 256 buckets with
+exponentially growing capacity (dht.py:13-16), local-first ``query`` that
+forwards misses to the XOR-nearest *validator* peer (dht.py:110-121), and a
+local-only ``store`` (replication is the same TODO the reference carries,
+dht.py:135-137). Keys are 64-hex sha256 ids; values are JSON-able dicts.
+
+Async redesign: ``query`` awaits a remote answer with timeout + reroute
+(reference polls with a 3 s timeout then re-routes, smart_node.py:533-577).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from typing import Any, Awaitable, Callable
+
+ID_BITS = 256
+
+
+def hash_key(data: bytes | str) -> str:
+    if isinstance(data, str):
+        data = data.encode()
+    return hashlib.sha256(data).hexdigest()
+
+
+def xor_distance(a: str, b: str) -> int:
+    return int(a, 16) ^ int(b, 16)
+
+
+def bucket_index(a: str, b: str) -> int:
+    d = xor_distance(a, b)
+    return d.bit_length() - 1 if d else 0
+
+
+class Bucket:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.keys: list[str] = []
+
+    def add(self, key: str) -> bool:
+        if key in self.keys:
+            return True
+        if len(self.keys) >= self.capacity:
+            return False
+        self.keys.append(key)
+        return True
+
+    def remove(self, key: str) -> None:
+        if key in self.keys:
+            self.keys.remove(key)
+
+
+class DHT:
+    """Local routing table + key/value store.
+
+    ``forward`` — async callback ``(peer_id, key) -> value | None`` used when
+    a queried key is not local; the node wires it to a DHT_GET round-trip.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        *,
+        forward: Callable[[str, str], Awaitable[Any]] | None = None,
+        base_capacity: int = 2,
+    ):
+        self.node_id = node_id
+        self.store_map: dict[str, Any] = {}
+        self.updated_at: dict[str, float] = {}
+        # bucket i covers distances [2^i, 2^(i+1)); capacity grows with range
+        self.buckets = [
+            Bucket(base_capacity * max(1, 2 ** (i // 32))) for i in range(ID_BITS)
+        ]
+        self.forward = forward
+
+    # -- routing table -----------------------------------------------------
+    def add_node(self, key: str) -> bool:
+        if key == self.node_id:
+            return False
+        return self.buckets[bucket_index(self.node_id, key)].add(key)
+
+    def remove_node(self, key: str) -> None:
+        self.buckets[bucket_index(self.node_id, key)].remove(key)
+
+    def known_nodes(self) -> list[str]:
+        return [k for b in self.buckets for k in b.keys]
+
+    def nearest(self, key: str, candidates: list[str] | None = None, n: int = 1) -> list[str]:
+        pool = candidates if candidates is not None else self.known_nodes()
+        return sorted(pool, key=lambda c: xor_distance(key, c))[:n]
+
+    # -- store -------------------------------------------------------------
+    def store(self, key: str, value: Any) -> None:
+        self.store_map[key] = value
+        self.updated_at[key] = time.time()
+
+    def delete(self, key: str) -> bool:
+        self.updated_at.pop(key, None)
+        return self.store_map.pop(key, None) is not None
+
+    def get_local(self, key: str) -> Any:
+        return self.store_map.get(key)
+
+    # -- query -------------------------------------------------------------
+    async def query(
+        self,
+        key: str,
+        *,
+        route_pool: list[str] | None = None,
+        timeout: float = 3.0,
+        max_retries: int = 3,
+        hops: int = 0,
+    ) -> Any:
+        """Local lookup, then forward to XOR-nearest peers in ``route_pool``
+        (normally the connected validators), rerouting on timeout. ``hops``
+        rides along on the wire so a chain of misses terminates instead of
+        cycling between validators."""
+        if key in self.store_map:
+            return self.store_map[key]
+        if self.forward is None or not route_pool:
+            return None
+        tried: set[str] = set()
+        for _ in range(max_retries):
+            remaining = [p for p in route_pool if p not in tried]
+            if not remaining:
+                return None
+            peer = self.nearest(key, remaining)[0]
+            tried.add(peer)
+            try:
+                value = await asyncio.wait_for(
+                    self.forward(peer, key, hops), timeout
+                )
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                continue
+            if value is not None:
+                self.store(key, value)
+                return value
+        return None
+
+
+__all__ = ["DHT", "Bucket", "hash_key", "xor_distance", "bucket_index"]

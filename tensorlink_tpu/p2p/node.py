@@ -1,0 +1,428 @@
+"""P2PNode — authenticated asyncio TCP mesh node.
+
+Capability match for the reference's ``Smartnode`` (p2p/smart_node.py):
+listener + handshake, bootstrap to seed validators (smart_node.py:1100-1159),
+DHT query routing with timeout + reroute (533-577), per-IP rate limiting
+(247-250), tagged logging. Redesigned:
+
+- asyncio event loop in a dedicated thread (reference: thread per socket);
+  synchronous callers use :meth:`call`.
+- Handshake is a 4-step mutual RSA challenge (HELLO→CHALLENGE→PROOF→WELCOME)
+  over the single listener socket — no random-number OAEP dance and no "port
+  swap" reconnection (reference smart_node.py:786-955).
+- Request/response correlation by explicit ``_rid`` ids instead of polling
+  shared dicts.
+
+No jax imports here — the networking process stays device-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import threading
+import time
+from pathlib import Path
+from typing import Any, Awaitable, Callable
+
+from tensorlink_tpu.core.logging import get_logger
+from tensorlink_tpu.crypto import identity as crypto
+from tensorlink_tpu.p2p import protocol as proto
+from tensorlink_tpu.p2p.connection import Connection
+from tensorlink_tpu.p2p.dht import DHT, hash_key
+from tensorlink_tpu.p2p.monitor import RateLimiter
+
+Handler = Callable[[Connection, int, str, Any], Awaitable[None]]
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class P2PNode:
+    def __init__(
+        self,
+        role: str,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        key_dir: str | Path = "keys",
+        local_test: bool = False,
+        spill_dir: str | Path | None = None,
+        max_connections: int = 256,
+        request_timeout: float = 10.0,
+    ):
+        self.role = role
+        self.local_test = local_test
+        self.host = "127.0.0.1" if local_test else host
+        self.port = port
+        self.identity = crypto.load_or_create_identity(role, key_dir)
+        self.node_id = crypto.node_id_from_public_key(self.identity.public_pem)
+        self.spill_dir = spill_dir
+        self.max_connections = max_connections
+        self.request_timeout = request_timeout
+        self.log = get_logger(f"p2p.{role}.{self.node_id[:8]}")
+
+        self.connections: dict[str, Connection] = {}  # node_id -> conn
+        self.roles: dict[str, str] = {}  # node_id -> role
+        self.addresses: dict[str, tuple[str, int]] = {}  # node_id -> (host, port)
+        self.dht = DHT(self.node_id, forward=self._dht_forward)
+        self.limiter = RateLimiter()
+        self.handlers: dict[str, Handler] = {}
+        self.started = threading.Event()
+        self.terminate = threading.Event()
+
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._pending: dict[str, asyncio.Future] = {}
+        self._conn_tasks: set[asyncio.Task] = set()
+
+        self.register(proto.DHT_GET, self._handle_dht_get)
+        self.register(proto.DHT_STORE, self._handle_dht_store)
+        self.register(proto.DHT_DELETE, self._handle_dht_delete)
+        self.register(proto.PEERS, self._handle_peers)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        """Run the event loop + listener in a dedicated thread."""
+        if self._thread:
+            return
+        ready = threading.Event()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._start_server())
+            ready.set()
+            self.started.set()
+            try:
+                self._loop.run_forever()
+            finally:
+                self._loop.run_until_complete(self._shutdown())
+                self._loop.close()
+
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(target=run, name=f"p2p-{self.role}", daemon=True)
+        self._thread.start()
+        if not ready.wait(10):
+            raise RuntimeError("p2p node failed to start")
+        self.log.info("listening on %s:%s id=%s", self.host, self.port, self.node_id[:16])
+
+    def stop(self) -> None:
+        if not self._loop:
+            return
+        self.terminate.set()
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    async def _start_server(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, self.host, self.port or None
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _shutdown(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections.values()):
+            await conn.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+    def call(self, coro, timeout: float | None = 30.0):
+        """Run a coroutine on the node loop from another thread."""
+        assert self._loop is not None, "node not started"
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    # ------------------------------------------------------------------
+    # handshake
+    # ------------------------------------------------------------------
+    async def _read_frame(self, reader: asyncio.StreamReader) -> tuple[int, str, bytes]:
+        head = await reader.readexactly(proto.HEADER_SIZE)
+        hdr = proto.unpack_header(head)
+        if hdr.payload_len > 1 << 20:
+            raise HandshakeError("oversized handshake frame")
+        tag = (await reader.readexactly(hdr.tag_len)).decode("ascii")
+        payload = await reader.readexactly(hdr.payload_len)
+        return hdr.kind, tag, payload
+
+    @staticmethod
+    async def _write_frame(writer: asyncio.StreamWriter, tag: str, body: dict) -> None:
+        kind, tag, payload = proto.control(tag, body)
+        writer.write(proto.pack_header(kind, tag, len(payload)) + payload)
+        await writer.drain()
+
+    def _hello_body(self, nonce: str) -> dict:
+        return {
+            "pub": self.identity.public_pem.decode(),
+            "role": self.role,
+            "nonce": nonce,
+            "port": self.port,
+            "id": self.node_id,
+        }
+
+    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        ip = (writer.get_extra_info("peername") or ("?",))[0]
+        if not self.limiter.allow(ip):
+            self.log.warning("rate-limited %s", ip)
+            writer.close()
+            return
+        if len(self.connections) >= self.max_connections:
+            writer.close()
+            return
+        try:
+            kind, tag, payload = await asyncio.wait_for(self._read_frame(reader), 10)
+            if tag != proto.HELLO:
+                raise HandshakeError(f"expected hello, got {tag}")
+            hello = proto.parse_control(payload)
+            peer_pub = hello["pub"].encode()
+            if not crypto.authenticate_public_key(peer_pub):
+                raise HandshakeError("bad public key")
+            nonce_b = secrets.token_hex(32)
+            await self._write_frame(
+                writer,
+                proto.CHALLENGE,
+                {
+                    **self._hello_body(nonce_b),
+                    "sig": crypto.sign(self.identity, hello["nonce"].encode()).hex(),
+                },
+            )
+            kind, tag, payload = await asyncio.wait_for(self._read_frame(reader), 10)
+            if tag != proto.PROOF:
+                raise HandshakeError(f"expected proof, got {tag}")
+            proof = proto.parse_control(payload)
+            if not crypto.verify(peer_pub, bytes.fromhex(proof["sig"]), nonce_b.encode()):
+                raise HandshakeError("bad proof signature")
+            await self._write_frame(writer, proto.WELCOME, {"id": self.node_id})
+            await self._register_peer(
+                reader, writer, peer_pub, hello["role"], ip, int(hello.get("port", 0))
+            )
+        except (HandshakeError, asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError, OSError, KeyError, ValueError) as e:
+            self.log.warning("handshake with %s failed: %s", ip, e)
+            writer.close()
+
+    async def connect(self, host: str, port: int) -> Connection:
+        """Outgoing connection + handshake; returns the live Connection."""
+        for conn in self.connections.values():
+            if self.addresses.get(conn.node_id) == (host, port):
+                return conn
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            nonce_a = secrets.token_hex(32)
+            await self._write_frame(writer, proto.HELLO, self._hello_body(nonce_a))
+            kind, tag, payload = await asyncio.wait_for(self._read_frame(reader), 10)
+            if tag != proto.CHALLENGE:
+                raise HandshakeError(f"expected challenge, got {tag}")
+            ch = proto.parse_control(payload)
+            peer_pub = ch["pub"].encode()
+            if not crypto.authenticate_public_key(peer_pub):
+                raise HandshakeError("bad public key")
+            if not crypto.verify(peer_pub, bytes.fromhex(ch["sig"]), nonce_a.encode()):
+                raise HandshakeError("bad challenge signature")
+            await self._write_frame(
+                writer,
+                proto.PROOF,
+                {"sig": crypto.sign(self.identity, ch["nonce"].encode()).hex()},
+            )
+            kind, tag, payload = await asyncio.wait_for(self._read_frame(reader), 10)
+            if tag != proto.WELCOME:
+                raise HandshakeError(f"expected welcome, got {tag}")
+            return await self._register_peer(
+                reader, writer, peer_pub, ch["role"], host, int(ch.get("port", port))
+            )
+        except Exception:
+            writer.close()
+            raise
+
+    async def _register_peer(
+        self,
+        reader,
+        writer,
+        peer_pub: bytes,
+        peer_role: str,
+        host: str,
+        listen_port: int,
+    ) -> Connection:
+        node_id = crypto.node_id_from_public_key(peer_pub)
+        if node_id == self.node_id:
+            raise HandshakeError("connected to self")
+        old = self.connections.get(node_id)
+        if old is not None:
+            await old.close()
+        conn = Connection(reader, writer, spill_dir=self.spill_dir)
+        conn.node_id = node_id
+        conn.role = peer_role
+        conn.pub_pem = peer_pub
+        self.connections[node_id] = conn
+        self.roles[node_id] = peer_role
+        if listen_port:
+            self.addresses[node_id] = (host, listen_port)
+        self.dht.add_node(node_id)
+        task = asyncio.ensure_future(conn.run(self._on_frame))
+        self._conn_tasks.add(task)
+        task.add_done_callback(lambda t: (self._conn_tasks.discard(t), self._on_disconnect(conn)))
+        self.log.info("peer up %s role=%s %s:%s", node_id[:8], peer_role, host, listen_port)
+        return conn
+
+    def _on_disconnect(self, conn: Connection) -> None:
+        if conn.node_id and self.connections.get(conn.node_id) is conn:
+            del self.connections[conn.node_id]
+            self.log.info("peer down %s", conn.node_id[:8])
+
+    # ------------------------------------------------------------------
+    # dispatch + request/response
+    # ------------------------------------------------------------------
+    def register(self, tag: str, handler: Handler) -> None:
+        self.handlers[tag] = handler
+
+    async def _on_frame(self, conn: Connection, kind: int, tag: str, payload) -> None:
+        body = proto.parse_control(payload) if kind == proto.CONTROL else payload
+        if isinstance(body, dict) and body.get("_resp"):
+            fut = self._pending.pop(body.get("_rid"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(body)
+            # a reply whose requester timed out must never re-enter the
+            # request handlers (a late PEERS reply would otherwise ping-pong)
+            return
+        handler = self.handlers.get(tag)
+        if handler is None:
+            conn.ghosts += 1
+            self.log.debug("ghost frame tag=%s from %s", tag, conn.node_id and conn.node_id[:8])
+            return
+        try:
+            await handler(conn, kind, tag, body)
+        except Exception:
+            self.log.exception("handler %s failed", tag)
+
+    async def request(
+        self, conn: Connection, tag: str, body: dict, timeout: float | None = None
+    ) -> dict:
+        """Send a control message and await the correlated reply."""
+        rid = secrets.token_hex(8)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await conn.send_control(tag, {**body, "_rid": rid})
+            return await asyncio.wait_for(fut, timeout or self.request_timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    @staticmethod
+    async def respond(conn: Connection, tag: str, request_body: dict, body: dict) -> None:
+        await conn.send_control(
+            tag, {**body, "_rid": request_body.get("_rid"), "_resp": True}
+        )
+
+    # ------------------------------------------------------------------
+    # DHT wiring
+    # ------------------------------------------------------------------
+    async def _dht_forward(self, peer_id: str, key: str, hops: int = 0) -> Any:
+        conn = self.connections.get(peer_id)
+        if conn is None:
+            raise ConnectionError(f"no connection to {peer_id[:8]}")
+        reply = await self.request(conn, proto.DHT_GET, {"key": key, "hops": hops})
+        return reply.get("value")
+
+    async def _handle_dht_get(self, conn, kind, tag, body) -> None:
+        key = body["key"]
+        hops = int(body.get("hops", 0))
+        value = self.dht.get_local(key)
+        if value is None and hops < 2:
+            pool = [c for c in self.validator_ids() if c != conn.node_id]
+            if pool:
+                value = await self.dht.query(key, route_pool=pool, hops=hops + 1)
+        await self.respond(conn, proto.DHT_GET_RESP, body, {"key": key, "value": value})
+
+    async def _handle_dht_store(self, conn, kind, tag, body) -> None:
+        self.dht.store(body["key"], body["value"])
+
+    async def _handle_dht_delete(self, conn, kind, tag, body) -> None:
+        self.dht.delete(body["key"])
+
+    async def _handle_peers(self, conn, kind, tag, body) -> None:
+        peers = [
+            {"id": nid, "role": self.roles.get(nid), "addr": list(self.addresses.get(nid, ()))}
+            for nid in self.connections
+            if self.roles.get(nid) == "validator" and nid in self.addresses
+        ]
+        await self.respond(conn, proto.PEERS, body, {"peers": peers})
+
+    def validator_ids(self) -> list[str]:
+        return [nid for nid, r in self.roles.items() if r == "validator" and nid in self.connections]
+
+    async def dht_query(self, key: str, timeout: float = 3.0) -> Any:
+        return await self.dht.query(key, route_pool=self.validator_ids(), timeout=timeout)
+
+    async def dht_store_global(self, key: str, value: Any) -> None:
+        """Store locally and push to connected validators (the reference's
+        replication is local-only with a TODO, dht.py:135-137 — we at least
+        fan out to validators)."""
+        self.dht.store(key, value)
+        for nid in self.validator_ids():
+            conn = self.connections.get(nid)
+            if conn is not None:
+                try:
+                    await conn.send_control(proto.DHT_STORE, {"key": key, "value": value})
+                except (ConnectionError, OSError):
+                    pass
+
+    # ------------------------------------------------------------------
+    # bootstrap
+    # ------------------------------------------------------------------
+    async def bootstrap(self, seeds: list[tuple[str, int]], retries: int = 3) -> int:
+        """Connect to seed validators; learn + connect to their validator
+        peers (reference smart_node.py:1100-1159, retry loop
+        worker_thread.py:189-197). Returns number of live connections."""
+        for attempt in range(retries):
+            for host, port in seeds:
+                if (host, port) == (self.host, self.port):
+                    continue
+                try:
+                    conn = await self.connect(host, port)
+                    reply = await self.request(conn, proto.PEERS, {})
+                    for peer in reply.get("peers", []):
+                        pid, addr = peer.get("id"), peer.get("addr")
+                        if pid and addr and pid != self.node_id and pid not in self.connections:
+                            try:
+                                await self.connect(addr[0], addr[1])
+                            except (OSError, HandshakeError, asyncio.TimeoutError):
+                                pass
+                except (OSError, HandshakeError, asyncio.TimeoutError, ConnectionError) as e:
+                    self.log.warning("bootstrap %s:%s failed: %s", host, port, e)
+            if self.connections or not seeds:
+                break
+            await asyncio.sleep(1.5 * (attempt + 1))
+        return len(self.connections)
+
+    # ------------------------------------------------------------------
+    # stats
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "id": self.node_id,
+            "role": self.role,
+            "addr": [self.host, self.port],
+            "peers": {
+                nid[:16]: {
+                    "role": self.roles.get(nid),
+                    "latency_s": c.latency_s,
+                    "sent": c.bytes_sent,
+                    "recv": c.bytes_received,
+                    "ghosts": c.ghosts,
+                }
+                for nid, c in self.connections.items()
+            },
+            "dht_keys": len(self.dht.store_map),
+            "uptime_s": time.monotonic() - getattr(self, "_t0", time.monotonic()),
+        }
+
+
+__all__ = ["P2PNode", "HandshakeError", "hash_key"]
