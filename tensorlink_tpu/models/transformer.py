@@ -302,10 +302,7 @@ def _mask_bias(
     return jnp.where(ok, 0.0, -jnp.inf).astype(jnp.float32)[:, None, None]
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "remat", "return_hidden", "collect_aux"),
-)
+@partial(jax.jit, static_argnames=("cfg", "remat", "return_hidden"))
 def forward(
     params: dict,
     tokens: jax.Array,  # int32 [B, T]
@@ -315,29 +312,116 @@ def forward(
     positions: jax.Array | None = None,  # int32 [B, T] absolute positions
     remat: bool = False,
     return_hidden: bool = False,
-    collect_aux: bool = False,
 ):
     """Full forward. Returns ``(logits, new_cache)``.
 
     - Training / no-cache: causal self-attention over the sequence.
     - Prefill: pass a fresh ``cache``; keys/values land at positions
       ``cache.length + arange(T)`` per row.
-    - Decode: same call with ``T=1`` — one compiled program per (B, T) bucket
-      (recompile policy: engine/compile_cache.py).
+    - Decode: same call with ``T=1`` — one compiled program per (B, T) bucket.
+
+    Implemented as the single-stage case of :func:`_stage_impl` — the
+    stage-chained pipeline path and this whole-model path share one
+    implementation, which is what keeps the "stage chain == forward" parity
+    tests (tests/test_stages.py) meaningful.
     """
-    B, T = tokens.shape
+    if return_hidden:
+        x, new_cache = _stage_impl(
+            params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
+            positions=positions, first=True, last=False, remat=remat,
+        )
+        return _norm(x, params["final_norm"], cfg), new_cache
+    return _stage_impl(
+        params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
+        positions=positions, first=True, last=True, remat=remat,
+    )
+
+
+def _logits(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tok"].T.astype(cfg.dtype)
+    else:
+        logits = x @ params["lm_head"]
+    if cfg.logit_cap is not None:
+        logits = cfg.logit_cap * jnp.tanh(logits / cfg.logit_cap)
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# Stage-wise forward (pipeline parallelism)
+# ---------------------------------------------------------------------------
+#
+# A pipeline stage holds a contiguous layer slice (params["layers"] stacked
+# over just those layers) plus, per the plan flags, the embedding
+# (StagePlan.first) and final norm + head (StagePlan.holds_head). Chaining
+# stage_forward over all stages reproduces forward() exactly — that
+# equivalence is the unit test replacing the reference's "logits match the
+# unsharded model" check (reference assembles per-worker nn.Module
+# fragments, ml/graphing.py).
+#
+# Flag mapping for executors: pass ``first=stage.first`` and
+# ``last=stage.last and stage.holds_head``. When embeddings are tied across
+# a multi-stage plan the head lives on stage 0 (holds_head=True there), so
+# the final stage returns hidden and the driver finishes with
+# :func:`head_forward` on stage 0.
+
+
+@partial(jax.jit, static_argnames=("cfg", "first", "last", "remat"))
+def stage_forward(
+    params: dict,
+    cfg: ModelConfig,  # FULL model config (stage layer count comes from params)
+    *,
+    tokens: jax.Array | None = None,  # int32 [B, T] (first stage)
+    hidden: jax.Array | None = None,  # [B, T, D] (later stages)
+    cache: KVCache | None = None,  # this stage's cache (its layers only)
+    attn_mask: jax.Array | None = None,  # bool [B, T]
+    positions: jax.Array | None = None,  # int32 [B, T]
+    first: bool = False,
+    last: bool = False,
+    remat: bool = False,
+):
+    """Run one pipeline stage. Returns ``(out, new_cache)`` where ``out`` is
+    logits when ``last`` else the hidden state to ship to the next stage."""
+    return _stage_impl(
+        params, cfg, tokens=tokens, hidden=hidden, cache=cache,
+        attn_mask=attn_mask, positions=positions, first=first, last=last,
+        remat=remat,
+    )
+
+
+def _stage_impl(
+    params: dict,
+    cfg: ModelConfig,
+    *,
+    tokens: jax.Array | None = None,
+    hidden: jax.Array | None = None,
+    cache: KVCache | None = None,
+    attn_mask: jax.Array | None = None,
+    positions: jax.Array | None = None,
+    first: bool,
+    last: bool,
+    remat: bool,
+):
+    if first:
+        if tokens is None:
+            raise ValueError("first stage requires tokens")
+        B, T = tokens.shape
+    else:
+        if hidden is None:
+            raise ValueError("non-first stage requires hidden")
+        B, T = hidden.shape[:2]
     if attn_mask is None:
         attn_mask = jnp.ones((B, T), bool)
-    if cache is not None:
-        offset = cache.length
-    else:
-        offset = jnp.zeros((B,), jnp.int32)
+    offset = cache.length if cache is not None else jnp.zeros((B,), jnp.int32)
     if positions is None:
         positions = offset[:, None] + jnp.arange(T)[None, :]
 
-    x = params["embed"]["tok"][tokens].astype(cfg.dtype)
-    if cfg.pos == "learned":
-        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+    if first:
+        x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+        if cfg.pos == "learned":
+            x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+    else:
+        x = hidden.astype(cfg.dtype)
 
     cos = sin = None
     if cfg.pos == "rope":
@@ -350,8 +434,7 @@ def forward(
         valid_kv = kv_idx < new_len[:, None]
     else:
         valid_kv = attn_mask
-        S = T
-    bias = _mask_bias(positions, S, valid_kv, cfg.sliding_window)
+    bias = _mask_bias(positions, valid_kv.shape[-1], valid_kv, cfg.sliding_window)
 
     block = _block
     if remat:
@@ -359,36 +442,63 @@ def forward(
             _block, policy=jax.checkpoint_policies.nothing_saveable, static_argnums=(2,)
         )
 
-    if cache is not None:
+    layers = params.get("layers")
+    new_cache = cache
+    if layers is not None:
+        if cache is not None:
 
-        def scan_fn(carry, xs):
-            lp, ck, cv = xs
-            y, ck, cv = block(carry, lp, cfg, cos, sin, bias, ck, cv, offset)
-            return y, (ck, cv)
+            def scan_fn(carry, xs):
+                lp, ck, cv = xs
+                y, ck, cv = block(carry, lp, cfg, cos, sin, bias, ck, cv, offset)
+                return y, (ck, cv)
 
-        x, (new_k, new_v) = lax.scan(
-            scan_fn, x, (params["layers"], cache.k, cache.v)
-        )
-        new_cache = KVCache(k=new_k, v=new_v, length=offset + attn_mask.sum(-1).astype(jnp.int32))
-    else:
+            x, (new_k, new_v) = lax.scan(scan_fn, x, (layers, cache.k, cache.v))
+            new_cache = KVCache(
+                k=new_k,
+                v=new_v,
+                length=offset + attn_mask.sum(-1).astype(jnp.int32),
+            )
+        else:
 
-        def scan_fn(carry, lp):
-            y, _, _ = block(carry, lp, cfg, cos, sin, bias, None, None, None)
-            return y, None
+            def scan_fn(carry, lp):
+                y, _, _ = block(carry, lp, cfg, cos, sin, bias, None, None, None)
+                return y, None
 
-        x, _ = lax.scan(scan_fn, x, params["layers"])
-        new_cache = None
+            x, _ = lax.scan(scan_fn, x, layers)
 
-    x = _norm(x, params["final_norm"], cfg)
-    if return_hidden:
-        return x, new_cache
-    if cfg.tie_embeddings:
-        logits = x @ params["embed"]["tok"].T.astype(cfg.dtype)
-    else:
-        logits = x @ params["lm_head"]
-    if cfg.logit_cap is not None:
-        logits = cfg.logit_cap * jnp.tanh(logits / cfg.logit_cap)
-    return logits, new_cache
+    if last:
+        x = _norm(x, params["final_norm"], cfg)
+        return _logits(params, x, cfg), new_cache
+    return x, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def head_forward(params: dict, hidden: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Final norm + lm head only — serves the tied-embedding hop where the
+    last pipeline stage ships hidden states back to stage 0 for logits
+    (planner.py marks stage 0 ``last`` when embeddings are tied)."""
+    x = _norm(hidden.astype(cfg.dtype), params["final_norm"], cfg)
+    return _logits(params, x, cfg)
+
+
+def slice_stage_params(
+    params: dict, lo: int, hi: int, *, first: bool, holds_head: bool
+) -> dict:
+    """Cut a full parameter tree down to one stage's tree (host-side; used by
+    tests and by single-host multi-stage simulations — real workers load only
+    their slice from the checkpoint, engine/loader.py)."""
+    out: dict = {}
+    if first:
+        out["embed"] = params["embed"]
+    if holds_head:
+        out["final_norm"] = params["final_norm"]
+        if "lm_head" in params:
+            out["lm_head"] = params["lm_head"]
+        if "embed" not in out and "lm_head" not in params:
+            out["embed"] = params["embed"]  # tied head needs the embedding
+    if hi > lo:
+        out["layers"] = jax.tree.map(lambda a: a[lo:hi], params["layers"])
+    return out
 
 
 # ---------------------------------------------------------------------------

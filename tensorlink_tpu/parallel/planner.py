@@ -105,13 +105,21 @@ class MemoryEstimate:
 
 @dataclass
 class StagePlan:
-    """One pipeline stage: a contiguous layer range on one worker's mesh."""
+    """One pipeline stage: a contiguous layer range on one worker's mesh.
+
+    ``first``/``last`` are pipeline *positions*; ``holds_head`` says which
+    stage's params include final_norm + lm_head. They coincide except for
+    tied embeddings over >1 stage, where the head (= the embedding matrix)
+    lives on stage 0: there stages[-1].last=True but holds_head=False, and
+    the driver finishes with ``head_forward`` on stage 0. Executors call
+    ``stage_forward(..., first=s.first, last=s.last and s.holds_head)``."""
 
     worker_id: str
     layer_lo: int
     layer_hi: int
-    first: bool  # holds token (+pos) embedding
-    last: bool  # holds final norm + lm_head (tied → also first==last stage 0)
+    first: bool  # pipeline position 0 — embeds tokens
+    last: bool  # final pipeline position — its output feeds the head
+    holds_head: bool = False  # params include final_norm (+ lm_head)
     mesh_axes: dict[str, int] = field(default_factory=dict)
 
     @property
@@ -208,6 +216,7 @@ def plan_sharding(
             layer_hi=cfg.n_layers,
             first=True,
             last=True,
+            holds_head=True,
             mesh_axes=_mesh_axes_for(cfg, best, training),
         )
         return ShardingPlan(
@@ -252,23 +261,25 @@ def plan_sharding(
     stages = []
     lo = 0
     for i, (w, n_l) in enumerate(zip(chosen, cap_layers)):
+        is_last = i == len(chosen) - 1
         stages.append(
             StagePlan(
                 worker_id=w.node_id,
                 layer_lo=lo,
                 layer_hi=lo + n_l,
                 first=i == 0,
-                last=i == len(chosen) - 1,
+                last=is_last,
+                holds_head=is_last,
                 mesh_axes=_mesh_axes_for(cfg, w, training),
             )
         )
         lo += n_l
-    # tied embeddings: lm_head reuses the stage-0 embedding → last stage must
-    # ship its hidden back to stage 0 for logits; planner marks stage 0 last
-    # as well in that case (the executor handles the hop).
+    # tied embeddings: lm_head IS the stage-0 embedding matrix → the head
+    # lives on stage 0 and the last stage ships hidden back for logits
+    # (head_forward hop; see StagePlan docstring).
     if cfg.tie_embeddings and len(stages) > 1:
-        stages[-1].last = False
-        stages[0].last = True
+        stages[-1].holds_head = False
+        stages[0].holds_head = True
 
     micro = n_micro or max(2 * len(stages), 1) if len(stages) > 1 else (n_micro or 1)
     return ShardingPlan(
@@ -290,9 +301,9 @@ def stage_param_specs(cfg: ModelConfig, stage: StagePlan) -> dict:
     specs = partition_specs(cfg, tensor_axis=tp, expert_axis=ep, fsdp_axis=fs)
     if not stage.first:
         specs["embed"].pop("pos", None)
-        if not (stage.last and cfg.tie_embeddings):
+        if not (stage.holds_head and cfg.tie_embeddings):
             specs.pop("embed", None)
-    if not stage.last:
+    if not stage.holds_head:
         specs.pop("final_norm", None)
         specs.pop("lm_head", None)
     return specs

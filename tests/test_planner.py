@@ -29,7 +29,8 @@ def test_single_worker_fit():
     plan = plan_sharding(cfg, _workers(16), model_name="gpt2", seq_len=1024)
     assert plan.n_stages == 1
     s = plan.stages[0]
-    assert s.first and s.last and s.layer_range == (0, cfg.n_layers)
+    assert s.first and s.last and s.holds_head
+    assert s.layer_range == (0, cfg.n_layers)
 
 
 def test_pipeline_split_contiguous():
@@ -52,8 +53,9 @@ def test_tied_embeddings_pin_head_to_stage0():
     cfg = config_presets()["qwen3-1p7b"]  # tied
     plan = plan_sharding(cfg, _workers(2, 2, 2), seq_len=1024)
     if plan.n_stages > 1:
-        assert plan.stages[0].last  # logits computed where the embedding lives
-        assert not plan.stages[-1].last
+        # logits computed where the embedding lives; pipeline order unchanged
+        assert plan.stages[0].holds_head and not plan.stages[0].last
+        assert plan.stages[-1].last and not plan.stages[-1].holds_head
 
 
 def test_assignment_error():

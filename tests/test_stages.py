@@ -1,0 +1,125 @@
+"""Stage-chaining parity: pipeline stages == whole-model forward.
+
+The reference's equivalent guarantee is implicit (per-worker nn.Module
+fragments assembled back into the original model); here it is an explicit
+numerical test, cheap because JAX programs are deterministic functions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.models import ModelConfig
+from tensorlink_tpu.models.base import KVCache
+from tensorlink_tpu.models.transformer import (
+    forward,
+    head_forward,
+    init_params,
+    slice_stage_params,
+    stage_forward,
+)
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        family="llama",
+        vocab_size=128,
+        d_model=32,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=8,
+        d_ff=64,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+BOUNDARIES = [(0, 2, 4)]  # two stages: layers [0,2) and [2,4)
+
+
+@pytest.mark.parametrize("tie", [True, False])
+def test_stage_chain_matches_forward(tie):
+    cfg = tiny_cfg(tie_embeddings=tie)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, cfg.vocab_size)
+
+    ref, _ = forward(params, toks, cfg)
+
+    (lo0, mid, hi1) = BOUNDARIES[0]
+    s0 = slice_stage_params(params, lo0, mid, first=True, holds_head=False)
+    s1 = slice_stage_params(params, mid, hi1, first=False, holds_head=True)
+    h, _ = stage_forward(s0, cfg, tokens=toks, first=True)
+    out, _ = stage_forward(s1, cfg, hidden=h, last=True)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_tied_head_hop_matches_forward():
+    """Tied embeddings, multi-stage: last stage returns hidden, stage 0
+    computes logits via head_forward (the planner's tied-embedding hop)."""
+    cfg = tiny_cfg(tie_embeddings=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 7), 0, cfg.vocab_size)
+
+    ref, _ = forward(params, toks, cfg)
+
+    s0 = slice_stage_params(params, 0, 2, first=True, holds_head=True)  # has head
+    s1 = slice_stage_params(params, 2, 4, first=False, holds_head=False)
+    h, _ = stage_forward(s0, cfg, tokens=toks, first=True)
+    h, _ = stage_forward(s1, cfg, hidden=h)
+    out = head_forward(s0, h, cfg)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_stage_chain_decode_with_cache():
+    """Per-stage KV caches through prefill + 3 decode steps equals the
+    whole-model cached path."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab_size)
+
+    # whole-model reference with one cache
+    full_cache = KVCache.init(cfg, 1, max_len=16)
+    ref_logits, full_cache = forward(params, toks, cfg, cache=full_cache)
+    ref_steps = []
+    tok = jnp.argmax(ref_logits[:, -1], -1)
+    for _ in range(3):
+        lg, full_cache = forward(params, tok[:, None], cfg, cache=full_cache)
+        tok = jnp.argmax(lg[:, 0], -1)
+        ref_steps.append(np.asarray(tok))
+
+    # staged path with one cache per stage
+    bounds = [(0, 2, True, False), (2, 4, False, True)]
+    stages = [
+        slice_stage_params(params, lo, hi, first=f, holds_head=l)
+        for lo, hi, f, l in bounds
+    ]
+    caches = [
+        KVCache.init(cfg.with_(n_layers=hi - lo), 1, max_len=16)
+        for lo, hi, _, _ in bounds
+    ]
+
+    def staged_step(inp):
+        nonlocal caches
+        x = inp
+        for i, (lo, hi, f, l) in enumerate(bounds):
+            kw = {"tokens": x} if f else {"hidden": x}
+            x, caches[i] = stage_forward(
+                stages[i], cfg, cache=caches[i], first=f, last=l, **kw
+            )
+        return x
+
+    logits = staged_step(toks)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    tok = jnp.argmax(logits[:, -1], -1)
+    for i in range(3):
+        lg = staged_step(tok[:, None])
+        tok = jnp.argmax(lg[:, 0], -1)
+        assert np.asarray(tok) == ref_steps[i]
