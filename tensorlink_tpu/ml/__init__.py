@@ -1,0 +1,12 @@
+"""ML-process executors and the user-facing DistributedModel.
+
+Reference package: tensorlink/ml (module.py, worker.py, validator.py,
+optim.py, graphing.py). The graphing/planner capability lives in
+``tensorlink_tpu.parallel``; models are native JAX programs
+(``tensorlink_tpu.models``), so there is no injector and no module shipping —
+jobs ship a plan + checkpoint reference, workers run compiled programs.
+"""
+
+from tensorlink_tpu.ml.module import DistributedModel
+
+__all__ = ["DistributedModel"]

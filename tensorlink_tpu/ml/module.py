@@ -1,0 +1,405 @@
+"""DistributedModel — the user-facing handle on a distributed job.
+
+Reference: ml/module.py:237 — an ``nn.Module`` wrapper whose offloaded
+submodules RPC forward/backward/generate to workers. Here the model is a
+functional program split into pipeline stages; this class is the driver:
+
+- ``__init__`` requests a job (validator plans over live worker capacity),
+  connects to the assigned workers, and ships each its stage assignment —
+  a plan slice + model config + checkpoint reference, never code.
+- ``forward`` chains FORWARD tensor-requests across the stages (the
+  reference's OffloadedModule chain, module.py:1536), including the
+  tied-embedding head hop.
+- ``generate`` uses the worker-side compiled engine for single-stage jobs
+  (streaming over the TOKEN relay) and drives a session-cached stage chain
+  per token for pipelined jobs.
+
+All waits are bounded (reference MAX_WAIT_TIME=150 s, module.py:58).
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from tensorlink_tpu.core.logging import get_logger
+from tensorlink_tpu.p2p import protocol as proto
+
+MAX_WAIT_TIME = 150.0  # reference ml/module.py:58
+
+
+class JobDeclinedError(RuntimeError):
+    pass
+
+
+class DistributedModel:
+    def __init__(
+        self,
+        model: Any,  # preset name | ModelConfig | checkpoint dir
+        node=None,
+        *,
+        training: bool = False,
+        batch: int = 1,
+        seq_len: int | None = None,
+        n_micro: int | None = None,
+        seed: int = 0,
+        ckpt: str | None = None,
+        start_session: bool = True,
+        **node_kw,
+    ):
+        from tensorlink_tpu.models.base import ModelConfig
+
+        self.log = get_logger("ml.model")
+        self._owns_node = node is None
+        if node is None:
+            from tensorlink_tpu.nodes.runners import UserNode
+
+            node = UserNode(**node_kw).start()
+        self.node = node
+        self.training = training
+
+        # model identity → job spec (resolution happens on the validator)
+        if isinstance(model, ModelConfig):
+            self.model_spec = {"name": "custom", "config": model.to_json()}
+        elif isinstance(model, str) and ("/" in model or model.startswith(".")):
+            self.model_spec = {"name": model, "ckpt": model}
+        else:
+            self.model_spec = {"name": str(model)}
+        if ckpt:
+            self.model_spec["ckpt"] = ckpt
+        self.model_spec["seed"] = seed
+
+        self.spec = {
+            "model": self.model_spec,
+            "batch": batch,
+            "seq_len": seq_len or 2048,
+            "training": training,
+            "n_micro": n_micro,
+        }
+        self.job_id: str | None = None
+        self.plan = None
+        self.cfg = None
+        self.workers: dict[str, str] = {}  # worker plan id -> connected node id
+        if start_session:
+            self._initialize_distribution()
+
+    # ------------------------------------------------------------------
+    # job setup (reference _initialize_distribution → distribute_model,
+    # module.py:987-1021,699)
+    # ------------------------------------------------------------------
+    def _initialize_distribution(self) -> None:
+        from tensorlink_tpu.models.base import ModelConfig
+        from tensorlink_tpu.parallel.planner import ShardingPlan
+
+        reply = self.node.send_request(
+            "request_job", {"spec": self.spec}, timeout=MAX_WAIT_TIME
+        )
+        if not reply.get("accepted"):
+            raise JobDeclinedError(str(reply.get("error", reply)))
+        self.job_id = reply["job_id"]
+        self.plan = ShardingPlan.from_json(reply["plan"])
+        self.model_spec = reply.get("model", self.model_spec)
+        self.cfg = ModelConfig.from_json(self.model_spec["config"])
+
+        # connect to each assigned worker and ship its stage
+        for stage in self.plan.stages:
+            wid = stage.worker_id
+            if wid in self.workers:
+                continue
+            host, port = reply["workers"][wid]
+            conn_id = self.node.connect_to(host, int(port))
+            self.workers[wid] = conn_id
+        for stage in self.plan.stages:
+            resp = self._request(
+                stage.worker_id,
+                proto.MODULE,
+                {
+                    "job_id": self.job_id,
+                    "model": self.model_spec,
+                    "stage": _stage_dict(stage),
+                    "training": self.training,
+                },
+                timeout=MAX_WAIT_TIME,
+            )
+            if not resp.get("ok"):
+                raise RuntimeError(f"stage load failed: {resp}")
+        self.log.info(
+            "job %s distributed over %d stage(s)",
+            self.job_id[:8], self.plan.n_stages,
+        )
+
+    def _request(self, worker_plan_id: str, tag: str, body: dict, timeout=MAX_WAIT_TIME):
+        resp = self.node.send_request(
+            "tensor_request",
+            {
+                "peer": self.workers[worker_plan_id],
+                "tag": tag,
+                "body": body,
+                "timeout": timeout,
+            },
+            timeout=timeout + 10.0,
+        )
+        if isinstance(resp, dict) and resp.get("error"):
+            raise RuntimeError(f"{tag} failed on worker: {resp['error']}")
+        return resp
+
+    # ------------------------------------------------------------------
+    # forward (reference module.py:348-411 + OffloadedModule.forward:1536)
+    # ------------------------------------------------------------------
+    def forward(
+        self,
+        tokens: np.ndarray,  # int [B, T]
+        attn_mask: np.ndarray | None = None,
+        *,
+        session: str | None = None,
+        cache_len: int | None = None,
+    ) -> np.ndarray:
+        """Chain the pipeline stages; returns logits ``[B, T, V]``.
+
+        ``session`` keeps per-stage KV caches alive on the workers between
+        calls (decode); omit it for stateless forward.
+        """
+        assert self.plan is not None
+        x = np.asarray(tokens, np.int32)
+        body_common: dict[str, Any] = {"job_id": self.job_id}
+        if session is not None:
+            body_common["session"] = session
+            body_common["cache_len"] = cache_len or self.spec["seq_len"]
+        if attn_mask is not None:
+            body_common["attn_mask"] = np.asarray(attn_mask, bool)
+
+        out: np.ndarray | None = None
+        for stage in self.plan.stages:
+            body = dict(body_common, op="stage")
+            if stage.first:
+                body["tokens"] = x
+            else:
+                body["hidden"] = out
+            resp = self._request(stage.worker_id, proto.FORWARD, body)
+            out = np.asarray(resp["out"])
+
+        last = self.plan.stages[-1]
+        if not (last.last and last.holds_head):
+            head_stage = next(s for s in self.plan.stages if s.holds_head)
+            resp = self._request(
+                head_stage.worker_id,
+                proto.FORWARD,
+                {"job_id": self.job_id, "op": "head", "hidden": out},
+            )
+            out = np.asarray(resp["out"])
+        return out
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------
+    # generate (reference module.py:763-769, OffloadedModule.generate:1496)
+    # ------------------------------------------------------------------
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        *,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_ids: Sequence[int] = (),
+        seed: int = 0,
+        stream_cb: Callable[[list[int]], None] | None = None,
+    ) -> list[list[int]]:
+        assert self.plan is not None
+        if self.plan.n_stages == 1:
+            return self._generate_remote(
+                prompts, max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
+                stream_cb=stream_cb,
+            )
+        return self._generate_pipelined(
+            prompts, max_new_tokens=max_new_tokens, temperature=temperature,
+            eos_ids=eos_ids, seed=seed, stream_cb=stream_cb,
+        )
+
+    def _generate_remote(
+        self, prompts, *, max_new_tokens, temperature, top_k, top_p,
+        eos_ids, seed, stream_cb,
+    ) -> list[list[int]]:
+        """Whole model on one worker → its compiled engine does the loop."""
+        stage = self.plan.stages[0]
+        body = {
+            "job_id": self.job_id,
+            "prompts": [list(map(int, p)) for p in prompts],
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "eos_ids": list(eos_ids),
+            "seed": seed,
+        }
+        stream_id = None
+        if stream_cb is not None:
+            stream_id = secrets.token_hex(8)
+            body["stream"] = stream_id
+
+        if stream_id is None:
+            resp = self._request(stage.worker_id, proto.GENERATE, body)
+            return [list(map(int, s)) for s in resp["sequences"]]
+
+        # streaming: issue the request in a thread so we can drain tokens
+        import threading
+
+        result: dict = {}
+
+        def issue():
+            try:
+                result["resp"] = self._request(stage.worker_id, proto.GENERATE, body)
+            except Exception as e:  # surfaced after the stream drains
+                result["err"] = e
+
+        t = threading.Thread(target=issue, daemon=True)
+        t.start()
+        while True:
+            tk = self.node.send_request(
+                "next_tokens",
+                {"stream": stream_id, "timeout": 30.0},
+                timeout=35.0,
+            )
+            if tk.get("tokens"):
+                stream_cb(list(tk["tokens"]))
+            if tk.get("done"):
+                break
+            if tk.get("timeout") and not t.is_alive():
+                break
+        t.join(timeout=MAX_WAIT_TIME)
+        if "err" in result:
+            raise result["err"]
+        return [list(map(int, s)) for s in result["resp"]["sequences"]]
+
+    def _generate_pipelined(
+        self, prompts, *, max_new_tokens, temperature, eos_ids, seed, stream_cb,
+    ) -> list[list[int]]:
+        """Host-driven decode across stages with per-stage session caches
+        (net-new vs the reference, which cannot generate across shards
+        without re-running the full forward per token)."""
+        prompts = [list(map(int, p)) for p in prompts]
+        B = len(prompts)
+        T = max(len(p) for p in prompts)
+        toks = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), bool)
+        for i, p in enumerate(prompts):
+            toks[i, : len(p)] = p
+            mask[i, : len(p)] = True
+
+        session = secrets.token_hex(8)
+        cache_len = min(self.spec["seq_len"], T + max_new_tokens)
+        rng = np.random.default_rng(seed)
+        eos = set(int(e) for e in eos_ids)
+
+        logits = self.forward(
+            toks, mask, session=session, cache_len=cache_len
+        )
+        last_idx = mask.sum(-1) - 1
+        step_logits = logits[np.arange(B), last_idx]
+
+        seqs: list[list[int]] = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        tok = _sample_host(step_logits, temperature, rng)
+        for step in range(max_new_tokens):
+            emitted = []
+            for i in range(B):
+                if not done[i]:
+                    seqs[i].append(int(tok[i]))
+                    emitted.append(int(tok[i]))
+                done[i] |= int(tok[i]) in eos
+            if stream_cb is not None and emitted:
+                stream_cb(emitted)
+            if done.all() or step == max_new_tokens - 1:
+                break
+            logits = self.forward(
+                tok[:, None].astype(np.int32),
+                session=session,
+                cache_len=cache_len,
+            )
+            tok = _sample_host(logits[:, 0], temperature, rng)
+
+        # drop the session caches on the workers
+        for stage in self.plan.stages:
+            try:
+                self._request(
+                    stage.worker_id, proto.FORWARD,
+                    {"job_id": self.job_id, "op": "end_session",
+                     "session": session},
+                    timeout=10.0,
+                )
+            except Exception:
+                pass
+        return seqs
+
+    # ------------------------------------------------------------------
+    # parameters (reference module.py:577-650 downloads state dicts)
+    # ------------------------------------------------------------------
+    def parameters(self) -> list[dict]:
+        """Pull each stage's parameter tree (numpy) from its worker."""
+        out = []
+        for stage in self.plan.stages:
+            resp = self._request(
+                stage.worker_id, proto.PARAMS_REQ, {"job_id": self.job_id}
+            )
+            out.append(resp["params"])
+        return out
+
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        """Release the job: workers drop the stage runtime and free the
+        reserved capacity (reference SHUTDOWN-JOB, worker_thread.py:92-95;
+        the reference's users leak reservations on exit — see Keeper
+        cleanup gap, SURVEY §5 failure-detection notes)."""
+        if self.job_id is None:
+            return
+        peers = set(self.workers.values())
+        try:
+            peers |= set(self.node.send_request("validators", timeout=10.0))
+        except Exception:
+            pass
+        for conn_id in peers:
+            try:
+                self.node.send_request(
+                    "send_control",
+                    {"peer": conn_id, "tag": proto.JOB_SHUTDOWN,
+                     "body": {"job_id": self.job_id}},
+                    timeout=10.0,
+                )
+            except Exception:
+                pass
+        self.job_id = None
+
+    def close(self) -> None:
+        self.shutdown()
+        if self._owns_node:
+            self.node.stop()
+
+    def __enter__(self) -> "DistributedModel":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _stage_dict(stage) -> dict:
+    from dataclasses import asdict
+
+    return asdict(stage)
+
+
+def _sample_host(logits: np.ndarray, temperature: float, rng) -> np.ndarray:
+    """Greedy / temperature sampling on host (pipelined decode only; the
+    single-stage path samples on device, engine/sampling.py)."""
+    if temperature <= 0.0:
+        return np.argmax(logits, -1).astype(np.int32)
+    x = logits.astype(np.float64) / temperature
+    x -= x.max(-1, keepdims=True)
+    p = np.exp(x)
+    p /= p.sum(-1, keepdims=True)
+    return np.array(
+        [rng.choice(len(row), p=row) for row in p], np.int32
+    )

@@ -1,0 +1,136 @@
+"""DistributedValidator — the ML-process planner on a validator node.
+
+Reference: ml/validator.py:122 (``DistributedValidator.check_node`` polling
+``get_jobs`` every tick, inspect_model → ModelParser → send_job_request).
+Here job requests arrive as work events; planning = resolve the model config
+(preset registry or HF checkpoint config) + ``plan_sharding`` over the live
+worker capacities, then hand the job back to the network process to recruit
+(roles.py `cmd_create_job`).
+"""
+
+from __future__ import annotations
+
+import uuid
+
+from tensorlink_tpu.core.logging import get_logger
+
+
+class DistributedValidator:
+    def __init__(self, node):
+        self.node = node
+        self.bridge = node.bridge
+        self.log = get_logger(f"ml.validator{node.config.duplicate}")
+        # model demand tracking (reference logs/models.json, ml/utils.py:663)
+        self.demand: dict[str, int] = {}
+
+    def run(self) -> None:
+        while True:
+            item = self.bridge.get_work(timeout=1.0)
+            if item is None:
+                continue
+            kind, payload = item
+            if kind == "_stop":
+                return
+            try:
+                if kind == "job_req":
+                    self._plan_job(payload)
+                elif kind == "token":
+                    pass  # API streaming relay lands here in the serving layer
+                else:
+                    self.log.warning("unhandled work kind %s", kind)
+            except Exception:
+                self.log.exception("work %s failed", kind)
+                if kind == "job_req":
+                    self.bridge.request(
+                        "decline_job",
+                        {"req_id": payload.get("req_id"), "error": "planning failed"},
+                    )
+
+    # -- planning -------------------------------------------------------
+    def _resolve_config(self, model_spec: dict):
+        """Model identity → ModelConfig. Accepts an explicit config dict, a
+        preset name (registry), or a checkpoint dir with an HF config.json
+        (reference resolves HF names via AutoConfig, ml/validator.py:367)."""
+        from tensorlink_tpu.models.base import ModelConfig
+        from tensorlink_tpu.models.registry import config_presets
+
+        if model_spec.get("config"):
+            return ModelConfig.from_json(model_spec["config"])
+        name = model_spec.get("name", "")
+        presets = config_presets()
+        if name in presets:
+            return presets[name]
+        if model_spec.get("ckpt"):
+            from tensorlink_tpu.engine.loader import CheckpointReader
+            from tensorlink_tpu.models.registry import config_from_hf
+
+            return config_from_hf(CheckpointReader(model_spec["ckpt"]).config())
+        raise ValueError(f"cannot resolve model {name!r}")
+
+    def _plan_job(self, p: dict) -> None:
+        from tensorlink_tpu.parallel.planner import (
+            AssignmentError,
+            WorkerCapacity,
+            plan_sharding,
+        )
+
+        spec = p["spec"]
+        model_spec = dict(spec.get("model", {}))
+        name = model_spec.get("name", "")
+        self.demand[name] = self.demand.get(name, 0) + 1
+        try:
+            cfg = self._resolve_config(model_spec)
+        except Exception as e:
+            self.bridge.request(
+                "decline_job", {"req_id": p["req_id"], "error": str(e)}
+            )
+            return
+        model_spec["config"] = cfg.to_json()
+
+        stats = self.bridge.request("stats_workers", timeout=15.0)
+        workers = [
+            WorkerCapacity(
+                node_id=s["id"],
+                hbm_bytes=float(s.get("free_bytes", s.get("hbm_bytes", 0.0))),
+                n_devices=int(s.get("n_devices", 1)),
+            )
+            for s in stats
+        ]
+        try:
+            plan = plan_sharding(
+                cfg,
+                workers,
+                model_name=name,
+                batch=int(spec.get("batch", 1)),
+                seq_len=int(spec.get("seq_len", 2048)),
+                training=bool(spec.get("training", False)),
+                n_micro=spec.get("n_micro"),
+            )
+        except AssignmentError as e:
+            self.log.info("declining job %s: %s", name, e)
+            self.bridge.request(
+                "decline_job", {"req_id": p["req_id"], "error": str(e)}
+            )
+            return
+
+        # per-worker byte estimate for the recruit capacity check
+        total_layers = max(cfg.n_layers, 1)
+        stage_bytes = {
+            s.worker_id: plan.estimate.total * (s.layer_hi - s.layer_lo) / total_layers
+            for s in plan.stages
+        }
+        job = {
+            "job_id": uuid.uuid4().hex,
+            "model": model_spec,
+            "plan": plan.to_json(),
+            "stage_bytes": stage_bytes,
+        }
+        result = self.bridge.request(
+            "create_job",
+            {"req_id": p["req_id"], "user_id": p.get("user_id"), "job": job},
+            timeout=30.0,
+        )
+        self.log.info(
+            "job %s (%s): accepted=%s stages=%d",
+            job["job_id"][:8], name, result.get("accepted"), plan.n_stages,
+        )

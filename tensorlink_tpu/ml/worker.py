@@ -1,0 +1,361 @@
+"""DistributedWorker — the ML-process executor on a worker node.
+
+Reference: ml/worker.py:147 (``DistributedWorker``), a 1 kHz poll loop over
+five IPC queues per module (main_loop:1349-1437). Here the executor blocks on
+one event queue and runs **compiled** programs:
+
+- a *stage* job executes ``stage_forward`` over its contiguous layer slice
+  (sharded over the worker's local mesh when it has >1 device),
+- a whole-model job additionally serves ``generate`` through the
+  :class:`~tensorlink_tpu.engine.generate.GenerationEngine` (compiled
+  prefill/decode pair) with per-token streaming over the TOKEN relay,
+- decode sessions keep per-stage KV caches on device, keyed by session id —
+  the explicit replacement for torch's implicit autograd/cache state
+  (reference stores ``intermediates`` per micro-batch, module.py:1543).
+
+Weights come from a checkpoint reference (selective per-stage safetensors
+reads, engine/loader.py — the reference's selective shard loading idea,
+ml/worker.py:542-638) or from seeded random init for tests/benchmarks; no
+pickled modules ever cross the wire (reference trusted mode,
+ml/worker.py:473-476, deliberately dropped — SURVEY §7.4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from tensorlink_tpu.core.logging import get_logger
+from tensorlink_tpu.p2p import protocol as proto
+
+
+@dataclass
+class StageRuntime:
+    """One loaded job stage: config + params + live decode sessions."""
+
+    job_id: str
+    cfg: Any  # ModelConfig
+    stage: dict  # StagePlan as dict (layer_lo/hi, first, last, holds_head)
+    params: Any
+    mesh: Any = None
+    engine: Any = None  # GenerationEngine for whole-model jobs
+    sessions: dict[str, Any] = field(default_factory=dict)  # session -> KVCache
+    training: bool = False
+    # L6 state (activation store for cross-host backward) lives here later
+    saved: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def n_layers(self) -> int:
+        return self.stage["layer_hi"] - self.stage["layer_lo"]
+
+    @property
+    def whole_model(self) -> bool:
+        return (
+            self.stage["first"]
+            and self.stage["last"]
+            and self.stage["holds_head"]
+        )
+
+
+class DistributedWorker:
+    """Event-driven executor; one instance per WorkerNode."""
+
+    def __init__(self, node):
+        self.node = node
+        self.bridge = node.bridge
+        self.log = get_logger(f"ml.worker{node.config.duplicate}")
+        self.jobs: dict[str, StageRuntime] = {}
+        self._lock = threading.Lock()
+
+    # -- capacity -------------------------------------------------------
+    def capacity(self) -> dict:
+        """What this worker advertises (reference STATS-RESPONSE payload,
+        worker_thread.py:245-268): HBM bytes + device count."""
+        import jax
+
+        devs = jax.local_devices()
+        cap = 0.0
+        for d in devs:
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            cap += float(stats.get("bytes_limit", 0.0))
+        if not cap:
+            gb = self.node.config.ml.max_memory_gb or 4.0
+            cap = gb * 1e9 * len(devs)
+        if self.node.config.ml.max_memory_gb:
+            cap = min(cap, self.node.config.ml.max_memory_gb * 1e9 * len(devs))
+        return {
+            "hbm_bytes": cap,
+            "n_devices": len(devs),
+            "platform": devs[0].platform,
+            "training": True,
+        }
+
+    # -- main loop ------------------------------------------------------
+    def run(self) -> None:
+        while True:
+            item = self.bridge.get_work(timeout=1.0)
+            if item is None:
+                continue
+            kind, payload = item
+            if kind == "_stop":
+                return
+            try:
+                self._handle(kind, payload)
+            except Exception as e:
+                self.log.exception("work %s failed", kind)
+                rid, peer = payload.get("rid"), payload.get("peer")
+                if rid and peer:
+                    resp_tag = {
+                        proto.FORWARD: proto.FORWARD_RESP,
+                        proto.BACKWARD: proto.BACKWARD_RESP,
+                        proto.GENERATE: proto.GENERATE_RESP,
+                        proto.OPTIMIZER: proto.OPTIMIZER_RESP,
+                        proto.PARAMS_REQ: proto.PARAMETERS,
+                        "load_stage": proto.MODULE_LOADED,
+                    }.get(kind, proto.FORWARD_RESP)
+                    self._respond(peer, resp_tag, rid, {"error": f"{type(e).__name__}: {e}"})
+
+    def _handle(self, kind: str, p: dict) -> None:
+        if kind == "load_stage":
+            self._load_stage(p)
+        elif kind == proto.FORWARD:
+            self._forward(p)
+        elif kind == proto.GENERATE:
+            self._generate(p)
+        elif kind == proto.PARAMS_REQ:
+            self._params_req(p)
+        elif kind == proto.TRAIN_MODE:
+            self._train_mode(p)
+        elif kind in (proto.BACKWARD, proto.OPTIMIZER):
+            # L6 training path; fail fast instead of letting the requester
+            # wait out the full tensor-request timeout
+            raise NotImplementedError(f"{kind} not supported yet (training path)")
+        elif kind == "shutdown_job":
+            with self._lock:
+                self.jobs.pop(p.get("job_id", ""), None)
+        elif kind == "token":
+            pass  # token relays are user/validator side
+        else:
+            self.log.warning("unhandled work kind %s", kind)
+
+    def _respond(self, peer: str, tag: str, rid: str, body: dict) -> None:
+        self.bridge.request(
+            "respond", {"peer": peer, "tag": tag, "rid": rid, "body": body}
+        )
+
+    # -- loading --------------------------------------------------------
+    def _load_stage(self, p: dict) -> None:
+        import jax
+
+        from tensorlink_tpu.models.base import ModelConfig
+        from tensorlink_tpu.models.transformer import (
+            init_params,
+            slice_stage_params,
+        )
+
+        t0 = time.time()
+        job_id = p["job_id"]
+        model = p["model"]
+        stage = p["stage"]
+        cfg = ModelConfig.from_json(model["config"])
+        lo, hi = stage["layer_lo"], stage["layer_hi"]
+        first, holds_head = stage["first"], stage["holds_head"]
+
+        if model.get("ckpt"):
+            from tensorlink_tpu.engine.loader import load_params
+
+            _, full = load_params(model["ckpt"], cfg, layer_range=(lo, hi))
+            # loader returns embed/final_norm/head too; keep what the stage owns
+            params = {"layers": full["layers"]} if hi > lo else {}
+            if first:
+                params["embed"] = full["embed"]
+            if holds_head:
+                params["final_norm"] = full["final_norm"]
+                if "lm_head" in full:
+                    params["lm_head"] = full["lm_head"]
+                elif "embed" not in params:
+                    params["embed"] = full["embed"]
+        else:
+            seed = int(model.get("seed", 0))
+            full = init_params(cfg, jax.random.PRNGKey(seed))
+            params = slice_stage_params(
+                full, lo, hi, first=first, holds_head=holds_head
+            )
+            del full
+
+        rt = StageRuntime(
+            job_id=job_id,
+            cfg=cfg,
+            stage=stage,
+            params=params,
+            training=bool(p.get("training", False)),
+        )
+        if rt.whole_model:
+            from tensorlink_tpu.engine.generate import GenerationEngine
+
+            ml_cfg = self.node.config.ml
+            rt.engine = GenerationEngine(
+                cfg,
+                params,
+                max_seq_len=min(cfg.max_seq_len, ml_cfg.max_seq_len),
+                seq_buckets=ml_cfg.seq_buckets,
+                batch_buckets=ml_cfg.batch_buckets,
+            )
+        with self._lock:
+            self.jobs[job_id] = rt
+        self.log.info(
+            "loaded %s layers [%d,%d) first=%s head=%s in %.1fs",
+            model.get("name", "?"), lo, hi, first, holds_head, time.time() - t0,
+        )
+        self._respond(
+            p["peer"], proto.MODULE_LOADED, p["rid"],
+            {"job_id": job_id, "ok": True, "n_layers": hi - lo},
+        )
+
+    def _runtime(self, job_id: str) -> StageRuntime:
+        rt = self.jobs.get(job_id)
+        if rt is None:
+            raise KeyError(f"job {job_id} not loaded")
+        return rt
+
+    # -- forward --------------------------------------------------------
+    def _forward(self, p: dict) -> None:
+        """op="stage": run my layer slice (optionally with a decode-session
+        KV cache). op="head": final norm + logits (tied-embedding hop)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tensorlink_tpu.models.base import KVCache
+        from tensorlink_tpu.models.transformer import head_forward, stage_forward
+
+        rt = self._runtime(p["job_id"])
+        op = p.get("op", "stage")
+        if op == "end_session":
+            rt.sessions.pop(p.get("session"), None)
+            self._respond(p["peer"], proto.FORWARD_RESP, p["rid"], {"ok": True})
+            return
+        if op == "head":
+            hidden = jnp.asarray(np.asarray(p["hidden"]))
+            logits = head_forward(rt.params, hidden, rt.cfg)
+            self._respond(
+                p["peer"], proto.FORWARD_RESP, p["rid"],
+                {"out": np.asarray(jax.device_get(logits))},
+            )
+            return
+
+        stage = rt.stage
+        first = stage["first"]
+        apply_head = stage["last"] and stage["holds_head"]
+        kw: dict[str, Any] = {}
+        if first:
+            kw["tokens"] = jnp.asarray(np.asarray(p["tokens"], np.int32))
+        else:
+            kw["hidden"] = jnp.asarray(np.asarray(p["hidden"]))
+        if p.get("attn_mask") is not None:
+            kw["attn_mask"] = jnp.asarray(np.asarray(p["attn_mask"], bool))
+
+        session = p.get("session")
+        cache = None
+        if session is not None:
+            cache = rt.sessions.get(session)
+            if cache is None:
+                batch = (kw.get("tokens") if first else kw["hidden"]).shape[0]
+                scfg = rt.cfg.with_(n_layers=rt.n_layers)
+                cache = KVCache.init(
+                    scfg, batch, max_len=int(p.get("cache_len", rt.cfg.max_seq_len))
+                )
+        out, new_cache = stage_forward(
+            rt.params, rt.cfg, cache=cache, first=first, last=apply_head, **kw
+        )
+        if session is not None:
+            rt.sessions[session] = new_cache
+        self._respond(
+            p["peer"], proto.FORWARD_RESP, p["rid"],
+            {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
+        )
+
+    # -- generate (whole-model jobs) ------------------------------------
+    def _generate(self, p: dict) -> None:
+        """Compiled generation on a whole-model job. Streams token ids over
+        the TOKEN relay when ``stream`` is set (reference worker streamer,
+        ml/worker.py:359-447), then resolves with the full sequences."""
+        from tensorlink_tpu.engine.sampling import SamplingParams
+
+        rt = self._runtime(p["job_id"])
+        if rt.engine is None:
+            raise ValueError("generate requires a whole-model stage")
+        prompts = [list(map(int, row)) for row in p["prompts"]]
+        sampling = SamplingParams.make(
+            temperature=float(p.get("temperature", 0.0)),
+            top_k=int(p.get("top_k", 0)),
+            top_p=float(p.get("top_p", 1.0)),
+        )
+        stream_id = p.get("stream")
+        peer = p["peer"]
+
+        def stream_cb(emitted):
+            toks = [t for t in emitted if t is not None]
+            if toks:
+                # fire-and-forget: a blocking round-trip here would add a
+                # full IPC latency to every decode step
+                self.bridge.notify(
+                    "send_token",
+                    {"peer": peer, "stream": stream_id, "tokens": toks},
+                )
+
+        if stream_id:
+            result = rt.engine.generate(
+                prompts,
+                max_new_tokens=int(p.get("max_new_tokens", 128)),
+                sampling=sampling,
+                eos_ids=p.get("eos_ids", ()),
+                seed=int(p.get("seed", 0)),
+                stream_cb=stream_cb,
+            )
+            self.bridge.request(
+                "send_token",
+                {"peer": peer, "stream": stream_id, "tokens": [], "done": True},
+            )
+        else:
+            result = rt.engine.generate_compiled(
+                prompts,
+                max_new_tokens=int(p.get("max_new_tokens", 128)),
+                sampling=sampling,
+                eos_ids=p.get("eos_ids", ()),
+                seed=int(p.get("seed", 0)),
+            )
+        self._respond(
+            peer, proto.GENERATE_RESP, p["rid"],
+            {
+                "sequences": [list(map(int, s)) for s in result.sequences],
+                "finished": list(map(bool, result.finished)),
+            },
+        )
+
+    # -- parameters -----------------------------------------------------
+    def _params_req(self, p: dict) -> None:
+        """Ship this stage's parameters back (reference parameter download,
+        ml/worker.py:1394-1413 writes a file; here it is one bulk frame)."""
+        import jax
+
+        rt = self._runtime(p["job_id"])
+        host_params = jax.tree.map(
+            lambda a: np.asarray(jax.device_get(a)), rt.params
+        )
+        self._respond(p["peer"], proto.PARAMETERS, p["rid"], {"params": host_params})
+
+    def _train_mode(self, p: dict) -> None:
+        rt = self._runtime(p["job_id"])
+        rt.training = bool(p.get("training", True))
+        self._respond(
+            p["peer"], proto.TRAIN_MODE_ACK, p["rid"],
+            {"job_id": rt.job_id, "training": rt.training},
+        )

@@ -77,6 +77,22 @@ class ModelConfig:
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
 
+    def to_json(self) -> dict:
+        """JSON-safe dict (job specs carry the config over the wire — the
+        reference ships whole serialized modules instead, torch_node.py:879)."""
+        from dataclasses import asdict
+
+        d = asdict(self)
+        d["dtype"] = jnp.dtype(self.dtype).name
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ModelConfig":
+        d = dict(d)
+        if isinstance(d.get("dtype"), str):
+            d["dtype"] = jnp.dtype(d["dtype"]).type
+        return cls(**d)
+
     def param_count(self) -> int:
         """Analytic parameter count (used by the sharding planner's memory
         estimator — TPU analogue of reference ml/utils.py:36-124)."""

@@ -1,0 +1,405 @@
+"""Role servers — the network-process half of each node.
+
+Reference equivalents: WorkerThread / ValidatorThread / UserThread
+(nodes/worker_thread.py, validator_thread.py, user_thread.py) running inside
+the spawned networking process. Redesigned around asyncio + the IPC bridge:
+wire handlers post work events; the ML process answers with commands; no
+shared-memory parking lots or poll loops.
+
+Job lifecycle (asyncio version of SURVEY §3.2):
+
+1. user ML → ``request_job`` cmd → UserServer sends JOB_REQ to a validator.
+2. ValidatorServer posts ``job_req`` work → DistributedValidator plans
+   (sharding planner) → ``recruit`` cmd → ValidatorServer asks each chosen
+   worker JOB_REQ (3 s accept window, reference validator_thread.py:845-887);
+   workers reserve capacity and accept.
+3. Validator replies to the user's JOB_REQ with the plan + worker addresses
+   and stores the job in the DHT.
+4. The user connects to each worker and ships MODULE (plan slice + model
+   config + checkpoint ref — never code; reference ships serialized modules,
+   torch_node.py:879-924). Worker ML loads and the MODULE request resolves
+   with MODULE_LOADED.
+5. FORWARD / BACKWARD / GENERATE are correlated tensor requests straight to
+   the owning worker.
+
+No jax imports in this module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any
+
+from tensorlink_tpu.core.config import NodeConfig
+from tensorlink_tpu.nodes.ipc import BridgeQueues, NetBridge
+from tensorlink_tpu.p2p import protocol as proto
+from tensorlink_tpu.p2p.connection import Connection
+from tensorlink_tpu.p2p.tensor_node import TensorNode
+
+RECRUIT_TIMEOUT = 3.0  # reference validator_thread.py:871
+JOB_REQ_TIMEOUT = 120.0  # reference user_thread.py:406
+MODULE_LOAD_TIMEOUT = 150.0  # reference MAX_WAIT_TIME ml/module.py:58
+
+
+class RoleServer(TensorNode):
+    """TensorNode + IPC command surface shared by all roles."""
+
+    def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
+        super().__init__(
+            cfg.role,
+            host=cfg.effective_host(),
+            port=cfg.port or 0,
+            key_dir=cfg.key_dir,
+            local_test=cfg.local_test,
+            identity_name=cfg.role + cfg.duplicate,
+        )
+        self.cfg = cfg
+        self.bridge = NetBridge(queues)
+        self.work = queues.work  # TensorNode.post_work target
+        self.capacity: dict[str, Any] = {
+            "hbm_bytes": 0.0,
+            "n_devices": 0,
+            "slice_id": "",
+            "role": cfg.role,
+            "training": True,
+        }
+        self.reserved: dict[str, float] = {}  # job_id -> reserved bytes
+        self.register(proto.STATS_REQUEST, self._handle_stats_request)
+
+    # -- entrypoint (net process main) ----------------------------------
+    def main(self) -> None:
+        self.start()  # event loop thread + listener
+        info = {"port": self.port, "id": self.node_id, "role": self.role}
+        self.bridge.q.resp.put((-1, True, info))
+        fut = asyncio.run_coroutine_threadsafe(
+            self.bridge.serve(self.dispatch), self._loop
+        )
+        try:
+            fut.result()  # blocks until _stop
+        finally:
+            self.stop()
+
+    # -- command dispatch ----------------------------------------------
+    async def dispatch(self, verb: str, payload: Any) -> Any:
+        fn = getattr(self, f"cmd_{verb}", None)
+        if fn is None:
+            raise ValueError(f"unknown ipc verb {verb!r}")
+        return await fn(payload or {})
+
+    def _conn(self, peer: str) -> Connection:
+        conn = self.connections.get(peer)
+        if conn is None:
+            raise ConnectionError(f"no connection to {peer[:12]}")
+        return conn
+
+    async def cmd_status(self, p) -> dict:
+        return self.status()
+
+    async def cmd_validators(self, p) -> list[str]:
+        return self.validator_ids()
+
+    async def cmd_bootstrap(self, p) -> int:
+        seeds = [tuple(s) for s in p.get("seeds", self.cfg.seed_validators)]
+        return await self.bootstrap(seeds, retries=p.get("retries", 3))
+
+    async def cmd_connect(self, p) -> str:
+        conn = await self.connect(p["host"], p["port"])
+        return conn.node_id
+
+    async def cmd_dht_get(self, p):
+        return await self.dht_query(p["key"])
+
+    async def cmd_dht_store(self, p) -> bool:
+        await self.dht_store_global(p["key"], p["value"])
+        return True
+
+    async def cmd_set_capacity(self, p) -> bool:
+        self.capacity.update(p)
+        return True
+
+    async def cmd_tensor_request(self, p) -> dict:
+        """Generic correlated array-carrying request to a peer."""
+        reply = await self.tensor_request(
+            self._conn(p["peer"]), p["tag"], p.get("body", {}),
+            timeout=p.get("timeout"),
+        )
+        reply.pop("_rid", None)
+        reply.pop("_resp", None)
+        return reply
+
+    async def cmd_send_tensor(self, p) -> bool:
+        await self.send_tensor(self._conn(p["peer"]), p["tag"], p.get("body", {}))
+        return True
+
+    async def cmd_respond(self, p) -> bool:
+        """Resolve an earlier inbound tensor request (ML finished the work)."""
+        await self.tensor_respond(
+            self._conn(p["peer"]), p["tag"], {"_rid": p["rid"]}, p.get("body", {})
+        )
+        return True
+
+    async def cmd_send_control(self, p) -> bool:
+        """Generic fire-and-forget control frame to a peer."""
+        await self._conn(p["peer"]).send_control(p["tag"], p.get("body", {}))
+        return True
+
+    async def cmd_send_token(self, p) -> bool:
+        await self.send_token(
+            self._conn(p["peer"]), p["stream"], p.get("tokens", []),
+            done=p.get("done", False),
+        )
+        return True
+
+    async def cmd_next_tokens(self, p):
+        try:
+            tokens, done = await self.next_tokens(
+                p["stream"], timeout=p.get("timeout", 30.0)
+            )
+            if done:
+                self.drop_stream(p["stream"])
+            return {"tokens": tokens, "done": done}
+        except asyncio.TimeoutError:
+            return {"tokens": [], "done": False, "timeout": True}
+
+    # -- stats ----------------------------------------------------------
+    async def _handle_stats_request(self, conn, kind, tag, body) -> None:
+        free = self.capacity["hbm_bytes"] - sum(self.reserved.values())
+        await self.respond(
+            conn, proto.STATS_RESPONSE, body,
+            {**self.capacity, "free_bytes": max(free, 0.0), "id": self.node_id},
+        )
+
+
+class WorkerServer(RoleServer):
+    """Accepts jobs when capacity allows; relays tensor work to the ML
+    process (reference WorkerThread, nodes/worker_thread.py:14)."""
+
+    def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
+        super().__init__(cfg, queues)
+        self.jobs: dict[str, dict] = {}
+        self.register(proto.JOB_REQ, self._handle_job_req)
+        self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
+        self.register(proto.MODULE, self._handle_module)
+        for tag in (
+            proto.FORWARD, proto.BACKWARD, proto.GENERATE,
+            proto.PARAMS_REQ, proto.OPTIMIZER, proto.TRAIN_MODE,
+        ):
+            self.register(tag, self._relay_to_ml)
+
+    async def _handle_job_req(self, conn, kind, tag, body) -> None:
+        """Validator recruiting (reference worker_thread.py:128-166):
+        accept iff free capacity covers the stage estimate."""
+        est = float(body.get("est_bytes", 0.0))
+        free = self.capacity["hbm_bytes"] - sum(self.reserved.values())
+        job_id = body.get("job_id", "")
+        if est and est > free:
+            await self.respond(conn, proto.JOB_DECLINE, body, {"job_id": job_id})
+            return
+        self.reserved[job_id] = est
+        self.jobs[job_id] = {"stage": body.get("stage"), "t0": time.time()}
+        await self.respond(
+            conn, proto.JOB_ACCEPT, body,
+            {"job_id": job_id, "id": self.node_id,
+             "addr": [self.host, self.port]},
+        )
+
+    async def _handle_job_shutdown(self, conn, kind, tag, body) -> None:
+        job_id = body.get("job_id", "")
+        self.reserved.pop(job_id, None)
+        self.jobs.pop(job_id, None)
+        self.post_work("shutdown_job", {"job_id": job_id})
+
+    async def _handle_module(self, conn, kind, tag, body) -> None:
+        """A stage assignment arrives (plan + model config + ckpt ref).
+        ML loads it and resolves the request via the ``respond`` cmd."""
+        self.post_work(
+            "load_stage",
+            {**{k: v for k, v in body.items() if k not in ("_rid",)},
+             "peer": conn.node_id, "rid": body.get("_rid")},
+        )
+
+    async def _relay_to_ml(self, conn, kind, tag, body) -> None:
+        rid = body.pop("_rid", None)
+        body.pop("_resp", None)
+        self.post_work(tag, {**body, "peer": conn.node_id, "rid": rid})
+
+
+class ValidatorServer(RoleServer):
+    """Job orchestration (reference ValidatorThread,
+    nodes/validator_thread.py:22). Plans come from the validator ML process;
+    this side recruits workers and answers users."""
+
+    def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
+        super().__init__(cfg, queues)
+        self.jobs: dict[str, dict] = {}
+        self._job_requests: dict[str, tuple[Connection, dict]] = {}
+        self.register(proto.JOB_REQ, self._handle_job_req)
+        self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
+
+    async def _handle_job_shutdown(self, conn, kind, tag, body) -> None:
+        """User ends a job: drop validator state + DHT record and make sure
+        the workers released it (idempotent on their side)."""
+        await self.cmd_shutdown_job({"job_id": body.get("job_id", "")})
+
+    async def _handle_job_req(self, conn, kind, tag, body) -> None:
+        """A user asks for a model (reference validator_thread.py:583-609).
+        Hand the spec to the validator ML process for planning."""
+        req_id = uuid.uuid4().hex
+        self._job_requests[req_id] = (conn, body)
+        self.post_work(
+            "job_req",
+            {"spec": body.get("spec", {}), "user_id": conn.node_id,
+             "req_id": req_id},
+        )
+
+    async def cmd_stats_workers(self, p) -> list[dict]:
+        """Fan STATS_REQUEST out to connected workers (reference
+        validator_thread.py:889-928)."""
+        out = []
+        for nid in list(self.connections):
+            if self.roles.get(nid) != "worker":
+                continue
+            try:
+                reply = await self.request(
+                    self._conn(nid), proto.STATS_REQUEST, {}, timeout=5.0
+                )
+                out.append({k: v for k, v in reply.items()
+                            if k not in ("_rid", "_resp")})
+            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                continue
+        return out
+
+    async def cmd_create_job(self, p) -> dict:
+        """Recruit the planned workers, store the job, answer the user.
+
+        ``p`` = {req_id, job: {job_id, model, plan}} from the validator ML.
+        Recruiting = JOB_REQ to each stage's worker with a 3 s accept window
+        (reference recruit_worker, validator_thread.py:845-887).
+        """
+        job = p["job"]
+        job_id = job["job_id"]
+        plan = job["plan"]
+        accepted: dict[str, list] = {}
+        declined: list[str] = []
+        for stage in plan["stages"]:
+            wid = stage["worker_id"]
+            if wid in accepted:
+                continue
+            try:
+                reply = await self.request(
+                    self._conn(wid), proto.JOB_REQ,
+                    {"job_id": job_id, "stage": stage,
+                     "est_bytes": job.get("stage_bytes", {}).get(wid, 0.0)},
+                    timeout=RECRUIT_TIMEOUT,
+                )
+            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                declined.append(wid)
+                continue
+            if "addr" not in reply:  # decline replies carry no address
+                declined.append(wid)
+            else:
+                # the worker reports its *bind* host (may be 0.0.0.0); the
+                # routable address is the one this validator observed at
+                # handshake (P2PNode.addresses) + the advertised listen port
+                host, _ = self.addresses.get(wid, (None, None))
+                accepted[wid] = [host or reply["addr"][0], reply["addr"][1]]
+
+        ok = not declined
+        if not ok:
+            # release reservations on the workers that already accepted —
+            # otherwise every failed recruit permanently shrinks their
+            # advertised free capacity
+            for wid in accepted:
+                try:
+                    await self._conn(wid).send_control(
+                        proto.JOB_SHUTDOWN, {"job_id": job_id}
+                    )
+                except (ConnectionError, OSError):
+                    pass
+        result = {
+            "job_id": job_id,
+            "accepted": ok,
+            "workers": accepted,
+            "declined": declined,
+            "model": job.get("model"),
+            "plan": plan,
+        }
+        if ok:
+            self.jobs[job_id] = {
+                "job_id": job_id, "plan": plan, "workers": accepted,
+                "user_id": p.get("user_id"), "t0": time.time(),
+                "model": job.get("model", {}).get("name", ""),
+            }
+            await self.dht_store_global(f"job:{job_id}", _json_safe(self.jobs[job_id]))
+
+        req = self._job_requests.pop(p.get("req_id", ""), None)
+        if req is not None:
+            conn, body = req
+            await self.respond(conn, proto.JOB_ACCEPT if ok else proto.JOB_DECLINE,
+                               body, result)
+        return result
+
+    async def cmd_decline_job(self, p) -> bool:
+        """Planning failed (no capacity / unknown model)."""
+        req = self._job_requests.pop(p.get("req_id", ""), None)
+        if req is not None:
+            conn, body = req
+            await self.respond(conn, proto.JOB_DECLINE, body,
+                               {"error": p.get("error", "declined")})
+        return True
+
+    async def cmd_shutdown_job(self, p) -> bool:
+        job = self.jobs.pop(p["job_id"], None)
+        if job:
+            for wid in job.get("workers", {}):
+                try:
+                    await self._conn(wid).send_control(
+                        proto.JOB_SHUTDOWN, {"job_id": p["job_id"]}
+                    )
+                except (ConnectionError, OSError):
+                    pass
+            self.dht.delete(f"job:{p['job_id']}")
+        return True
+
+
+class UserServer(RoleServer):
+    """User-side networking (reference UserThread, nodes/user_thread.py:13).
+    The DistributedModel drives everything through generic commands; the only
+    role-specific verb is the job request."""
+
+    def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
+        super().__init__(cfg, queues)
+        self.forward_tokens_to_ml = False  # drained via cmd_next_tokens
+
+    async def cmd_request_job(self, p) -> dict:
+        """Send JOB_REQ to a connected validator and await the decision
+        (reference user_thread.py:242-415, 120 s timeout)."""
+        validators = self.validator_ids()
+        if not validators:
+            raise ConnectionError("no validator connections (bootstrap first)")
+        reply = await self.request(
+            self._conn(validators[0]), proto.JOB_REQ, {"spec": p.get("spec", {})},
+            timeout=p.get("timeout", JOB_REQ_TIMEOUT),
+        )
+        reply.pop("_rid", None)
+        reply.pop("_resp", None)
+        return reply
+
+
+def _json_safe(obj: Any) -> Any:
+    return json.loads(json.dumps(obj, default=str))
+
+
+SERVERS = {
+    "worker": WorkerServer,
+    "validator": ValidatorServer,
+    "user": UserServer,
+}
+
+
+def run_server(role: str, cfg: NodeConfig, queues: BridgeQueues) -> None:
+    """Entry point for the spawned network process."""
+    SERVERS[role](cfg, queues).main()

@@ -51,12 +51,16 @@ class P2PNode:
         spill_dir: str | Path | None = None,
         max_connections: int = 256,
         request_timeout: float = 10.0,
+        identity_name: str | None = None,
     ):
         self.role = role
         self.local_test = local_test
         self.host = "127.0.0.1" if local_test else host
         self.port = port
-        self.identity = crypto.load_or_create_identity(role, key_dir)
+        # identity_name separates keypairs for same-role nodes sharing a
+        # key_dir (reference duplicate="1" role suffix, tests/conftest.py:114)
+        # while the advertised role stays canonical for peer-role routing.
+        self.identity = crypto.load_or_create_identity(identity_name or role, key_dir)
         self.node_id = crypto.node_id_from_public_key(self.identity.public_pem)
         self.spill_dir = spill_dir
         self.max_connections = max_connections

@@ -36,6 +36,10 @@ class TensorNode(P2PNode):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.work = None  # mp.Queue installed by the runner (net -> ML)
+        # roles without an ML token consumer (users — the synchronous driver
+        # drains stream_buffers via next_tokens) set this False so the work
+        # queue cannot grow unboundedly
+        self.forward_tokens_to_ml = True
         self.stream_buffers: dict[str, asyncio.Queue] = {}  # stream_id -> tokens
         self.register(proto.TOKEN, self._handle_token)
         self.register(proto.STREAM_END, self._handle_token)
@@ -106,7 +110,7 @@ class TensorNode(P2PNode):
     async def _handle_token(self, conn, kind, tag, body) -> None:
         q = self.stream_buffers.setdefault(body["stream"], asyncio.Queue())
         await q.put((body.get("tokens", []), tag == proto.STREAM_END))
-        if self.work is not None:
+        if self.work is not None and self.forward_tokens_to_ml:
             self.post_work("token", {
                 "stream": body["stream"],
                 "tokens": body.get("tokens", []),

@@ -1,0 +1,174 @@
+"""End-to-end distributed slice: User + Validator + Worker(s) as real
+processes on localhost (reference tests/conftest.py:25-161 node groups and
+tests/test_distributed_model.py), with numerical parity against a local
+single-process forward — the check the reference never does (SURVEY §4).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tensorlink_tpu.core.config import (
+    UserConfig,
+    ValidatorConfig,
+    WorkerConfig,
+)
+from tensorlink_tpu.models import ModelConfig
+
+pytestmark = pytest.mark.e2e
+
+
+def tiny_cfg(**kw):
+    import jax.numpy as jnp
+
+    base = dict(
+        family="llama",
+        vocab_size=256,
+        d_model=64,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """validator + 2 workers wired on 127.0.0.1 (ephemeral ports)."""
+    from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
+
+    tmp = tmp_path_factory.mktemp("cluster")
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp / "keys"),
+        log_dir=str(tmp / "logs"),
+        env_file=str(tmp / ".env"),
+    )
+    validator = ValidatorNode(ValidatorConfig(endpoint=False, **common)).start()
+    seeds = [["127.0.0.1", validator.port]]
+    w1 = WorkerNode(WorkerConfig(seed_validators=seeds, **common)).start()
+    w2 = WorkerNode(
+        WorkerConfig(seed_validators=seeds, duplicate="1", **common)
+    ).start()
+    user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
+    # let the mesh settle
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        peers = validator.status()["peers"]
+        if len(peers) >= 3:
+            break
+        time.sleep(0.2)
+    yield {"validator": validator, "workers": [w1, w2], "user": user}
+    for n in (user, w1, w2, validator):
+        n.stop()
+
+
+def test_cluster_wiring(cluster):
+    st = cluster["validator"].status()
+    roles = sorted(p["role"] for p in st["peers"].values())
+    assert roles == ["user", "worker", "worker"]
+
+
+def test_single_stage_forward_parity(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import forward, init_params
+
+    cfg = tiny_cfg()
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as model:
+        assert model.plan.n_stages == 1
+
+        toks = np.array([[5, 9, 2, 77, 31, 8]], np.int32)
+        out = model(toks)
+
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    ref, _ = forward(params, toks, cfg)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_single_stage_generate_matches_local(cluster):
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import init_params
+
+    cfg = tiny_cfg()
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as model:
+        prompt = [3, 14, 15, 92]
+        seqs = model.generate([prompt], max_new_tokens=8)
+
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    engine = GenerationEngine(cfg, params, max_seq_len=128)
+    ref = engine.generate_compiled([prompt], max_new_tokens=8)
+    assert seqs[0] == ref.sequences[0]
+
+
+def test_streaming_generate(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as model:
+        got: list[int] = []
+        seqs = model.generate(
+            [[1, 2, 3]], max_new_tokens=6, stream_cb=lambda t: got.extend(t)
+        )
+    assert got == seqs[0]
+
+
+def test_pipelined_forward_and_generate_parity(cluster):
+    """Force a 2-stage split by shrinking the advertised capacity, then check
+    logits + greedy decode against the local whole model."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import forward, init_params
+
+    cfg = tiny_cfg(n_layers=6, d_model=128, d_ff=256, vocab_size=512)
+    # one worker cannot host the estimate; two must split
+    est_bytes = None
+    for w in cluster["workers"]:
+        cap = w.executor.capacity()
+        est_bytes = cap  # noqa: F841 (debug aid)
+        w.send_request(
+            "set_capacity", {"hbm_bytes": 2_600_000.0, "n_devices": 1}
+        )
+    try:
+        model = DistributedModel(
+            cfg, node=cluster["user"], seed=11, seq_len=64, batch=1
+        )
+        assert model.plan.n_stages == 2, model.plan
+        toks = np.array([[4, 8, 15, 16, 23, 42]], np.int32)
+        out = model(toks)
+        params = init_params(cfg, jax.random.PRNGKey(11))
+        ref, _ = forward(params, toks, cfg)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=3e-4, atol=3e-4)
+
+        # pipelined (session-cached) greedy decode vs local compiled decode
+        prompt = [7, 3, 200]
+        seqs = model.generate([prompt], max_new_tokens=6)
+        engine = GenerationEngine(cfg, params, max_seq_len=64)
+        refgen = engine.generate_compiled([prompt], max_new_tokens=6)
+        assert seqs[0] == refgen.sequences[0]
+    finally:
+        try:
+            model.shutdown()
+        except NameError:
+            pass
+        for w in cluster["workers"]:
+            w.send_request("set_capacity", w.executor.capacity())
+
+
+def test_parameters_download(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as model:
+        trees = model.parameters()
+        assert len(trees) == model.plan.n_stages
+    tree = trees[0]
+    assert "layers" in tree and "embed" in tree
+    assert tree["embed"]["tok"].shape == (cfg.vocab_size, cfg.d_model)
