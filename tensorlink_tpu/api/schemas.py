@@ -1,0 +1,154 @@
+"""Request/response schemas (reference api/models.py, pydantic).
+
+Dataclasses + explicit validation: the environment has no pydantic, and the
+validation the API actually needs is small (types, ranges, enums). Unlike the
+reference's ``GenerationRequest`` — which is mutated in-flight with
+``output``/``processing``/``cancelled`` fields as it rides through the
+pipeline (api/models.py:17-57) — these are immutable inputs; pipeline state
+lives in :class:`~tensorlink_tpu.api.server.PendingRequest`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValidationError(msg)
+
+
+@dataclass(frozen=True)
+class GenerationRequest:
+    """POST /v1/generate body (reference api/models.py:17)."""
+
+    hf_name: str
+    message: str = ""
+    history: list[dict] = field(default_factory=list)  # [{role, content}]
+    max_length: int | None = None
+    max_new_tokens: int = 256
+    temperature: float = 0.6
+    top_p: float = 0.95
+    top_k: int = 0
+    do_sample: bool = True
+    stream: bool = False
+    output_format: str = "simple"  # "simple" | "openai" | "raw"
+    enable_thinking: bool = False
+
+    @classmethod
+    def parse(cls, d: dict) -> "GenerationRequest":
+        _require(isinstance(d.get("hf_name"), str) and d["hf_name"], "hf_name required")
+        req = cls(
+            hf_name=d["hf_name"],
+            message=str(d.get("message", "")),
+            history=list(d.get("history", [])),
+            max_length=d.get("max_length"),
+            max_new_tokens=int(d.get("max_new_tokens", 256)),
+            temperature=float(d.get("temperature", 0.6)),
+            top_p=float(d.get("top_p", 0.95)),
+            top_k=int(d.get("top_k", 0)),
+            do_sample=bool(d.get("do_sample", True)),
+            stream=bool(d.get("stream", False)),
+            output_format=str(d.get("output_format", "simple")),
+            enable_thinking=bool(d.get("enable_thinking", False)),
+        )
+        _require(req.max_new_tokens > 0, "max_new_tokens must be positive")
+        _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")
+        _require(0.0 < req.top_p <= 1.0, "top_p must be in (0, 1]")
+        _require(req.top_k >= 0, "top_k must be >= 0")
+        _require(
+            req.output_format in ("simple", "openai", "raw"),
+            "output_format must be simple|openai|raw",
+        )
+        for h in req.history:
+            _require(
+                isinstance(h, dict) and "role" in h and "content" in h,
+                "history entries need role+content",
+            )
+        return req
+
+
+@dataclass(frozen=True)
+class ChatCompletionRequest:
+    """POST /v1/chat/completions body (reference api/models.py:60)."""
+
+    model: str
+    messages: list[dict]
+    max_tokens: int = 256
+    temperature: float = 0.6
+    top_p: float = 0.95
+    stream: bool = False
+
+    @classmethod
+    def parse(cls, d: dict) -> "ChatCompletionRequest":
+        _require(isinstance(d.get("model"), str) and d["model"], "model required")
+        msgs = d.get("messages")
+        _require(isinstance(msgs, list) and msgs, "messages required")
+        for m in msgs:
+            _require(
+                isinstance(m, dict) and "role" in m and "content" in m,
+                "each message needs role+content",
+            )
+        req = cls(
+            model=d["model"],
+            messages=msgs,
+            max_tokens=int(d.get("max_tokens", d.get("max_completion_tokens", 256))),
+            temperature=float(d.get("temperature", 0.6)),
+            top_p=float(d.get("top_p", 0.95)),
+            stream=bool(d.get("stream", False)),
+        )
+        _require(req.max_tokens > 0, "max_tokens must be positive")
+        return req
+
+    def to_generation_request(self) -> GenerationRequest:
+        """OpenAI messages → internal request (reference
+        _parse_chat_messages, api/node.py:53-92): last user message is the
+        prompt, the rest is history."""
+        history = [
+            {"role": m["role"], "content": m["content"]} for m in self.messages[:-1]
+        ]
+        last = self.messages[-1]
+        return GenerationRequest(
+            hf_name=self.model,
+            message=str(last.get("content", "")),
+            history=history,
+            max_new_tokens=self.max_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            stream=self.stream,
+            output_format="openai",
+        )
+
+
+@dataclass(frozen=True)
+class JobRequest:
+    """POST /request-model body (reference api/models.py:9). ``config`` is
+    an optional explicit ModelConfig dict — the analogue of the reference's
+    custom-distribution job path (user_thread.py:242 explicit jobs)."""
+
+    hf_name: str
+    batch: int = 1
+    seq_len: int = 2048
+    training: bool = False
+    config: dict | None = None
+
+    @classmethod
+    def parse(cls, d: dict) -> "JobRequest":
+        _require(isinstance(d.get("hf_name"), str) and d["hf_name"], "hf_name required")
+        cfg = d.get("config")
+        _require(cfg is None or isinstance(cfg, dict), "config must be an object")
+        req = cls(
+            hf_name=d["hf_name"],
+            batch=int(d.get("batch", 1)),
+            seq_len=int(d.get("seq_len", 2048)),
+            training=bool(d.get("training", False)),
+            config=cfg,
+        )
+        _require(req.batch >= 1, "batch must be >= 1")
+        _require(req.seq_len >= 1, "seq_len must be >= 1")
+        return req

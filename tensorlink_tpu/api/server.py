@@ -1,0 +1,398 @@
+"""TensorlinkAPI — the validator's HTTP endpoint.
+
+Reference: api/node.py:94 (FastAPI + uvicorn in a daemon thread, routes
+/v1/generate, /v1/chat/completions, /request-model, /model-status, /models,
+/model-demand, /stats, /network-history, /node-info). Same routes and wire
+shapes, implemented on stdlib asyncio (no fastapi/uvicorn in the TPU image):
+an HTTP/1.1 parser, JSON bodies, and SSE streaming fed by the compiled
+decode loop through ``loop.call_soon_threadsafe`` (the reference feeds
+asyncio queues from the ML thread the same way, api/node.py:440-454).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Awaitable, Callable
+from urllib.parse import unquote, urlparse
+
+from tensorlink_tpu.api.formatter import (
+    SSE_DONE,
+    ResponseFormatter,
+    sse_event,
+)
+from tensorlink_tpu.api.schemas import (
+    ChatCompletionRequest,
+    GenerationRequest,
+    JobRequest,
+    ValidationError,
+)
+from tensorlink_tpu.core.logging import get_logger
+
+MAX_BODY = 8 << 20
+MAX_CONCURRENT = 100  # reference api/node.py:537
+REQUEST_TIMEOUT = 300.0  # reference api/node.py:506
+STREAM_TOKEN_TIMEOUT = 30.0  # reference api/node.py:410
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str, extra: dict | None = None):
+        super().__init__(message)
+        self.status = status
+        self.body = {"error": message, **(extra or {})}
+
+
+_STATUS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
+    408: "Request Timeout", 413: "Payload Too Large", 429: "Too Many Requests",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class TensorlinkAPI:
+    """HTTP server bound to a validator node + its ML executor."""
+
+    def __init__(
+        self,
+        node,  # ValidatorNode (runner)
+        executor,  # DistributedValidator
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.node = node
+        self.executor = executor
+        self.host = host
+        self.port = port
+        self.log = get_logger("api")
+        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="api-ml")
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._inflight = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "TensorlinkAPI":
+        if self._thread:
+            return self
+        ready = threading.Event()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                self._server = await asyncio.start_server(
+                    self._handle_conn, self.host, self.port or None
+                )
+                self.port = self._server.sockets[0].getsockname()[1]
+
+            self._loop.run_until_complete(boot())
+            ready.set()
+            try:
+                self._loop.run_forever()
+            finally:
+                self._loop.run_until_complete(self._shutdown())
+                self._loop.close()
+
+        self._thread = threading.Thread(target=run, name="api-http", daemon=True)
+        self._thread.start()
+        if not ready.wait(10):
+            raise RuntimeError("API server failed to start")
+        self.log.info("serving on http://%s:%s", self.host, self.port)
+        return self
+
+    async def _shutdown(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    def stop(self) -> None:
+        if self._loop:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._pool.shutdown(wait=False)
+
+    async def _ml(self, fn: Callable, *args) -> Any:
+        """Run blocking executor work off the event loop."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._pool, fn, *args
+        )
+
+    # -- connection handling -------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            req = await asyncio.wait_for(self._read_request(reader), 30)
+            if req is None:
+                return
+            method, path, headers, body = req
+            await self._route(method, path, headers, body, writer)
+        except HTTPError as e:
+            await self._send_json(writer, e.status, e.body)
+        except asyncio.TimeoutError:
+            await self._send_json(writer, 408, {"error": "request timeout"})
+        except (ConnectionError, OSError):
+            pass
+        except Exception:
+            self.log.exception("request failed")
+            try:
+                await self._send_json(writer, 500, {"error": "internal error"})
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode("latin1").split(None, 2)
+        except ValueError:
+            raise HTTPError(400, "malformed request line")
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if b":" in h:
+                k, v = h.decode("latin1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0))
+        if length > MAX_BODY:
+            raise HTTPError(413, "body too large")
+        body = await reader.readexactly(length) if length else b""
+        return method.upper(), target, headers, body
+
+    @staticmethod
+    def _json_body(body: bytes) -> dict:
+        if not body:
+            return {}
+        try:
+            d = json.loads(body)
+        except json.JSONDecodeError:
+            raise HTTPError(400, "invalid JSON body")
+        if not isinstance(d, dict):
+            raise HTTPError(400, "JSON body must be an object")
+        return d
+
+    async def _send_json(self, writer, status: int, payload: dict) -> None:
+        data = json.dumps(payload, default=str).encode()
+        head = (
+            f"HTTP/1.1 {status} {_STATUS.get(status, 'OK')}\r\n"
+            "Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode()
+        writer.write(head + data)
+        await writer.drain()
+
+    async def _send_sse_headers(self, writer) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+
+    # -- routing --------------------------------------------------------
+    async def _route(self, method, target, headers, body, writer) -> None:
+        path = unquote(urlparse(target).path.rstrip("/") or "/")
+        if method == "GET":
+            if path == "/health":
+                return await self._send_json(writer, 200, {"status": "ok"})
+            if path == "/models":
+                return await self._send_json(writer, 200, self._models())
+            if path == "/model-demand":
+                return await self._send_json(
+                    writer, 200, {"demand": dict(self.executor.demand)}
+                )
+            if path.startswith("/model-status/"):
+                name = path[len("/model-status/"):]
+                return await self._send_json(
+                    writer, 200, self.executor.model_status(name)
+                )
+            if path == "/stats":
+                st = await self._ml(self.node.status)
+                return await self._send_json(writer, 200, st)
+            if path == "/node-info":
+                return await self._send_json(writer, 200, self._node_info())
+            if path == "/network-history":
+                return await self._send_json(
+                    writer, 200, self._network_history()
+                )
+            raise HTTPError(404, f"no route {path}")
+        if method != "POST":
+            raise HTTPError(405, f"method {method} not allowed")
+        data = self._json_body(body)
+        if path == "/v1/generate":
+            return await self._generate(data, writer)
+        if path == "/v1/chat/completions":
+            try:
+                chat = ChatCompletionRequest.parse(data)
+            except ValidationError as e:
+                raise HTTPError(400, str(e))
+            gen = chat.to_generation_request()
+            return await self._generate_common(gen, writer)
+        if path == "/request-model":
+            return await self._request_model(data, writer)
+        raise HTTPError(404, f"no route {path}")
+
+    # -- route bodies ---------------------------------------------------
+    def _models(self) -> dict:
+        return {
+            "models": [
+                {"name": j.name, "status": j.status}
+                for j in self.executor.hosted.values()
+            ]
+        }
+
+    def _node_info(self) -> dict:
+        return {
+            "id": self.node.node_id,
+            "role": self.node.role,
+            "port": self.node.port,
+            "hosted_models": list(self.executor.hosted),
+        }
+
+    def _network_history(self) -> dict:
+        # Keeper-backed statistics land in the platform-services layer
+        # (reference keeper.py:502); until then report live topology only.
+        st = self.node.status()
+        roles: dict[str, int] = {}
+        for p in st.get("peers", {}).values():
+            roles[p.get("role", "?")] = roles.get(p.get("role", "?"), 0) + 1
+        return {"current": roles, "history": []}
+
+    async def _request_model(self, data: dict, writer) -> None:
+        try:
+            jr = JobRequest.parse(data)
+        except ValidationError as e:
+            raise HTTPError(400, str(e))
+        wait = bool(data.get("wait", True))
+        if wait:
+            job = await self._ml(
+                lambda: self.executor.host_model(
+                    jr.hf_name, batch=jr.batch, seq_len=jr.seq_len,
+                    config=jr.config,
+                )
+            )
+            status = 200 if job.status == "ready" else 503
+            out = {"model": jr.hf_name, "status": job.status}
+            if job.error:
+                out["error"] = job.error
+            return await self._send_json(writer, status, out)
+        self._pool.submit(
+            self.executor.host_model, jr.hf_name,
+            batch=jr.batch, seq_len=jr.seq_len, config=jr.config,
+        )
+        await self._send_json(
+            writer, 200, {"model": jr.hf_name, "status": "loading"}
+        )
+
+    async def _generate(self, data: dict, writer) -> None:
+        try:
+            gen = GenerationRequest.parse(data)
+        except ValidationError as e:
+            raise HTTPError(400, str(e))
+        await self._generate_common(gen, writer)
+
+    async def _generate_common(self, gen: GenerationRequest, writer) -> None:
+        from tensorlink_tpu.ml.validator import ModelNotReady
+
+        if self._inflight >= MAX_CONCURRENT:
+            raise HTTPError(429, "too many concurrent requests")
+        job = self.executor.hosted.get(gen.hf_name)
+        if job is None or job.status != "ready":
+            # 503 + auto-load trigger (reference api/node.py:143-155)
+            if job is None:
+                self._pool.submit(self.executor.host_model, gen.hf_name)
+                state = "loading"
+            else:
+                state = job.status
+            raise HTTPError(
+                503, f"model {gen.hf_name} is {state}",
+                {"model": gen.hf_name, "status": state},
+            )
+
+        fmt = ResponseFormatter(gen.hf_name, gen.output_format)
+        self._inflight += 1
+        try:
+            if not gen.stream:
+                try:
+                    result = await asyncio.wait_for(
+                        self._ml(self.executor.generate_api, gen),
+                        REQUEST_TIMEOUT,
+                    )
+                except ModelNotReady as e:
+                    raise HTTPError(503, str(e))
+                return await self._send_json(
+                    writer, 200,
+                    fmt.complete(
+                        result["text"],
+                        prompt_tokens=result["prompt_tokens"],
+                        completion_tokens=result["completion_tokens"],
+                        reasoning=result["reasoning"],
+                        finish_reason=result["finish_reason"],
+                    ),
+                )
+            await self._stream_generate(gen, fmt, writer)
+        finally:
+            self._inflight -= 1
+
+    async def _stream_generate(self, gen, fmt, writer) -> None:
+        """SSE: ML thread pushes deltas through call_soon_threadsafe."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_delta(piece: str) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, ("delta", piece))
+
+        def work():
+            try:
+                res = self.executor.generate_api(gen, on_delta=on_delta)
+                loop.call_soon_threadsafe(q.put_nowait, ("done", res))
+            except Exception as e:
+                loop.call_soon_threadsafe(q.put_nowait, ("err", e))
+
+        fut = loop.run_in_executor(self._pool, work)
+        await self._send_sse_headers(writer)
+        try:
+            while True:
+                try:
+                    kind, item = await asyncio.wait_for(
+                        q.get(), STREAM_TOKEN_TIMEOUT
+                    )
+                except asyncio.TimeoutError:
+                    writer.write(sse_event(fmt.error("stream token timeout", status=408)))
+                    break
+                if kind == "delta":
+                    writer.write(sse_event(fmt.stream_chunk(item)))
+                    await writer.drain()
+                elif kind == "done":
+                    writer.write(
+                        sse_event(fmt.stream_final(
+                            prompt_tokens=item["prompt_tokens"],
+                            completion_tokens=item["completion_tokens"],
+                            finish_reason=item["finish_reason"],
+                        ))
+                    )
+                    writer.write(SSE_DONE)
+                    await writer.drain()
+                    break
+                else:  # err
+                    writer.write(sse_event(fmt.error(str(item))))
+                    writer.write(SSE_DONE)
+                    await writer.drain()
+                    break
+        finally:
+            await fut

@@ -89,15 +89,32 @@ class DistributedModel:
     # job setup (reference _initialize_distribution → distribute_model,
     # module.py:987-1021,699)
     # ------------------------------------------------------------------
-    def _initialize_distribution(self) -> None:
-        from tensorlink_tpu.models.base import ModelConfig
-        from tensorlink_tpu.parallel.planner import ShardingPlan
+    @classmethod
+    def from_job(cls, node, job_result: dict, **kw) -> "DistributedModel":
+        """Attach to an already-created job (validator-hosted models: the
+        validator plans + recruits itself — reference _initialize_hosted_job,
+        ml/validator.py:901 — then drives the job through its own node)."""
+        model = cls(
+            job_result["model"].get("name", "hosted"),
+            node=node,
+            start_session=False,
+            **kw,
+        )
+        model._attach(job_result)
+        return model
 
+    def _initialize_distribution(self) -> None:
         reply = self.node.send_request(
             "request_job", {"spec": self.spec}, timeout=MAX_WAIT_TIME
         )
         if not reply.get("accepted"):
             raise JobDeclinedError(str(reply.get("error", reply)))
+        self._attach(reply)
+
+    def _attach(self, reply: dict) -> None:
+        from tensorlink_tpu.models.base import ModelConfig
+        from tensorlink_tpu.parallel.planner import ShardingPlan
+
         self.job_id = reply["job_id"]
         self.plan = ShardingPlan.from_json(reply["plan"])
         self.model_spec = reply.get("model", self.model_spec)

@@ -10,9 +10,29 @@ worker capacities, then hand the job back to the network process to recruit
 
 from __future__ import annotations
 
+import threading
+import time
 import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
 
 from tensorlink_tpu.core.logging import get_logger
+
+
+@dataclass
+class HostedJob:
+    """A model the validator serves through the HTTP API (reference hosted
+    jobs, ml/validator.py:901-1041)."""
+
+    name: str
+    status: str = "loading"  # loading | ready | failed
+    model: Any = None  # DistributedModel
+    tokenizer: Any = None  # TokenizerAdapter
+    cfg: Any = None
+    seq_len: int = 2048
+    error: str = ""
+    t0: float = field(default_factory=time.time)
+    lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class DistributedValidator:
@@ -22,6 +42,8 @@ class DistributedValidator:
         self.log = get_logger(f"ml.validator{node.config.duplicate}")
         # model demand tracking (reference logs/models.json, ml/utils.py:663)
         self.demand: dict[str, int] = {}
+        self.hosted: dict[str, HostedJob] = {}
+        self._host_lock = threading.Lock()
 
     def run(self) -> None:
         while True:
@@ -67,12 +89,61 @@ class DistributedValidator:
             return config_from_hf(CheckpointReader(model_spec["ckpt"]).config())
         raise ValueError(f"cannot resolve model {name!r}")
 
-    def _plan_job(self, p: dict) -> None:
-        from tensorlink_tpu.parallel.planner import (
-            AssignmentError,
-            WorkerCapacity,
-            plan_sharding,
+    def _plan_and_create(
+        self,
+        model_spec: dict,
+        cfg,
+        *,
+        batch: int = 1,
+        seq_len: int = 2048,
+        training: bool = False,
+        n_micro=None,
+        req_id: str | None = None,
+        user_id: str | None = None,
+    ) -> dict:
+        """Shared plan→recruit path for user jobs and hosted models: live
+        worker capacities → plan_sharding → create_job on the net process.
+        Returns the create_job result. Raises AssignmentError on no fit."""
+        from tensorlink_tpu.parallel.planner import WorkerCapacity, plan_sharding
+
+        name = model_spec.get("name", "")
+        stats = self.bridge.request("stats_workers", timeout=15.0)
+        workers = [
+            WorkerCapacity(
+                node_id=s["id"],
+                hbm_bytes=float(s.get("free_bytes", s.get("hbm_bytes", 0.0))),
+                n_devices=int(s.get("n_devices", 1)),
+            )
+            for s in stats
+        ]
+        plan = plan_sharding(
+            cfg, workers, model_name=name, batch=batch,
+            seq_len=seq_len, training=training, n_micro=n_micro,
         )
+        total_layers = max(cfg.n_layers, 1)
+        job = {
+            "job_id": uuid.uuid4().hex,
+            "model": model_spec,
+            "plan": plan.to_json(),
+            "stage_bytes": {
+                s.worker_id: plan.estimate.total
+                * (s.layer_hi - s.layer_lo) / total_layers
+                for s in plan.stages
+            },
+        }
+        result = self.bridge.request(
+            "create_job",
+            {"req_id": req_id, "user_id": user_id, "job": job},
+            timeout=30.0,
+        )
+        self.log.info(
+            "job %s (%s): accepted=%s stages=%d",
+            job["job_id"][:8], name, result.get("accepted"), plan.n_stages,
+        )
+        return result
+
+    def _plan_job(self, p: dict) -> None:
+        from tensorlink_tpu.parallel.planner import AssignmentError
 
         spec = p["spec"]
         model_spec = dict(spec.get("model", {}))
@@ -86,51 +157,218 @@ class DistributedValidator:
             )
             return
         model_spec["config"] = cfg.to_json()
-
-        stats = self.bridge.request("stats_workers", timeout=15.0)
-        workers = [
-            WorkerCapacity(
-                node_id=s["id"],
-                hbm_bytes=float(s.get("free_bytes", s.get("hbm_bytes", 0.0))),
-                n_devices=int(s.get("n_devices", 1)),
-            )
-            for s in stats
-        ]
         try:
-            plan = plan_sharding(
-                cfg,
-                workers,
-                model_name=name,
+            self._plan_and_create(
+                model_spec, cfg,
                 batch=int(spec.get("batch", 1)),
                 seq_len=int(spec.get("seq_len", 2048)),
                 training=bool(spec.get("training", False)),
                 n_micro=spec.get("n_micro"),
+                req_id=p["req_id"],
+                user_id=p.get("user_id"),
             )
         except AssignmentError as e:
             self.log.info("declining job %s: %s", name, e)
             self.bridge.request(
                 "decline_job", {"req_id": p["req_id"], "error": str(e)}
             )
-            return
 
-        # per-worker byte estimate for the recruit capacity check
-        total_layers = max(cfg.n_layers, 1)
-        stage_bytes = {
-            s.worker_id: plan.estimate.total * (s.layer_hi - s.layer_lo) / total_layers
-            for s in plan.stages
-        }
-        job = {
-            "job_id": uuid.uuid4().hex,
-            "model": model_spec,
-            "plan": plan.to_json(),
-            "stage_bytes": stage_bytes,
-        }
-        result = self.bridge.request(
-            "create_job",
-            {"req_id": p["req_id"], "user_id": p.get("user_id"), "job": job},
-            timeout=30.0,
+    # ------------------------------------------------------------------
+    # hosted models (reference _initialize_hosted_job → DistributedModel,
+    # ml/validator.py:901-1041) — the validator is its own "user"
+    # ------------------------------------------------------------------
+    def host_model(
+        self,
+        name: str,
+        *,
+        batch: int = 1,
+        seq_len: int | None = None,
+        config: dict | None = None,
+        seed: int = 0,
+    ) -> HostedJob:
+        """Plan, recruit, and attach a model for API serving. Synchronous and
+        thread-safe; callable from API handler threads."""
+        with self._host_lock:
+            job = self.hosted.get(name)
+            if job is not None and job.status in ("loading", "ready"):
+                return job
+            job = HostedJob(name=name)
+            self.hosted[name] = job
+        try:
+            self._do_host(job, batch=batch, seq_len=seq_len, config=config, seed=seed)
+        except Exception as e:
+            job.status = "failed"
+            job.error = f"{type(e).__name__}: {e}"
+            self.log.exception("hosting %s failed", name)
+        return job
+
+    def _do_host(self, job: HostedJob, *, batch, seq_len, config, seed) -> None:
+        from tensorlink_tpu.api.tokenizer import load_tokenizer
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        name = job.name
+        model_spec: dict = {"name": name, "seed": seed}
+        if config:
+            model_spec["config"] = config
+        if "/" in name or name.startswith("."):
+            model_spec.setdefault("ckpt", name)
+        cfg = self._resolve_config(model_spec)
+        model_spec["config"] = cfg.to_json()
+        job.cfg = cfg
+        job.seq_len = min(seq_len or cfg.max_seq_len, cfg.max_seq_len)
+
+        result = self._plan_and_create(
+            model_spec, cfg, batch=batch, seq_len=job.seq_len, training=False,
         )
-        self.log.info(
-            "job %s (%s): accepted=%s stages=%d",
-            job["job_id"][:8], name, result.get("accepted"), plan.n_stages,
+        if not result.get("accepted"):
+            raise RuntimeError(f"recruiting failed: {result.get('declined')}")
+        try:
+            job.model = DistributedModel.from_job(
+                self.node, result, seq_len=job.seq_len, seed=seed,
+            )
+        except Exception:
+            # release what recruiting reserved — workers that accepted would
+            # otherwise keep the reservation forever (same leak the recruit
+            # decline path guards against, roles.py cmd_create_job)
+            try:
+                self.bridge.request(
+                    "shutdown_job", {"job_id": result["job_id"]}, timeout=15.0
+                )
+            except Exception:
+                self.log.warning("rollback of job %s failed", result["job_id"][:8])
+            raise
+        job.tokenizer = load_tokenizer(model_spec)
+        job.status = "ready"
+        self.log.info("hosting %s ready (%d stages)", name, len(result["plan"]["stages"]))
+
+    def unhost_model(self, name: str) -> bool:
+        """Drop a hosted model and release its workers (reference
+        _remove_hosted_job, ml/validator.py:1043)."""
+        with self._host_lock:
+            job = self.hosted.pop(name, None)
+        if job is None:
+            return False
+        if job.model is not None:
+            with job.lock:  # let an in-flight generation finish first
+                job.model.shutdown()
+        return True
+
+    def model_status(self, name: str) -> dict:
+        job = self.hosted.get(name)
+        if job is None:
+            return {"model": name, "status": "absent"}
+        out = {"model": name, "status": job.status}
+        if job.error:
+            out["error"] = job.error
+        return out
+
+    # ------------------------------------------------------------------
+    # generation service for the API (reference _prepare_generation /
+    # _generate / _generate_streaming, ml/validator.py:579-850)
+    # ------------------------------------------------------------------
+    def generate_api(
+        self,
+        req,  # schemas.GenerationRequest
+        on_delta: Callable[[str], None] | None = None,
+    ) -> dict:
+        """Run one generation on a hosted model. Returns
+        ``{text, reasoning, prompt_tokens, completion_tokens, finish_reason}``.
+        ``on_delta`` receives visible-answer text pieces as they decode."""
+        from tensorlink_tpu.api.formatter import (
+            ThinkStripStream,
+            extract_reasoning_and_answer,
+            format_chat_prompt,
+            normalize_generate_args,
         )
+
+        job = self.hosted.get(req.hf_name)
+        if job is None or job.status != "ready":
+            raise ModelNotReady(req.hf_name, job.status if job else "absent")
+        self.demand[req.hf_name] = self.demand.get(req.hf_name, 0) + 1
+        tok = job.tokenizer
+
+        prompt = format_chat_prompt(
+            req.message,
+            req.history,
+            tokenizer=tok if tok.chat_template else None,
+            model_name=req.hf_name,
+            enable_thinking=req.enable_thinking,
+        )
+        ids = tok.encode(prompt)
+        max_ctx = min(job.seq_len, tok.model_max_length)
+        # clamp the prompt against the context window while reserving room
+        # for the requested completion (reference formatter.py:47-71
+        # truncates against model_max_length)
+        reserve = min(int(req.max_new_tokens), max(max_ctx // 2, 1))
+        if len(ids) > max_ctx - reserve:
+            ids = ids[-(max_ctx - reserve):]
+        args = normalize_generate_args(req, prompt_len=len(ids), max_context=max_ctx)
+
+        stripper = ThinkStripStream() if not req.enable_thinking else None
+        emitted_ids: list[int] = []
+        last_text = ""
+        # incremental detokenization: re-decoding the full sequence per step
+        # is O(n²) on the SSE hot path. Decode a bounded tail window; fold
+        # the window into an exact full-prefix decode every WINDOW tokens.
+        WINDOW = 64
+        base_ids = 0
+        base_text = ""
+
+        def current_text() -> str:
+            nonlocal base_ids, base_text
+            if len(emitted_ids) - base_ids > 2 * WINDOW:
+                base_ids = len(emitted_ids) - WINDOW
+                base_text = tok.decode(emitted_ids[:base_ids])
+            return base_text + tok.decode(emitted_ids[base_ids:])
+
+        def stream_cb(new_tokens: list[int]) -> None:
+            nonlocal last_text
+            if on_delta is None:
+                return
+            emitted_ids.extend(new_tokens)
+            text = current_text()
+            delta = text[len(last_text):]
+            # hold back trailing replacement char (partial multibyte)
+            if delta.endswith("�"):
+                delta = delta[:-1]
+            if not delta:
+                return
+            last_text += delta
+            if stripper is not None:
+                delta = stripper.feed(delta)
+            if delta:
+                on_delta(delta)
+
+        with job.lock:  # serialize per-model generation
+            seqs = job.model.generate(
+                [ids],
+                max_new_tokens=args["max_new_tokens"],
+                temperature=args["temperature"],
+                top_k=args["top_k"],
+                top_p=args["top_p"],
+                eos_ids=tok.eos_ids,
+                stream_cb=stream_cb if on_delta is not None else None,
+            )
+        out_ids = seqs[0]
+        if on_delta is not None and stripper is not None:
+            tail = stripper.flush()
+            if tail:
+                on_delta(tail)
+        eos = set(tok.eos_ids)
+        full_text = tok.decode([i for i in out_ids if i not in eos])
+        reasoning, answer = extract_reasoning_and_answer(full_text)
+        hit_eos = bool(out_ids) and out_ids[-1] in eos
+        return {
+            "text": answer,
+            "reasoning": reasoning,
+            "prompt_tokens": len(ids),
+            "completion_tokens": len(out_ids),
+            "finish_reason": "stop" if hit_eos else "length",
+        }
+
+
+class ModelNotReady(RuntimeError):
+    def __init__(self, name: str, status: str):
+        super().__init__(f"model {name!r} is {status}")
+        self.model = name
+        self.status = status

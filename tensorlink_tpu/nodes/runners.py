@@ -135,9 +135,14 @@ class WorkerNode(BaseNode):
 
 
 class ValidatorNode(BaseNode):
-    """Plans jobs, tracks workers (reference Validator, nodes.py:304-377)."""
+    """Plans jobs, tracks workers, serves the HTTP API (reference Validator,
+    nodes.py:304-377 + TensorlinkAPI, api/node.py:523-541)."""
 
     CONFIG = ValidatorConfig
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.api = None
 
     def _start_ml(self) -> None:
         from tensorlink_tpu.ml.validator import DistributedValidator
@@ -147,6 +152,21 @@ class ValidatorNode(BaseNode):
             target=self.executor.run, name="ml-validator", daemon=True
         )
         self._ml_thread.start()
+        if self.config.endpoint:
+            from tensorlink_tpu.api.server import TensorlinkAPI
+
+            self.api = TensorlinkAPI(
+                self,
+                self.executor,
+                host=self.config.endpoint_host,
+                port=self.config.endpoint_port,
+            ).start()
+
+    def stop(self) -> None:
+        if self.api is not None:
+            self.api.stop()
+            self.api = None
+        super().stop()
 
 
 class UserNode(BaseNode):

@@ -1,0 +1,208 @@
+"""REST API end-to-end against a live validator endpoint (reference
+tests/test_model_api.py:54-396): preload via /request-model, then generate in
+simple + OpenAI shapes, SSE streaming with [DONE], chat completions, status
+and stats routes."""
+
+import http.client
+import json
+import socket
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from tensorlink_tpu.core.config import ValidatorConfig, WorkerConfig
+from tensorlink_tpu.models import ModelConfig
+
+pytestmark = pytest.mark.e2e
+
+MODEL = "tiny-test"
+
+
+def tiny_cfg_json():
+    return ModelConfig(
+        family="llama",
+        vocab_size=258,  # byte tokenizer range + BOS/EOS
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=256,
+        dtype=jnp.float32,
+    ).to_json()
+
+
+@pytest.fixture(scope="module")
+def api_cluster(tmp_path_factory):
+    from tensorlink_tpu.nodes.runners import ValidatorNode, WorkerNode
+
+    tmp = tmp_path_factory.mktemp("api_cluster")
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp / "keys"),
+        log_dir=str(tmp / "logs"),
+        env_file=str(tmp / ".env"),
+    )
+    validator = ValidatorNode(
+        ValidatorConfig(endpoint=True, endpoint_port=0, **common)
+    ).start()
+    worker = WorkerNode(
+        WorkerConfig(seed_validators=[["127.0.0.1", validator.port]], **common)
+    ).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if validator.status()["peers"]:
+            break
+        time.sleep(0.2)
+    yield validator
+    worker.stop()
+    validator.stop()
+
+
+def _req(api, method, path, body=None, timeout=200.0):
+    conn = http.client.HTTPConnection("127.0.0.1", api.port, timeout=timeout)
+    payload = json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"} if payload else {}
+    conn.request(method, path, body=payload, headers=headers)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data) if data else {}
+
+
+def _sse(api, path, body, timeout=200.0):
+    """POST and parse the SSE stream into a list of data payloads."""
+    s = socket.create_connection(("127.0.0.1", api.port), timeout=timeout)
+    payload = json.dumps(body).encode()
+    s.sendall(
+        f"POST {path} HTTP/1.1\r\nHost: x\r\nContent-Type: application/json\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+    )
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(65536)
+    head, buf = buf.split(b"\r\n\r\n", 1)
+    status = int(head.split(b" ")[1])
+    assert b"text/event-stream" in head
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    events = []
+    for block in buf.decode().split("\n\n"):
+        block = block.strip()
+        if block.startswith("data: "):
+            events.append(block[len("data: "):])
+    return status, events
+
+
+def test_health_and_preload(api_cluster):
+    api = api_cluster.api
+    status, body = _req(api, "GET", "/health")
+    assert status == 200 and body["status"] == "ok"
+
+    status, body = _req(
+        api, "POST", "/request-model",
+        {"hf_name": MODEL, "config": tiny_cfg_json(), "seq_len": 256},
+    )
+    assert status == 200, body
+    assert body["status"] == "ready"
+
+    status, body = _req(api, "GET", f"/model-status/{MODEL}")
+    assert body["status"] == "ready"
+    status, body = _req(api, "GET", "/models")
+    assert {"name": MODEL, "status": "ready"} in body["models"]
+
+
+def test_generate_simple(api_cluster):
+    api = api_cluster.api
+    status, body = _req(
+        api, "POST", "/v1/generate",
+        {"hf_name": MODEL, "message": "hi", "max_new_tokens": 8,
+         "do_sample": False},
+    )
+    assert status == 200, body
+    assert "response" in body
+    u = body["usage"]
+    assert u["prompt_tokens"] > 0 and 0 < u["completion_tokens"] <= 8
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+
+def test_generate_openai_format(api_cluster):
+    api = api_cluster.api
+    status, body = _req(
+        api, "POST", "/v1/generate",
+        {"hf_name": MODEL, "message": "hi", "max_new_tokens": 4,
+         "do_sample": False, "output_format": "openai"},
+    )
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_chat_completions(api_cluster):
+    api = api_cluster.api
+    status, body = _req(
+        api, "POST", "/v1/chat/completions",
+        {"model": MODEL, "max_tokens": 4,
+         "messages": [{"role": "user", "content": "hello"}]},
+    )
+    assert status == 200, body
+    assert body["object"] == "chat.completion"
+    assert isinstance(body["choices"][0]["message"]["content"], str)
+
+
+def test_streaming_sse_with_done(api_cluster):
+    api = api_cluster.api
+    status, events = _sse(
+        api, "/v1/generate",
+        {"hf_name": MODEL, "message": "go", "max_new_tokens": 6,
+         "do_sample": False, "stream": True, "output_format": "openai"},
+    )
+    assert status == 200
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+    final = parsed[-1]
+    assert final["choices"][0]["finish_reason"] in ("stop", "length")
+    assert "usage" in final
+    text = "".join(
+        p["choices"][0]["delta"].get("content", "") for p in parsed[:-1]
+    )
+    assert isinstance(text, str)
+
+
+def test_generate_absent_model_503_triggers_load(api_cluster):
+    api = api_cluster.api
+    status, body = _req(
+        api, "POST", "/v1/generate",
+        {"hf_name": "nonexistent-model", "message": "x"},
+    )
+    assert status == 503
+    assert body["status"] in ("loading", "failed")
+
+
+def test_validation_errors(api_cluster):
+    api = api_cluster.api
+    status, body = _req(api, "POST", "/v1/generate", {"message": "no model"})
+    assert status == 400
+    status, body = _req(api, "POST", "/v1/generate", None)
+    assert status == 400
+    status, body = _req(api, "GET", "/nope")
+    assert status == 404
+
+
+def test_stats_and_node_info(api_cluster):
+    api = api_cluster.api
+    status, body = _req(api, "GET", "/stats")
+    assert status == 200 and "peers" in body
+    status, body = _req(api, "GET", "/node-info")
+    assert body["role"] == "validator" and MODEL in body["hosted_models"]
+    status, body = _req(api, "GET", "/model-demand")
+    assert body["demand"].get(MODEL, 0) >= 1
+    status, body = _req(api, "GET", "/network-history")
+    assert "current" in body

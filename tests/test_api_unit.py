@@ -1,0 +1,190 @@
+"""Unit tests for the serving layer's pure pieces (schemas + formatter).
+
+The reference has no unit tests for ml/formatter.py (SURVEY §4 gap); these
+cover arg normalization, chat templating, think-block handling, and the
+OpenAI/simple response shapes its API tests assert end-to-end.
+"""
+
+import json
+
+import pytest
+
+from tensorlink_tpu.api.formatter import (
+    SSE_DONE,
+    ResponseFormatter,
+    ThinkStripStream,
+    extract_reasoning_and_answer,
+    format_chat_prompt,
+    normalize_generate_args,
+    sse_event,
+)
+from tensorlink_tpu.api.schemas import (
+    ChatCompletionRequest,
+    GenerationRequest,
+    JobRequest,
+    ValidationError,
+)
+
+
+# -- schemas ----------------------------------------------------------------
+
+
+def test_generation_request_parse_defaults():
+    r = GenerationRequest.parse({"hf_name": "m", "message": "hi"})
+    assert r.hf_name == "m" and r.max_new_tokens == 256 and not r.stream
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {},
+        {"hf_name": ""},
+        {"hf_name": "m", "max_new_tokens": 0},
+        {"hf_name": "m", "temperature": 3.0},
+        {"hf_name": "m", "top_p": 0.0},
+        {"hf_name": "m", "output_format": "xml"},
+        {"hf_name": "m", "history": [{"role": "user"}]},
+    ],
+)
+def test_generation_request_rejects(bad):
+    with pytest.raises(ValidationError):
+        GenerationRequest.parse(bad)
+
+
+def test_chat_completion_maps_to_generation():
+    r = ChatCompletionRequest.parse(
+        {
+            "model": "m",
+            "messages": [
+                {"role": "system", "content": "be nice"},
+                {"role": "user", "content": "a"},
+                {"role": "assistant", "content": "b"},
+                {"role": "user", "content": "c"},
+            ],
+            "max_tokens": 7,
+            "stream": True,
+        }
+    )
+    g = r.to_generation_request()
+    assert g.message == "c" and len(g.history) == 3
+    assert g.max_new_tokens == 7 and g.stream and g.output_format == "openai"
+
+
+def test_job_request_config_passthrough():
+    r = JobRequest.parse({"hf_name": "custom", "config": {"d_model": 8}})
+    assert r.config == {"d_model": 8}
+    with pytest.raises(ValidationError):
+        JobRequest.parse({"hf_name": "m", "config": 5})
+
+
+# -- normalization ----------------------------------------------------------
+
+
+def test_normalize_clamps_to_context():
+    r = GenerationRequest.parse(
+        {"hf_name": "m", "max_new_tokens": 1000, "temperature": 0.0}
+    )
+    a = normalize_generate_args(r, prompt_len=100, max_context=128)
+    assert a["max_new_tokens"] == 28
+    assert a["temperature"] == 0.0  # greedy passthrough
+
+
+def test_normalize_greedy_when_do_sample_false():
+    r = GenerationRequest.parse({"hf_name": "m", "do_sample": False, "temperature": 0.9})
+    assert normalize_generate_args(r, prompt_len=1, max_context=64)["temperature"] == 0.0
+
+
+# -- chat templates ---------------------------------------------------------
+
+
+def test_qwen_manual_template():
+    p = format_chat_prompt("hi", model_name="Qwen/Qwen3-8B")
+    assert "<|im_start|>user\nhi<|im_end|>" in p
+    assert p.rstrip().endswith("</think>")  # thinking disabled by default
+
+
+def test_qwen_thinking_enabled():
+    p = format_chat_prompt("hi", model_name="Qwen/Qwen3-8B", enable_thinking=True)
+    assert "</think>" not in p
+
+
+def test_llama3_template_and_history():
+    p = format_chat_prompt(
+        "q2",
+        history=[{"role": "user", "content": "q1"},
+                 {"role": "assistant", "content": "a1"}],
+        model_name="meta-llama/Llama-3-8B-Instruct",
+        system_prompt="sys",
+    )
+    assert p.startswith("<|begin_of_text|>")
+    assert p.index("sys") < p.index("q1") < p.index("a1") < p.index("q2")
+
+
+def test_generic_template():
+    p = format_chat_prompt("hello", model_name="gpt2")
+    assert p == "User: hello\nAssistant:"
+
+
+# -- reasoning extraction ---------------------------------------------------
+
+
+def test_extract_reasoning():
+    r, a = extract_reasoning_and_answer("<think>step 1</think>The answer is 4.")
+    assert r == "step 1" and a == "The answer is 4."
+
+
+def test_extract_no_reasoning():
+    r, a = extract_reasoning_and_answer("plain")
+    assert r == "" and a == "plain"
+
+
+def test_extract_unterminated_block():
+    r, a = extract_reasoning_and_answer("<think>still going")
+    assert r == "still going" and a == ""
+
+
+def test_think_strip_stream_across_chunks():
+    s = ThinkStripStream()
+    out = "".join(
+        s.feed(p) for p in ["before <thi", "nk>hidden", " stuff</thi", "nk>\nafter", " end"]
+    ) + s.flush()
+    assert out == "before after end"
+
+
+def test_think_strip_stream_no_block():
+    s = ThinkStripStream()
+    out = s.feed("hello world") + s.flush()
+    assert out == "hello world"
+
+
+# -- response shapes ----------------------------------------------------------
+
+
+def test_openai_complete_shape():
+    f = ResponseFormatter("m", "openai")
+    body = f.complete("hi", prompt_tokens=3, completion_tokens=2, reasoning="r")
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["content"] == "hi"
+    assert body["choices"][0]["message"]["reasoning_content"] == "r"
+    assert body["usage"]["total_tokens"] == 5
+
+
+def test_simple_complete_shape():
+    body = ResponseFormatter("m", "simple").complete("hi", prompt_tokens=1, completion_tokens=1)
+    assert body["response"] == "hi" and body["usage"]["total_tokens"] == 2
+
+
+def test_stream_chunk_shapes():
+    oa = ResponseFormatter("m", "openai").stream_chunk("t")
+    assert oa["object"] == "chat.completion.chunk"
+    assert oa["choices"][0]["delta"]["content"] == "t"
+    simple = ResponseFormatter("m", "simple").stream_chunk("t")
+    assert simple == {"token": "t", "model": "m"}
+
+
+def test_sse_encoding():
+    ev = sse_event({"a": 1})
+    assert ev == b'data: {"a":1}\n\n'
+    assert SSE_DONE == b"data: [DONE]\n\n"
+    payload = json.loads(ev[len(b"data: "):].strip())
+    assert payload == {"a": 1}
