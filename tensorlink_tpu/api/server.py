@@ -228,7 +228,7 @@ class TensorlinkAPI:
                 return await self._send_json(writer, 200, self._node_info())
             if path == "/network-history":
                 return await self._send_json(
-                    writer, 200, self._network_history()
+                    writer, 200, await self._ml(self._network_history)
                 )
             raise HTTPError(404, f"no route {path}")
         if method != "POST":
@@ -249,19 +249,15 @@ class TensorlinkAPI:
 
     # -- route bodies ---------------------------------------------------
     def _models(self) -> dict:
-        return {
-            "models": [
-                {"name": j.name, "status": j.status}
-                for j in self.executor.hosted.values()
-            ]
-        }
+        # snapshot under the executor's lock — pool threads mutate hosted
+        return {"models": self.executor.hosted_snapshot()}
 
     def _node_info(self) -> dict:
         return {
             "id": self.node.node_id,
             "role": self.node.role,
             "port": self.node.port,
-            "hosted_models": list(self.executor.hosted),
+            "hosted_models": [j["name"] for j in self.executor.hosted_snapshot()],
         }
 
     def _network_history(self) -> dict:
@@ -364,35 +360,38 @@ class TensorlinkAPI:
             except Exception as e:
                 loop.call_soon_threadsafe(q.put_nowait, ("err", e))
 
-        fut = loop.run_in_executor(self._pool, work)
+        # not awaited on the timeout path: the generation thread cannot be
+        # cancelled mid-decode, and holding the connection (and the caller's
+        # inflight slot) for it would stall unrelated requests; the closure
+        # keeps q alive, late puts are simply dropped with the queue
+        loop.run_in_executor(self._pool, work)
         await self._send_sse_headers(writer)
-        try:
-            while True:
-                try:
-                    kind, item = await asyncio.wait_for(
-                        q.get(), STREAM_TOKEN_TIMEOUT
-                    )
-                except asyncio.TimeoutError:
-                    writer.write(sse_event(fmt.error("stream token timeout", status=408)))
-                    break
-                if kind == "delta":
-                    writer.write(sse_event(fmt.stream_chunk(item)))
-                    await writer.drain()
-                elif kind == "done":
-                    writer.write(
-                        sse_event(fmt.stream_final(
-                            prompt_tokens=item["prompt_tokens"],
-                            completion_tokens=item["completion_tokens"],
-                            finish_reason=item["finish_reason"],
-                        ))
-                    )
-                    writer.write(SSE_DONE)
-                    await writer.drain()
-                    break
-                else:  # err
-                    writer.write(sse_event(fmt.error(str(item))))
-                    writer.write(SSE_DONE)
-                    await writer.drain()
-                    break
-        finally:
-            await fut
+        while True:
+            try:
+                kind, item = await asyncio.wait_for(
+                    q.get(), STREAM_TOKEN_TIMEOUT
+                )
+            except asyncio.TimeoutError:
+                writer.write(sse_event(fmt.error("stream token timeout", status=408)))
+                writer.write(SSE_DONE)
+                await writer.drain()
+                return
+            if kind == "delta":
+                writer.write(sse_event(fmt.stream_chunk(item)))
+                await writer.drain()
+            elif kind == "done":
+                writer.write(
+                    sse_event(fmt.stream_final(
+                        prompt_tokens=item["prompt_tokens"],
+                        completion_tokens=item["completion_tokens"],
+                        finish_reason=item["finish_reason"],
+                    ))
+                )
+                writer.write(SSE_DONE)
+                await writer.drain()
+                return
+            else:  # err
+                writer.write(sse_event(fmt.error(str(item))))
+                writer.write(SSE_DONE)
+                await writer.drain()
+                return

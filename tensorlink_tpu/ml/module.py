@@ -234,7 +234,8 @@ class DistributedModel:
             )
         return self._generate_pipelined(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
-            eos_ids=eos_ids, seed=seed, stream_cb=stream_cb,
+            top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
+            stream_cb=stream_cb,
         )
 
     def _generate_remote(
@@ -293,7 +294,8 @@ class DistributedModel:
         return [list(map(int, s)) for s in result["resp"]["sequences"]]
 
     def _generate_pipelined(
-        self, prompts, *, max_new_tokens, temperature, eos_ids, seed, stream_cb,
+        self, prompts, *, max_new_tokens, temperature, top_k=0, top_p=1.0,
+        eos_ids=(), seed=0, stream_cb=None,
     ) -> list[list[int]]:
         """Host-driven decode across stages with per-stage session caches
         (net-new vs the reference, which cannot generate across shards
@@ -320,7 +322,7 @@ class DistributedModel:
 
         seqs: list[list[int]] = [[] for _ in range(B)]
         done = np.zeros(B, bool)
-        tok = _sample_host(step_logits, temperature, rng)
+        tok = _sample_host(step_logits, temperature, rng, top_k=top_k, top_p=top_p)
         for step in range(max_new_tokens):
             emitted = []
             for i in range(B):
@@ -337,7 +339,7 @@ class DistributedModel:
                 session=session,
                 cache_len=cache_len,
             )
-            tok = _sample_host(logits[:, 0], temperature, rng)
+            tok = _sample_host(logits[:, 0], temperature, rng, top_k=top_k, top_p=top_p)
 
         # drop the session caches on the workers
         for stage in self.plan.stages:
@@ -351,6 +353,230 @@ class DistributedModel:
             except Exception:
                 pass
         return seqs
+
+    # ------------------------------------------------------------------
+    # training (reference module.py:348-524 micro-batch threads + autograd
+    # router; here: explicit vjp tags + token-weighted accumulation that
+    # matches engine/training.py::make_train_step exactly)
+    # ------------------------------------------------------------------
+    def _train_forward(self, tokens, attn_mask, tag: str) -> Any:
+        """Forward chain with train=True; workers record vjps under ``tag``.
+        Returns logits (jax array on the user process)."""
+        import jax.numpy as jnp
+
+        x = np.asarray(tokens, np.int32)
+        out = None
+        for stage in self.plan.stages:
+            body = {"job_id": self.job_id, "op": "stage", "train": True,
+                    "tag": tag}
+            if attn_mask is not None:
+                body["attn_mask"] = np.asarray(attn_mask, bool)
+            if stage.first:
+                body["tokens"] = x
+            else:
+                body["hidden"] = out
+            resp = self._request(stage.worker_id, proto.FORWARD, body)
+            out = np.asarray(resp["out"])
+        last = self.plan.stages[-1]
+        if not (last.last and last.holds_head):
+            head_stage = next(s for s in self.plan.stages if s.holds_head)
+            resp = self._request(
+                head_stage.worker_id, proto.FORWARD,
+                {"job_id": self.job_id, "op": "head", "hidden": out,
+                 "train": True, "tag": tag},
+            )
+            out = np.asarray(resp["out"])
+        return jnp.asarray(out)
+
+    def _train_backward(self, dlogits, tag: str) -> None:
+        """Reverse chain: cotangents flow last→first (head hop first when
+        the head lives on stage 0)."""
+        g = np.asarray(dlogits)
+        last = self.plan.stages[-1]
+        if not (last.last and last.holds_head):
+            head_stage = next(s for s in self.plan.stages if s.holds_head)
+            resp = self._request(
+                head_stage.worker_id, proto.BACKWARD,
+                {"job_id": self.job_id, "op": "head", "tag": tag, "grad": g},
+            )
+            g = np.asarray(resp["grad"])
+        for stage in reversed(self.plan.stages):
+            resp = self._request(
+                stage.worker_id, proto.BACKWARD,
+                {"job_id": self.job_id, "op": "stage", "tag": tag, "grad": g},
+            )
+            if "grad" in resp:
+                g = np.asarray(resp["grad"])
+
+    def init_optimizer(self, name: str = "adamw", **spec) -> None:
+        """Fan the optimizer spec out to every stage (reference
+        create_distributed_optimizer init, ml/optim.py:81-129).
+
+        Gradient clipping is handled by the DRIVER, not per-stage: each
+        stage clipping by its own norm would diverge from the reference
+        single-program semantics, so workers get grad_clip=None and the
+        driver folds ``min(1, clip/global_norm)`` into the step scale."""
+        self._grad_clip = spec.pop("grad_clip", 1.0)
+        for stage in self.plan.stages:
+            self._request(
+                stage.worker_id, proto.OPTIMIZER,
+                {"job_id": self.job_id, "op": "init",
+                 "spec": {"name": name, "grad_clip": None, **spec}},
+            )
+        self._opt_ready = True
+
+    def _global_grad_norm(self, scale: float = 1.0) -> float:
+        sq = 0.0
+        for stage in self.plan.stages:
+            resp = self._request(
+                stage.worker_id, proto.OPTIMIZER,
+                {"job_id": self.job_id, "op": "grad_norm"},
+            )
+            sq += float(resp.get("grad_norm", 0.0)) ** 2
+        return (sq**0.5) * scale
+
+    def optimizer_step(self, scale: float = 1.0) -> dict:
+        """Apply accumulated gradients on every stage; returns the global
+        grad norm (of the scaled, pre-clip gradients — same number the
+        compiled train step reports)."""
+        gnorm = self._global_grad_norm(scale)
+        final_scale = scale
+        clip = getattr(self, "_grad_clip", None)
+        if clip and gnorm > clip:
+            final_scale = scale * clip / gnorm
+        for stage in self.plan.stages:
+            self._request(
+                stage.worker_id, proto.OPTIMIZER,
+                {"job_id": self.job_id, "op": "step", "scale": final_scale},
+            )
+        return {"grad_norm": gnorm}
+
+    def zero_grad(self) -> None:
+        for stage in self.plan.stages:
+            self._request(
+                stage.worker_id, proto.OPTIMIZER,
+                {"job_id": self.job_id, "op": "zero"},
+            )
+
+    def train_step(
+        self,
+        tokens: np.ndarray,  # int [B, T]
+        loss_mask: np.ndarray | None = None,  # bool [B, T]
+        attn_mask: np.ndarray | None = None,
+        *,
+        step_optimizer: bool = True,
+    ) -> dict:
+        """One token-weighted causal-LM training step across the pipeline.
+
+        Numerically equivalent to the single-program
+        ``engine.training.make_train_step`` (the parity test for this is the
+        backward-correctness check the reference never had, SURVEY §4).
+        """
+        assert self.plan is not None
+        tokens = np.asarray(tokens, np.int32)
+        B = tokens.shape[0]
+        n_micro = self.plan.n_micro if B % max(self.plan.n_micro, 1) == 0 else 1
+        mb = B // n_micro
+
+        self._step = getattr(self, "_step", 0) + 1
+        total_nll = 0.0
+        # Forward and backward are interleaved per micro-batch so each
+        # worker holds residuals for ONE micro at a time — the memory
+        # contract micro-batching exists for. Cotangents are sums (not
+        # means), so scaling once by the total token count — computable
+        # upfront from the loss masks — reproduces the token-mean gradient.
+        def micro_mask(m: int):
+            sl = slice(m * mb, (m + 1) * mb)
+            am = attn_mask[sl] if attn_mask is not None else None
+            lm = loss_mask[sl] if loss_mask is not None else (
+                am if am is not None else np.ones_like(tokens[sl], bool)
+            )
+            return sl, am, np.asarray(lm, bool)
+
+        total_tok = max(
+            float(sum(micro_mask(m)[2][:, 1:].sum() for m in range(n_micro))),
+            1.0,
+        )
+        for m in range(n_micro):
+            sl, am, lm = micro_mask(m)
+            tag = f"s{self._step}m{m}"
+            logits = self._train_forward(tokens[sl], am, tag)
+            nll_sum, dlogits, _ = _ce_sum_and_grad(logits, tokens[sl], lm)
+            total_nll += float(nll_sum)
+            self._train_backward(np.asarray(dlogits), tag)
+
+        out = {"loss": total_nll / total_tok, "n_tokens": int(total_tok),
+               "n_micro": n_micro}
+        if step_optimizer:
+            if not getattr(self, "_opt_ready", False):
+                raise RuntimeError("call init_optimizer() before train_step()")
+            out.update(self.optimizer_step(scale=1.0 / total_tok))
+        return out
+
+    # ------------------------------------------------------------------
+    # checkpointing (net-new: the reference has no mid-training
+    # checkpoint/resume, SURVEY §5 — Orbax-style save/restore + HF export)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, ckpt_dir: str) -> dict:
+        """Each stage writes params (+ optimizer state) to ``ckpt_dir``
+        (shared filesystem), plus a manifest for resume."""
+        import json
+        from pathlib import Path
+
+        paths = []
+        for stage in self.plan.stages:
+            resp = self._request(
+                stage.worker_id, proto.CHECKPOINT,
+                {"job_id": self.job_id, "op": "save", "dir": str(ckpt_dir)},
+            )
+            paths.append(resp["path"])
+        manifest = {
+            "model": {k: v for k, v in self.model_spec.items()},
+            "plan": self.plan.to_json(),
+            "step": getattr(self, "_step", 0),
+        }
+        Path(ckpt_dir).mkdir(parents=True, exist_ok=True)
+        (Path(ckpt_dir) / "manifest.json").write_text(json.dumps(manifest, indent=2))
+        return {"paths": paths}
+
+    def restore_checkpoint(self, ckpt_dir: str) -> None:
+        for stage in self.plan.stages:
+            self._request(
+                stage.worker_id, proto.CHECKPOINT,
+                {"job_id": self.job_id, "op": "restore", "dir": str(ckpt_dir)},
+            )
+
+    def export_hf_checkpoint(self, out_dir: str):
+        """Download all stage params, merge, and write an HF-layout
+        safetensors checkpoint (engine/loader.py::export_hf) — the analogue
+        of the reference's parameter download into ``models/<name>/``
+        (module.py:614-630), but in the interoperable HF format."""
+        from tensorlink_tpu.engine.loader import export_hf
+
+        merged = self._merge_stage_params(self.parameters())
+        return export_hf(self.cfg, merged, out_dir)
+
+    def _merge_stage_params(self, trees: list[dict]) -> dict:
+        import jax
+
+        full: dict = {}
+        layer_trees = []
+        for stage, tree in zip(self.plan.stages, trees):
+            if stage.first and "embed" in tree:
+                full["embed"] = tree["embed"]
+            if stage.holds_head:
+                if "final_norm" in tree:
+                    full["final_norm"] = tree["final_norm"]
+                if "lm_head" in tree:
+                    full["lm_head"] = tree["lm_head"]
+                if "embed" in tree and "embed" not in full:
+                    full["embed"] = tree["embed"]
+            if "layers" in tree:
+                layer_trees.append(tree["layers"])
+        full["layers"] = jax.tree.map(
+            lambda *xs: np.concatenate(xs, axis=0), *layer_trees
+        )
+        return full
 
     # ------------------------------------------------------------------
     # parameters (reference module.py:577-650 downloads state dicts)
@@ -408,15 +634,56 @@ def _stage_dict(stage) -> dict:
     return asdict(stage)
 
 
-def _sample_host(logits: np.ndarray, temperature: float, rng) -> np.ndarray:
-    """Greedy / temperature sampling on host (pipelined decode only; the
-    single-stage path samples on device, engine/sampling.py)."""
+def _ce_sum_and_grad(logits, tokens, loss_mask):
+    """Next-token cross-entropy SUM (not mean) + dlogits, fp32 — cotangents
+    of the sum accumulate linearly across micro-batches, so dividing once by
+    the total token count at optimizer-step time reproduces the token-mean
+    loss of engine/training.py::causal_lm_loss exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.asarray(logits)
+    tokens = jnp.asarray(np.asarray(tokens, np.int32))
+    mask = jnp.asarray(np.asarray(loss_mask, bool))
+
+    def loss_fn(lg):
+        lg32 = lg[:, :-1].astype(jnp.float32)
+        tg = tokens[:, 1:]
+        m = mask[:, 1:]
+        logz = jax.nn.logsumexp(lg32, axis=-1)
+        gold = jnp.take_along_axis(lg32, tg[..., None], axis=-1)[..., 0]
+        return ((logz - gold) * m).sum()
+
+    nll_sum, dlogits = jax.value_and_grad(loss_fn)(logits)
+    n_tok = np.asarray(mask[:, 1:].sum())
+    return np.asarray(nll_sum), np.asarray(dlogits), n_tok
+
+
+def _sample_host(
+    logits: np.ndarray, temperature: float, rng, *, top_k: int = 0,
+    top_p: float = 1.0,
+) -> np.ndarray:
+    """Greedy / temperature / top-k / top-p sampling on host (pipelined
+    decode only; the single-stage path samples on device, engine/sampling.py
+    — same filtering order: top-k then top-p)."""
     if temperature <= 0.0:
         return np.argmax(logits, -1).astype(np.int32)
     x = logits.astype(np.float64) / temperature
     x -= x.max(-1, keepdims=True)
     p = np.exp(x)
     p /= p.sum(-1, keepdims=True)
-    return np.array(
-        [rng.choice(len(row), p=row) for row in p], np.int32
-    )
+    out = np.empty(p.shape[0], np.int32)
+    for i, row in enumerate(p):
+        if top_k and top_k < len(row):
+            kth = np.partition(row, -top_k)[-top_k]
+            row = np.where(row >= kth, row, 0.0)
+        if top_p < 1.0:
+            order = np.argsort(-row)
+            csum = np.cumsum(row[order])
+            keep_n = max(int(np.searchsorted(csum, top_p * csum[-1]) + 1), 1)
+            mask = np.zeros_like(row, bool)
+            mask[order[:keep_n]] = True
+            row = np.where(mask, row, 0.0)
+        row = row / row.sum()
+        out[i] = rng.choice(len(row), p=row)
+    return out

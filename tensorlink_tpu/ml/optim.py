@@ -1,0 +1,38 @@
+"""Distributed optimizer handle (reference ml/optim.py:81-205).
+
+The reference dynamically subclasses a torch optimizer whose ``step`` /
+``zero_grad`` fan OPTIMIZER RPCs to every worker and poll for completion.
+Here each stage runs optax on its own (sharded) parameters — the fan-out
+carries only the op + a gradient scale, and completion is the tensor-request
+reply (no polling)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DistributedOptimizer:
+    """Thin handle over the per-stage optax optimizers of one job."""
+
+    def __init__(self, model, name: str = "adamw", **spec: Any):
+        self.model = model
+        self.name = name
+        self.spec = spec
+        model.init_optimizer(name, **spec)
+
+    def step(self, scale: float = 1.0) -> dict:
+        """Apply accumulated gradients on every stage. ``scale`` multiplies
+        the accumulated cotangent sums first (DistributedModel.train_step
+        passes 1/total_tokens; manual training loops usually pass 1.0)."""
+        return self.model.optimizer_step(scale=scale)
+
+    def zero_grad(self) -> None:
+        self.model.zero_grad()
+
+
+def create_distributed_optimizer(model, name: str = "adamw", **spec: Any):
+    """Factory matching the reference's surface
+    (``create_distributed_optimizer(model, torch.optim.AdamW, **kwargs)``,
+    ml/optim.py:81) — optimizer identity is a name + kwargs resolved by
+    engine/training.py::make_optimizer on each worker."""
+    return DistributedOptimizer(model, name, **spec)

@@ -253,6 +253,12 @@ class DistributedValidator:
                 job.model.shutdown()
         return True
 
+    def hosted_snapshot(self) -> list[dict]:
+        """Consistent view for API threads (the hosted dict is mutated by
+        pool threads under _host_lock; readers must take it too)."""
+        with self._host_lock:
+            return [{"name": j.name, "status": j.status} for j in self.hosted.values()]
+
     def model_status(self, name: str) -> dict:
         job = self.hosted.get(name)
         if job is None:
@@ -305,39 +311,33 @@ class DistributedValidator:
         args = normalize_generate_args(req, prompt_len=len(ids), max_context=max_ctx)
 
         stripper = ThinkStripStream() if not req.enable_thinking else None
+        # Incremental detokenization via the offset algorithm (HF
+        # TextStreamer): both decodes share the same start token, so
+        # SentencePiece leading-space handling stays consistent, and each
+        # step decodes only a bounded window — not the whole sequence
+        # (O(n²) otherwise on the SSE hot path).
         emitted_ids: list[int] = []
-        last_text = ""
-        # incremental detokenization: re-decoding the full sequence per step
-        # is O(n²) on the SSE hot path. Decode a bounded tail window; fold
-        # the window into an exact full-prefix decode every WINDOW tokens.
-        WINDOW = 64
-        base_ids = 0
-        base_text = ""
+        prefix_offset = 0
+        read_offset = 0
 
-        def current_text() -> str:
-            nonlocal base_ids, base_text
-            if len(emitted_ids) - base_ids > 2 * WINDOW:
-                base_ids = len(emitted_ids) - WINDOW
-                base_text = tok.decode(emitted_ids[:base_ids])
-            return base_text + tok.decode(emitted_ids[base_ids:])
-
-        def stream_cb(new_tokens: list[int]) -> None:
-            nonlocal last_text
-            if on_delta is None:
-                return
-            emitted_ids.extend(new_tokens)
-            text = current_text()
-            delta = text[len(last_text):]
-            # hold back trailing replacement char (partial multibyte)
-            if delta.endswith("�"):
-                delta = delta[:-1]
-            if not delta:
-                return
-            last_text += delta
+        def _emit(delta: str) -> None:
             if stripper is not None:
                 delta = stripper.feed(delta)
             if delta:
                 on_delta(delta)
+
+        def stream_cb(new_tokens: list[int]) -> None:
+            nonlocal prefix_offset, read_offset
+            if on_delta is None:
+                return
+            emitted_ids.extend(new_tokens)
+            prefix_text = tok.decode(emitted_ids[prefix_offset:read_offset])
+            new_text = tok.decode(emitted_ids[prefix_offset:])
+            if len(new_text) > len(prefix_text) and not new_text.endswith("�"):
+                delta = new_text[len(prefix_text):]
+                prefix_offset = read_offset
+                read_offset = len(emitted_ids)
+                _emit(delta)
 
         with job.lock:  # serialize per-model generation
             seqs = job.model.generate(
@@ -350,10 +350,18 @@ class DistributedValidator:
                 stream_cb=stream_cb if on_delta is not None else None,
             )
         out_ids = seqs[0]
-        if on_delta is not None and stripper is not None:
-            tail = stripper.flush()
-            if tail:
-                on_delta(tail)
+        if on_delta is not None:
+            # flush whatever the offset algorithm still holds (including a
+            # trailing partial-UTF8 replacement char — the stream must match
+            # the non-stream text for the same request)
+            prefix_text = tok.decode(emitted_ids[prefix_offset:read_offset])
+            new_text = tok.decode(emitted_ids[prefix_offset:])
+            if len(new_text) > len(prefix_text):
+                _emit(new_text[len(prefix_text):])
+            if stripper is not None:
+                tail = stripper.flush()
+                if tail:
+                    on_delta(tail)
         eos = set(tok.eos_ids)
         full_text = tok.decode([i for i in out_ids if i not in eos])
         reasoning, answer = extract_reasoning_and_answer(full_text)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any
 
 import numpy as np
@@ -45,8 +46,14 @@ class StageRuntime:
     engine: Any = None  # GenerationEngine for whole-model jobs
     sessions: dict[str, Any] = field(default_factory=dict)  # session -> KVCache
     training: bool = False
-    # L6 state (activation store for cross-host backward) lives here later
+    # activation store for cross-host backward: tag -> (vjp_fn, wrt_input)
+    # — the explicit replacement for torch's implicit autograd graph the
+    # reference replays on the worker (ml/worker.py:233-291)
     saved: dict[str, Any] = field(default_factory=dict)
+    grad_accum: Any = None  # summed param cotangents across micro-batches
+    n_accum: int = 0
+    opt: Any = None  # optax transform
+    opt_state: Any = None
 
     @property
     def n_layers(self) -> int:
@@ -119,6 +126,7 @@ class DistributedWorker:
                         proto.GENERATE: proto.GENERATE_RESP,
                         proto.OPTIMIZER: proto.OPTIMIZER_RESP,
                         proto.PARAMS_REQ: proto.PARAMETERS,
+                        proto.CHECKPOINT: proto.CHECKPOINT_RESP,
                         "load_stage": proto.MODULE_LOADED,
                     }.get(kind, proto.FORWARD_RESP)
                     self._respond(peer, resp_tag, rid, {"error": f"{type(e).__name__}: {e}"})
@@ -134,10 +142,12 @@ class DistributedWorker:
             self._params_req(p)
         elif kind == proto.TRAIN_MODE:
             self._train_mode(p)
-        elif kind in (proto.BACKWARD, proto.OPTIMIZER):
-            # L6 training path; fail fast instead of letting the requester
-            # wait out the full tensor-request timeout
-            raise NotImplementedError(f"{kind} not supported yet (training path)")
+        elif kind == proto.BACKWARD:
+            self._backward(p)
+        elif kind == proto.OPTIMIZER:
+            self._optimizer(p)
+        elif kind == proto.CHECKPOINT:
+            self._checkpoint(p)
         elif kind == "shutdown_job":
             with self._lock:
                 self.jobs.pop(p.get("job_id", ""), None)
@@ -229,7 +239,8 @@ class DistributedWorker:
     # -- forward --------------------------------------------------------
     def _forward(self, p: dict) -> None:
         """op="stage": run my layer slice (optionally with a decode-session
-        KV cache). op="head": final norm + logits (tied-embedding hop)."""
+        KV cache). op="head": final norm + logits (tied-embedding hop).
+        ``train=True`` + ``tag`` records the vjp for a later BACKWARD."""
         import jax
         import jax.numpy as jnp
 
@@ -242,9 +253,17 @@ class DistributedWorker:
             rt.sessions.pop(p.get("session"), None)
             self._respond(p["peer"], proto.FORWARD_RESP, p["rid"], {"ok": True})
             return
+        train = bool(p.get("train", False))
+        tag = p.get("tag", "")
         if op == "head":
             hidden = jnp.asarray(np.asarray(p["hidden"]))
-            logits = head_forward(rt.params, hidden, rt.cfg)
+            if train:
+                logits, vjp = jax.vjp(
+                    lambda prm, h: head_forward(prm, h, rt.cfg), rt.params, hidden
+                )
+                rt.saved[tag + ".head"] = (vjp, True)
+            else:
+                logits = head_forward(rt.params, hidden, rt.cfg)
             self._respond(
                 p["peer"], proto.FORWARD_RESP, p["rid"],
                 {"out": np.asarray(jax.device_get(logits))},
@@ -261,6 +280,36 @@ class DistributedWorker:
             kw["hidden"] = jnp.asarray(np.asarray(p["hidden"]))
         if p.get("attn_mask") is not None:
             kw["attn_mask"] = jnp.asarray(np.asarray(p["attn_mask"], bool))
+
+        if train:
+            # no KV cache in training; record the vjp keyed by the driver's
+            # (batch, micro) tag — cotangents arrive via BACKWARD
+            mask = kw.get("attn_mask")
+            if first:
+                toks = kw["tokens"]
+                out, vjp = jax.vjp(
+                    lambda prm: stage_forward(
+                        prm, rt.cfg, tokens=toks, attn_mask=mask,
+                        first=True, last=apply_head, remat=True,
+                    )[0],
+                    rt.params,
+                )
+                rt.saved[tag] = (vjp, False)
+            else:
+                hid = kw["hidden"]
+                out, vjp = jax.vjp(
+                    lambda prm, h: stage_forward(
+                        prm, rt.cfg, hidden=h, attn_mask=mask,
+                        first=False, last=apply_head, remat=True,
+                    )[0],
+                    rt.params, hid,
+                )
+                rt.saved[tag] = (vjp, True)
+            self._respond(
+                p["peer"], proto.FORWARD_RESP, p["rid"],
+                {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
+            )
+            return
 
         session = p.get("session")
         cache = None
@@ -281,6 +330,141 @@ class DistributedWorker:
             p["peer"], proto.FORWARD_RESP, p["rid"],
             {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
         )
+
+    # -- backward (reference _handle_backward replays torch autograd,
+    # ml/worker.py:233-291; here it applies the recorded vjp) -------------
+    def _backward(self, p: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        rt = self._runtime(p["job_id"])
+        tag = p.get("tag", "")
+        op = p.get("op", "stage")
+        key = tag + ".head" if op == "head" else tag
+        entry = rt.saved.pop(key, None)
+        if entry is None:
+            raise KeyError(f"no saved activations for tag {key!r}")
+        vjp, wrt_input = entry
+        g = jnp.asarray(np.asarray(p["grad"]), rt.cfg.dtype)
+        if wrt_input:
+            grad_params, grad_input = vjp(g)
+        else:
+            (grad_params,) = vjp(g)
+            grad_input = None
+        self._accumulate(rt, grad_params)
+        body = {"ok": True}
+        if grad_input is not None:
+            body["grad"] = np.asarray(jax.device_get(grad_input))
+        self._respond(p["peer"], proto.BACKWARD_RESP, p["rid"], body)
+
+    def _accumulate(self, rt: StageRuntime, grads) -> None:
+        import jax
+
+        if rt.grad_accum is None:
+            rt.grad_accum = grads
+        else:
+            rt.grad_accum = jax.tree.map(
+                lambda a, b: a + b, rt.grad_accum, grads
+            )
+        rt.n_accum += 1
+
+    # -- optimizer (reference optimizer RPC fan-out, ml/optim.py:81-205;
+    # here each stage runs optax on its own sharded params) ---------------
+    def _optimizer(self, p: dict) -> None:
+        import jax
+        import optax
+
+        from tensorlink_tpu.engine.training import make_optimizer
+
+        rt = self._runtime(p["job_id"])
+        op = p.get("op")
+        if op == "init":
+            spec = dict(p.get("spec", {}))
+            name = spec.pop("name", "adamw")
+            rt.opt = make_optimizer(name, **spec)
+            rt.opt_state = rt.opt.init(rt.params)
+            body = {"ok": True, "op": op}
+        elif op == "zero":
+            rt.grad_accum = None
+            rt.n_accum = 0
+            body = {"ok": True, "op": op}
+        elif op == "grad_norm":
+            # this stage's raw accumulated-cotangent norm; the driver
+            # combines stages into the true global norm so clipping matches
+            # the single-program optimizer chain (engine/training.py)
+            gn = (
+                float(jax.device_get(optax.global_norm(rt.grad_accum)))
+                if rt.grad_accum is not None
+                else 0.0
+            )
+            body = {"ok": True, "op": op, "grad_norm": gn}
+        elif op == "step":
+            if rt.opt is None:
+                raise ValueError("optimizer not initialized")
+            if rt.grad_accum is None:
+                raise ValueError("no accumulated gradients")
+            scale = float(p.get("scale", 1.0))
+            if scale != 1.0:
+                # driver-supplied 1/total_tokens: turns the accumulated
+                # sum-NLL cotangents into the token-mean gradient
+                rt.grad_accum = jax.tree.map(
+                    lambda g: g * scale, rt.grad_accum
+                )
+            updates, rt.opt_state = rt.opt.update(
+                rt.grad_accum, rt.opt_state, rt.params
+            )
+            rt.params = optax.apply_updates(rt.params, updates)
+            if rt.engine is not None:
+                rt.engine.params = rt.params
+            gnorm = float(jax.device_get(optax.global_norm(rt.grad_accum)))
+            rt.grad_accum = None
+            rt.n_accum = 0
+            body = {"ok": True, "op": op, "grad_norm": gnorm}
+        else:
+            raise ValueError(f"unknown optimizer op {op!r}")
+        self._respond(p["peer"], proto.OPTIMIZER_RESP, p["rid"], body)
+
+    # -- checkpoint (net-new vs reference: no mid-training checkpoint
+    # exists there, SURVEY §5) -------------------------------------------
+    def _checkpoint(self, p: dict) -> None:
+        import jax
+
+        from tensorlink_tpu.core import serialization as ser
+
+        rt = self._runtime(p["job_id"])
+        op = p.get("op", "save")
+        path = Path(p["dir"]) / f"stage_{rt.stage['layer_lo']}_{rt.stage['layer_hi']}.tlts"
+        if op == "save":
+            path.parent.mkdir(parents=True, exist_ok=True)
+            host = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), rt.params)
+            state = {"params": host, "stage": rt.stage}
+            if rt.opt_state is not None:
+                state["opt_state"] = jax.tree.map(
+                    lambda a: np.asarray(jax.device_get(a)), rt.opt_state
+                )
+            ser.encode_to_file(state, path)
+            body = {"ok": True, "path": str(path)}
+        elif op == "restore":
+            import jax.numpy as jnp
+
+            state = ser.decode_from_file(path)
+            rt.params = jax.tree.map(jnp.asarray, state["params"])
+            restored_opt = False
+            if "opt_state" in state and rt.opt is not None:
+                tmpl = rt.opt.init(rt.params)
+                flat, treedef = jax.tree.flatten(tmpl)
+                restored = jax.tree.leaves(state["opt_state"])
+                rt.opt_state = jax.tree.unflatten(
+                    treedef, [jnp.asarray(r) for r in restored]
+                )
+                restored_opt = True
+            if rt.engine is not None:
+                rt.engine.params = rt.params
+            body = {"ok": True, "restored_opt": restored_opt,
+                    "opt_in_checkpoint": "opt_state" in state}
+        else:
+            raise ValueError(f"unknown checkpoint op {op!r}")
+        self._respond(p["peer"], proto.CHECKPOINT_RESP, p["rid"], body)
 
     # -- generate (whole-model jobs) ------------------------------------
     def _generate(self, p: dict) -> None:

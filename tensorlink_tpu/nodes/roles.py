@@ -186,6 +186,7 @@ class WorkerServer(RoleServer):
         for tag in (
             proto.FORWARD, proto.BACKWARD, proto.GENERATE,
             proto.PARAMS_REQ, proto.OPTIMIZER, proto.TRAIN_MODE,
+            proto.CHECKPOINT,
         ):
             self.register(tag, self._relay_to_ml)
 

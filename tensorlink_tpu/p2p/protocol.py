@@ -80,6 +80,8 @@ OPTIMIZER = "opt"
 OPTIMIZER_RESP = "opt.resp"
 TRAIN_MODE = "train.mode"
 TRAIN_MODE_ACK = "train.mode.ack"
+CHECKPOINT = "ckpt"  # save/restore stage params + optimizer state
+CHECKPOINT_RESP = "ckpt.resp"
 
 
 def pack_header(kind: int, tag: str, payload_len: int) -> bytes:

@@ -1,0 +1,218 @@
+"""Distributed training parity: pipeline train_step == compiled single-
+program train step (engine/training.py). This is the backward-correctness
+test against a non-distributed reference that the reference codebase lacks
+(SURVEY §4 gaps), plus checkpoint save/restore and HF export round-trips.
+"""
+
+import numpy as np
+import pytest
+
+from tensorlink_tpu.core.config import UserConfig, ValidatorConfig, WorkerConfig
+from tensorlink_tpu.models import ModelConfig
+
+pytestmark = pytest.mark.e2e
+
+
+def tiny_cfg(**kw):
+    import jax.numpy as jnp
+
+    base = dict(
+        family="llama",
+        vocab_size=128,
+        d_model=48,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=12,
+        d_ff=96,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
+
+    tmp = tmp_path_factory.mktemp("train_cluster")
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp / "keys"),
+        log_dir=str(tmp / "logs"),
+        env_file=str(tmp / ".env"),
+    )
+    validator = ValidatorNode(ValidatorConfig(endpoint=False, **common)).start()
+    seeds = [["127.0.0.1", validator.port]]
+    w1 = WorkerNode(WorkerConfig(seed_validators=seeds, **common)).start()
+    w2 = WorkerNode(
+        WorkerConfig(seed_validators=seeds, duplicate="1", **common)
+    ).start()
+    user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if len(validator.status()["peers"]) >= 3:
+            break
+        time.sleep(0.2)
+    yield {"validator": validator, "workers": [w1, w2], "user": user}
+    for n in (user, w1, w2, validator):
+        n.stop()
+
+
+def _local_reference(cfg, seed, batches, *, lr=1e-3):
+    """Single-program train steps via the compiled path."""
+    import jax
+
+    from tensorlink_tpu.engine.training import make_optimizer, make_train_step
+    from tensorlink_tpu.models.transformer import init_params
+
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    opt = make_optimizer("adamw", lr=lr, grad_clip=1.0)
+    ts = make_train_step(cfg, opt, n_micro=1, donate=False)
+    state = ts.init_state(params)
+    losses = []
+    for toks in batches:
+        params, state, metrics = ts.step_fn(
+            params, state, {"tokens": toks, "loss_mask": None}
+        )
+        losses.append(float(metrics["loss"]))
+    return params, losses
+
+
+def _batches(cfg, n, B=4, T=16, seed=123):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, cfg.vocab_size, size=(B, T)).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def test_single_stage_training_parity(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    batches = _batches(cfg, 3)
+    ref_params, ref_losses = _local_reference(cfg, seed=21, batches=batches)
+
+    with DistributedModel(
+        cfg, node=cluster["user"], seed=21, seq_len=64, training=True
+    ) as model:
+        assert model.plan.n_stages == 1
+        model.init_optimizer("adamw", lr=1e-3)
+        losses = [model.train_step(t)["loss"] for t in batches]
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+        got = model.parameters()[0]
+    np.testing.assert_allclose(
+        got["embed"]["tok"], np.asarray(ref_params["embed"]["tok"]),
+        rtol=2e-4, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        got["layers"]["attn"]["wq"], np.asarray(ref_params["layers"]["attn"]["wq"]),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_pipelined_tied_training_parity(cluster):
+    """2-stage tied-embedding pipeline (head hop + micro-batching) must
+    match the single-program step too."""
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg(n_layers=6, d_model=64, d_ff=128, tie_embeddings=True)
+    batches = _batches(cfg, 2, B=4, T=12)
+    ref_params, ref_losses = _local_reference(cfg, seed=5, batches=batches)
+
+    for w in cluster["workers"]:
+        w.send_request("set_capacity", {"hbm_bytes": 4_000_000.0, "n_devices": 1})
+    model = None
+    try:
+        model = DistributedModel(
+            cfg, node=cluster["user"], seed=5, seq_len=32, batch=4, training=True
+        )
+        assert model.plan.n_stages == 2, model.plan
+        assert model.plan.n_micro >= 2
+        model.init_optimizer("adamw", lr=1e-3)
+        losses = [model.train_step(t)["loss"] for t in batches]
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=1e-5)
+        merged = model._merge_stage_params(model.parameters())
+        np.testing.assert_allclose(
+            merged["embed"]["tok"], np.asarray(ref_params["embed"]["tok"]),
+            rtol=3e-4, atol=3e-5,
+        )
+        np.testing.assert_allclose(
+            merged["layers"]["mlp"]["w_gate"],
+            np.asarray(ref_params["layers"]["mlp"]["w_gate"]),
+            rtol=3e-4, atol=3e-5,
+        )
+    finally:
+        if model is not None:
+            model.shutdown()
+        for w in cluster["workers"]:
+            w.send_request("set_capacity", w.executor.capacity())
+
+
+def test_checkpoint_save_restore(cluster, tmp_path):
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    batches = _batches(cfg, 2)
+    with DistributedModel(
+        cfg, node=cluster["user"], seed=3, seq_len=64, training=True
+    ) as model:
+        model.init_optimizer("adamw", lr=1e-3)
+        model.train_step(batches[0])
+        model.save_checkpoint(str(tmp_path / "ckpt"))
+        snap = model.parameters()[0]
+
+        model.train_step(batches[1])  # diverge
+        moved = model.parameters()[0]
+        assert not np.allclose(snap["embed"]["tok"], moved["embed"]["tok"])
+
+        model.restore_checkpoint(str(tmp_path / "ckpt"))
+        back = model.parameters()[0]
+        np.testing.assert_array_equal(snap["embed"]["tok"], back["embed"]["tok"])
+        # optimizer state restored too: next step from the restored point
+        # must match a fresh step from the snapshot
+        r1 = model.train_step(batches[1])
+        assert np.isfinite(r1["loss"])
+
+
+def test_hf_export_roundtrip(cluster, tmp_path):
+    from tensorlink_tpu.engine.loader import load_params
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    with DistributedModel(
+        cfg, node=cluster["user"], seed=9, seq_len=64
+    ) as model:
+        out = model.export_hf_checkpoint(str(tmp_path / "hf"))
+        merged = model._merge_stage_params(model.parameters())
+    _, loaded = load_params(out, cfg)
+    np.testing.assert_allclose(
+        np.asarray(loaded["embed"]["tok"]), merged["embed"]["tok"],
+        rtol=1e-6, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(loaded["layers"]["attn"]["wk"]),
+        merged["layers"]["attn"]["wk"],
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_distributed_optimizer_factory(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.ml.optim import create_distributed_optimizer
+
+    cfg = tiny_cfg()
+    with DistributedModel(
+        cfg, node=cluster["user"], seed=1, seq_len=64, training=True
+    ) as model:
+        opt = create_distributed_optimizer(model, "adamw", lr=1e-3)
+        r = model.train_step(_batches(cfg, 1)[0], step_optimizer=False)
+        assert np.isfinite(r["loss"])
+        out = opt.step(scale=1.0 / max(r["n_tokens"], 1))
+        assert out["grad_norm"] > 0
+        opt.zero_grad()
