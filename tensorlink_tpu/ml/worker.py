@@ -201,11 +201,15 @@ class DistributedWorker:
             )
             del full
 
+        mesh = self._build_stage_mesh(cfg, stage)
+        if mesh is not None:
+            params = self._shard_params(params, cfg, stage, mesh)
         rt = StageRuntime(
             job_id=job_id,
             cfg=cfg,
             stage=stage,
             params=params,
+            mesh=mesh,
             training=bool(p.get("training", False)),
         )
         if rt.whole_model:
@@ -215,6 +219,12 @@ class DistributedWorker:
             rt.engine = GenerationEngine(
                 cfg,
                 params,
+                mesh=mesh,
+                # batch buckets include 1, so never shard cache batch on the
+                # data axis here; kv heads ride the tensor axis
+                cache_specs=(
+                    self._cache_specs_for(rt, batch=1) if mesh is not None else None
+                ),
                 max_seq_len=min(cfg.max_seq_len, ml_cfg.max_seq_len),
                 seq_buckets=ml_cfg.seq_buckets,
                 batch_buckets=ml_cfg.batch_buckets,
@@ -228,6 +238,55 @@ class DistributedWorker:
         self._respond(
             p["peer"], proto.MODULE_LOADED, p["rid"],
             {"job_id": job_id, "ok": True, "n_layers": hi - lo},
+        )
+
+    def _build_stage_mesh(self, cfg, stage: dict):
+        """Build this stage's local device mesh from the plan's axis sizes
+        (TP/FSDP/DP/EP inside one worker — GSPMD shards, XLA inserts the
+        collectives; SURVEY §2.2 capability upgrades the reference lacks)."""
+        import jax
+
+        axes = {k: int(v) for k, v in (stage.get("mesh_axes") or {}).items()}
+        n = 1
+        for v in axes.values():
+            n *= v
+        if n <= 1:
+            return None
+        devs = jax.local_devices()
+        if n > len(devs):
+            self.log.warning(
+                "plan wants %d-device mesh, have %d — running unsharded",
+                n, len(devs),
+            )
+            return None
+        from tensorlink_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(axes, devs[:n])
+
+    def _shard_params(self, params, cfg, stage: dict, mesh):
+        from tensorlink_tpu.parallel.mesh import put
+        from tensorlink_tpu.parallel.planner import StagePlan, stage_param_specs
+
+        specs = stage_param_specs(cfg, StagePlan(**stage))
+        try:
+            return put(mesh, params, specs)
+        except ValueError as e:
+            self.log.warning("param sharding failed (%s); replicating", e)
+            return params
+
+    def _cache_specs_for(self, rt: StageRuntime, batch: int):
+        """KV-cache PartitionSpecs on this stage's mesh: kv heads on tensor
+        (when they divide), batch on data only when the batch divides it —
+        serving batches of 1 must not fail against a data axis."""
+        from tensorlink_tpu.models.transformer import cache_specs
+
+        axes = rt.stage.get("mesh_axes") or {}
+        tp = axes.get("tensor", 1)
+        dp = axes.get("data", 1)
+        return cache_specs(
+            rt.cfg,
+            data_axis="data" if dp > 1 and batch % dp == 0 else None,
+            tensor_axis="tensor" if tp > 1 and rt.cfg.n_kv_heads % tp == 0 else None,
         )
 
     def _runtime(self, job_id: str) -> StageRuntime:
@@ -321,6 +380,10 @@ class DistributedWorker:
                 cache = KVCache.init(
                     scfg, batch, max_len=int(p.get("cache_len", rt.cfg.max_seq_len))
                 )
+                if rt.mesh is not None:
+                    from tensorlink_tpu.parallel.mesh import put
+
+                    cache = put(rt.mesh, cache, self._cache_specs_for(rt, batch))
         out, new_cache = stage_forward(
             rt.params, rt.cfg, cache=cache, first=first, last=apply_head, **kw
         )

@@ -78,6 +78,28 @@ def shard(mesh: Mesh, spec: P):
     return NamedSharding(mesh, spec)
 
 
+def get_shard_map():
+    """shard_map across jax versions (moved out of experimental in 0.8)."""
+    try:
+        from jax import shard_map
+
+        return shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def mark_varying(x, axis_name: str):
+    """Mark an array varying over a manual axis (VMA) across jax versions
+    (lax.pvary → lax.pcast in 0.9)."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
+
+
 def put(mesh: Mesh, tree, specs):
     """device_put a pytree with a matching PartitionSpec pytree."""
     return jax.tree.map(

@@ -1,0 +1,96 @@
+"""Compiled pipeline parallelism inside one mesh (GPipe schedule).
+
+The reference's pipeline is emergent thread timing: micro-batches run in
+Python threads and interleave only by chance (ml/module.py:374-399 — SURVEY
+§2.2 "no schedule"). On TPU the schedule is *compiled*: layers are sharded
+over a ``stage`` mesh axis, micro-batches stream through the ring via
+``lax.ppermute``, and one jit program executes the whole GPipe diagram —
+bubble fill/drain included — with XLA overlapping compute and ICI transfer.
+
+This in-mesh pipeline composes with the cross-node stage pipeline
+(parallel/planner.py): a *worker* is one mesh (possibly itself pipelined
+over its devices), stages between workers ride the P2P transport.
+
+Differentiable end-to-end: ``ppermute`` has a transpose rule, so
+``jax.grad`` through :func:`gpipe` yields exactly the 1F1B-equivalent
+backward sweep.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+from tensorlink_tpu.parallel.mesh import get_shard_map, mark_varying as _vary
+
+
+def _gpipe_local(
+    stacked_params,  # local layer slice (leading dim L/n_stage)
+    micros,  # [n_micro, ...] full micro-batch stack (replicated)
+    *,
+    stage_fn: Callable,
+    axis_name: str,
+):
+    n_stage = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = micros.shape[0]
+    n_ticks = n_micro + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    act0 = _vary(jnp.zeros_like(micros[0]), axis_name)
+    outs0 = _vary(jnp.zeros_like(micros), axis_name)
+
+    def tick(carry, t):
+        act_in, outs = carry
+        # stage 0 injects micro t (clipped index; masked out-of-range below)
+        inject = micros[jnp.clip(t, 0, n_micro - 1)]
+        x = jnp.where(idx == 0, _vary(inject, axis_name), act_in)
+        y = stage_fn(stacked_params, x)
+        # this stage is working on micro (t - idx); only keep real ticks
+        mine = t - idx
+        live = (mine >= 0) & (mine < n_micro)
+        y = jnp.where(live, y, act_in)
+        # last stage collects its finished micro
+        outs = jnp.where(
+            (idx == n_stage - 1) & live,
+            outs.at[jnp.clip(mine, 0, n_micro - 1)].set(y),
+            outs,
+        )
+        act_next = lax.ppermute(y, axis_name, perm)
+        return (act_next, outs), None
+
+    (_, outs), _ = lax.scan(
+        tick, (act0, outs0), jnp.arange(n_ticks)
+    )
+    return outs[None]  # leading singleton stage dim for out_specs
+
+
+def gpipe(
+    stage_fn: Callable,  # (local_layer_params, x) -> y, applied per stage
+    stacked_params,  # pytree, leaves with leading layer dim L (L % n_stage == 0)
+    micros: jax.Array,  # [n_micro, mb, ...] micro-batch stack
+    mesh: Mesh,
+    *,
+    axis_name: str = "stage",
+):
+    """Run ``micros`` through the layer pipeline; returns ``[n_micro, ...]``
+    outputs equal to applying all layers sequentially (parity test:
+    tests/test_pipeline.py)."""
+    shard_map = get_shard_map()
+
+    n_stage = mesh.shape[axis_name]
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(
+        partial(_gpipe_local, stage_fn=stage_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(axis_name),
+    )
+    out = fn(stacked_params, micros)  # [n_stage, n_micro, mb, ...]
+    return out[n_stage - 1]

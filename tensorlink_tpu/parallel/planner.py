@@ -168,18 +168,33 @@ class AssignmentError(RuntimeError):
 
 
 def _mesh_axes_for(cfg: ModelConfig, cap: WorkerCapacity, training: bool) -> dict[str, int]:
-    """Within one worker: choose TP degree that divides both heads and
-    devices; remaining devices go to fsdp (training) or data (serving)."""
+    """Within one worker: MoE models first claim an expert axis (EP —
+    required by BASELINE config 5, Mixtral), then a TP degree that divides
+    both head counts; remaining devices go to fsdp (training) or data
+    (serving). All axes ride ICI inside the worker's slice."""
     n = cap.n_devices
+    ep = 1
+    if cfg.moe:
+        for cand in (8, 4, 2, 1):
+            if cand <= n and cfg.n_experts % cand == 0 and n % cand == 0:
+                ep = cand
+                break
+    rem = n // ep
     tp = 1
     for cand in (8, 4, 2, 1):
-        if cand <= n and cfg.n_kv_heads % cand == 0 and cfg.n_heads % cand == 0 and n % cand == 0:
+        if (
+            cand <= rem
+            and cfg.n_kv_heads % cand == 0
+            and cfg.n_heads % cand == 0
+            and rem % cand == 0
+        ):
             tp = cand
             break
-    rest = n // tp
-    if training:
-        return {"fsdp": rest, "tensor": tp}
-    return {"data": rest, "tensor": tp}
+    rest = rem // tp
+    axes = {"fsdp" if training else "data": rest, "tensor": tp}
+    if ep > 1:
+        axes["expert"] = ep
+    return axes
 
 
 def plan_sharding(

@@ -1,0 +1,138 @@
+"""Ring attention — sequence/context parallelism over a device mesh.
+
+Net-new vs the reference, which scales sequence length only by renting a
+bigger worker (``max_seq_len`` appears solely in its memory arithmetic,
+ml/utils.py:94-118 — SURVEY §5 long-context notes). Here long sequences are
+sharded over a ``seq`` mesh axis and attention runs as a ring:
+
+- each device holds its local Q/K/V blocks ``[B, T/n, H, hd]``,
+- K/V blocks rotate around the ring via ``lax.ppermute`` (one ICI hop per
+  step, n-1 steps) while each device accumulates flash-style blockwise
+  softmax statistics (running max, normalizer, weighted values),
+- causal masking is global-position arithmetic: block start offsets rotate
+  with the K/V so every device masks exactly the right region,
+- GQA contracts un-repeated K/V heads (``[B, S, n_kv, group, hd]``
+  grouping), so no repeated KV is ever materialized.
+
+Compute/communication overlap and per-block skipping of fully-masked tiles
+are XLA's job once the ring is expressed this way (scaling-book recipe:
+annotate, let the compiler schedule).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    """Grouped-query scores. q: [B, Tq, Hkv, G, hd], k: [B, Tk, Hkv, hd]
+    → [B, Hkv, G, Tq, Tk] in fp32."""
+    return jnp.einsum(
+        "bqhgd,bkhd->bhgqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+
+
+def _ring_attention_local(
+    q,  # [B, Tq, Hq, hd] this device's query block
+    k,  # [B, Tk, Hkv, hd] this device's key block
+    v,  # [B, Tk, Hkv, hd]
+    *,
+    axis_name: str,
+    scale: float,
+    causal: bool,
+):
+    """Runs inside shard_map: full ring of n_dev steps, blockwise-stable
+    softmax accumulation."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tq, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, Tq, Hkv, G, hd)
+
+    q_pos = idx * Tq + jnp.arange(Tq)  # global query positions
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_blk, v_blk, kv_start, m, l, o = carry
+        s = _block_scores(qg, k_blk, scale)  # [B, Hkv, G, Tq, Tk]
+        if causal:
+            kv_pos = kv_start + jnp.arange(k_blk.shape[1])
+            mask = q_pos[:, None] >= kv_pos[None, :]  # [Tq, Tk]
+            s = jnp.where(mask[None, None, None], s, NEG_INF)
+        blk_max = s.max(-1)  # [B, Hkv, G, Tq]
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m[..., None])
+        corr = jnp.exp(m - new_m)
+        l_new = l * corr + p.sum(-1)
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_blk.astype(jnp.float32))
+        o_new = o * corr.transpose(0, 3, 1, 2)[..., None] + pv
+        # rotate K/V (+ their global start offset) one hop around the ring
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        kv_nxt = lax.ppermute(kv_start, axis_name, perm)
+        return (k_nxt, v_nxt, kv_nxt, new_m, l_new, o_new), None
+
+    # initial accumulators must be marked varying over the ring axis or the
+    # scan carry types disagree (jax VMA check under shard_map)
+    from tensorlink_tpu.parallel.mesh import mark_varying
+
+    m0 = mark_varying(jnp.full((B, Hkv, G, Tq), NEG_INF, jnp.float32), axis_name)
+    l0 = mark_varying(jnp.zeros((B, Hkv, G, Tq), jnp.float32), axis_name)
+    o0 = mark_varying(jnp.zeros((B, Tq, Hkv, G, hd), jnp.float32), axis_name)
+    kv_start0 = idx * k.shape[1]
+    (_, _, _, m, l, o), _ = lax.scan(
+        step, (k, v, kv_start0, m0, l0, o0), None, length=n
+    )
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(B, Tq, Hq, hd).astype(q.dtype)
+
+
+def ring_attention(
+    q,  # [B, S, Hq, hd] GLOBAL arrays (sharded over S by the caller's mesh)
+    k,  # [B, S, Hkv, hd]
+    v,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    scale: float | None = None,
+    causal: bool = True,
+):
+    """Sequence-parallel attention over ``mesh[axis_name]``.
+
+    Equivalent to full (causal) attention on the unsharded arrays — that
+    equivalence is the unit test (tests/test_ring.py). Sequence length must
+    divide the axis size."""
+    from tensorlink_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            scale=scale,
+            causal=causal,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def sequence_sharded(mesh: Mesh, x, axis_name: str = "seq", dim: int = 1):
+    """Shard an array's sequence dimension over the ring axis."""
+    spec = [None] * x.ndim
+    spec[dim] = axis_name
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))

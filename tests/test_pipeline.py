@@ -1,0 +1,59 @@
+"""Compiled GPipe == sequential layer application, on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.parallel.mesh import build_mesh
+from tensorlink_tpu.parallel.pipeline import gpipe
+
+
+def _stage_fn(local_w, x):
+    """Apply this stage's layer slice sequentially (scan over local dim)."""
+
+    def body(h, w):
+        return h + jnp.tanh(h @ w), None
+
+    y, _ = jax.lax.scan(body, x, local_w)
+    return y
+
+
+def _sequential(w, x):
+    def body(h, wl):
+        return h + jnp.tanh(h @ wl), None
+
+    y, _ = jax.lax.scan(body, x, w)
+    return y
+
+
+@pytest.mark.parametrize("n_stage,n_micro", [(2, 2), (4, 4), (4, 6)])
+def test_gpipe_matches_sequential(n_stage, n_micro):
+    mesh = build_mesh({"stage": n_stage}, jax.devices("cpu")[:n_stage])
+    L, mb, T, D = 8, 2, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 2)
+    w = jax.random.normal(ks[0], (L, D, D), jnp.float32) * 0.1
+    micros = jax.random.normal(ks[1], (n_micro, mb, T, D), jnp.float32)
+
+    ref = jax.vmap(lambda x: _sequential(w, x))(micros)
+    out = gpipe(_stage_fn, w, micros, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_is_differentiable():
+    n_stage, n_micro = 4, 4
+    mesh = build_mesh({"stage": n_stage}, jax.devices("cpu")[:n_stage])
+    L, mb, T, D = 4, 2, 3, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 2)
+    w = jax.random.normal(ks[0], (L, D, D), jnp.float32) * 0.1
+    micros = jax.random.normal(ks[1], (n_micro, mb, T, D), jnp.float32)
+
+    def pipe_loss(w):
+        return (gpipe(_stage_fn, w, micros, mesh) ** 2).sum()
+
+    def ref_loss(w):
+        return (jax.vmap(lambda x: _sequential(w, x))(micros) ** 2).sum()
+
+    g_pipe = jax.grad(pipe_loss)(w)
+    g_ref = jax.grad(ref_loss)(w)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_ref), rtol=5e-5, atol=5e-5)

@@ -1,0 +1,69 @@
+"""Ring attention == full attention, on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.parallel.mesh import build_mesh
+from tensorlink_tpu.parallel.ring import ring_attention, sequence_sharded
+
+
+def _reference_attention(q, k, v, scale, causal=True):
+    """Plain full attention with GQA (no repetition materialized)."""
+    B, S, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, G, hd).astype(jnp.float32)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, hd)
+
+
+@pytest.mark.parametrize("n_seq", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_full(n_seq, causal):
+    mesh = build_mesh({"seq": n_seq}, jax.devices("cpu")[:n_seq])
+    B, S, Hq, Hkv, hd = 2, 32, 4, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, Hq, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Hkv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Hkv, hd), jnp.float32)
+    scale = hd**-0.5
+
+    ref = _reference_attention(q, k, v, scale, causal)
+
+    qs = sequence_sharded(mesh, q)
+    ks_ = sequence_sharded(mesh, k)
+    vs = sequence_sharded(mesh, v)
+    out = ring_attention(qs, ks_, vs, mesh, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_is_differentiable():
+    """Gradients flow through the ring (ppermute has a transpose rule) —
+    required for sequence-parallel training."""
+    n = 4
+    mesh = build_mesh({"seq": n}, jax.devices("cpu")[:n])
+    B, S, H, hd = 1, 16, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, H, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, H, hd), jnp.float32)
+
+    def ring_loss(q, k, v):
+        return ring_attention(q, k, v, mesh).astype(jnp.float32).sum()
+
+    def ref_loss(q, k, v):
+        return _reference_attention(q, k, v, hd**-0.5).sum()
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-5, atol=3e-5)
