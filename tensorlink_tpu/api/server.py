@@ -230,6 +230,21 @@ class TensorlinkAPI:
                 return await self._send_json(
                     writer, 200, await self._ml(self._network_history)
                 )
+            if path == "/proposal-history":
+                hist = await self._ml(
+                    lambda: self.node.send_request("proposal_history")
+                )
+                return await self._send_json(writer, 200, {"proposals": hist})
+            if path.startswith("/claim-info/"):
+                wid = path[len("/claim-info/"):]
+                claim = await self._ml(
+                    lambda: self.node.send_request(
+                        "claim_info", {"worker_id": wid}
+                    )
+                )
+                return await self._send_json(
+                    writer, 200 if "error" not in claim else 404, claim
+                )
             raise HTTPError(404, f"no route {path}")
         if method != "POST":
             raise HTTPError(405, f"method {method} not allowed")
@@ -261,13 +276,14 @@ class TensorlinkAPI:
         }
 
     def _network_history(self) -> dict:
-        # Keeper-backed statistics land in the platform-services layer
-        # (reference keeper.py:502); until then report live topology only.
+        # Keeper daily/weekly statistics (reference keeper.py:502-572)
+        hist = self.node.send_request("network_history")
         st = self.node.status()
         roles: dict[str, int] = {}
         for p in st.get("peers", {}).values():
             roles[p.get("role", "?")] = roles.get(p.get("role", "?"), 0) + 1
-        return {"current": roles, "history": []}
+        hist["current"] = {**hist.get("current", {}), **roles}
+        return hist
 
     async def _request_model(self, data: dict, writer) -> None:
         try:

@@ -117,6 +117,11 @@ class NodeConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     utilization: bool = True  # offer capacity (workers)
     duplicate: str = ""  # role suffix for same-host multi-node tests
+    # platform-service cadences (reference: keeper write every 300 s,
+    # JobMonitor 30 s cycle — validator_thread.py:978-1011, job_monitor.py:104)
+    keeper_interval: float = 300.0
+    monitor_interval: float = 30.0
+    proposal_interval: float = 3600.0  # contract round cadence (0 = manual)
 
     def effective_host(self) -> str:
         return "127.0.0.1" if self.local_test else self.host

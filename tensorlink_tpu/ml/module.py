@@ -147,20 +147,126 @@ class DistributedModel:
             self.job_id[:8], self.plan.n_stages,
         )
 
-    def _request(self, worker_plan_id: str, tag: str, body: dict, timeout=MAX_WAIT_TIME):
-        resp = self.node.send_request(
-            "tensor_request",
-            {
-                "peer": self.workers[worker_plan_id],
-                "tag": tag,
-                "body": body,
-                "timeout": timeout,
-            },
-            timeout=timeout + 10.0,
-        )
+    def _request(
+        self, worker_plan_id: str, tag: str, body: dict, timeout=MAX_WAIT_TIME,
+        _repaired: bool = False,
+    ):
+        try:
+            resp = self.node.send_request(
+                "tensor_request",
+                {
+                    "peer": self.workers[worker_plan_id],
+                    "tag": tag,
+                    "body": body,
+                    "timeout": timeout,
+                },
+                timeout=timeout + 10.0,
+            )
+        except Exception as e:
+            # connection to the worker died mid-request → pull a replacement
+            # from the validator and retry once (the reference's
+            # "request another worker" TODO, module.py:510-511, made real)
+            if _repaired or "no connection" not in str(e):
+                raise
+            new_id = self._repair(worker_plan_id)
+            return self._request(new_id, tag, body, timeout, _repaired=True)
         if isinstance(resp, dict) and resp.get("error"):
             raise RuntimeError(f"{tag} failed on worker: {resp['error']}")
         return resp
+
+    # ------------------------------------------------------------------
+    # worker replacement (user-pulled; validator may also push JOB_UPDATE —
+    # the monitor path, platform/job_monitor.py)
+    # ------------------------------------------------------------------
+    def _repair(self, dead_plan_wid: str) -> str:
+        """Ask the validator for a replacement, connect, re-ship the stage.
+        Returns the new plan worker id. Raises if none is available."""
+        validators = self.node.send_request("validators", timeout=10.0)
+        if not validators:
+            raise RuntimeError("no validator available for job repair")
+        update = self.node.send_request(
+            "control_request",
+            {"peer": validators[0], "tag": proto.JOB_REPAIR,
+             "body": {"job_id": self.job_id, "worker_id": dead_plan_wid},
+             "timeout": 15.0},
+            timeout=25.0,
+        )
+        if not isinstance(update, dict) or "worker" not in update:
+            raise RuntimeError(
+                f"job repair failed: {update.get('error') if isinstance(update, dict) else update}"
+            )
+        return self._apply_update(update, dead_plan_wid)
+
+    def _apply_update(self, update: dict, dead_plan_wid: str) -> str:
+        new_id = update["worker"]["id"]
+        host, port = update["worker"]["addr"]
+        conn_id = self.node.connect_to(host, int(port))
+        affected = [
+            s for s in self.plan.stages if s.worker_id == dead_plan_wid
+        ]
+        for s in affected:
+            s.worker_id = new_id
+        self.workers.pop(dead_plan_wid, None)
+        self.workers[new_id] = conn_id
+        for s in affected:
+            resp = self._request(
+                new_id, proto.MODULE,
+                {
+                    "job_id": self.job_id,
+                    "model": self.model_spec,
+                    "stage": _stage_dict(s),
+                    "training": self.training,
+                },
+                timeout=MAX_WAIT_TIME, _repaired=True,
+            )
+            if not resp.get("ok"):
+                raise RuntimeError(f"replacement stage load failed: {resp}")
+        # Restore training state consistently: a replacement stage loads
+        # fresh checkpoint-reference weights, so if training has progressed
+        # EVERY stage must roll back to the same snapshot — restoring only
+        # the new worker would silently mix parameter versions across stages.
+        if getattr(self, "_opt_ready", False):
+            self._request(
+                new_id, proto.OPTIMIZER,
+                {"job_id": self.job_id, "op": "init",
+                 "spec": {"name": getattr(self, "_opt_name", "adamw"),
+                          "grad_clip": None,
+                          **getattr(self, "_opt_spec", {})}},
+                _repaired=True,
+            )
+            if getattr(self, "_last_ckpt", None):
+                for s in self.plan.stages:
+                    self._request(
+                        s.worker_id, proto.CHECKPOINT,
+                        {"job_id": self.job_id, "op": "restore",
+                         "dir": self._last_ckpt},
+                        _repaired=True,
+                    )
+            elif getattr(self, "_step", 0) > 0:
+                raise RuntimeError(
+                    "worker replaced mid-training with no checkpoint to roll "
+                    "back to: trained state on surviving stages is "
+                    "inconsistent with the fresh replacement stage — call "
+                    "save_checkpoint() periodically to make repair lossless"
+                )
+        self.log.info(
+            "repaired job %s: %s -> %s", self.job_id[:8],
+            dead_plan_wid[:8], new_id[:8],
+        )
+        return new_id
+
+    def poll_job_updates(self) -> int:
+        """Apply validator-pushed replacements (monitor path); returns how
+        many updates were applied."""
+        updates = self.node.send_request("job_updates", timeout=10.0)
+        n = 0
+        for u in updates:
+            if u.get("job_id") == self.job_id and "worker" in u:
+                old = u.get("old_worker", "")
+                if old in self.workers:
+                    self._apply_update(u, old)
+                    n += 1
+        return n
 
     # ------------------------------------------------------------------
     # forward (reference module.py:348-411 + OffloadedModule.forward:1536)
@@ -417,6 +523,7 @@ class DistributedModel:
         single-program semantics, so workers get grad_clip=None and the
         driver folds ``min(1, clip/global_norm)`` into the step scale."""
         self._grad_clip = spec.pop("grad_clip", 1.0)
+        self._opt_name, self._opt_spec = name, dict(spec)
         for stage in self.plan.stages:
             self._request(
                 stage.worker_id, proto.OPTIMIZER,
@@ -537,6 +644,7 @@ class DistributedModel:
         }
         Path(ckpt_dir).mkdir(parents=True, exist_ok=True)
         (Path(ckpt_dir) / "manifest.json").write_text(json.dumps(manifest, indent=2))
+        self._last_ckpt = str(ckpt_dir)  # repair restores from here
         return {"paths": paths}
 
     def restore_checkpoint(self, ckpt_dir: str) -> None:

@@ -31,6 +31,7 @@ import asyncio
 import json
 import time
 import uuid
+from pathlib import Path
 from typing import Any
 
 from tensorlink_tpu.core.config import NodeConfig
@@ -74,13 +75,24 @@ class RoleServer(TensorNode):
         self.start()  # event loop thread + listener
         info = {"port": self.port, "id": self.node_id, "role": self.role}
         self.bridge.q.resp.put((-1, True, info))
+        self.on_started()
         fut = asyncio.run_coroutine_threadsafe(
             self.bridge.serve(self.dispatch), self._loop
         )
         try:
             fut.result()  # blocks until _stop
         finally:
+            try:
+                self.on_shutdown()
+            except Exception:
+                self.log.exception("shutdown hook failed")
             self.stop()
+
+    def on_started(self) -> None:
+        """Role hook: schedule background tasks after the listener is up."""
+
+    def on_shutdown(self) -> None:
+        """Role hook: flush state before the event loop stops."""
 
     # -- command dispatch ----------------------------------------------
     async def dispatch(self, verb: str, payload: Any) -> Any:
@@ -145,6 +157,16 @@ class RoleServer(TensorNode):
         """Generic fire-and-forget control frame to a peer."""
         await self._conn(p["peer"]).send_control(p["tag"], p.get("body", {}))
         return True
+
+    async def cmd_control_request(self, p) -> dict:
+        """Generic correlated control-frame request to a peer."""
+        reply = await self.request(
+            self._conn(p["peer"]), p["tag"], p.get("body", {}),
+            timeout=p.get("timeout"),
+        )
+        reply.pop("_rid", None)
+        reply.pop("_resp", None)
+        return reply
 
     async def cmd_send_token(self, p) -> bool:
         await self.send_token(
@@ -235,15 +257,198 @@ class ValidatorServer(RoleServer):
 
     def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
         super().__init__(cfg, queues)
+        from tensorlink_tpu.platform.contract import ContractManager
+        from tensorlink_tpu.platform.job_monitor import JobMonitor
+        from tensorlink_tpu.platform.keeper import Keeper
+
         self.jobs: dict[str, dict] = {}
         self._job_requests: dict[str, tuple[Connection, dict]] = {}
+        self.keeper = Keeper(Path(cfg.log_dir) / "dht_state.json")
+        self.monitor = JobMonitor(self)
+        self.contract = ContractManager(self.node_id)
+        self.worker_capacity_total = 0.0
+        self._restore_state()
         self.register(proto.JOB_REQ, self._handle_job_req)
         self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
+        self.register(proto.JOB_REPAIR, self._handle_job_repair)
+        self.register(proto.PROPOSAL, self._handle_proposal)
+
+    def _restore_state(self) -> None:
+        """Reload persisted DHT entries + stats (reference keeper restore at
+        validator startup, validator_thread.py:135-137)."""
+        state = self.keeper.load_previous_state()
+        for k, v in state.get("dht", {}).items():
+            self.dht.store(k, v.get("value"))
+        now = time.time()
+        for jid, j in state.get("jobs", {}).items():
+            j.setdefault("t0_restored", now)  # don't credit downtime
+            self.jobs.setdefault(jid, j)
+
+    def on_started(self) -> None:
+        asyncio.run_coroutine_threadsafe(self._platform_loop(), self._loop)
+
+    def on_shutdown(self) -> None:
+        self.keeper.write_state(self)
+
+    async def _platform_loop(self) -> None:
+        """Keeper writes, job monitoring, stats, contract rounds — the
+        validator run loop's periodic duties (validator_thread.py:978-1011)."""
+        last_keeper = last_round = time.time()
+        interval = max(min(self.cfg.monitor_interval, self.cfg.keeper_interval), 0.5)
+        while not self.terminate.is_set():
+            await asyncio.sleep(min(interval, self.cfg.monitor_interval))
+            try:
+                await self.monitor.check_jobs()
+                self.keeper.update_statistics(self)
+                self.keeper.clean_node(self)
+                now = time.time()
+                if now - last_keeper >= self.cfg.keeper_interval:
+                    self.keeper.write_state(self)
+                    last_keeper = now
+                if (
+                    self.cfg.proposal_interval
+                    and now - last_round >= self.cfg.proposal_interval
+                ):
+                    await self._run_proposal_round()
+                    last_round = now
+            except Exception:
+                self.log.exception("platform loop iteration failed")
+
+    # -- worker replacement (net-new working path; reference stubs it,
+    # job_monitor.py:293-328) -------------------------------------------
+    async def replace_worker(self, job_id: str, dead_wid: str) -> dict | None:
+        """Recruit a spare worker for a dead stage; rewrite plan + DHT and
+        push JOB_UPDATE to the user. Returns the update dict or None."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return None
+        stages = [
+            s for s in job.get("plan", {}).get("stages", [])
+            if s["worker_id"] == dead_wid
+        ]
+        if not stages:
+            return None
+        current = set(job.get("workers", {}))
+        candidates = [
+            nid for nid in self.connections
+            if self.roles.get(nid) == "worker" and nid not in current
+        ]
+        est = float(job.get("stage_bytes", {}).get(dead_wid, 0.0))
+        for cand in candidates:
+            try:
+                reply = await self.request(
+                    self._conn(cand), proto.JOB_REQ,
+                    {"job_id": job_id, "stage": stages[0], "est_bytes": est},
+                    timeout=RECRUIT_TIMEOUT,
+                )
+            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                continue
+            if "addr" not in reply:
+                continue
+            host, _ = self.addresses.get(cand, (None, None))
+            addr = [host or reply["addr"][0], reply["addr"][1]]
+            for s in stages:
+                s["worker_id"] = cand
+            job["workers"].pop(dead_wid, None)
+            job["workers"][cand] = addr
+            job["stage_bytes"][cand] = job.get("stage_bytes", {}).pop(dead_wid, est)
+            await self.dht_store_global(f"job:{job_id}", _json_safe(job))
+            update = {
+                "job_id": job_id,
+                "old_worker": dead_wid,
+                "worker": {"id": cand, "addr": addr},
+                "stages": [s["layer_lo"] for s in stages],
+            }
+            user_conn = self.connections.get(job.get("user_id", ""))
+            if user_conn is not None:
+                try:
+                    await user_conn.send_control(proto.JOB_UPDATE, update)
+                except (ConnectionError, OSError):
+                    pass
+            self.log.info(
+                "job %s: replaced worker %s -> %s", job_id[:8],
+                dead_wid[:8], cand[:8],
+            )
+            return update
+        self.log.warning("job %s: no replacement for %s", job_id[:8], dead_wid[:8])
+        return None
+
+    async def _handle_job_repair(self, conn, kind, tag, body) -> None:
+        """User pulls a replacement synchronously after a failed request."""
+        update = await self.replace_worker(
+            body.get("job_id", ""), body.get("worker_id", "")
+        )
+        await self.respond(
+            conn, proto.JOB_UPDATE, body,
+            update or {"error": "no replacement available"},
+        )
 
     async def _handle_job_shutdown(self, conn, kind, tag, body) -> None:
         """User ends a job: drop validator state + DHT record and make sure
         the workers released it (idempotent on their side)."""
+        job = self.jobs.get(body.get("job_id", ""))
+        if job is not None:
+            self.contract.record_job(job)
         await self.cmd_shutdown_job({"job_id": body.get("job_id", "")})
+
+    # -- contract / stats commands --------------------------------------
+    async def _run_proposal_round(self) -> dict:
+        """Create → collect validator votes → execute one reward round
+        (reference proposal_creator flow, contract_manager.py:317-683):
+        the full proposal body goes to every connected validator, each
+        recomputes the hash and votes; quorum over validators + self."""
+        offline = [
+            nid for nid in list(self.addresses)
+            if nid not in self.connections and self.roles.get(nid) == "worker"
+        ]
+        prop = self.contract.create_proposal(offline)
+        h = prop.hash()
+        await self.dht_store_global(f"proposal:{h}", prop.to_json())
+        self.contract.vote(h, self.node_id, True)
+        for vid in self.validator_ids():
+            try:
+                reply = await self.request(
+                    self._conn(vid), proto.PROPOSAL,
+                    {"proposal": prop.to_json(), "hash": h},
+                    timeout=10.0,
+                )
+                self.contract.vote(h, vid, bool(reply.get("approve")))
+            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                continue
+        n_validators = len(self.validator_ids()) + 1
+        executed = self.contract.try_execute(h, n_validators)
+        record = prop.to_json()
+        self.keeper.proposals.append(record)
+        self.log.info("proposal round %d: executed=%s", prop.round, executed)
+        return record
+
+    async def _handle_proposal(self, conn, kind, tag, body) -> None:
+        """Another validator asks for our vote: recompute the hash from the
+        full body (reference proposal_validator, contract_manager.py:45-242)."""
+        ok = False
+        try:
+            ok = self.contract.validate_proposal(
+                body.get("proposal", {}), body.get("hash", "")
+            )
+        except Exception:
+            self.log.exception("proposal validation failed")
+        await self.respond(conn, proto.PROPOSAL_VOTE, body, {"approve": ok})
+
+    async def cmd_run_proposal_round(self, p) -> dict:
+        return await self._run_proposal_round()
+
+    async def cmd_proposal_history(self, p) -> list[dict]:
+        return list(self.keeper.proposals)
+
+    async def cmd_claim_info(self, p) -> dict:
+        for h, prop in reversed(list(self.contract.proposals.items())):
+            claim = self.contract.claim_data(h, p["worker_id"])
+            if claim is not None:
+                return claim
+        return {"error": "no executed proposal covers this worker"}
+
+    async def cmd_network_history(self, p) -> dict:
+        return self.keeper.get_network_status(self)
 
     async def _handle_job_req(self, conn, kind, tag, body) -> None:
         """A user asks for a model (reference validator_thread.py:583-609).
@@ -271,6 +476,9 @@ class ValidatorServer(RoleServer):
                             if k not in ("_rid", "_resp")})
             except (TimeoutError, asyncio.TimeoutError, ConnectionError):
                 continue
+        self.worker_capacity_total = sum(
+            float(s.get("hbm_bytes", 0.0)) for s in out
+        )
         return out
 
     async def cmd_create_job(self, p) -> dict:
@@ -333,6 +541,8 @@ class ValidatorServer(RoleServer):
                 "job_id": job_id, "plan": plan, "workers": accepted,
                 "user_id": p.get("user_id"), "t0": time.time(),
                 "model": job.get("model", {}).get("name", ""),
+                "stage_bytes": dict(job.get("stage_bytes", {})),
+                "status": "active",
             }
             await self.dht_store_global(f"job:{job_id}", _json_safe(self.jobs[job_id]))
 
@@ -374,6 +584,18 @@ class UserServer(RoleServer):
     def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
         super().__init__(cfg, queues)
         self.forward_tokens_to_ml = False  # drained via cmd_next_tokens
+        self.job_updates: list[dict] = []  # JOB_UPDATE pushes from validators
+        self.register(proto.JOB_UPDATE, self._handle_job_update)
+
+    async def _handle_job_update(self, conn, kind, tag, body) -> None:
+        """A validator replaced one of our workers (monitor push path)."""
+        body.pop("_rid", None)
+        body.pop("_resp", None)
+        self.job_updates.append(body)
+
+    async def cmd_job_updates(self, p) -> list[dict]:
+        out, self.job_updates = self.job_updates, []
+        return out
 
     async def cmd_request_job(self, p) -> dict:
         """Send JOB_REQ to a connected validator and await the decision

@@ -80,6 +80,7 @@ class P2PNode:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._pending: dict[str, asyncio.Future] = {}
+        self._pending_conn: dict[str, Connection] = {}  # rid -> conn it rides
         self._conn_tasks: set[asyncio.Task] = set()
 
         self.register(proto.DHT_GET, self._handle_dht_get)
@@ -280,6 +281,20 @@ class P2PNode:
         if conn.node_id and self.connections.get(conn.node_id) is conn:
             del self.connections[conn.node_id]
             self.log.info("peer down %s", conn.node_id[:8])
+        # fail in-flight requests riding this connection immediately —
+        # otherwise callers wait out the full request timeout on a peer
+        # that is already gone (and repair paths never learn the cause)
+        for rid, c in list(self._pending_conn.items()):
+            if c is conn:
+                fut = self._pending.pop(rid, None)
+                self._pending_conn.pop(rid, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(
+                        ConnectionError(
+                            f"no connection to {conn.node_id[:12] if conn.node_id else '?'}"
+                            " (peer dropped mid-request)"
+                        )
+                    )
 
     # ------------------------------------------------------------------
     # dispatch + request/response
@@ -313,11 +328,13 @@ class P2PNode:
         rid = secrets.token_hex(8)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        self._pending_conn[rid] = conn
         try:
             await conn.send_control(tag, {**body, "_rid": rid})
             return await asyncio.wait_for(fut, timeout or self.request_timeout)
         finally:
             self._pending.pop(rid, None)
+            self._pending_conn.pop(rid, None)
 
     @staticmethod
     async def respond(conn: Connection, tag: str, request_body: dict, body: dict) -> None:

@@ -58,10 +58,13 @@ JOB_ACCEPT = "job.accept"
 JOB_DECLINE = "job.decline"
 JOB_UPDATE = "job.update"
 JOB_SHUTDOWN = "job.shutdown"
+JOB_REPAIR = "job.repair"  # user pulls a replacement worker for a dead stage
 STATS_REQUEST = "stats.req"
 STATS_RESPONSE = "stats.resp"
 REQUEST_WORKERS = "workers.req"
 WORKERS = "workers.resp"
+PROPOSAL = "proposal"  # contract round: full proposal body for validation
+PROPOSAL_VOTE = "proposal.vote"
 
 # tensor-node layer (reference torch_node.py:119-131)
 MODULE = "module"  # ship a stage assignment (plan + checkpoint ref)

@@ -84,11 +84,13 @@ class TensorNode(P2PNode):
         rid = secrets.token_hex(8)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        self._pending_conn[rid] = conn
         try:
             await self.send_tensor(conn, tag, {**body, "_rid": rid})
             return await asyncio.wait_for(fut, timeout or self.request_timeout)
         finally:
             self._pending.pop(rid, None)
+            self._pending_conn.pop(rid, None)
 
     async def tensor_respond(
         self, conn: Connection, tag: str, request_body: dict, body: dict

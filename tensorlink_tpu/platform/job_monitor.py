@@ -1,0 +1,68 @@
+"""JobMonitor — per-job health, expiry, and REAL worker replacement.
+
+Reference: nodes/job_monitor.py:88 — a 30 s watchdog with an
+ACTIVE→PENDING_OFFLINE→FAILED/COMPLETED state machine whose recovery is a
+comment ("request another worker", module.py:510-511) and stubbed penalty
+hooks (job_monitor.py:293-328). Here replacement is a working, tested path
+(SURVEY §5 explicitly calls this out as the gap to close):
+
+- a job whose worker connection drops goes PENDING_OFFLINE;
+- the validator recruits a spare worker for the dead stage (same 3 s accept
+  window as initial recruiting), rewrites the plan + DHT record, and pushes
+  a JOB_UPDATE to the user;
+- the user side (DistributedModel._repair) can also *pull* a replacement
+  synchronously via JOB_REPAIR when a request fails mid-flight;
+- free jobs expire after FREE_JOB_MAX_TIME and completed/failed jobs fold
+  into the contract layer's capacity accounting.
+"""
+
+from __future__ import annotations
+
+import time
+
+FREE_JOB_MAX_TIME = 3600.0  # reference validator_thread.py:19
+OFFLINE_GRACE = 5.0  # seconds a worker may be missing before replacement
+
+
+class JobMonitor:
+    """Operates on a ValidatorServer from its event loop."""
+
+    def __init__(self, server):
+        self.server = server
+
+    async def check_jobs(self) -> None:
+        now = time.time()
+        for job_id, job in list(self.server.jobs.items()):
+            status = job.get("status", "active")
+            if status in ("failed", "completed"):
+                continue
+            if now - job.get("t0", now) > FREE_JOB_MAX_TIME:
+                await self._finish(job_id, job, "completed")
+                continue
+            missing = [
+                wid for wid in job.get("workers", {})
+                if wid not in self.server.connections
+            ]
+            if not missing:
+                if status != "active":
+                    job["status"] = "active"
+                job.pop("offline_since", None)  # full self-recovery resets grace
+                continue
+            job.setdefault("offline_since", now)
+            job["status"] = "pending_offline"
+            if now - job["offline_since"] < OFFLINE_GRACE:
+                continue
+            ok = True
+            for wid in missing:
+                update = await self.server.replace_worker(job_id, wid)
+                ok = ok and update is not None
+            if ok:
+                job["status"] = "active"
+                job.pop("offline_since", None)
+            elif now - job["offline_since"] > 6 * OFFLINE_GRACE:
+                await self._finish(job_id, job, "failed")
+
+    async def _finish(self, job_id: str, job: dict, status: str) -> None:
+        job["status"] = status
+        self.server.contract.record_job(job)
+        await self.server.cmd_shutdown_job({"job_id": job_id})

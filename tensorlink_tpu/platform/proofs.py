@@ -1,0 +1,57 @@
+"""Proof-of-learning primitives (reference ml/proofs.py:18 — gradient
+continuity, loss-trajectory plausibility, gradient hashing; scaffolding the
+reference never wired into enforcement, SURVEY §2.1). Implemented over
+numpy pytree leaves so both driver and monitor can verify worker claims."""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def _leaves(tree) -> list[np.ndarray]:
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def gradient_hash(grads) -> str:
+    """Deterministic digest of a gradient pytree (reference
+    calculate_gradient_hash, proofs.py:6)."""
+    h = hashlib.sha256()
+    for leaf in _leaves(grads):
+        h.update(np.ascontiguousarray(leaf, dtype=np.float32).tobytes())
+    return h.hexdigest()
+
+
+def gradient_continuity(g1, g2, *, min_cosine: float = -0.2) -> tuple[bool, float]:
+    """Cosine similarity between consecutive gradient pytrees; wildly
+    anti-correlated consecutive gradients suggest fabricated work
+    (reference continuity check, proofs.py:23)."""
+    a = np.concatenate([l.ravel().astype(np.float64) for l in _leaves(g1)])
+    b = np.concatenate([l.ravel().astype(np.float64) for l in _leaves(g2)])
+    denom = np.linalg.norm(a) * np.linalg.norm(b)
+    if denom == 0:
+        return False, 0.0
+    cos = float(a @ b / denom)
+    return cos >= min_cosine, cos
+
+
+def loss_plausibility(
+    losses: list[float], *, max_spike: float = 3.0, min_progress: float = -0.5
+) -> tuple[bool, dict]:
+    """Loss-trajectory sanity (reference monotonicity check, proofs.py:41,
+    loosened: real training is noisy). Flags NaN/Inf, per-step spikes
+    > max_spike×, and net regression beyond min_progress of the start."""
+    arr = np.asarray(losses, np.float64)
+    if arr.size == 0:
+        return False, {"reason": "empty"}
+    if not np.isfinite(arr).all():
+        return False, {"reason": "non-finite"}
+    spikes = arr[1:] / np.maximum(arr[:-1], 1e-12)
+    if arr.size > 1 and float(spikes.max()) > max_spike:
+        return False, {"reason": "spike", "max_ratio": float(spikes.max())}
+    progress = (arr[0] - arr[-1]) / max(abs(arr[0]), 1e-12)
+    ok = progress >= min_progress
+    return ok, {"progress": float(progress)}

@@ -1,0 +1,92 @@
+"""Profiling and memory diagnostics.
+
+The reference has NO tracing/profiling (SURVEY §5: "none" — only colored
+debug prints and byte counters). On TPU this must be first-class:
+``jax.profiler`` traces viewable in XProf/TensorBoard, plus HBM live/peak
+accounting per device for the capacity math the planner depends on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from pathlib import Path
+from typing import Any, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture an XProf-compatible trace of the enclosed block::
+
+        with profiling.trace("logs/trace"):
+            engine.generate_compiled(...)
+
+    View with TensorBoard's profile plugin or xprof."""
+    log_dir = str(log_dir)
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a trace (shows up on the trace timeline)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory() -> list[dict[str, Any]]:
+    """Per-device HBM stats (bytes_in_use / peak / limit where the backend
+    reports them; CPU backends may report nothing)."""
+    out = []
+    for d in jax.local_devices():
+        stats: dict[str, Any] = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        out.append(
+            {
+                "device": str(d),
+                "platform": d.platform,
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+        )
+    return out
+
+
+class StepTimer:
+    """Wall-clock step timing with warmup skip — the number bench.py
+    reports (compile time excluded the same way everywhere)."""
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self.times: list[float] = []
+        self._n = 0
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        yield
+        dt = time.perf_counter() - t0
+        self._n += 1
+        if self._n > self.warmup:
+            self.times.append(dt)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else float("nan")
+
+    @property
+    def p50(self) -> float:
+        if not self.times:
+            return float("nan")
+        s = sorted(self.times)
+        return s[len(s) // 2]

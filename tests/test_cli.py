@@ -1,0 +1,79 @@
+"""CLI runner + profiling utilities."""
+
+import json
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def test_cli_starts_worker_and_reports(tmp_path):
+    cfg = {
+        "role": "worker",
+        "mode": "local",
+        "key_dir": str(tmp_path / "keys"),
+        "log_dir": str(tmp_path / "logs"),
+        "env_file": str(tmp_path / ".env"),
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorlink_tpu.cli", "-c", str(cfg_path),
+         "--ui-interval", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["role"] == "worker" and info["port"] > 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_status_report_format(tmp_path):
+    from tensorlink_tpu.cli import status_report
+    from tensorlink_tpu.core.config import WorkerConfig
+    from tensorlink_tpu.nodes.runners import WorkerNode
+
+    node = WorkerNode(
+        WorkerConfig(local_test=True, key_dir=str(tmp_path / "k"),
+                     log_dir=str(tmp_path / "l"), env_file=str(tmp_path / ".e"))
+    ).start()
+    try:
+        out = status_report(node)
+        assert "worker" in out and "peers (0)" in out
+    finally:
+        node.stop()
+
+
+def test_step_timer_and_device_memory():
+    from tensorlink_tpu.utils.profiling import StepTimer, device_memory
+
+    t = StepTimer(warmup=1)
+    for _ in range(3):
+        with t.step():
+            time.sleep(0.01)
+    assert len(t.times) == 2 and t.mean >= 0.01
+
+    mem = device_memory()
+    assert mem and mem[0]["platform"] == "cpu"
+
+
+def test_profiler_trace_writes(tmp_path):
+    import jax.numpy as jnp
+
+    from tensorlink_tpu.utils.profiling import annotate, trace
+
+    with trace(tmp_path / "tr"):
+        with annotate("matmul"):
+            (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    files = list((tmp_path / "tr").rglob("*"))
+    assert files, "no trace output written"

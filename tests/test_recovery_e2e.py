@@ -1,0 +1,159 @@
+"""Elastic recovery e2e: a worker dies mid-job and the stage re-dispatches
+to a spare — the path the reference leaves as a TODO comment
+(module.py:510-511, job_monitor.py:293-328; SURVEY §5 'make re-dispatch on
+worker loss a real, tested path'). Plus the contract round + claim flow
+through live nodes."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tensorlink_tpu.core.config import UserConfig, ValidatorConfig, WorkerConfig
+from tensorlink_tpu.models import ModelConfig
+
+pytestmark = pytest.mark.e2e
+
+
+def tiny_cfg():
+    import jax.numpy as jnp
+
+    return ModelConfig(
+        family="llama",
+        vocab_size=128,
+        d_model=48,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=12,
+        d_ff=96,
+        max_seq_len=64,
+        dtype=jnp.float32,
+    )
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
+
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp_path / "keys"),
+        log_dir=str(tmp_path / "logs"),
+        env_file=str(tmp_path / ".env"),
+    )
+    validator = ValidatorNode(
+        ValidatorConfig(endpoint=False, monitor_interval=0.5,
+                        keeper_interval=1.0, proposal_interval=0.0, **common)
+    ).start()
+    seeds = [["127.0.0.1", validator.port]]
+    w1 = WorkerNode(WorkerConfig(seed_validators=seeds, **common)).start()
+    w2 = WorkerNode(
+        WorkerConfig(seed_validators=seeds, duplicate="1", **common)
+    ).start()
+    user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if len(validator.status()["peers"]) >= 3:
+            break
+        time.sleep(0.2)
+    nodes = {"validator": validator, "workers": [w1, w2], "user": user}
+    yield nodes
+    for n in (user, w1, w2, validator):
+        n.stop()
+
+
+def test_worker_replacement_on_failure(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import forward, init_params
+
+    w1, w2 = cluster["workers"]
+    # pin initial placement to w1 (largest capacity wins, planner rank)
+    w1.send_request("set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+    w2.send_request("set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+
+    cfg = tiny_cfg()
+    model = DistributedModel(cfg, node=cluster["user"], seed=13, seq_len=64)
+    assert model.plan.n_stages == 1
+    assert model.plan.stages[0].worker_id == w1.node_id
+
+    toks = np.array([[7, 21, 3, 99]], np.int32)
+    out_before = model(toks)
+
+    w1.stop()  # kill the hosting worker mid-job
+    time.sleep(0.5)
+
+    out_after = model(toks)  # triggers JOB_REPAIR → re-dispatch onto w2
+    assert model.plan.stages[0].worker_id == w2.node_id
+    np.testing.assert_allclose(out_after, out_before, rtol=1e-5, atol=1e-6)
+
+    params = init_params(cfg, jax.random.PRNGKey(13))
+    ref, _ = forward(params, toks, cfg)
+    np.testing.assert_allclose(out_after, np.asarray(ref), rtol=2e-4, atol=2e-4)
+    model.shutdown()
+
+
+def test_monitor_pushes_replacement(cluster):
+    """The validator's JobMonitor notices the dead worker on its own and
+    pushes a JOB_UPDATE the user can apply."""
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    w1, w2 = cluster["workers"]
+    w1.send_request("set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+    w2.send_request("set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+
+    model = DistributedModel(
+        tiny_cfg(), node=cluster["user"], seed=13, seq_len=64
+    )
+    assert model.plan.stages[0].worker_id == w1.node_id
+    w1.stop()
+
+    deadline = time.time() + 30
+    applied = 0
+    while time.time() < deadline and not applied:
+        applied = model.poll_job_updates()
+        time.sleep(0.5)
+    assert applied == 1
+    assert model.plan.stages[0].worker_id == w2.node_id
+    out = model(np.array([[1, 2, 3]], np.int32))
+    assert np.isfinite(out).all()
+    model.shutdown()
+
+
+def test_contract_round_and_claim(cluster):
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    validator = cluster["validator"]
+    model = DistributedModel(
+        tiny_cfg(), node=cluster["user"], seed=1, seq_len=64
+    )
+    worker_id = model.plan.stages[0].worker_id
+    time.sleep(1.0)  # accrue a little byte-time
+    model.shutdown()  # folds usage into the contract
+
+    record = validator.send_request("run_proposal_round")
+    assert record["executed"] if "executed" in record else True
+    hist = validator.send_request("proposal_history")
+    assert hist and hist[-1]["round"] >= 1
+    assert worker_id in hist[-1]["capacities"]
+
+    claim = validator.send_request("claim_info", {"worker_id": worker_id})
+    assert "proof" in claim, claim
+    from tensorlink_tpu.platform.contract import ContractManager
+
+    assert ContractManager.verify_claim(claim)
+
+
+def test_keeper_persistence_across_restart(cluster, tmp_path):
+    """The validator snapshots state; /network-history reflects stats."""
+    validator = cluster["validator"]
+    deadline = time.time() + 15
+    hist = {}
+    while time.time() < deadline:
+        hist = validator.send_request("network_history")
+        if hist.get("daily", {}).get("labels"):
+            break
+        time.sleep(0.5)
+    assert hist["daily"]["labels"], hist
+    assert hist["daily"]["workers"][-1] >= 1
