@@ -53,7 +53,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="operator config file (reference bin/config.json)")
     ap.add_argument("--role", choices=["worker", "validator", "user"],
                     help="override the config's role")
-    ap.add_argument("--seed", action="append", default=[],
+    def seed_addr(s: str) -> tuple[str, int]:
+        host, sep, port = s.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise argparse.ArgumentTypeError(
+                f"expected HOST:PORT, got {s!r}"
+            )
+        return (host, int(port))
+
+    ap.add_argument("--seed", action="append", default=[], type=seed_addr,
                     metavar="HOST:PORT", help="seed validator (repeatable)")
     ap.add_argument("--port", type=int, help="listen port override")
     ap.add_argument("--local", action="store_true",
@@ -74,9 +82,7 @@ def main(argv: list[str] | None = None) -> int:
         flat = {k: v for k, v in cfg.__dict__.items() if k != "role"}
         cfg = _coerce(ROLE_CONFIGS[args.role], flat)
     if args.seed:
-        cfg.seed_validators = [
-            (h, int(p)) for h, p in (s.rsplit(":", 1) for s in args.seed)
-        ]
+        cfg.seed_validators = list(args.seed)
     if args.port is not None:
         cfg.port = args.port
     if args.local:

@@ -117,6 +117,9 @@ class NodeConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     utilization: bool = True  # offer capacity (workers)
     duplicate: str = ""  # role suffix for same-host multi-node tests
+    # native shm message ring for the ML↔net bridge (core/ring.py); falls
+    # back to mp.Queue when the C++ toolchain / platform can't build it
+    native_ipc: bool = True
     # platform-service cadences (reference: keeper write every 300 s,
     # JobMonitor 30 s cycle — validator_thread.py:978-1011, job_monitor.py:104)
     keeper_interval: float = 300.0

@@ -1,0 +1,135 @@
+"""RingChannel — queue-shaped Python wrapper over the native shm ring.
+
+Drop-in for the ``mp.Queue`` trio in nodes/ipc.py: ``put(obj)`` /
+``get(timeout)`` with ``queue.Empty`` on timeout. Objects serialize through
+TLTS (core/serialization.py — arrays are raw buffers, never pickled);
+messages bigger than half the ring spill to a TLTS temp file and ship as a
+path marker (the reference spills >20 MB frames the same way,
+p2p/connection.py:110-122).
+
+Pickling a RingChannel transfers only ``(name, capacity)`` — the spawned
+process attaches to the same shm segment (creator side unlinks on close).
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import secrets
+import tempfile
+import threading
+from pathlib import Path
+
+from tensorlink_tpu.core import serialization as ser
+
+_FILE_MARKER = b"TLF1"
+DEFAULT_CAPACITY = 64 << 20
+
+
+class RingChannel:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, *, _name: str | None = None):
+        from tensorlink_tpu.native import load_tlring
+
+        self._lib = load_tlring()
+        if self._lib is None:
+            raise RuntimeError("native tlring unavailable")
+        self.capacity = capacity
+        self._wlock = threading.Lock()
+        self._rlock = threading.Lock()
+        if _name is None:
+            self.name = f"/tlring-{os.getpid()}-{secrets.token_hex(6)}"
+            self._h = self._lib.tlring_create(self.name.encode(), capacity)
+            self.owner = True
+        else:
+            self.name = _name
+            self._h = self._lib.tlring_attach(self.name.encode())
+            self.owner = False
+        if not self._h:
+            raise RuntimeError(f"tlring setup failed for {self.name}")
+
+    # -- pickling: child attaches ---------------------------------------
+    def __reduce__(self):
+        return (_attach, (self.name, self.capacity))
+
+    # -- queue interface -------------------------------------------------
+    def put(self, obj, timeout: float = 120.0) -> None:
+        blob = ser.encode(obj)
+        if len(blob) + 8 > self.capacity // 2:
+            # oversized → spill file + tiny marker message
+            fd, path = tempfile.mkstemp(prefix="tlring-", suffix=".tlts")
+            os.close(fd)
+            ser.encode_to_file(obj, path)
+            blob = _FILE_MARKER + path.encode()
+        with self._wlock:
+            if self._h is None:
+                raise OSError(f"ring {self.name} released")
+            rc = self._lib.tlring_write(self._h, blob, len(blob), timeout)
+        if rc == -1:
+            raise queue_mod.Full(f"ring {self.name} full after {timeout}s")
+        if rc == -2:
+            raise OSError(f"ring {self.name} closed")
+        if rc != 0:
+            raise OSError(f"ring write failed rc={rc}")
+
+    def get(self, timeout: float | None = None):
+        t = 3600.0 if timeout is None else float(timeout)
+        with self._rlock:
+            if self._h is None:
+                raise EOFError(f"ring {self.name} released")
+            size = self._lib.tlring_next_size(self._h, t)
+            if size == -1:
+                raise queue_mod.Empty
+            if size == -2:
+                raise EOFError(f"ring {self.name} closed")
+            if size < 0:
+                raise OSError(f"ring read failed rc={size}")
+            import ctypes
+
+            cbuf = ctypes.create_string_buffer(size)
+            n = self._lib.tlring_read(self._h, cbuf, size)
+            if n != size:
+                raise OSError(f"ring read short: {n} != {size}")
+            buf = cbuf.raw
+        if buf[:4] == _FILE_MARKER:
+            path = Path(buf[4:].decode())
+            obj = ser.decode_from_file(path)
+            path.unlink(missing_ok=True)
+            return obj
+        return ser.decode(buf, copy=True)
+
+    # -- lifecycle --------------------------------------------------------
+    def close(self) -> None:
+        if self._h:
+            self._lib.tlring_close(self._h)
+
+    def release(self) -> None:
+        """Detach (and unlink when owner). Thread-safe against concurrent
+        put/get: close() first wakes any thread blocked inside the C calls
+        (they return closed), then the detach waits for both user locks so
+        the munmap can never pull memory out from under a live call."""
+        if self._h is None:
+            return
+        self._lib.tlring_close(self._h)
+        with self._wlock, self._rlock:
+            if self._h is None:
+                return
+            self._lib.tlring_detach(self._h)
+            self._h = None
+        if self.owner:
+            self._lib.tlring_unlink(self.name.encode())
+
+    def __del__(self):  # best-effort; explicit release preferred
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+def _attach(name: str, capacity: int) -> RingChannel:
+    return RingChannel(capacity, _name=name)
+
+
+def ring_supported() -> bool:
+    from tensorlink_tpu.native import load_tlring
+
+    return load_tlring() is not None

@@ -110,14 +110,32 @@ class MLBridge:
 
 
 class NetBridge:
-    """Network-process side: executes commands against the role server."""
+    """Network-process side: executes commands against the role server.
+
+    Queue writes from the event loop go through an executor thread — the
+    native ring's put blocks when the consumer lags, and a blocked event
+    loop would stall all networking (heartbeats, every connection)."""
 
     def __init__(self, queues: BridgeQueues):
         self.q = queues
         self._task: asyncio.Task | None = None
 
     def post_work(self, kind: str, item: Any) -> None:
-        self.q.work.put((kind, item))
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            loop.run_in_executor(None, self._safe_put, self.q.work, (kind, item))
+        else:
+            self._safe_put(self.q.work, (kind, item))
+
+    @staticmethod
+    def _safe_put(q, item) -> None:
+        try:
+            q.put(item)
+        except Exception:
+            pass  # consumer gone (shutdown) — nothing to deliver to
 
     async def serve(self, dispatch: Callable[[str, Any], Any]) -> None:
         """Pump the cmd queue; run each command as its own task.
@@ -150,4 +168,6 @@ class NetBridge:
             result = traceback.format_exc(limit=20)
             ok = False
         if rid:  # rid 0 = notify, no reply wanted
-            self.q.resp.put((rid, ok, result))
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._safe_put, self.q.resp, (rid, ok, result)
+            )

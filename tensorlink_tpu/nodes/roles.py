@@ -70,6 +70,11 @@ class RoleServer(TensorNode):
         self.reserved: dict[str, float] = {}  # job_id -> reserved bytes
         self.register(proto.STATS_REQUEST, self._handle_stats_request)
 
+    def post_work(self, kind: str, item: Any) -> None:
+        # executor-offloaded put: the ring transport blocks when full and
+        # must never stall the event loop (see NetBridge.post_work)
+        self.bridge.post_work(kind, item)
+
     # -- entrypoint (net process main) ----------------------------------
     def main(self) -> None:
         self.start()  # event loop thread + listener
@@ -267,6 +272,10 @@ class ValidatorServer(RoleServer):
         self.monitor = JobMonitor(self)
         self.contract = ContractManager(self.node_id)
         self.worker_capacity_total = 0.0
+        # workers seen disconnecting since the last proposal round —
+        # keeper.clean_node prunes addresses/roles, so the proposal's
+        # offline list must come from its own record
+        self.offline_workers: dict[str, float] = {}
         self._restore_state()
         self.register(proto.JOB_REQ, self._handle_job_req)
         self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
@@ -289,6 +298,11 @@ class ValidatorServer(RoleServer):
 
     def on_shutdown(self) -> None:
         self.keeper.write_state(self)
+
+    def _on_disconnect(self, conn) -> None:
+        if conn.node_id and self.roles.get(conn.node_id) == "worker":
+            self.offline_workers[conn.node_id] = time.time()
+        super()._on_disconnect(conn)
 
     async def _platform_loop(self) -> None:
         """Keeper writes, job monitoring, stats, contract rounds — the
@@ -398,9 +412,9 @@ class ValidatorServer(RoleServer):
         the full proposal body goes to every connected validator, each
         recomputes the hash and votes; quorum over validators + self."""
         offline = [
-            nid for nid in list(self.addresses)
-            if nid not in self.connections and self.roles.get(nid) == "worker"
+            nid for nid in self.offline_workers if nid not in self.connections
         ]
+        self.offline_workers.clear()
         prop = self.contract.create_proposal(offline)
         h = prop.hash()
         await self.dht_store_global(f"proposal:{h}", prop.to_json())

@@ -47,14 +47,29 @@ class BaseNode:
         self.config = config
         self.role = config.role
         self.log = get_logger(f"node.{self.role}{config.duplicate}")
-        ctx = _spawn_ctx()
-        self.queues = BridgeQueues(cmd=ctx.Queue(), resp=ctx.Queue(), work=ctx.Queue())
+        self.queues = self._make_queues()
         self.bridge = MLBridge(self.queues)
         self._proc: mp.process.BaseProcess | None = None
         self._ml_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.node_id: str | None = None
         self.port: int | None = None
+
+    def _make_queues(self) -> BridgeQueues:
+        """Native shm message ring when available (C++ tlring — blocking
+        reads, TLTS payloads, no pickling); mp.Queue otherwise."""
+        if self.config.native_ipc:
+            try:
+                from tensorlink_tpu.core.ring import RingChannel, ring_supported
+
+                if ring_supported():
+                    return BridgeQueues(
+                        cmd=RingChannel(), resp=RingChannel(), work=RingChannel()
+                    )
+            except Exception as e:
+                self.log.warning("native ipc unavailable (%s); using mp.Queue", e)
+        ctx = _spawn_ctx()
+        return BridgeQueues(cmd=ctx.Queue(), resp=ctx.Queue(), work=ctx.Queue())
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BaseNode":
@@ -83,18 +98,33 @@ class BaseNode:
         pass
 
     def stop(self) -> None:
+        import queue as queue_mod
+
         self._stop.set()
         if self._ml_thread is not None:
-            self.queues.work.put(("_stop", None))
+            try:
+                self.queues.work.put(("_stop", None))
+            except (OSError, EOFError, queue_mod.Full):
+                pass  # ring closed by a dead peer / full — join regardless
             self._ml_thread.join(timeout=10)
             self._ml_thread = None
         if self._proc is not None:
-            self.queues.cmd.put((0, "_stop", None))
+            try:
+                self.queues.cmd.put((0, "_stop", None))
+            except (OSError, EOFError, queue_mod.Full):
+                pass
             self._proc.join(timeout=10)
             if self._proc.is_alive():
                 self._proc.terminate()
             self._proc = None
         self.bridge.close()
+        for q in (self.queues.cmd, self.queues.resp, self.queues.work):
+            release = getattr(q, "release", None)
+            if release is not None:
+                try:
+                    release()
+                except Exception:
+                    pass
 
     def __enter__(self) -> "BaseNode":
         return self.start()
