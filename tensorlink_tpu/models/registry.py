@@ -254,6 +254,36 @@ def config_presets() -> dict[str, ModelConfig]:
             qk_norm=True,
             tie_embeddings=False,
         ),
+        "qwen3-4b": ModelConfig(
+            family="qwen3",
+            vocab_size=151936,
+            d_model=2560,
+            n_layers=36,
+            n_heads=32,
+            n_kv_heads=8,
+            head_dim=128,
+            d_ff=9728,
+            max_seq_len=40960,
+            norm_eps=1e-6,
+            rope_theta=1e6,
+            qk_norm=True,
+            tie_embeddings=True,
+        ),
+        "qwen3-0p6b": ModelConfig(
+            family="qwen3",
+            vocab_size=151936,
+            d_model=1024,
+            n_layers=28,
+            n_heads=16,
+            n_kv_heads=8,
+            head_dim=128,
+            d_ff=3072,
+            max_seq_len=40960,
+            norm_eps=1e-6,
+            rope_theta=1e6,
+            qk_norm=True,
+            tie_embeddings=True,
+        ),
         "qwen3-1p7b": ModelConfig(
             family="qwen3",
             vocab_size=151936,

@@ -10,11 +10,15 @@ virtual devices.
 
 import os
 
-# Force CPU. The environment pins JAX_PLATFORMS=axon (real TPU via tunnel)
-# and the axon plugin imports jax at interpreter start, so a plain env
-# setdefault is not enough: override the env (for spawned subprocesses) AND
-# update the already-imported config (for this process).
+# Force CPU. The environment pins JAX_PLATFORMS=axon (real TPU via a tunnel)
+# and a sitecustomize hook registers that backend at interpreter start, so a
+# plain env setdefault is not enough: override the env (for spawned
+# subprocesses), update the already-imported config (for this process), AND
+# evict the tunneled-backend factory — jax's backends() initializes every
+# registered factory, and the tunnel one hangs indefinitely when the TPU
+# runtime is unreachable (round-1 failure mode).
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # disarm hook in subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -27,6 +31,29 @@ os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+try:
+    # Neutralize any non-CPU backend factory registered by site hooks: the
+    # tunneled TPU factory hangs indefinitely in init when the runtime is
+    # unreachable. Keep the dict KEYS (known_platforms() derives from them —
+    # popping would make "tpu" an unknown platform and break lowering-rule
+    # registration) but make init fail fast instead of hanging.
+    from jax._src import xla_bridge as _xb
+
+    def _disabled_factory(*a, **k):
+        raise RuntimeError("non-CPU backends are disabled in the test suite")
+
+    for _name in [n for n in _xb._backend_factories if n != "cpu"]:
+        _entry = _xb._backend_factories[_name]
+        # entries are either callables or objects with a .factory attribute
+        if callable(_entry):
+            _xb._backend_factories[_name] = _disabled_factory
+        elif hasattr(_entry, "factory"):
+            try:
+                _entry.factory = _disabled_factory
+            except Exception:
+                pass
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 
