@@ -1,0 +1,177 @@
+"""Bounded accelerator acquisition.
+
+The reference's worker reports GPU memory straight from CUDA calls
+(ml/utils.py:127 get_gpu_memory) — when the driver is wedged, its process
+blocks. The TPU analogue is worse: JAX backend init against a tunneled or
+dead TPU runtime can hang *indefinitely* inside ``jax.local_devices()``
+(the PJRT client constructor blocks, no timeout). Production paths —
+``DistributedWorker.capacity()``, ``WorkerNode.start()``, the CLI — must
+never do that.
+
+:func:`acquire_devices` probes the inherited backend in a **subprocess**
+with a deadline before letting the calling process initialize JAX. If the
+probe fails or times out, the calling process is switched to the CPU
+backend (env + config + factory neutralization, so nothing later can hang
+on the dead runtime) and a loud warning is logged. The result is cached:
+one probe per process.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+from .logging import get_logger
+
+log = get_logger("core.devices")
+
+# env var that arms a sitecustomize hook force-registering a tunneled TPU
+# backend; must be scrubbed when falling back to CPU (see tests/conftest.py)
+_TUNNEL_HOOK_VAR = "PALLAS_AXON_POOL_IPS"
+
+
+@dataclass
+class DeviceProbe:
+    platform: str
+    n_devices: int
+    degraded: bool = False  # True when we fell back to CPU
+    error: str = ""
+    devices: list = field(default_factory=list)
+
+
+_CACHED: DeviceProbe | None = None
+
+
+def _jax_initialized() -> bool:
+    """True if this process already has a live JAX backend (in which case
+    device calls are safe and a probe would be wasted work)."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(xb._backend_lock and xb._backends)
+    except Exception:
+        return False
+
+
+def _force_cpu_inprocess() -> None:
+    """Point this process (and its future children) at the CPU backend and
+    make any still-registered accelerator factory fail fast instead of
+    hanging (keeps factory keys — known_platforms() derives from them)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop(_TUNNEL_HOOK_VAR, None)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        from jax._src import xla_bridge as xb
+
+        def _disabled_factory(*a, **k):
+            raise RuntimeError("accelerator backend disabled after failed probe")
+
+        for name in [n for n in xb._backend_factories if n != "cpu"]:
+            entry = xb._backend_factories[name]
+            if callable(entry):
+                xb._backend_factories[name] = _disabled_factory
+            elif hasattr(entry, "factory"):
+                entry.factory = _disabled_factory
+    except Exception:
+        pass
+
+
+def probe_backend(deadline: float = 60.0) -> tuple[str, int] | None:
+    """Initialize the inherited JAX backend in a subprocess with a deadline.
+
+    Returns ``(platform, n_local_devices)`` or None on failure/timeout."""
+    code = (
+        "import jax; d = jax.local_devices(); "
+        "print('PROBE=' + d[0].platform + ':' + str(len(d)))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=deadline,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if p.returncode != 0:
+        return None
+    for ln in p.stdout.splitlines():
+        if ln.startswith("PROBE="):
+            plat, _, n = ln[len("PROBE="):].partition(":")
+            try:
+                return plat, int(n)
+            except ValueError:
+                return None
+    return None
+
+
+def acquire_devices(deadline: float = 60.0) -> DeviceProbe:
+    """Bounded replacement for ``jax.local_devices()`` in production paths.
+
+    Never hangs: either the inherited backend comes up within ``deadline``
+    (probed out-of-process first, so a wedged runtime can't block us), or
+    the process is switched to CPU with ``degraded=True``.
+    """
+    global _CACHED
+    if _CACHED is not None:
+        return _CACHED
+
+    env_plat = os.environ.get("JAX_PLATFORMS", "")
+    if _jax_initialized():
+        import jax
+
+        devs = jax.local_devices()
+        _CACHED = DeviceProbe(devs[0].platform, len(devs), devices=devs)
+        return _CACHED
+
+    if env_plat == "cpu" and not os.environ.get(_TUNNEL_HOOK_VAR):
+        # CPU pinned and no tunnel hook armed — init is safe and fast.
+        import jax
+
+        devs = jax.local_devices()
+        _CACHED = DeviceProbe("cpu", len(devs), devices=devs)
+        return _CACHED
+
+    res = probe_backend(deadline)
+    if res is None:
+        log.warning(
+            "accelerator backend failed to initialize within %.0fs "
+            "(JAX_PLATFORMS=%r) — falling back to CPU; this worker will "
+            "advertise CPU capacity only",
+            deadline,
+            env_plat,
+        )
+        _force_cpu_inprocess()
+        import jax
+
+        devs = jax.local_devices()
+        _CACHED = DeviceProbe(
+            "cpu",
+            len(devs),
+            degraded=True,
+            error=f"backend init exceeded {deadline:.0f}s deadline",
+            devices=devs,
+        )
+        return _CACHED
+
+    plat, _n = res
+    import jax
+
+    devs = jax.local_devices()
+    _CACHED = DeviceProbe(plat, len(devs), devices=devs)
+    return _CACHED
+
+
+def reset_probe_cache() -> None:
+    """Test hook."""
+    global _CACHED
+    _CACHED = None

@@ -239,6 +239,7 @@ def _block(
     cache_k: jax.Array | None,  # [B, S, Hkv, hd] this layer's cache
     cache_v: jax.Array | None,
     write_at: jax.Array | None,  # [B] int32 write offsets
+    attn_fn=None,  # static override: (q, k, v, mask_bias, scale) -> out
 ):
     B, T, _ = x.shape
     h = _norm(x, lp["ln1"], cfg)
@@ -269,7 +270,8 @@ def _block(
         k_all, v_all = k, v
 
     scale = cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
-    attn_out = attention(q, k_all.astype(q.dtype), v_all.astype(q.dtype), mask_bias, scale)
+    impl = attn_fn or attention
+    attn_out = impl(q, k_all.astype(q.dtype), v_all.astype(q.dtype), mask_bias, scale)
     attn_out = attn_out.reshape(B, T, cfg.q_dim) @ ap["wo"]
     if "bo" in ap:
         attn_out = attn_out + ap["bo"]
@@ -302,7 +304,10 @@ def _mask_bias(
     return jnp.where(ok, 0.0, -jnp.inf).astype(jnp.float32)[:, None, None]
 
 
-@partial(jax.jit, static_argnames=("cfg", "remat", "return_hidden"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "remat", "return_hidden", "seq_mesh", "seq_axis"),
+)
 def forward(
     params: dict,
     tokens: jax.Array,  # int32 [B, T]
@@ -312,6 +317,8 @@ def forward(
     positions: jax.Array | None = None,  # int32 [B, T] absolute positions
     remat: bool = False,
     return_hidden: bool = False,
+    seq_mesh=None,  # Mesh with a ring axis → sequence-parallel attention
+    seq_axis: str = "seq",
 ):
     """Full forward. Returns ``(logits, new_cache)``.
 
@@ -329,11 +336,13 @@ def forward(
         x, new_cache = _stage_impl(
             params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
             positions=positions, first=True, last=False, remat=remat,
+            seq_mesh=seq_mesh, seq_axis=seq_axis,
         )
         return _norm(x, params["final_norm"], cfg), new_cache
     return _stage_impl(
         params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
         positions=positions, first=True, last=True, remat=remat,
+        seq_mesh=seq_mesh, seq_axis=seq_axis,
     )
 
 
@@ -366,7 +375,10 @@ def _logits(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
 # :func:`head_forward` on stage 0.
 
 
-@partial(jax.jit, static_argnames=("cfg", "first", "last", "remat"))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "first", "last", "remat", "seq_mesh", "seq_axis"),
+)
 def stage_forward(
     params: dict,
     cfg: ModelConfig,  # FULL model config (stage layer count comes from params)
@@ -379,13 +391,21 @@ def stage_forward(
     first: bool = False,
     last: bool = False,
     remat: bool = False,
+    seq_mesh=None,  # Mesh with a ring axis → sequence-parallel attention
+    seq_axis: str = "seq",
 ):
     """Run one pipeline stage. Returns ``(out, new_cache)`` where ``out`` is
-    logits when ``last`` else the hidden state to ship to the next stage."""
+    logits when ``last`` else the hidden state to ship to the next stage.
+
+    ``seq_mesh`` switches attention to the ring formulation
+    (parallel/ring.py) with activations sequence-sharded over
+    ``mesh[seq_axis]`` — the long-context product path (SURVEY §5: the
+    reference scales sequence only by renting a bigger worker). Ring mode
+    requires no KV cache, no padding mask, and no sliding window."""
     return _stage_impl(
         params, cfg, tokens=tokens, hidden=hidden, cache=cache,
         attn_mask=attn_mask, positions=positions, first=first, last=last,
-        remat=remat,
+        remat=remat, seq_mesh=seq_mesh, seq_axis=seq_axis,
     )
 
 
@@ -401,7 +421,29 @@ def _stage_impl(
     first: bool,
     last: bool,
     remat: bool,
+    seq_mesh=None,
+    seq_axis: str = "seq",
 ):
+    attn_fn = None
+    if seq_mesh is not None:
+        if cache is not None:
+            raise ValueError("sequence-parallel attention has no KV cache path")
+        if attn_mask is not None:
+            raise ValueError(
+                "sequence-parallel attention does not support padding masks"
+            )
+        if cfg.sliding_window is not None:
+            raise ValueError(
+                "sequence-parallel attention does not support sliding windows"
+            )
+        from ..parallel.ring import ring_attention
+
+        def attn_fn(q, k, v, _bias, scale):  # causal masking is global-
+            # position arithmetic inside the ring; _bias is unused
+            return ring_attention(
+                q, k, v, seq_mesh, axis_name=seq_axis, scale=scale, causal=True
+            )
+
     if first:
         if tokens is None:
             raise ValueError("first stage requires tokens")
@@ -439,7 +481,9 @@ def _stage_impl(
     block = _block
     if remat:
         block = jax.checkpoint(
-            _block, policy=jax.checkpoint_policies.nothing_saveable, static_argnums=(2,)
+            _block,
+            policy=jax.checkpoint_policies.nothing_saveable,
+            static_argnums=(2, 9),
         )
 
     layers = params.get("layers")
@@ -449,7 +493,9 @@ def _stage_impl(
 
             def scan_fn(carry, xs):
                 lp, ck, cv = xs
-                y, ck, cv = block(carry, lp, cfg, cos, sin, bias, ck, cv, offset)
+                y, ck, cv = block(
+                    carry, lp, cfg, cos, sin, bias, ck, cv, offset, attn_fn
+                )
                 return y, (ck, cv)
 
             x, (new_k, new_v) = lax.scan(scan_fn, x, (layers, cache.k, cache.v))
@@ -461,7 +507,9 @@ def _stage_impl(
         else:
 
             def scan_fn(carry, lp):
-                y, _, _ = block(carry, lp, cfg, cos, sin, bias, None, None, None)
+                y, _, _ = block(
+                    carry, lp, cfg, cos, sin, bias, None, None, None, attn_fn
+                )
                 return y, None
 
             x, _ = lax.scan(scan_fn, x, layers)

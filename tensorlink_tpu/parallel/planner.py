@@ -167,11 +167,71 @@ class AssignmentError(RuntimeError):
     """No worker set can host the job (reference graphing.py:640-650)."""
 
 
-def _mesh_axes_for(cfg: ModelConfig, cap: WorkerCapacity, training: bool) -> dict[str, int]:
-    """Within one worker: MoE models first claim an expert axis (EP —
+# training jobs at/above this sequence length get a seq (ring-attention)
+# axis automatically when devices remain after EP/TP
+SEQ_PARALLEL_THRESHOLD = 8192
+
+
+def _apply_mesh_hints(
+    cfg: ModelConfig,
+    cap: WorkerCapacity,
+    training: bool,
+    hints: dict[str, int],
+    *,
+    stage_layers: int,
+) -> dict[str, int]:
+    """Validate explicit per-axis requests (job spec ``parallelism`` field)
+    and fill the remaining devices with fsdp/data."""
+    n = cap.n_devices
+    axes: dict[str, int] = {}
+    used = 1
+    for name, size in hints.items():
+        size = int(size)
+        if size <= 1:
+            continue
+        if name not in ("tensor", "expert", "seq", "stage", "fsdp", "data"):
+            raise AssignmentError(f"unknown mesh axis {name!r}")
+        if used * size > n:
+            raise AssignmentError(
+                f"parallelism hints need {used * size} devices, worker has {n}"
+            )
+        if name == "tensor" and (
+            cfg.n_heads % size or cfg.n_kv_heads % size
+        ):
+            raise AssignmentError(f"tensor={size} does not divide head counts")
+        if name == "expert" and (not cfg.moe or cfg.n_experts % size):
+            raise AssignmentError(f"expert={size} invalid for this model")
+        if name == "stage" and stage_layers % size:
+            raise AssignmentError(
+                f"stage={size} does not divide {stage_layers} layers"
+            )
+        axes[name] = size
+        used *= size
+    rest = n // used
+    if rest > 1 and "fsdp" not in axes and "data" not in axes:
+        axes["fsdp" if training else "data"] = rest
+    return axes
+
+
+def _mesh_axes_for(
+    cfg: ModelConfig,
+    cap: WorkerCapacity,
+    training: bool,
+    *,
+    seq_len: int = 0,
+    stage_layers: int = 0,
+    mesh_hints: dict[str, int] | None = None,
+) -> dict[str, int]:
+    """Within one worker: explicit ``mesh_hints`` (job spec ``parallelism``)
+    win outright; otherwise MoE models first claim an expert axis (EP —
     required by BASELINE config 5, Mixtral), then a TP degree that divides
-    both head counts; remaining devices go to fsdp (training) or data
+    both head counts, then long-context *training* jobs claim a seq
+    (ring-attention) axis; remaining devices go to fsdp (training) or data
     (serving). All axes ride ICI inside the worker's slice."""
+    if mesh_hints:
+        return _apply_mesh_hints(
+            cfg, cap, training, mesh_hints, stage_layers=stage_layers
+        )
     n = cap.n_devices
     ep = 1
     if cfg.moe:
@@ -191,7 +251,19 @@ def _mesh_axes_for(cfg: ModelConfig, cap: WorkerCapacity, training: bool) -> dic
             tp = cand
             break
     rest = rem // tp
+    sp = 1
+    if training and seq_len >= SEQ_PARALLEL_THRESHOLD and rest > 1:
+        # ring attention shards activations over seq — the axis that actually
+        # bounds long-context memory (SURVEY §5); KV-cache decode never takes
+        # this path, so serving plans skip it
+        for cand in (8, 4, 2):
+            if cand <= rest and seq_len % cand == 0 and rest % cand == 0:
+                sp = cand
+                break
+        rest //= sp
     axes = {"fsdp" if training else "data": rest, "tensor": tp}
+    if sp > 1:
+        axes["seq"] = sp
     if ep > 1:
         axes["expert"] = ep
     return axes
@@ -206,6 +278,7 @@ def plan_sharding(
     seq_len: int = 2048,
     training: bool = False,
     n_micro: int | None = None,
+    mesh_hints: dict[str, int] | None = None,
 ) -> ShardingPlan:
     """Assign the model to workers.
 
@@ -232,7 +305,12 @@ def plan_sharding(
             first=True,
             last=True,
             holds_head=True,
-            mesh_axes=_mesh_axes_for(cfg, best, training),
+            mesh_axes=_mesh_axes_for(
+                cfg, best, training,
+                seq_len=seq_len,
+                stage_layers=cfg.n_layers,
+                mesh_hints=mesh_hints,
+            ),
         )
         return ShardingPlan(
             model_name=model_name,
@@ -285,7 +363,12 @@ def plan_sharding(
                 first=i == 0,
                 last=is_last,
                 holds_head=is_last,
-                mesh_axes=_mesh_axes_for(cfg, w, training),
+                mesh_axes=_mesh_axes_for(
+                    cfg, w, training,
+                    seq_len=seq_len,
+                    stage_layers=n_l,
+                    mesh_hints=mesh_hints,
+                ),
             )
         )
         lo += n_l
@@ -309,11 +392,28 @@ def plan_sharding(
 
 
 def stage_param_specs(cfg: ModelConfig, stage: StagePlan) -> dict:
-    """PartitionSpec tree for one stage's params given its mesh axes."""
+    """PartitionSpec tree for one stage's params given its mesh axes.
+
+    A ``stage`` axis (in-mesh GPipe, parallel/pipeline.py) shards the
+    *leading layer dim* of every layer param — embedding/head stay
+    replicated across the pipeline ring and run outside the pipelined
+    region."""
     tp = "tensor" if stage.mesh_axes.get("tensor", 1) > 1 else None
     fs = "fsdp" if stage.mesh_axes.get("fsdp", 1) > 1 else None
     ep = "expert" if stage.mesh_axes.get("expert", 1) > 1 else None
+    pp = stage.mesh_axes.get("stage", 1) > 1
+    if pp:
+        # gpipe's shard_map runs manual over the stage axis with everything
+        # else replicated inside the region — do not mix in tensor/fsdp specs
+        tp = fs = ep = None
     specs = partition_specs(cfg, tensor_axis=tp, expert_axis=ep, fsdp_axis=fs)
+    if pp:
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        specs["layers"] = jax.tree.map(
+            lambda s: P("stage", *s[1:]), specs["layers"]
+        )
     if not stage.first:
         specs["embed"].pop("pos", None)
         if not (stage.holds_head and cfg.tie_embeddings):

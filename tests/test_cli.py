@@ -38,6 +38,58 @@ def test_cli_starts_worker_and_reports(tmp_path):
             proc.kill()
 
 
+def test_cli_survives_dead_accelerator_backend(tmp_path):
+    """A worker whose accelerator runtime is unreachable must degrade to
+    CPU capacity within the probe deadline instead of hanging forever
+    (core/devices.py bounded acquisition)."""
+    import os
+
+    cfg = {
+        "role": "worker",
+        "mode": "local",
+        "key_dir": str(tmp_path / "keys"),
+        "log_dir": str(tmp_path / "logs"),
+        "env_file": str(tmp_path / ".env"),
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    # a platform name with no registered factory: backend init fails, the
+    # probe reports failure, and the worker must fall back to CPU
+    env["JAX_PLATFORMS"] = "bogus_tpu_runtime"
+    env["TLTPU_DEVICE_PROBE_S"] = "30"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tensorlink_tpu.cli", "-c", str(cfg_path),
+         "--ui-interval", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        t0 = time.time()
+        line = proc.stdout.readline()
+        assert time.time() - t0 < 90, "CLI took too long to come up"
+        info = json.loads(line)
+        assert info["role"] == "worker" and info["port"] > 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_acquire_devices_cpu_fast():
+    from tensorlink_tpu.core.devices import acquire_devices
+
+    probe = acquire_devices()
+    assert probe.n_devices >= 1
+    assert probe.platform == "cpu"
+    assert not probe.degraded
+    assert len(probe.devices) == probe.n_devices
+
+
 def test_status_report_format(tmp_path):
     from tensorlink_tpu.cli import status_report
     from tensorlink_tpu.core.config import WorkerConfig
