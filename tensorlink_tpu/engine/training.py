@@ -130,6 +130,10 @@ def make_train_step(
             # so scale its grads back to sums and divide once by the total
             # token count — the result matches the n_micro=1 step even when
             # loss masks make micro-batches unevenly populated.
+            # accumulate in fp32 regardless of param dtype: bf16 params
+            # would otherwise carry a bf16 accumulator that `g * n_tok`
+            # (fp32 scalar) promotes to fp32 — a lax.scan carry dtype
+            # mismatch — and fp32 is the numerically right accumulator
             def scan_fn(acc, xs):
                 t = xs[0]
                 m = xs[1] if lm is not None else None
@@ -137,17 +141,24 @@ def make_train_step(
                 n_tok = aux["n_tokens"].astype(jnp.float32)
                 acc_grads, acc_nll, acc_tok = acc
                 acc_grads = jax.tree.map(
-                    lambda a, g: a + g * n_tok, acc_grads, grads
+                    lambda a, g: a + g.astype(jnp.float32) * n_tok,
+                    acc_grads, grads,
                 )
                 return (acc_grads, acc_nll + loss * n_tok, acc_tok + n_tok), None
 
-            zero = jax.tree.map(jnp.zeros_like, params)
+            zero = jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+            )
             xs = (toks, lm) if lm is not None else (toks,)
             (grads, nll_sum, tok_sum), _ = jax.lax.scan(
                 scan_fn, (zero, jnp.float32(0.0), jnp.float32(0.0)), xs
             )
             tok_sum = jnp.maximum(tok_sum, 1.0)
-            grads = jax.tree.map(lambda g: g / tok_sum, grads)
+            # hand the optimizer grads in param dtype, matching n_micro=1
+            # (keeps opt_state dtypes stable across both paths)
+            grads = jax.tree.map(
+                lambda g, p: (g / tok_sum).astype(p.dtype), grads, params
+            )
             loss = nll_sum / tok_sum
         else:
             loss, _aux, grads = compute_grads(params, tokens, loss_mask)

@@ -307,6 +307,79 @@ class DistributedWorker:
             raise KeyError(f"job {job_id} not loaded")
         return rt
 
+    def _stage_fwd_fn(
+        self,
+        rt: StageRuntime,
+        seq_mesh,
+        pp_size: int,
+        apply_head: bool,
+        kw: dict,
+        *,
+        remat: bool = False,
+    ):
+        """Build the ``(params, x) -> out`` program for this stage's layer
+        slice, where ``x`` is tokens (first stage) or hidden (later stages).
+
+        Dispatch, in order: a plan mesh with a ``stage`` axis runs the slice
+        through the in-mesh GPipe program (parallel/pipeline.py); a ``seq``
+        axis runs ring attention inside ``stage_forward``; otherwise the
+        plain compiled stage program. All three are differentiable, so the
+        training path takes ``jax.vjp`` of the returned closure directly
+        (the explicit replacement for the reference's torch-autograd replay,
+        ml/worker.py:233-291)."""
+        from tensorlink_tpu.models.transformer import stage_forward
+
+        first = rt.stage["first"]
+        attn_mask = kw.get("attn_mask")
+
+        if pp_size > 1:
+            from tensorlink_tpu.parallel.pipeline import pipelined_stage_forward
+
+            x_in = kw["tokens"] if first else kw["hidden"]
+            batch = int(x_in.shape[0])
+            # prefer 2 micro-batches per stage (keeps the bubble small),
+            # degrade to whatever divides the batch; this in-mesh micro
+            # count is sized to THIS stage's mesh, independent of the
+            # cross-worker plan.n_micro grad-accumulation knob
+            n_micro = 1
+            for cand in (2 * pp_size, pp_size, 2, 1):
+                if batch % cand == 0:
+                    n_micro = cand
+                    break
+
+            def fwd(params, x):
+                out, _ = pipelined_stage_forward(
+                    params,
+                    rt.cfg,
+                    rt.mesh,
+                    tokens=x if first else None,
+                    hidden=None if first else x,
+                    attn_mask=attn_mask,
+                    n_micro=n_micro,
+                    first=first,
+                    last=apply_head,
+                    remat=remat,
+                )
+                return out
+
+            return fwd
+
+        def fwd(params, x):
+            out, _ = stage_forward(
+                params,
+                rt.cfg,
+                tokens=x if first else None,
+                hidden=None if first else x,
+                attn_mask=attn_mask,
+                first=first,
+                last=apply_head,
+                remat=remat,
+                seq_mesh=seq_mesh,
+            )
+            return out
+
+        return fwd
+
     # -- forward --------------------------------------------------------
     def _forward(self, p: dict) -> None:
         """op="stage": run my layer slice (optionally with a decode-session

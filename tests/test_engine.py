@@ -127,6 +127,27 @@ def test_microbatch_grad_accum_matches_full(tiny_model):
     assert max(jax.tree.leaves(d)) < 1e-5
 
 
+def test_microbatch_bf16_train_step():
+    """bf16 params + n_micro>1 — every real TPU training config. The scan
+    carry must accumulate in fp32 or the program fails to trace (r2 bench
+    train_error: carry dtype mismatch, engine/training.py)."""
+    cfg = TINY.with_(dtype=jnp.bfloat16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = make_optimizer("adamw", lr=5e-3)
+    ts = make_train_step(cfg, opt, n_micro=2, remat=True, donate=False)
+    state = ts.init_state(params)
+    rng = np.random.default_rng(2)
+    batch = {"tokens": jnp.asarray(rng.integers(0, 64, (4, 16)).astype(np.int32))}
+    losses = []
+    p = params
+    for _ in range(8):
+        p, state, m = ts.step_fn(p, state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert jax.tree.leaves(p)[0].dtype == jnp.bfloat16
+
+
 def test_loss_mask(tiny_model):
     cfg, params = tiny_model
     toks = jnp.asarray(np.arange(32, dtype=np.int32).reshape(2, 16) % 64)
