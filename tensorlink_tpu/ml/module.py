@@ -44,6 +44,7 @@ class DistributedModel:
         batch: int = 1,
         seq_len: int | None = None,
         n_micro: int | None = None,
+        parallelism: dict[str, int] | None = None,
         seed: int = 0,
         ckpt: str | None = None,
         start_session: bool = True,
@@ -77,6 +78,9 @@ class DistributedModel:
             "seq_len": seq_len or 2048,
             "training": training,
             "n_micro": n_micro,
+            # explicit per-worker mesh axes (tensor/seq/stage/expert/...);
+            # validated by the planner (parallel/planner._apply_mesh_hints)
+            "parallelism": parallelism,
         }
         self.job_id: str | None = None
         self.plan = None

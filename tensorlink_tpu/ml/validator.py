@@ -98,6 +98,7 @@ class DistributedValidator:
         seq_len: int = 2048,
         training: bool = False,
         n_micro=None,
+        mesh_hints: dict | None = None,
         req_id: str | None = None,
         user_id: str | None = None,
     ) -> dict:
@@ -119,6 +120,7 @@ class DistributedValidator:
         plan = plan_sharding(
             cfg, workers, model_name=name, batch=batch,
             seq_len=seq_len, training=training, n_micro=n_micro,
+            mesh_hints=mesh_hints,
         )
         total_layers = max(cfg.n_layers, 1)
         job = {
@@ -164,6 +166,7 @@ class DistributedValidator:
                 seq_len=int(spec.get("seq_len", 2048)),
                 training=bool(spec.get("training", False)),
                 n_micro=spec.get("n_micro"),
+                mesh_hints=spec.get("parallelism"),
                 req_id=p["req_id"],
                 user_id=p.get("user_id"),
             )

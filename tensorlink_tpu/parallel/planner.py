@@ -179,6 +179,7 @@ def _apply_mesh_hints(
     hints: dict[str, int],
     *,
     stage_layers: int,
+    seq_len: int = 0,
 ) -> dict[str, int]:
     """Validate explicit per-axis requests (job spec ``parallelism`` field)
     and fill the remaining devices with fsdp/data."""
@@ -191,6 +192,13 @@ def _apply_mesh_hints(
             continue
         if name not in ("tensor", "expert", "seq", "stage", "fsdp", "data"):
             raise AssignmentError(f"unknown mesh axis {name!r}")
+        if name in ("seq", "stage") and not training:
+            # serving sessions take the KV-cache path, which neither the
+            # in-mesh GPipe nor ring attention supports (ml/worker.py
+            # dispatch policy) — reject at plan time, not per request
+            raise AssignmentError(
+                f"{name} parallelism applies to training jobs only"
+            )
         if used * size > n:
             raise AssignmentError(
                 f"parallelism hints need {used * size} devices, worker has {n}"
@@ -205,6 +213,15 @@ def _apply_mesh_hints(
             raise AssignmentError(
                 f"stage={size} does not divide {stage_layers} layers"
             )
+        if name == "seq":
+            if cfg.sliding_window is not None:
+                raise AssignmentError(
+                    "seq parallelism does not support sliding-window models"
+                )
+            if seq_len % size:
+                raise AssignmentError(
+                    f"seq={size} does not divide seq_len={seq_len}"
+                )
         axes[name] = size
         used *= size
     rest = n // used
@@ -230,7 +247,8 @@ def _mesh_axes_for(
     (serving). All axes ride ICI inside the worker's slice."""
     if mesh_hints:
         return _apply_mesh_hints(
-            cfg, cap, training, mesh_hints, stage_layers=stage_layers
+            cfg, cap, training, mesh_hints,
+            stage_layers=stage_layers, seq_len=seq_len,
         )
     n = cap.n_devices
     ep = 1

@@ -109,3 +109,35 @@ def test_mesh_build_cpu(cpu_devices):
     assert mesh.shape == {"data": 2, "tensor": 4}
     m2 = local_mesh(data=-1, tensor=2)
     assert m2.shape["tensor"] == 2 and m2.shape["data"] == 4
+
+
+def test_mesh_hints_validated():
+    """Explicit parallelism hints reject configs the worker dispatch cannot
+    run, at plan time (serving + stage/seq; sliding windows or indivisible
+    seq_len + seq; bad sizes)."""
+    cfg = config_presets()["gpt2-small"]
+    w = _workers(64, n_devices=8)
+
+    plan = plan_sharding(
+        cfg, w, seq_len=1024, training=True, mesh_hints={"stage": 2}
+    )
+    assert plan.stages[0].mesh_axes.get("stage") == 2
+    # remaining devices fill the fsdp axis for training jobs
+    assert plan.stages[0].mesh_axes.get("fsdp") == 4
+
+    # serving jobs cannot take the GPipe/ring paths (KV-cache sessions)
+    for hint in ({"stage": 2}, {"seq": 2}):
+        with pytest.raises(AssignmentError):
+            plan_sharding(cfg, w, seq_len=1024, training=False, mesh_hints=hint)
+    # seq must divide seq_len
+    with pytest.raises(AssignmentError):
+        plan_sharding(cfg, w, seq_len=1023, training=True, mesh_hints={"seq": 2})
+    # sliding-window models have no ring-attention path
+    swcfg = cfg.with_(sliding_window=128)
+    with pytest.raises(AssignmentError):
+        plan_sharding(swcfg, w, seq_len=1024, training=True, mesh_hints={"seq": 2})
+    # unknown axis / oversubscription
+    with pytest.raises(AssignmentError):
+        plan_sharding(cfg, w, seq_len=1024, training=True, mesh_hints={"bogus": 2})
+    with pytest.raises(AssignmentError):
+        plan_sharding(cfg, w, seq_len=1024, training=True, mesh_hints={"stage": 16})
