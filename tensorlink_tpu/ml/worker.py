@@ -188,6 +188,16 @@ class DistributedWorker:
         model = p["model"]
         stage = p["stage"]
         cfg = ModelConfig.from_json(model["config"])
+        if (
+            cfg.moe
+            and bool(p.get("training", False))
+            and int((stage.get("mesh_axes") or {}).get("expert", 1)) > 1
+        ):
+            # training + expert axis → capacity-factor all-to-all dispatch
+            # (parallel/expert.py). Serving stays on dense dispatch: its
+            # capacity overflow drops tokens, which would silently change
+            # served logits — expert-axis sharding still applies via GSPMD.
+            cfg = cfg.with_(moe_dispatch="sparse")
         lo, hi = stage["layer_lo"], stage["layer_hi"]
         first, holds_head = stage["first"], stage["holds_head"]
 

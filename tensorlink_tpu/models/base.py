@@ -56,6 +56,14 @@ class ModelConfig:
     # MoE (Mixtral): 0 experts = dense
     n_experts: int = 0
     n_experts_per_tok: int = 2
+    # "dense" runs every token through every expert (exact, small scale);
+    # "sparse" is the capacity-factor top-k dispatch (parallel/expert.py) —
+    # the worker flips this on when its stage mesh carries an expert axis
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 2.0
+    # sparse dispatch groups tokens so the one-hot scatter einsums scale
+    # linearly with sequence length (GShard token grouping)
+    moe_group_size: int = 1024
     # sliding-window attention (Mistral); None = full causal
     sliding_window: int | None = None
     dtype: Any = jnp.bfloat16

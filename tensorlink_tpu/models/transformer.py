@@ -205,14 +205,19 @@ def _mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
 
 
 def _moe_mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
-    """Mixtral-style top-k sparse MoE, dense-dispatch formulation.
+    """Mixtral-style top-k MoE.
 
-    Every expert sees every token and results are combined with the (sparse)
-    top-k routing weights — numerically identical to gather-based routing and
-    XLA/GSPMD-friendly (expert axis shards cleanly). The capacity-based
-    all-to-all dispatch for large scale lives in
-    tensorlink_tpu/parallel/expert.py.
+    ``cfg.moe_dispatch == "sparse"`` routes to the capacity-factor top-k
+    all-to-all dispatch (parallel/expert.py) — ~E/K× fewer expert FLOPs,
+    used when the expert mesh axis is active. The default here is the
+    dense-dispatch formulation: every expert sees every token and results
+    combine with the (sparse) top-k routing weights — numerically identical
+    to gather-based routing, exact, and GSPMD-friendly at small scale.
     """
+    if cfg.moe_dispatch == "sparse":
+        from ..parallel.expert import sparse_moe_mlp
+
+        return sparse_moe_mlp(h, p, cfg)
     B, T, d = h.shape
     E, K = cfg.n_experts, cfg.n_experts_per_tok
     router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, T, E]

@@ -12,6 +12,7 @@ Capability map (SURVEY §2.2) onto TPU idioms:
 - expert parallelism (absent) → capacity-based all-to-all (:mod:`.expert`)
 """
 
+from .expert import sparse_moe_mlp
 from .mesh import MeshPlan, build_mesh, local_mesh
 from .planner import ShardingPlan, plan_sharding
 
@@ -21,4 +22,5 @@ __all__ = [
     "build_mesh",
     "local_mesh",
     "plan_sharding",
+    "sparse_moe_mlp",
 ]

@@ -1,0 +1,146 @@
+"""Sparse MoE dispatch — capacity-factor top-k expert parallelism.
+
+The dense-dispatch formulation (models/transformer._moe_mlp) runs every
+token through every expert: numerically exact, but ~E/K× the FLOPs the
+routing actually selects — disqualifying at Mixtral-8x7B scale (BASELINE
+config 5; the reference treats MoE as generic module offloading,
+/root/reference/tensorlink/ml/graphing.py:202-761, and pays the same
+dense cost through HF's gather-based eager path).
+
+This module is the GShard/Switch-style sparse formulation, shaped for
+GSPMD: tokens are scattered into per-expert capacity buffers ``[E, C, d]``
+with one-hot dispatch einsums, experts run their FFN on just their buffer,
+and results combine back weighted by the router. When the expert dim is
+sharded over an ``expert`` mesh axis (parallel/planner.py assigns it first
+for MoE models), XLA lowers the dispatch/combine einsums to all-to-alls
+over ICI — no hand-written collectives.
+
+Capacity semantics (standard GShard): tokens dispatch in independent
+groups; each expert accepts at most ``C = ceil(g · K · capacity_factor /
+E)`` token-slots per group of ``g`` tokens; overflow slots are dropped
+(their combine weight is simply lost, no renormalization — the GShard/
+Switch formulation). With ``capacity_factor = E/K`` nothing can ever drop
+and the result equals the dense dispatch exactly — that equivalence is the
+parity test (tests/test_expert_parallel.py). The worker enables this path
+for TRAINING jobs with an expert mesh axis only (ml/worker.py); serving
+keeps exact dense dispatch because dropped tokens would silently change
+served logits.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["sparse_moe_mlp", "topk_capacity_dispatch", "expert_capacity"]
+
+
+def expert_capacity(
+    n_tokens: int, n_experts: int, k: int, capacity_factor: float
+) -> int:
+    """Per-expert token-slot budget ``C`` (≥1, ≤ n_tokens)."""
+    c = int(math.ceil(n_tokens * k * capacity_factor / n_experts))
+    return max(1, min(c, n_tokens))
+
+
+def topk_capacity_dispatch(
+    router_logits: jax.Array,  # [S, E] fp32
+    k: int,
+    capacity: int,
+):
+    """Build dispatch / combine tensors for capacity-limited top-k routing.
+
+    Returns ``(dispatch, combine)``, both ``[S, E, C]``:
+
+    - ``dispatch`` is 0/1 — token ``s`` occupies slot ``c`` of expert ``e``,
+    - ``combine = dispatch · softmax(top-k router weights)``.
+
+    Slot assignment priority is (k-rank, token order): all rank-0 choices
+    claim capacity before any rank-1 choice, so dropping under pressure
+    loses the *lower-weighted* assignments first. K is tiny (≤4), so the
+    per-rank loop unrolls into the compiled program.
+    """
+    S, E = router_logits.shape
+    topw, topi = lax.top_k(router_logits, k)
+    topw = jax.nn.softmax(topw, axis=-1)  # [S, K] normalized over chosen
+
+    dispatch = jnp.zeros((S, E, capacity), jnp.float32)
+    combine = jnp.zeros((S, E, capacity), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)  # slots already claimed per expert
+    for r in range(k):
+        e_r = topi[:, r]  # [S] expert chosen at rank r
+        mask = jax.nn.one_hot(e_r, E, dtype=jnp.int32)  # [S, E]
+        # slot index each token would get in its chosen expert
+        pos = counts[None, :] + jnp.cumsum(mask, axis=0) - 1  # [S, E]
+        slot = jnp.take_along_axis(pos, e_r[:, None], axis=1)[:, 0]  # [S]
+        keep = slot < capacity
+        oh_slot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        d_r = (
+            mask.astype(jnp.float32)[:, :, None]
+            * (oh_slot * keep[:, None])[:, None, :]
+        )  # [S, E, C]
+        dispatch = dispatch + d_r
+        combine = combine + d_r * topw[:, r][:, None, None]
+        counts = counts + mask.sum(axis=0)
+    return dispatch, combine
+
+
+def _n_groups(S: int, group_size: int) -> int:
+    """Largest group count whose groups (a) divide S and (b) are at least
+    ``group_size`` tokens — one group when S is small."""
+    g = max(1, S // max(group_size, 1))
+    while S % g:
+        g -= 1
+    return g
+
+
+def sparse_moe_mlp(
+    h: jax.Array,  # [B, T, d]
+    p: dict,  # layer MoE params: router [d,E], w_gate/w_up [E,d,f], w_down [E,f,d]
+    cfg,
+    *,
+    capacity_factor: float | None = None,
+):
+    """Drop-in replacement for the dense ``_moe_mlp`` (same signature shape;
+    models/transformer routes here when ``cfg.moe_dispatch == "sparse"``).
+
+    Tokens dispatch in independent groups of ~``cfg.moe_group_size``
+    (GShard's token grouping): the one-hot scatter/gather einsums are
+    quadratic in group length, not total tokens, so dispatch cost stays a
+    small fraction of expert-FFN cost at long-sequence scale. Capacity is
+    per group. Expert placement comes from the params' sharding: with
+    ``w_gate``/``w_up``/``w_down`` sharded over an ``expert`` mesh axis
+    (parallel/planner.stage_param_specs), GSPMD lowers the dispatch and
+    combine einsums to all-to-alls over that axis — verified by the sharded
+    parity test (tests/test_expert_parallel.py).
+    """
+    from ..models.transformer import _act
+
+    B, T, d = h.shape
+    E, K = cfg.n_experts, cfg.n_experts_per_tok
+    S = B * T
+    cf = capacity_factor if capacity_factor is not None else cfg.moe_capacity_factor
+    G = _n_groups(S, cfg.moe_group_size)
+    gs = S // G  # tokens per dispatch group
+    C = expert_capacity(gs, E, K, cf)
+
+    x = h.reshape(G, gs, d)
+    router_logits = jnp.einsum(
+        "gsd,de->gse", x, p["router"]
+    ).astype(jnp.float32)
+    dispatch, combine = jax.vmap(
+        lambda lg: topk_capacity_dispatch(lg, K, C)
+    )(router_logits)  # both [G, gs, E, C]
+
+    # scatter tokens to per-group expert buffers — all-to-all over the
+    # expert axis when the expert params are sharded
+    xe = jnp.einsum("gsec,gsd->gecd", dispatch.astype(x.dtype), x)
+    g = jnp.einsum("gecd,edf->gecf", xe, p["w_gate"])
+    u = jnp.einsum("gecd,edf->gecf", xe, p["w_up"])
+    y = jnp.einsum("gecf,efd->gecd", _act(g, cfg.act) * u, p["w_down"])
+    # gather back, weighted by the router — the reverse all-to-all
+    out = jnp.einsum("gsec,gecd->gsd", combine.astype(y.dtype), y)
+    return out.reshape(B, T, d)

@@ -224,6 +224,13 @@ def _apply_mesh_hints(
                 )
         axes[name] = size
         used *= size
+    if axes.get("seq", 1) > 1 and axes.get("stage", 1) > 1:
+        # the in-mesh GPipe program has no ring-attention path — honoring
+        # one axis and silently ignoring the other would be worse than
+        # refusing (ml/worker.py dispatch picks GPipe when both are set)
+        raise AssignmentError(
+            "seq and stage parallelism cannot be combined on one worker"
+        )
     rest = n // used
     if rest > 1 and "fsdp" not in axes and "data" not in axes:
         axes["fsdp" if training else "data"] = rest
