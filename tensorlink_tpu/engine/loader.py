@@ -16,7 +16,9 @@ checkpoint parity (reference ml/module.py:577-650).
 from __future__ import annotations
 
 import json
+import os
 import re
+import shutil
 from pathlib import Path
 from typing import Any
 
@@ -28,6 +30,168 @@ from safetensors.numpy import save_file
 
 from ..models.base import ModelConfig
 from ..models.registry import config_from_hf, hf_name_map, hf_prefix
+
+# ---------------------------------------------------------------------------
+# HF Hub acquisition (reference parity: workers pull safetensors shards
+# themselves, ml/worker.py:542-638,1122 — here restricted to exactly the
+# shards covering the stage's layer slice)
+# ---------------------------------------------------------------------------
+
+_REPO_ID_RE = re.compile(r"[\w.\-]+/[\w.\-]+")
+_LAYER_RE = re.compile(r"(?:^|\.)(?:layers|h|blocks)\.(\d+)\.")
+_TOKENIZER_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "vocab.json",
+    "merges.txt",
+    "special_tokens_map.json",
+    "generation_config.json",
+)
+
+
+def _cache_root() -> Path:
+    return Path(
+        os.environ.get("TLTPU_CACHE", "~/.cache/tensorlink_tpu")
+    ).expanduser()
+
+
+def _absent_marker(dest: Path) -> Path:
+    return dest / ".absent.json"
+
+
+def _known_absent(dest: Path, filename: str) -> bool:
+    marker = _absent_marker(dest)
+    if not marker.exists():
+        return False
+    try:
+        return filename in json.loads(marker.read_text())
+    except Exception:
+        return False
+
+
+def _record_absent(dest: Path, filename: str) -> None:
+    marker = _absent_marker(dest)
+    try:
+        absent = json.loads(marker.read_text()) if marker.exists() else []
+    except Exception:
+        absent = []
+    if filename not in absent:
+        absent.append(filename)
+        marker.write_text(json.dumps(absent))
+
+
+def _hub_fetch(
+    repo_id: str, filename: str, dest: Path, *, required: bool = True
+) -> Path | None:
+    """Materialize one repo file into ``dest``.
+
+    ``TLTPU_HUB_SOURCE=<dir>`` serves files from ``<dir>/<repo_id>/`` instead
+    of the network — the offline test/air-gapped path (env-based so it also
+    reaches spawned worker processes). Otherwise ``huggingface_hub`` does the
+    download (its own cache applies).
+
+    Files land atomically (temp name + ``os.replace``) so a killed worker
+    never leaves a truncated shard that later calls would trust. A file the
+    repo genuinely lacks is recorded in ``.absent.json`` so optional probes
+    (tokenizer files and the index) don't hit the network on every load;
+    transient fetch errors are NOT treated as absence — they raise even for
+    optional files, so a flaky network can't misclassify a sharded repo as
+    single-file."""
+    target = dest / filename
+    if target.exists():
+        return target
+    if _known_absent(dest, filename):
+        if required:
+            raise FileNotFoundError(f"{repo_id}/{filename} does not exist in the repo")
+        return None
+    dest.mkdir(parents=True, exist_ok=True)
+    src_root = os.environ.get("TLTPU_HUB_SOURCE")
+    if src_root:
+        src = Path(src_root) / repo_id / filename
+        if src.exists():
+            tmp = target.with_name(target.name + ".tmp-fetch")
+            shutil.copy2(src, tmp)
+            os.replace(tmp, target)
+            return target
+        _record_absent(dest, filename)
+        if required:
+            raise FileNotFoundError(f"{repo_id}/{filename} not in hub source {src_root}")
+        return None
+    from huggingface_hub.utils import EntryNotFoundError
+
+    try:
+        from huggingface_hub import hf_hub_download
+
+        # hf_hub_download writes via its own temp file + rename (atomic)
+        hf_hub_download(repo_id, filename, local_dir=str(dest))
+        return target
+    except EntryNotFoundError as e:
+        _record_absent(dest, filename)
+        if required:
+            raise FileNotFoundError(
+                f"{repo_id}/{filename} does not exist in the repo"
+            ) from e
+        return None
+
+
+def resolve_checkpoint(
+    ref: str | Path,
+    *,
+    layer_range: tuple[int, int] | None = None,
+    config_only: bool = False,
+    cache_dir: str | Path | None = None,
+) -> Path:
+    """Turn a checkpoint reference into a local directory.
+
+    - an existing local path is returned as-is;
+    - a ``org/name`` repo id is materialized under the cache: ``config.json``,
+      the safetensors index, and — unless ``config_only`` — only the weight
+      shards containing tensors for ``layer_range`` (plus non-layer tensors:
+      embeddings/norms/head) and the tokenizer files. A pipeline stage
+      therefore downloads a fraction of the checkpoint proportional to its
+      layer slice.
+    """
+    p = Path(ref)
+    if p.exists():
+        return p
+    ref = str(ref)
+    if not _REPO_ID_RE.fullmatch(ref) or any(
+        set(seg) == {"."} for seg in ref.split("/")
+    ):
+        # the dot-segment check stops a network-supplied ckpt ref like
+        # "../.." from escaping TLTPU_HUB_SOURCE via path join
+        raise FileNotFoundError(
+            f"checkpoint {ref!r} is neither a local directory nor an org/name repo id"
+        )
+    dest = (
+        Path(cache_dir)
+        if cache_dir
+        else _cache_root() / "hub" / ref.replace("/", "--")
+    )
+    _hub_fetch(ref, "config.json", dest)
+    if config_only:
+        return dest
+    index = _hub_fetch(
+        ref, "model.safetensors.index.json", dest, required=False
+    )
+    if index is None:
+        _hub_fetch(ref, "model.safetensors", dest)
+    else:
+        weight_map: dict[str, str] = json.loads(index.read_text())["weight_map"]
+        needed: set[str] = set()
+        for name, fname in weight_map.items():
+            m = _LAYER_RE.search(name)
+            if (
+                layer_range is None
+                or m is None
+                or layer_range[0] <= int(m.group(1)) < layer_range[1]
+            ):
+                needed.add(fname)
+        for fname in sorted(needed):
+            _hub_fetch(ref, fname, dest)
+    for fname in _TOKENIZER_FILES:
+        _hub_fetch(ref, fname, dest, required=False)
+    return dest
 
 
 class CheckpointReader:
@@ -94,10 +258,12 @@ def load_params(
     """Load a checkpoint into the stacked parameter tree.
 
     ``layer_range=(lo, hi)`` loads only layers ``lo..hi-1`` (a pipeline
-    stage's slice) — IO is restricted to exactly those tensors.
-    Returns ``(cfg, params)``.
+    stage's slice) — IO (and, for a hub repo id, the download itself) is
+    restricted to exactly those tensors. Returns ``(cfg, params)``.
     """
-    reader = CheckpointReader(ckpt_dir)
+    reader = CheckpointReader(
+        resolve_checkpoint(ckpt_dir, layer_range=layer_range)
+    )
     if cfg is None:
         cfg = config_from_hf(reader.config())
     dt = dtype or cfg.dtype

@@ -83,10 +83,15 @@ class DistributedValidator:
         if name in presets:
             return presets[name]
         if model_spec.get("ckpt"):
-            from tensorlink_tpu.engine.loader import CheckpointReader
+            import json
+
+            from tensorlink_tpu.engine.loader import resolve_checkpoint
             from tensorlink_tpu.models.registry import config_from_hf
 
-            return config_from_hf(CheckpointReader(model_spec["ckpt"]).config())
+            ckpt = resolve_checkpoint(model_spec["ckpt"], config_only=True)
+            return config_from_hf(
+                json.loads((ckpt / "config.json").read_text())
+            )
         raise ValueError(f"cannot resolve model {name!r}")
 
     def _plan_and_create(

@@ -188,16 +188,6 @@ class DistributedWorker:
         model = p["model"]
         stage = p["stage"]
         cfg = ModelConfig.from_json(model["config"])
-        if (
-            cfg.moe
-            and bool(p.get("training", False))
-            and int((stage.get("mesh_axes") or {}).get("expert", 1)) > 1
-        ):
-            # training + expert axis → capacity-factor all-to-all dispatch
-            # (parallel/expert.py). Serving stays on dense dispatch: its
-            # capacity overflow drops tokens, which would silently change
-            # served logits — expert-axis sharding still applies via GSPMD.
-            cfg = cfg.with_(moe_dispatch="sparse")
         lo, hi = stage["layer_lo"], stage["layer_hi"]
         first, holds_head = stage["first"], stage["holds_head"]
 
@@ -341,6 +331,16 @@ class DistributedWorker:
 
         first = rt.stage["first"]
         attn_mask = kw.get("attn_mask")
+        cfg = rt.cfg
+        axes = rt.stage.get("mesh_axes") or {}
+        if cfg.moe and remat and int(axes.get("expert", 1)) > 1:
+            # TRAINING forwards with an expert axis take the capacity-factor
+            # sparse dispatch (parallel/expert.py); eval forwards, decode
+            # sessions, and the GenerationEngine stay on exact dense
+            # dispatch — capacity overflow drops tokens, which must never
+            # silently change served/eval logits. Expert-axis sharding
+            # still applies to the dense path via GSPMD.
+            cfg = cfg.with_(moe_dispatch="sparse")
 
         if pp_size > 1:
             from tensorlink_tpu.parallel.pipeline import pipelined_stage_forward
@@ -360,7 +360,7 @@ class DistributedWorker:
             def fwd(params, x):
                 out, _ = pipelined_stage_forward(
                     params,
-                    rt.cfg,
+                    cfg,
                     rt.mesh,
                     tokens=x if first else None,
                     hidden=None if first else x,
@@ -377,7 +377,7 @@ class DistributedWorker:
         def fwd(params, x):
             out, _ = stage_forward(
                 params,
-                rt.cfg,
+                cfg,
                 tokens=x if first else None,
                 hidden=None if first else x,
                 attn_mask=attn_mask,
