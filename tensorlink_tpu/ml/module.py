@@ -86,6 +86,10 @@ class DistributedModel:
         self.plan = None
         self.cfg = None
         self.workers: dict[str, str] = {}  # worker plan id -> connected node id
+        import threading
+
+        self._repair_lock = threading.Lock()
+        self._repaired: dict[str, str] = {}  # dead worker id -> replacement
         if start_session:
             self._initialize_distribution()
 
@@ -184,7 +188,25 @@ class DistributedModel:
     # ------------------------------------------------------------------
     def _repair(self, dead_plan_wid: str) -> str:
         """Ask the validator for a replacement, connect, re-ship the stage.
-        Returns the new plan worker id. Raises if none is available."""
+        Returns the new plan worker id. Raises if none is available.
+
+        Concurrent micro-batch threads (train_step overlap) can all hit the
+        same dead worker: the repair lock serializes them and the repair map
+        makes followers reuse the first thread's replacement instead of
+        recruiting again."""
+        with self._repair_lock:
+            fixed = self._repaired.get(dead_plan_wid)
+            if fixed:
+                # chase chained repairs (A→B then B→C): a straggler holding
+                # the oldest id must land on the live replacement
+                seen = {dead_plan_wid}
+                while fixed in self._repaired and fixed not in seen:
+                    seen.add(fixed)
+                    fixed = self._repaired[fixed]
+                return fixed
+            return self._repair_locked(dead_plan_wid)
+
+    def _repair_locked(self, dead_plan_wid: str) -> str:
         validators = self.node.send_request("validators", timeout=10.0)
         if not validators:
             raise RuntimeError("no validator available for job repair")
@@ -205,13 +227,17 @@ class DistributedModel:
         new_id = update["worker"]["id"]
         host, port = update["worker"]["addr"]
         conn_id = self.node.connect_to(host, int(port))
+        # order matters for concurrent readers: the new mapping must exist
+        # before any stage names it; the old mapping stays (its connection
+        # is dead, so a straggler request on it re-enters repair and gets
+        # the recorded replacement)
+        self.workers[new_id] = conn_id
         affected = [
             s for s in self.plan.stages if s.worker_id == dead_plan_wid
         ]
         for s in affected:
             s.worker_id = new_id
-        self.workers.pop(dead_plan_wid, None)
-        self.workers[new_id] = conn_id
+        self._repaired[dead_plan_wid] = new_id
         for s in affected:
             resp = self._request(
                 new_id, proto.MODULE,
@@ -267,9 +293,10 @@ class DistributedModel:
         for u in updates:
             if u.get("job_id") == self.job_id and "worker" in u:
                 old = u.get("old_worker", "")
-                if old in self.workers:
-                    self._apply_update(u, old)
-                    n += 1
+                with self._repair_lock:
+                    if old in self.workers and old not in self._repaired:
+                        self._apply_update(u, old)
+                        n += 1
         return n
 
     # ------------------------------------------------------------------
@@ -576,12 +603,23 @@ class DistributedModel:
         attn_mask: np.ndarray | None = None,
         *,
         step_optimizer: bool = True,
+        overlap: bool = True,
     ) -> dict:
         """One token-weighted causal-LM training step across the pipeline.
 
         Numerically equivalent to the single-program
         ``engine.training.make_train_step`` (the parity test for this is the
         backward-correctness check the reference never had, SURVEY §4).
+
+        ``overlap`` runs micro-batches in concurrent driver threads: the IPC
+        bridge supports many in-flight requests and each stage worker
+        executes its queue in order, so micro ``m+1`` occupies stage 0 while
+        micro ``m`` is on stage 1 — 1F1B-style pipelining of the cross-node
+        hops (the reference got only accidental thread-timing overlap,
+        ml/module.py:374-399; its serial equivalent idles every stage
+        (S-1)/S of the time). Gradient accumulation on each worker is a sum,
+        so completion order does not change the result beyond float
+        summation order.
         """
         assert self.plan is not None
         tokens = np.asarray(tokens, np.int32)
@@ -590,9 +628,9 @@ class DistributedModel:
         mb = B // n_micro
 
         self._step = getattr(self, "_step", 0) + 1
-        total_nll = 0.0
         # Forward and backward are interleaved per micro-batch so each
-        # worker holds residuals for ONE micro at a time — the memory
+        # worker holds residuals for a bounded number of micros at a time
+        # (one when serial, ≤ n_stages+1 when overlapped) — the memory
         # contract micro-batching exists for. Cotangents are sums (not
         # means), so scaling once by the total token count — computable
         # upfront from the loss masks — reproduces the token-mean gradient.
@@ -608,13 +646,27 @@ class DistributedModel:
             float(sum(micro_mask(m)[2][:, 1:].sum() for m in range(n_micro))),
             1.0,
         )
-        for m in range(n_micro):
+
+        def run_micro(m: int) -> float:
             sl, am, lm = micro_mask(m)
             tag = f"s{self._step}m{m}"
             logits = self._train_forward(tokens[sl], am, tag)
             nll_sum, dlogits, _ = _ce_sum_and_grad(logits, tokens[sl], lm)
-            total_nll += float(nll_sum)
             self._train_backward(np.asarray(dlogits), tag)
+            return float(nll_sum)
+
+        if overlap and n_micro > 1 and self.plan.n_stages > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # at most n_stages+1 micros in flight (1F1B bound): enough to
+            # keep every stage busy, while each worker's residual store
+            # holds O(n_stages) micros instead of all n_micro — preserving
+            # the memory contract micro-batching exists for
+            in_flight = min(n_micro, self.plan.n_stages + 1)
+            with ThreadPoolExecutor(max_workers=in_flight) as pool:
+                total_nll = sum(pool.map(run_micro, range(n_micro)))
+        else:
+            total_nll = sum(run_micro(m) for m in range(n_micro))
 
         out = {"loss": total_nll / total_tok, "n_tokens": int(total_tok),
                "n_micro": n_micro}

@@ -10,10 +10,12 @@ worker capacities, then hand the job back to the network process to recruit
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any, Callable
 
 from tensorlink_tpu.core.logging import get_logger
@@ -40,10 +42,61 @@ class DistributedValidator:
         self.node = node
         self.bridge = node.bridge
         self.log = get_logger(f"ml.validator{node.config.duplicate}")
-        # model demand tracking (reference logs/models.json, ml/utils.py:663)
-        self.demand: dict[str, int] = {}
+        # model demand tracking, persisted across restarts (reference
+        # logs/models.json, ml/utils.py:663-674 + ml/validator.py:169-365)
+        self._demand_path = Path(node.config.log_dir) / "models.json"
+        self._demand_lock = threading.Lock()
+        self._demand_written = 0.0
+        self._demand_flush_s = 5.0  # debounce between disk writes
+        self.demand: dict[str, int] = self._load_demand()
         self.hosted: dict[str, HostedJob] = {}
         self._host_lock = threading.Lock()
+        if node.config.ml.autoload_default_models:
+            threading.Thread(
+                target=self._autoload_defaults,
+                name="ml-autoload",
+                daemon=True,
+            ).start()
+
+    # -- demand persistence / default-model auto-load -------------------
+    def _load_demand(self) -> dict[str, int]:
+        try:
+            data = json.loads(self._demand_path.read_text())
+            if not isinstance(data, dict):
+                return {}
+            return {str(k): int(v) for k, v in data.items()}
+        except Exception:  # stats must never block startup
+            return {}
+
+    def _bump_demand(self, name: str) -> None:
+        with self._demand_lock:
+            self.demand[name] = self.demand.get(name, 0) + 1
+            now = time.time()
+            if now - self._demand_written < self._demand_flush_s:
+                return  # debounce: no disk write per inference request
+            self._demand_written = now
+            snapshot = dict(self.demand)
+        try:
+            self._demand_path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self._demand_path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(snapshot))
+            tmp.replace(self._demand_path)
+        except OSError:
+            pass  # stats persistence must never break planning
+
+    def _autoload_defaults(self) -> None:
+        """Host each configured default model so the API serves it without a
+        first-request cold start (reference DEFAULT_MODELS auto-load)."""
+        from tensorlink_tpu.core.config import DEFAULT_CONFIG
+
+        for name in DEFAULT_CONFIG.get("default_models", []):
+            try:
+                job = self.host_model(name)
+                self.log.info(
+                    "default model %s: %s", name, job.status
+                )
+            except Exception:
+                self.log.exception("default model %s failed to host", name)
 
     def run(self) -> None:
         while True:
@@ -155,7 +208,7 @@ class DistributedValidator:
         spec = p["spec"]
         model_spec = dict(spec.get("model", {}))
         name = model_spec.get("name", "")
-        self.demand[name] = self.demand.get(name, 0) + 1
+        self._bump_demand(name)
         try:
             cfg = self._resolve_config(model_spec)
         except Exception as e:
@@ -298,7 +351,7 @@ class DistributedValidator:
         job = self.hosted.get(req.hf_name)
         if job is None or job.status != "ready":
             raise ModelNotReady(req.hf_name, job.status if job else "absent")
-        self.demand[req.hf_name] = self.demand.get(req.hf_name, 0) + 1
+        self._bump_demand(req.hf_name)
         tok = job.tokenizer
 
         prompt = format_chat_prompt(

@@ -41,6 +41,7 @@ from tensorlink_tpu.p2p.connection import Connection
 from tensorlink_tpu.p2p.tensor_node import TensorNode
 
 RECRUIT_TIMEOUT = 3.0  # reference validator_thread.py:871
+JOB_REQS_PER_MINUTE = 30  # reference validator_thread.py:508-516
 JOB_REQ_TIMEOUT = 120.0  # reference user_thread.py:406
 MODULE_LOAD_TIMEOUT = 150.0  # reference MAX_WAIT_TIME ml/module.py:58
 
@@ -276,6 +277,16 @@ class ValidatorServer(RoleServer):
         # keeper.clean_node prunes addresses/roles, so the proposal's
         # offline list must come from its own record
         self.offline_workers: dict[str, float] = {}
+        from tensorlink_tpu.p2p.monitor import RateLimiter
+
+        # per-IP JOB_REQ rate limiting: a connected (authenticated) peer must
+        # not be able to spam planning work — each request costs the ML
+        # process a full plan_sharding pass (reference
+        # validator_thread.py:508-516; r2 gap — only connection attempts
+        # were limited)
+        self.job_req_limiter = RateLimiter(
+            max_per_minute=JOB_REQS_PER_MINUTE, block_s=600.0
+        )
         self._restore_state()
         self.register(proto.JOB_REQ, self._handle_job_req)
         self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
@@ -467,6 +478,19 @@ class ValidatorServer(RoleServer):
     async def _handle_job_req(self, conn, kind, tag, body) -> None:
         """A user asks for a model (reference validator_thread.py:583-609).
         Hand the spec to the validator ML process for planning."""
+        # key on the socket peer address (untainted), not the advertised
+        # handshake address a peer could rotate to evade the limit
+        try:
+            ip = conn.peername[0]
+        except Exception:
+            ip = (self.addresses.get(conn.node_id) or ("?",))[0]
+        if not self.job_req_limiter.allow(str(ip)):
+            self.log.warning("rate-limiting job requests from %s", ip)
+            await self.respond(
+                conn, proto.JOB_DECLINE, body,
+                {"error": "job request rate limit exceeded"},
+            )
+            return
         req_id = uuid.uuid4().hex
         self._job_requests[req_id] = (conn, body)
         self.post_work(

@@ -124,9 +124,17 @@ class Keeper:
                 slot[k] = max(slot.get(k, 0), v)
 
     def get_network_status(self, node) -> dict:
-        """Chart-ready output for /network-history (reference
-        keeper.py:502-572)."""
-        days = sorted(self.daily)
+        """Chart-ready output for /network-history. Day labels are
+        contiguous: days with no recorded sample (node offline) appear as
+        zero entries so charts show the outage instead of splicing it out
+        (reference gap filling, keeper.py:341-420)."""
+        days = _fill_day_gaps(sorted(self.daily))
+        zero = {"workers": 0, "validators": 0, "users": 0, "jobs": 0,
+                "capacity_bytes": 0.0}
+
+        def series(key):
+            return [self.daily.get(d, zero)[key] for d in days]
+
         return {
             "current": {
                 "peers": len(node.connections),
@@ -134,11 +142,11 @@ class Keeper:
             },
             "daily": {
                 "labels": days,
-                "workers": [self.daily[d]["workers"] for d in days],
-                "validators": [self.daily[d]["validators"] for d in days],
-                "users": [self.daily[d]["users"] for d in days],
-                "jobs": [self.daily[d]["jobs"] for d in days],
-                "capacity_bytes": [self.daily[d]["capacity_bytes"] for d in days],
+                "workers": series("workers"),
+                "validators": series("validators"),
+                "users": series("users"),
+                "jobs": series("jobs"),
+                "capacity_bytes": series("capacity_bytes"),
             },
             "weekly": self.weekly,
         }
@@ -155,6 +163,25 @@ class Keeper:
             node.addresses.pop(nid, None)
             node.roles.pop(nid, None)
         return len(dead)
+
+
+MAX_CHART_DAYS = 30
+
+
+def _fill_day_gaps(days: list[str]) -> list[str]:
+    """Contiguous YYYY-MM-DD labels from the first to the last recorded day,
+    capped to the most recent :data:`MAX_CHART_DAYS` — a sporadically-online
+    node can retain recorded days months apart (archival keeps the newest 7
+    by count, not calendar age), and an unbounded fill would zero-pad the
+    whole span into the API payload."""
+    if not days:
+        return []
+    import datetime as dt
+
+    d0 = dt.date.fromisoformat(days[0])
+    d1 = dt.date.fromisoformat(days[-1])
+    span = [(d0 + dt.timedelta(n)).isoformat() for n in range((d1 - d0).days + 1)]
+    return span[-MAX_CHART_DAYS:]
 
 
 def _json_safe_check(v: Any) -> bool:

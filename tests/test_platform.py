@@ -75,6 +75,23 @@ def test_keeper_network_status_shape(tmp_path):
     assert out["current"]["peers"] == 3
 
 
+def test_keeper_day_gap_filling(tmp_path):
+    """Days with no samples appear as zero entries between recorded days
+    (reference gap filling, keeper.py:341-420)."""
+    k = Keeper(tmp_path / "s.json")
+    node = _fake_node()
+    k.daily["2026-07-01"] = {"workers": 2, "validators": 1, "users": 1,
+                             "jobs": 1, "capacity_bytes": 5.0}
+    k.daily["2026-07-04"] = {"workers": 3, "validators": 1, "users": 0,
+                             "jobs": 0, "capacity_bytes": 7.0}
+    out = k.get_network_status(node)
+    assert out["daily"]["labels"] == [
+        "2026-07-01", "2026-07-02", "2026-07-03", "2026-07-04"
+    ]
+    assert out["daily"]["workers"] == [2, 0, 0, 3]
+    assert out["daily"]["capacity_bytes"] == [5.0, 0.0, 0.0, 7.0]
+
+
 # -- contract ---------------------------------------------------------------
 
 
@@ -143,3 +160,127 @@ def test_loss_plausibility():
     assert not loss_plausibility([5.0, float("nan")])[0]
     assert not loss_plausibility([1.0, 10.0])[0]  # spike
     assert not loss_plausibility([])[0]
+
+
+def test_validator_job_req_rate_limit():
+    """A connected peer spamming JOB_REQ gets declined after the per-IP
+    budget (reference validator_thread.py:508-516)."""
+    import asyncio
+
+    from tensorlink_tpu.nodes import roles as roles_mod
+
+    class FakeConn:
+        node_id = "peer1"
+        peername = ("10.0.0.9", 5050)
+
+    class FakeValidator:
+        addresses = {"peer1": ("10.0.0.9", 1234)}
+        log = __import__("logging").getLogger("test")
+        posted = []
+        responses = []
+
+        from tensorlink_tpu.p2p.monitor import RateLimiter
+
+        job_req_limiter = RateLimiter(max_per_minute=3, block_s=600.0)
+        _job_requests = {}
+
+        def post_work(self, kind, item):
+            self.posted.append((kind, item))
+
+        async def respond(self, conn, tag, body, result):
+            self.responses.append((tag, result))
+
+    v = FakeValidator()
+    handler = roles_mod.ValidatorServer._handle_job_req
+
+    async def drive():
+        for _ in range(5):
+            await handler(v, FakeConn(), "req", roles_mod.proto.JOB_REQ, {"spec": {}})
+
+    asyncio.run(drive())
+    assert len(v.posted) == 3  # budget of 3 planning requests reached ML
+    declines = [r for t, r in v.responses if t == roles_mod.proto.JOB_DECLINE]
+    assert len(declines) == 2 and "rate limit" in declines[0]["error"]
+
+
+def test_demand_persistence_and_autoload(tmp_path, monkeypatch):
+    """Demand counts survive restart via logs/models.json; the autoload
+    thread hosts DEFAULT_CONFIG default models when enabled (reference
+    ml/validator.py:169-365)."""
+    import types
+
+    from tensorlink_tpu.core.config import ValidatorConfig
+    from tensorlink_tpu.ml.validator import DistributedValidator
+
+    hosted = []
+
+    def make(autoload=False):
+        node = types.SimpleNamespace(
+            bridge=None,
+            config=ValidatorConfig(
+                log_dir=str(tmp_path),
+            ),
+        )
+        node.config.ml.autoload_default_models = autoload
+        dv = DistributedValidator.__new__(DistributedValidator)
+        monkeypatch.setattr(
+            DistributedValidator, "host_model",
+            lambda self, name, **kw: hosted.append(name) or types.SimpleNamespace(status="ready"),
+            raising=True,
+        )
+        DistributedValidator.__init__(dv, node)
+        return dv
+
+    dv = make()
+    dv._demand_flush_s = 0.0  # disable the hot-path write debounce
+    dv._bump_demand("Qwen/Qwen3-8B")
+    dv._bump_demand("Qwen/Qwen3-8B")
+    dv._bump_demand("gpt2")
+    assert (tmp_path / "models.json").exists()
+
+    dv2 = make()  # fresh instance, same log dir
+    assert dv2.demand == {"Qwen/Qwen3-8B": 2, "gpt2": 1}
+
+    dv3 = make(autoload=True)
+    import time as _t
+
+    deadline = _t.time() + 5
+    while _t.time() < deadline and not hosted:
+        _t.sleep(0.05)
+    assert "Qwen/Qwen3-8B" in hosted  # DEFAULT_CONFIG default model
+    assert dv3 is not None
+
+
+def test_export_hf_sharding(tmp_path):
+    """export_hf honors max_shard_bytes: HF-style shard files + index, and
+    the sharded checkpoint reads back identically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorlink_tpu.engine.loader import export_hf, load_params
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, d_model=16, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=8, d_ff=32, max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    out = export_hf(cfg, params, tmp_path / "sharded", max_shard_bytes=8 * 1024)
+    shards = sorted(p.name for p in out.glob("model-*.safetensors"))
+    assert len(shards) > 1
+    assert (out / "model.safetensors.index.json").exists()
+    idx = __import__("json").loads(
+        (out / "model.safetensors.index.json").read_text()
+    )
+    assert set(idx["weight_map"].values()) == set(shards)
+    assert shards[0].endswith(f"-of-{len(shards):05d}.safetensors")
+
+    _, loaded = load_params(out, cfg, dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # single file when everything fits
+    out2 = export_hf(cfg, params, tmp_path / "single")
+    assert (out2 / "model.safetensors").exists()
+    assert not (out2 / "model.safetensors.index.json").exists()

@@ -216,3 +216,66 @@ def test_distributed_optimizer_factory(cluster):
         out = opt.step(scale=1.0 / max(r["n_tokens"], 1))
         assert out["grad_norm"] > 0
         opt.zero_grad()
+
+
+def test_pipeline_overlap_speedup(cluster):
+    """Concurrent micro-batch issue (1F1B-style) must beat the strictly
+    serial schedule at equal work with unchanged loss (VERDICT r2 #7: the
+    serial loop idles each of S stages (S-1)/S of the time; with S=2 and
+    n_micro=4 the ideal overlap ratio is (4+1)/8 = 0.625)."""
+    import time as _time
+
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg(n_layers=8, d_model=128, d_ff=512, vocab_size=256)
+    toks = _batches(cfg, 1, B=8, T=64)[0]
+    for w in cluster["workers"]:
+        w.send_request("set_capacity", {"hbm_bytes": 25_000_000.0, "n_devices": 1})
+    model = None
+    try:
+        model = DistributedModel(
+            cfg, node=cluster["user"], seed=2, seq_len=64, batch=8,
+            n_micro=4, training=True,
+        )
+        assert model.plan.n_stages == 2 and model.plan.n_micro == 4
+        model.init_optimizer("sgd", lr=1e-3)
+
+        def run(overlap, reps=2):
+            model.train_step(toks, overlap=overlap)  # warm the compiles
+            t0 = _time.perf_counter()
+            losses = [
+                model.train_step(toks, overlap=overlap)["loss"]
+                for _ in range(reps)
+            ]
+            return (_time.perf_counter() - t0) / reps, losses
+
+        t_serial, l_serial = run(False)
+        t_overlap, l_overlap = run(True)
+        # training continues across both runs (numerical overlap-vs-compiled
+        # parity is test_pipelined_tied_training_parity's job — overlap is
+        # its default path); here: finite and still descending
+        losses = l_serial + l_overlap
+        assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+        ratio = t_overlap / t_serial
+        import os
+
+        cores = (
+            len(os.sched_getaffinity(0))
+            if hasattr(os, "sched_getaffinity")
+            else (os.cpu_count() or 1)
+        )
+        # The 0.625 ideal (S=2, n_micro=4) needs a dedicated core per stage
+        # worker; on shared/few-core hosts XLA already spreads each worker
+        # over all cores, so the observable win shrinks to ~0 and only a
+        # NON-REGRESSION bound is meaningful (asserting a win there — e.g.
+        # on 4-vCPU CI runners — would be flaky by scheduler noise).
+        bound = 0.75 if cores >= 6 else 1.15
+        assert ratio < bound, (
+            f"overlap/serial wall-clock {ratio:.2f} ≥ {bound}"
+            f" on {cores} cores (serial {t_serial:.2f}s)"
+        )
+    finally:
+        if model is not None:
+            model.shutdown()
+        for w in cluster["workers"]:
+            w.send_request("set_capacity", w.executor.capacity())
