@@ -286,6 +286,30 @@ def load_params(
                         for e in range(cfg.n_experts)
                     ]
                 )
+            if rule.startswith("rowsT"):
+                # fused [rows, d] torch weight (Phi-3 qkv_proj /
+                # gate_up_proj): take a row slice, then transpose
+                _, lo, hi = rule.split(".")
+                full = _resolve(reader, tmpl, prefix, **fmt)
+                return full[int(lo) : int(hi)].T
+            if rule.startswith("neox_qkvb"):
+                # GPT-NeoX fused qkv bias [3d] with per-head interleaving
+                part = int(rule.split(".")[1])
+                full = _resolve(reader, tmpl, prefix, **fmt)
+                hd = cfg.head_dim
+                return full.reshape(-1, 3, hd)[:, part].reshape(-1)
+            if rule.startswith("neox_qkv"):
+                # GPT-NeoX fused qkv weight [3d, d], rows laid out per head
+                # as (q | k | v) blocks of head_dim each
+                part = int(rule.split(".")[1])
+                full = _resolve(reader, tmpl, prefix, **fmt)
+                hd = cfg.head_dim
+                d_in = full.shape[-1]
+                return (
+                    full.reshape(-1, 3, hd, d_in)[:, part]
+                    .reshape(-1, d_in)
+                    .T
+                )
             raise ValueError(f"unknown fetch rule {rule}")
         return _resolve(reader, template, prefix, **fmt)
 
@@ -336,7 +360,10 @@ def export_hf(
     host = jax.device_get(params)
 
     tensors: dict[str, np.ndarray] = {}
-    fused: dict[str, list] = {}
+    fused: dict[str, list] = {}  # gpt2 split3 (concat on last axis)
+    fused_rows: dict[str, list] = {}  # phi3 rowsT (row-slice reassembly)
+    fused_qkv: dict[str, list] = {}  # gpt_neox interleaved qkv weight
+    fused_qkvb: dict[str, list] = {}  # gpt_neox interleaved qkv bias
     for path, template in nmap.items():
         parts = path.split(".")
         node = host
@@ -357,9 +384,9 @@ def export_hf(
                 a = arr[i]
                 if isinstance(template, tuple):
                     rule, tmpl = template
+                    key = tmpl.format(i=i)
                     if rule.startswith("split3"):
                         # collect the three slices, emit fused once complete
-                        key = tmpl.format(i=i)
                         fused.setdefault(key, [None, None, None])[
                             int(rule.split(".")[1])
                         ] = a
@@ -368,6 +395,23 @@ def export_hf(
                         for e in range(arr.shape[1]):
                             emit(tmpl, a[e], i=i, e=e)
                         continue
+                    if rule.startswith("rowsT"):
+                        _, lo, hi = rule.split(".")
+                        fused_rows.setdefault(key, []).append(
+                            (int(lo), int(hi), a.T)
+                        )
+                        continue
+                    if rule.startswith("neox_qkvb"):
+                        fused_qkvb.setdefault(key, [None, None, None])[
+                            int(rule.split(".")[1])
+                        ] = a
+                        continue
+                    if rule.startswith("neox_qkv"):
+                        fused_qkv.setdefault(key, [None, None, None])[
+                            int(rule.split(".")[1])
+                        ] = a.T
+                        continue
+                    raise AssertionError(f"unknown export rule {rule}")
                 emit(template, a, i=i)
         else:
             if isinstance(template, tuple):
@@ -377,11 +421,65 @@ def export_hf(
         tensors[prefix + name] = np.ascontiguousarray(
             np.concatenate(chunks, axis=-1)
         )
+    for name, pieces in fused_rows.items():
+        rows = max(hi for _, hi, _ in pieces)
+        cols = pieces[0][2].shape[1]
+        buf = np.zeros((rows, cols), pieces[0][2].dtype)
+        for lo, hi, arr in pieces:
+            buf[lo:hi] = arr
+        tensors[prefix + name] = buf
+    for name, parts3 in fused_qkv.items():
+        hd = cfg.head_dim
+        stacked = np.stack(
+            [p.reshape(-1, hd, p.shape[-1]) for p in parts3], axis=1
+        )  # [H, 3, hd, d]
+        tensors[prefix + name] = np.ascontiguousarray(
+            stacked.reshape(-1, stacked.shape[-1])
+        )
+    for name, parts3 in fused_qkvb.items():
+        hd = cfg.head_dim
+        stacked = np.stack([p.reshape(-1, hd) for p in parts3], axis=1)
+        tensors[prefix + name] = np.ascontiguousarray(stacked.reshape(-1))
 
-    save_file(tensors, out / "model.safetensors")
+    _write_sharded(tensors, out, max_shard_bytes)
     if hf_config is not None:
         (out / "config.json").write_text(json.dumps(hf_config, indent=2))
     return out
+
+
+def _write_sharded(
+    tensors: dict[str, np.ndarray], out: Path, max_shard_bytes: int
+) -> None:
+    """Write safetensors honoring ``max_shard_bytes``: one
+    ``model.safetensors`` when everything fits, else HF-convention
+    ``model-NNNNN-of-NNNNN.safetensors`` shards plus
+    ``model.safetensors.index.json`` (r1/r2 gap: export always wrote a
+    single unbounded file)."""
+    total = sum(int(t.nbytes) for t in tensors.values())
+    if total <= max_shard_bytes:
+        save_file(tensors, out / "model.safetensors")
+        return
+    shards: list[dict[str, np.ndarray]] = [{}]
+    cur_bytes = 0
+    for name, t in tensors.items():
+        if shards[-1] and cur_bytes + int(t.nbytes) > max_shard_bytes:
+            shards.append({})
+            cur_bytes = 0
+        shards[-1][name] = t
+        cur_bytes += int(t.nbytes)
+    n = len(shards)
+    weight_map: dict[str, str] = {}
+    for i, shard in enumerate(shards, 1):
+        fname = f"model-{i:05d}-of-{n:05d}.safetensors"
+        save_file(shard, out / fname)
+        for name in shard:
+            weight_map[name] = fname
+    (out / "model.safetensors.index.json").write_text(
+        json.dumps(
+            {"metadata": {"total_size": total}, "weight_map": weight_map},
+            indent=2,
+        )
+    )
 
 
 def estimate_params_bytes(cfg: ModelConfig, dtype_bytes: int = 2) -> int:

@@ -25,12 +25,15 @@ class ModelConfig:
 
     Families covered (reference supports any HF causal LM via module
     offloading; we cover the families its tests/docs/baseline actually use —
-    gpt2, Llama, Qwen2/2.5, Qwen3, Mistral, Mixtral, SmolLM — via config):
+    gpt2, Llama, Qwen2/2.5, Qwen3, Mistral, Mixtral, SmolLM, Gemma, Phi-3,
+    GPT-NeoX/Pythia — via config):
 
     - ``pos="learned"``, ``mlp="fused"``, ``norm="layernorm"`` → GPT-2.
     - ``pos="rope"``, ``mlp="gated"``, ``norm="rmsnorm"`` → Llama-family.
     - ``qk_norm=True`` → Qwen3.
     - ``n_experts>0`` → Mixtral-style sparse MoE.
+    - ``embed_scale`` + ``norm_plus_one`` → Gemma.
+    - ``parallel_residual`` + ``rope_pct<1`` + layernorm → GPT-NeoX/Pythia.
     """
 
     family: str = "llama"
@@ -43,14 +46,21 @@ class ModelConfig:
     d_ff: int = 11008
     max_seq_len: int = 4096
     norm_eps: float = 1e-6
-    act: str = "silu"  # "silu" | "gelu" (tanh-approx, GPT-2's gelu_new)
+    act: str = "silu"  # "silu" | "gelu" (tanh approx) | "gelu_exact" (erf)
     pos: str = "rope"  # "rope" | "learned"
     rope_theta: float = 10000.0
+    # rotary applied to the first rope_pct of each head's dims (GPT-NeoX /
+    # Pythia rotary_pct; 1.0 = full-dim rotary)
+    rope_pct: float = 1.0
     attn_bias: bool = False  # GPT-2 / Qwen2 have qkv biases
+    attn_out_bias: bool = False  # GPT-2 / GPT-NeoX bias on the o projection
     mlp_bias: bool = False
     mlp: str = "gated"  # "gated" (gate*up) | "fused" (up->act->down)
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    norm_plus_one: bool = False  # Gemma rmsnorm: x * rms * (1 + scale)
     qk_norm: bool = False  # Qwen3 per-head-dim RMSNorm on q and k
+    embed_scale: bool = False  # Gemma: embeddings scaled by sqrt(d_model)
+    parallel_residual: bool = False  # GPT-NeoX: x + attn(ln1 x) + mlp(ln2 x)
     tie_embeddings: bool = False
     attn_scale: float | None = None  # None → 1/sqrt(head_dim)
     # MoE (Mixtral): 0 experts = dense

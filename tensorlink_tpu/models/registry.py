@@ -123,6 +123,65 @@ def _mixtral(d: dict) -> ModelConfig:
     )
 
 
+@register_family("gemma")
+def _gemma(d: dict) -> ModelConfig:
+    # Gemma: llama layout + sqrt(d_model)-scaled embeddings, (1+w) rmsnorm,
+    # tanh-approx gelu, always-tied embeddings, explicit head_dim
+    return _llama_like(
+        d,
+        family="gemma",
+        act="gelu",
+        embed_scale=True,
+        norm_plus_one=True,
+        tie_embeddings=True,
+    )
+
+
+@register_family("phi3")
+def _phi3(d: dict) -> ModelConfig:
+    # Phi-3: llama compute with fused qkv_proj / gate_up_proj checkpoints
+    if d.get("rope_scaling"):
+        # longrope rescales rotary frequencies at every context length —
+        # loading such a checkpoint with plain rope would generate fluent
+        # garbage; refuse instead (128k-context Phi-3 variants)
+        raise ValueError(
+            "phi3 rope_scaling (longrope) is not supported; use a "
+            "non-rope-scaled Phi-3 checkpoint"
+        )
+    return _llama_like(
+        d, family="phi3", sliding_window=d.get("sliding_window")
+    )
+
+
+@register_family("gpt_neox")
+def _gpt_neox(d: dict) -> ModelConfig:
+    # GPT-NeoX / Pythia: layernorm with biases, parallel attn+mlp residual,
+    # partial rotary (rotary_pct), fused-mlp with biases, exact gelu
+    n_heads = d["num_attention_heads"]
+    return ModelConfig(
+        family="gpt_neox",
+        vocab_size=d["vocab_size"],
+        d_model=d["hidden_size"],
+        n_layers=d["num_hidden_layers"],
+        n_heads=n_heads,
+        n_kv_heads=n_heads,
+        head_dim=d["hidden_size"] // n_heads,
+        d_ff=d["intermediate_size"],
+        max_seq_len=d.get("max_position_embeddings", 2048),
+        norm_eps=d.get("layer_norm_eps", 1e-5),
+        act="gelu_exact" if d.get("hidden_act", "gelu") == "gelu" else "gelu",
+        pos="rope",
+        rope_theta=d.get("rotary_emb_base", 10000.0),
+        rope_pct=d.get("rotary_pct", 0.25),
+        attn_bias=d.get("attention_bias", True),
+        attn_out_bias=d.get("attention_bias", True),
+        mlp="fused",
+        norm="layernorm",
+        parallel_residual=d.get("use_parallel_residual", True),
+        tie_embeddings=d.get("tie_word_embeddings", False),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Checkpoint tensor-name mapping (engine/loader.py)
 # ---------------------------------------------------------------------------
@@ -155,6 +214,57 @@ def hf_name_map(cfg: ModelConfig) -> dict[str, Any]:
             "final_norm.scale": "ln_f.weight",
             "final_norm.bias": "ln_f.bias",
         }
+
+    if cfg.family == "phi3":
+        # fused qkv_proj ([q+2kv, d]) and gate_up_proj ([2f, d]) checkpoints
+        q, kv, f = cfg.q_dim, cfg.kv_dim, cfg.d_ff
+        qkv = "layers.{i}.self_attn.qkv_proj.weight"
+        gu = "layers.{i}.mlp.gate_up_proj.weight"
+        m = {
+            "embed.tok": "embed_tokens.weight",
+            "layers.ln1.scale": "layers.{i}.input_layernorm.weight",
+            "layers.attn.wq": (f"rowsT.0.{q}", qkv),
+            "layers.attn.wk": (f"rowsT.{q}.{q + kv}", qkv),
+            "layers.attn.wv": (f"rowsT.{q + kv}.{q + 2 * kv}", qkv),
+            "layers.attn.wo": "~T layers.{i}.self_attn.o_proj.weight",
+            "layers.ln2.scale": "layers.{i}.post_attention_layernorm.weight",
+            "layers.mlp.w_gate": (f"rowsT.0.{f}", gu),
+            "layers.mlp.w_up": (f"rowsT.{f}.{2 * f}", gu),
+            "layers.mlp.w_down": "~T layers.{i}.mlp.down_proj.weight",
+            "final_norm.scale": "norm.weight",
+        }
+        if not cfg.tie_embeddings:
+            m["lm_head"] = "~T ^lm_head.weight"
+        return m
+
+    if cfg.family == "gpt_neox":
+        # fused query_key_value with per-head-interleaved q/k/v rows
+        qkv_w = "layers.{i}.attention.query_key_value.weight"
+        qkv_b = "layers.{i}.attention.query_key_value.bias"
+        m = {
+            "embed.tok": "embed_in.weight",
+            "layers.ln1.scale": "layers.{i}.input_layernorm.weight",
+            "layers.ln1.bias": "layers.{i}.input_layernorm.bias",
+            "layers.attn.wq": ("neox_qkv.0", qkv_w),
+            "layers.attn.wk": ("neox_qkv.1", qkv_w),
+            "layers.attn.wv": ("neox_qkv.2", qkv_w),
+            "layers.attn.bq": ("neox_qkvb.0", qkv_b),
+            "layers.attn.bk": ("neox_qkvb.1", qkv_b),
+            "layers.attn.bv": ("neox_qkvb.2", qkv_b),
+            "layers.attn.wo": "~T layers.{i}.attention.dense.weight",
+            "layers.attn.bo": "layers.{i}.attention.dense.bias",
+            "layers.ln2.scale": "layers.{i}.post_attention_layernorm.weight",
+            "layers.ln2.bias": "layers.{i}.post_attention_layernorm.bias",
+            "layers.mlp.w_up": "~T layers.{i}.mlp.dense_h_to_4h.weight",
+            "layers.mlp.b_up": "layers.{i}.mlp.dense_h_to_4h.bias",
+            "layers.mlp.w_down": "~T layers.{i}.mlp.dense_4h_to_h.weight",
+            "layers.mlp.b_down": "layers.{i}.mlp.dense_4h_to_h.bias",
+            "final_norm.scale": "final_layer_norm.weight",
+            "final_norm.bias": "final_layer_norm.bias",
+        }
+        if not cfg.tie_embeddings:
+            m["lm_head"] = "~T ^embed_out.weight"
+        return m
 
     m = {
         "embed.tok": "embed_tokens.weight",
@@ -215,6 +325,8 @@ def hf_name_map(cfg: ModelConfig) -> dict[str, Any]:
 def hf_prefix(cfg: ModelConfig) -> str:
     if cfg.family == "gpt2":
         return "transformer."
+    if cfg.family == "gpt_neox":
+        return "gpt_neox."
     return "model."
 
 
@@ -325,6 +437,52 @@ def config_presets() -> dict[str, ModelConfig]:
             max_seq_len=8192,
             norm_eps=1e-5,
             rope_theta=5e5,
+        ),
+        "gemma-7b": ModelConfig(
+            family="gemma",
+            vocab_size=256000,
+            d_model=3072,
+            n_layers=28,
+            n_heads=16,
+            n_kv_heads=16,
+            head_dim=256,
+            d_ff=24576,
+            max_seq_len=8192,
+            act="gelu",
+            embed_scale=True,
+            norm_plus_one=True,
+            tie_embeddings=True,
+        ),
+        "phi3-mini": ModelConfig(
+            family="phi3",
+            vocab_size=32064,
+            d_model=3072,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=32,
+            head_dim=96,
+            d_ff=8192,
+            max_seq_len=4096,
+            norm_eps=1e-5,
+        ),
+        "pythia-1b": ModelConfig(
+            family="gpt_neox",
+            vocab_size=50304,
+            d_model=2048,
+            n_layers=16,
+            n_heads=8,
+            n_kv_heads=8,
+            head_dim=256,
+            d_ff=8192,
+            max_seq_len=2048,
+            norm_eps=1e-5,
+            act="gelu_exact",
+            rope_pct=0.25,
+            attn_bias=True,
+            attn_out_bias=True,
+            mlp="fused",
+            norm="layernorm",
+            parallel_residual=True,
         ),
         "mixtral-8x7b": ModelConfig(
             family="mixtral",

@@ -65,7 +65,7 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
             "bk": jnp.zeros((L, cfg.kv_dim), dt),
             "bv": jnp.zeros((L, cfg.kv_dim), dt),
         }
-    if cfg.family == "gpt2":
+    if cfg.attn_out_bias or cfg.family == "gpt2":
         attn["bo"] = jnp.zeros((L, d), dt)
     if cfg.qk_norm:
         attn |= {"q_norm": jnp.ones((L, hd), dt), "k_norm": jnp.ones((L, hd), dt)}
@@ -128,8 +128,11 @@ def _norm(x: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
         out = (xf - mu) * lax.rsqrt(var + cfg.norm_eps)
         out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
     else:
+        scale = p["scale"].astype(jnp.float32)
+        if cfg.norm_plus_one:  # Gemma stores the rmsnorm weight as an offset
+            scale = scale + 1.0
         var = (xf**2).mean(-1, keepdims=True)
-        out = xf * lax.rsqrt(var + cfg.norm_eps) * p["scale"].astype(jnp.float32)
+        out = xf * lax.rsqrt(var + cfg.norm_eps) * scale
     return out.astype(x.dtype)
 
 
@@ -160,9 +163,24 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _rope_dim(cfg: ModelConfig) -> int:
+    """Rotary dims per head (GPT-NeoX applies rotary to a prefix only)."""
+    rd = int(cfg.head_dim * cfg.rope_pct)
+    return rd - rd % 2
+
+
+def _embed_tokens(params: dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:  # Gemma normalizer, cast to activation dtype like HF
+        x = x * jnp.asarray(cfg.d_model**0.5, cfg.dtype)
+    return x
+
+
 def _act(x: jax.Array, name: str) -> jax.Array:
     if name == "silu":
         return jax.nn.silu(x)
+    if name == "gelu_exact":
+        return jax.nn.gelu(x, approximate=False)  # GPT-NeoX "gelu"
     return jax.nn.gelu(x, approximate=True)  # GPT-2 gelu_new
 
 
@@ -261,8 +279,17 @@ def _block(
         q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
         k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
     if cos is not None:
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        rd = cos.shape[-1]
+        if rd == cfg.head_dim:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        else:  # partial rotary (GPT-NeoX): prefix rotates, rest passes
+            q = jnp.concatenate(
+                [apply_rope(q[..., :rd], cos, sin), q[..., rd:]], axis=-1
+            )
+            k = jnp.concatenate(
+                [apply_rope(k[..., :rd], cos, sin), k[..., rd:]], axis=-1
+            )
 
     if cache_k is not None:
         upd = jax.vmap(
@@ -280,10 +307,11 @@ def _block(
     attn_out = attn_out.reshape(B, T, cfg.q_dim) @ ap["wo"]
     if "bo" in ap:
         attn_out = attn_out + ap["bo"]
-    x = x + attn_out
-
-    h2 = _norm(x, lp["ln2"], cfg)
-    x = x + _mlp(h2, lp["mlp"], cfg)
+    if cfg.parallel_residual:  # GPT-NeoX: both branches read the block input
+        x = x + attn_out + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+    else:
+        x = x + attn_out
+        x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
     return x, cache_k, cache_v
 
 
@@ -464,7 +492,7 @@ def _stage_impl(
         positions = offset[:, None] + jnp.arange(T)[None, :]
 
     if first:
-        x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+        x = _embed_tokens(params, tokens, cfg)
         if cfg.pos == "learned":
             x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
     else:
@@ -472,7 +500,7 @@ def _stage_impl(
 
     cos = sin = None
     if cfg.pos == "rope":
-        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
 
     if cache is not None:
         S = cache.max_len

@@ -135,9 +135,11 @@ def pipelined_stage_forward(
     """
     from ..models.transformer import (
         _block,
+        _embed_tokens,
         _logits,
         _mask_bias,
         _norm,
+        _rope_dim,
         rope_tables,
     )
 
@@ -160,7 +162,7 @@ def pipelined_stage_forward(
     mb = B // n_micro
 
     if first:
-        x = params["embed"]["tok"][tokens].astype(cfg.dtype)
+        x = _embed_tokens(params, tokens, cfg)
         if cfg.pos == "learned":
             pos = jnp.arange(T)[None, :]
             x = x + params["embed"]["pos"][pos].astype(cfg.dtype)
@@ -170,7 +172,7 @@ def pipelined_stage_forward(
     positions = jnp.arange(T)[None, :]  # no cache → absolute = local
     cos = sin = None
     if cfg.pos == "rope":
-        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
         # [1, T, hd] broadcasts over every micro's batch rows
 
     if attn_mask is None:

@@ -110,6 +110,60 @@ FAMILIES = {
             tie_word_embeddings=False,
         ),
     ),
+    "gemma": dict(
+        cls="GemmaForCausalLM",
+        cfg=dict(
+            model_type="gemma",
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim=16,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            hidden_activation="gelu_pytorch_tanh",
+            tie_word_embeddings=True,
+        ),
+    ),
+    "phi3": dict(
+        cls="Phi3ForCausalLM",
+        cfg=dict(
+            model_type="phi3",
+            vocab_size=128,
+            pad_token_id=0,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            rms_norm_eps=1e-5,
+            rope_theta=10000.0,
+            sliding_window=None,
+            tie_word_embeddings=False,
+        ),
+    ),
+    "gpt_neox": dict(
+        cls="GPTNeoXForCausalLM",
+        cfg=dict(
+            model_type="gpt_neox",
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            layer_norm_eps=1e-5,
+            rotary_pct=0.25,
+            rotary_emb_base=10000.0,
+            hidden_act="gelu",
+            use_parallel_residual=True,
+            tie_word_embeddings=False,
+        ),
+    ),
 }
 
 
@@ -156,7 +210,7 @@ def test_forward_parity(family, tmp_path):
     assert np.abs(got - ref).mean() < 5e-4
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen3"])
+@pytest.mark.parametrize("family", ["llama", "qwen3", "gpt_neox", "gemma"])
 def test_prefill_decode_consistency(family, tmp_path):
     """prefill+decode through the KV cache must equal the full forward."""
     from tensorlink_tpu.engine.loader import load_params
@@ -188,13 +242,16 @@ def test_prefill_decode_consistency(family, tmp_path):
     assert int(cache.length[0]) == 10
 
 
-def test_export_roundtrip(tmp_path):
-    """export_hf(load_params(ckpt)) reproduces the original tensors."""
+@pytest.mark.parametrize("family", ["qwen2", "phi3", "gpt_neox"])
+def test_export_roundtrip(family, tmp_path):
+    """export_hf(load_params(ckpt)) reproduces the original tensors —
+    including the fused qkv_proj/gate_up_proj (phi3) and per-head
+    interleaved query_key_value (gpt_neox) reassembly."""
     import torch
 
     from tensorlink_tpu.engine.loader import CheckpointReader, export_hf, load_params
 
-    model, hf_cfg, ckpt = _make_checkpoint("qwen2", tmp_path)
+    model, hf_cfg, ckpt = _make_checkpoint(family, tmp_path)
     cfg, params = load_params(ckpt, dtype=jnp.float32)
     out = export_hf(cfg, params, tmp_path / "export", hf_config=hf_cfg.to_dict())
 
