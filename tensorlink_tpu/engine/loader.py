@@ -384,16 +384,18 @@ def export_hf(
                 a = arr[i]
                 if isinstance(template, tuple):
                     rule, tmpl = template
+                    if rule == "stackE":
+                        # expert templates carry {e}; format per expert (a
+                        # premature .format(i=i) would KeyError on 'e')
+                        for e in range(arr.shape[1]):
+                            emit(tmpl, a[e], i=i, e=e)
+                        continue
                     key = tmpl.format(i=i)
                     if rule.startswith("split3"):
                         # collect the three slices, emit fused once complete
                         fused.setdefault(key, [None, None, None])[
                             int(rule.split(".")[1])
                         ] = a
-                        continue
-                    if rule == "stackE":
-                        for e in range(arr.shape[1]):
-                            emit(tmpl, a[e], i=i, e=e)
                         continue
                     if rule.startswith("rowsT"):
                         _, lo, hi = rule.split(".")

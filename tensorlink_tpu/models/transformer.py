@@ -620,7 +620,7 @@ def partition_specs(
     }
     if cfg.attn_bias:
         attn |= {"bq": spec(None, t), "bk": spec(None, t), "bv": spec(None, t)}
-    if cfg.family == "gpt2":
+    if cfg.attn_out_bias or cfg.family == "gpt2":  # must match init_params
         attn["bo"] = spec(None, None)
     if cfg.qk_norm:
         attn |= {"q_norm": spec(None, None), "k_norm": spec(None, None)}

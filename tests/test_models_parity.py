@@ -242,11 +242,12 @@ def test_prefill_decode_consistency(family, tmp_path):
     assert int(cache.length[0]) == 10
 
 
-@pytest.mark.parametrize("family", ["qwen2", "phi3", "gpt_neox"])
+@pytest.mark.parametrize("family", ["qwen2", "phi3", "gpt_neox", "mixtral"])
 def test_export_roundtrip(family, tmp_path):
     """export_hf(load_params(ckpt)) reproduces the original tensors —
-    including the fused qkv_proj/gate_up_proj (phi3) and per-head
-    interleaved query_key_value (gpt_neox) reassembly."""
+    including the fused qkv_proj/gate_up_proj (phi3), per-head interleaved
+    query_key_value (gpt_neox), and per-expert {e} templates (mixtral)
+    reassembly."""
     import torch
 
     from tensorlink_tpu.engine.loader import CheckpointReader, export_hf, load_params
@@ -269,6 +270,23 @@ def test_export_roundtrip(family, tmp_path):
         )
     missing = [n for n in orig.names() if n not in new and "inv_freq" not in n]
     assert not missing, f"export dropped tensors: {missing}"
+
+
+@pytest.mark.parametrize("family", list(FAMILIES))
+def test_partition_specs_match_param_tree(family, tmp_path):
+    """partition_specs(cfg) must have exactly the param tree's structure for
+    every family — a missing leaf (e.g. gpt_neox's attn 'bo') breaks every
+    sharded load/jit for that family."""
+    import jax
+
+    from tensorlink_tpu.engine.loader import load_params
+    from tensorlink_tpu.models.transformer import partition_specs
+
+    _, _, ckpt = _make_checkpoint(family, tmp_path)
+    cfg, params = load_params(ckpt, dtype=jnp.float32)
+    specs = partition_specs(cfg, tensor_axis="tensor", expert_axis="expert")
+    # raises if the trees differ in structure
+    jax.tree.map(lambda p, s: None, params, specs)
 
 
 def test_param_count_matches_hf(tmp_path):
