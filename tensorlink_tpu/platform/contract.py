@@ -9,9 +9,12 @@ proofs (get_worker_claim_data:911).
 
 Here the same consensus artifacts are produced off-chain (sha256 in place of
 keccak, DHT in place of the EVM): proposals, deterministic hashes, votes,
-and verifiable claim proofs. An on-chain submission hook can wrap this
-without changing any data structure (web3 is absent from the TPU image, and
-off-chain is the reference's test mode anyway — conftest ``on_chain=False``).
+and verifiable claim proofs. With ``off_chain=False`` the lifecycle also
+submits to the EVM through :mod:`tensorlink_tpu.platform.chain` (stdlib
+keccak/RLP/secp256k1 + JSON-RPC — web3 is absent from the TPU image):
+proposal hashes at creation, votes at validation, execution at quorum,
+each guarded so a flaky RPC degrades to off-chain instead of killing the
+validator.
 """
 
 from __future__ import annotations
@@ -114,9 +117,10 @@ class Proposal:
 class ContractManager:
     """Round-based proposal lifecycle over completed-job accounting."""
 
-    def __init__(self, node_id: str, *, quorum: float = 0.5):
+    def __init__(self, node_id: str, *, quorum: float = 0.5, chain=None):
         self.node_id = node_id
         self.quorum = quorum
+        self.chain = chain  # ChainSubmitter | None (platform/chain.py)
         self.round = 0
         self.usage: dict[str, float] = {}  # worker -> accumulated byte·s
         self.proposals: dict[str, Proposal] = {}  # hash -> proposal
@@ -145,13 +149,19 @@ class ContractManager:
             capacities={w: int(c) for w, c in self.usage.items()},
             offline=list(offline),
         )
-        self.proposals[prop.hash()] = prop
+        h = prop.hash()
+        self.proposals[h] = prop
+        if self.chain is not None:  # reference createProposal, :534
+            self.chain.submit_proposal(h, prop.round)
         return prop
 
     def validate_proposal(self, data: dict, claimed_hash: str) -> bool:
         """Recompute the hash from the full proposal body (reference
         proposal_validator, contract_manager.py:45-242)."""
-        return Proposal.from_json(data).hash() == claimed_hash
+        ok = Proposal.from_json(data).hash() == claimed_hash
+        if self.chain is not None:  # reference voteForProposal, :208-242
+            self.chain.submit_vote(claimed_hash, ok)
+        return ok
 
     def vote(self, prop_hash: str, voter: str, approve: bool = True) -> None:
         prop = self.proposals.get(prop_hash)
@@ -166,6 +176,8 @@ class ContractManager:
         if yes / max(n_validators, 1) > self.quorum:
             prop.executed = True
             self.usage = {}  # rewarded usage resets for the next round
+            if self.chain is not None:  # reference executeProposal, :683
+                self.chain.execute_proposal(prop.round)
             return True
         return False
 

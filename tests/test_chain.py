@@ -1,0 +1,205 @@
+"""On-chain submission layer, fully offline: keccak/RLP/secp256k1 against
+known vectors, then the whole build→sign→submit path against a fake
+JSON-RPC node that decodes and cryptographically checks the raw
+transaction (reference submits via web3 + a live RPC,
+contract_manager.py:534,208,683 — the wire artifacts are what we pin)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tensorlink_tpu.platform import chain as C
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+def test_keccak256_vectors():
+    assert C.keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert C.keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block absorb (>136-byte rate)
+    assert C.keccak256(b"q" * 300) != C.keccak256(b"q" * 301)
+
+
+def test_rlp_vectors_and_roundtrip():
+    assert C.rlp_encode(b"dog") == b"\x83dog"
+    assert C.rlp_encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert C.rlp_encode(b"") == b"\x80"
+    assert C.rlp_encode(0) == b"\x80"
+    assert C.rlp_encode(1024) == b"\x82\x04\x00"
+    long = b"L" * 60
+    nested = [b"cat", [long, b"x"], b""]
+    assert C.rlp_decode(C.rlp_encode(nested)) == nested
+
+
+def test_ecdsa_sign_verify_and_address():
+    # privkey 1 has a famous address
+    assert C.priv_to_address(1) == "0x7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+    h = C.keccak256(b"tensorlink")
+    r, s, rec = C.ecdsa_sign(h, 0x1234)
+    assert rec in (0, 1)
+    assert s <= C._N // 2  # EIP-2 low-s
+    assert C.ecdsa_verify(h, r, s, C.pubkey(0x1234))
+    assert not C.ecdsa_verify(C.keccak256(b"tamper"), r, s, C.pubkey(0x1234))
+    assert not C.ecdsa_verify(h, r, s, C.pubkey(0x9999))
+    # determinism (RFC 6979): same message+key -> same signature
+    assert C.ecdsa_sign(h, 0x1234) == (r, s, rec)
+
+
+def test_abi_encoding():
+    assert C.selector("transfer(address,uint256)").hex() == "a9059cbb"
+    data = C.call_data(
+        "createProposal(bytes32,uint256)", ["0x" + "ab" * 32, 7]
+    )
+    assert data[:4] == C.selector("createProposal(bytes32,uint256)")
+    assert data[4:36] == bytes.fromhex("ab" * 32)
+    assert int.from_bytes(data[36:68], "big") == 7
+    with pytest.raises(ValueError):
+        C.abi_encode_args("f(bytes32)", ["0xabcd"])  # wrong length
+    with pytest.raises(ValueError):
+        C.abi_encode_args("f(string)", ["x"])  # dynamic types unsupported
+
+
+# ---------------------------------------------------------------------------
+# fake JSON-RPC node
+# ---------------------------------------------------------------------------
+class FakeEthNode:
+    def __init__(self):
+        self.raw_txs: list[bytes] = []
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                req = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                m, p = req["method"], req.get("params", [])
+                if m == "eth_chainId":
+                    result = hex(84532)
+                elif m == "eth_getTransactionCount":
+                    result = hex(len(node.raw_txs))
+                elif m == "eth_gasPrice":
+                    result = hex(10**9)
+                elif m == "eth_sendRawTransaction":
+                    raw = bytes.fromhex(p[0][2:])
+                    node.raw_txs.append(raw)
+                    result = "0x" + C.keccak256(raw).hex()
+                elif m == "eth_call":
+                    result = "0x" + (42).to_bytes(32, "big").hex()
+                else:
+                    self._reply({"jsonrpc": "2.0", "id": req["id"],
+                                 "error": {"code": -32601, "message": m}})
+                    return
+                self._reply({"jsonrpc": "2.0", "id": req["id"], "result": result})
+
+            def _reply(self, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.http = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.http.server_address[1]}"
+        threading.Thread(target=self.http.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.http.shutdown()
+
+
+@pytest.fixture()
+def eth():
+    n = FakeEthNode()
+    yield n
+    n.close()
+
+
+CONTRACT = "0x" + "11" * 20
+PRIV = "0x" + "42".rjust(64, "0")
+
+
+def test_transact_produces_valid_signed_tx(eth):
+    client = C.ChainClient(eth.url, CONTRACT, PRIV)
+    txh = client.transact("createProposal(bytes32,uint256)", ["0x" + "cd" * 32, 3])
+    assert txh.startswith("0x")
+    assert len(eth.raw_txs) == 1
+
+    nonce, gas_price, gas, to, value, data, v, r, s = C.rlp_decode(eth.raw_txs[0])
+    assert to.hex() == "11" * 20
+    assert data[:4] == C.selector("createProposal(bytes32,uint256)")
+    assert data[4:36] == bytes.fromhex("cd" * 32)
+    assert int.from_bytes(data[36:68], "big") == 3
+
+    # EIP-155: v encodes the chain id; the signature must verify against
+    # the sender's public key over the replay-protected signing payload
+    v_int = int.from_bytes(v, "big")
+    chain_id = (v_int - 35) // 2
+    assert chain_id == 84532
+    signing = C.rlp_encode(
+        [nonce, gas_price, gas, to, value, data, chain_id, 0, 0]
+    )
+    assert C.ecdsa_verify(
+        C.keccak256(signing),
+        int.from_bytes(r, "big"),
+        int.from_bytes(s, "big"),
+        C.pubkey(int(PRIV, 16)),
+    )
+
+
+def test_submitter_lifecycle_and_guarding(eth):
+    sub = C.ChainSubmitter(C.ChainClient(eth.url, CONTRACT, PRIV))
+    assert sub.submit_proposal("ab" * 32, 1)
+    assert sub.submit_vote("ab" * 32, True)
+    assert sub.execute_proposal(1)
+    assert len(eth.raw_txs) == 3
+    # a dead RPC degrades to None, never raises (validator must survive)
+    dead = C.ChainSubmitter(
+        C.ChainClient("http://127.0.0.1:1", CONTRACT, PRIV, chain_id=84532)
+    )
+    assert dead.submit_proposal("ab" * 32, 2) is None
+
+
+def test_contract_manager_submits_on_chain(eth, tmp_path):
+    """ContractManager with a chain submitter pushes create/vote/execute
+    while keeping off-chain consensus artifacts identical."""
+    from tensorlink_tpu.platform.contract import ContractManager
+
+    sub = C.ChainSubmitter(C.ChainClient(eth.url, CONTRACT, PRIV))
+    cm = ContractManager("aa" * 32, chain=sub)
+    cm.usage = {"worker1": 1000.0}
+    prop = cm.create_proposal()
+    h = prop.hash()
+    assert len(eth.raw_txs) == 1  # createProposal
+    other = ContractManager("bb" * 32, chain=sub)
+    assert other.validate_proposal(prop.to_json(), h)
+    assert len(eth.raw_txs) == 2  # voteForProposal
+    cm.vote(h, "aa" * 32, True)
+    cm.vote(h, "bb" * 32, True)
+    assert cm.try_execute(h, 2)
+    assert len(eth.raw_txs) == 3  # executeProposal
+    # off-chain claim artifacts unchanged by chain wiring
+    claim = cm.claim_data(h, "worker1")
+    assert ContractManager.verify_claim(claim)
+
+
+def test_from_env_degrades_without_credentials(tmp_path):
+    from tensorlink_tpu.core.config import EnvFile
+
+    env = EnvFile(tmp_path / ".env")
+    assert C.from_env(env) is None
+    env.set("CHAIN_URL", "http://127.0.0.1:9")
+    env.set("CONTRACT_ADDRESS", CONTRACT)
+    env.set("CHAIN_PRIVATE_KEY", PRIV)
+    env.set("CHAIN_ID", "84532")
+    sub = C.from_env(env)
+    assert sub is not None
+    assert sub.client.chain_id == 84532
