@@ -1,0 +1,148 @@
+"""UPnP-IGD port mapping against a fake gateway on 127.0.0.1 — the full
+protocol offline: SSDP M-SEARCH -> device XML -> SOAP AddPortMapping /
+GetExternalIPAddress / DeletePortMapping (reference smart_node.py:1200-1312
+does this through miniupnpc against a real router; the wire behavior is what
+we pin down here)."""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tensorlink_tpu.p2p import upnp
+
+DEVICE_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+  <device>
+    <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+    <deviceList><device>
+      <serviceList><service>
+        <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+        <controlURL>/ctl</controlURL>
+      </service></serviceList>
+    </device></deviceList>
+  </device>
+</root>"""
+
+
+class FakeIGD:
+    """SSDP responder (UDP) + description/control endpoint (HTTP)."""
+
+    def __init__(self):
+        self.mappings: dict[int, dict] = {}
+        igd = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                body = DEVICE_XML.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                action = (self.headers.get("SOAPAction") or "").strip('"')
+                action = action.split("#")[-1]
+                text = body.decode()
+
+                def field(name):
+                    return text.split(f"<{name}>")[1].split(f"</{name}>")[0]
+
+                if action == "AddPortMapping":
+                    igd.mappings[int(field("NewExternalPort"))] = {
+                        "internal": field("NewInternalClient"),
+                        "port": int(field("NewInternalPort")),
+                        "proto": field("NewProtocol"),
+                    }
+                    resp = "<ok/>"
+                elif action == "DeletePortMapping":
+                    igd.mappings.pop(int(field("NewExternalPort")), None)
+                    resp = "<ok/>"
+                elif action == "GetExternalIPAddress":
+                    resp = (
+                        "<r><NewExternalIPAddress>203.0.113.7"
+                        "</NewExternalIPAddress></r>"
+                    )
+                else:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                out = resp.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.http = HTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.http.server_address[1]
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.udp.getsockname()
+        self._threads = [
+            threading.Thread(target=self.http.serve_forever, daemon=True),
+            threading.Thread(target=self._ssdp_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _ssdp_loop(self):
+        while True:
+            try:
+                data, addr = self.udp.recvfrom(65507)
+            except OSError:
+                return
+            if b"M-SEARCH" not in data:
+                continue
+            resp = (
+                "HTTP/1.1 200 OK\r\n"
+                f"LOCATION: http://127.0.0.1:{self.http_port}/desc.xml\r\n"
+                f"ST: {upnp.IGD_SEARCH_TARGET}\r\n\r\n"
+            ).encode()
+            self.udp.sendto(resp, addr)
+
+    def close(self):
+        self.http.shutdown()
+        self.udp.close()
+
+
+@pytest.fixture()
+def igd():
+    g = FakeIGD()
+    yield g
+    g.close()
+
+
+def test_discovery_and_mapping_lifecycle(igd):
+    pm = upnp.PortMapper(ssdp_addr=igd.ssdp_addr, timeout=3.0)
+    ext = pm.map_port(41234)
+    assert ext == "203.0.113.7"
+    assert igd.mappings[41234]["port"] == 41234
+    assert igd.mappings[41234]["proto"] == "TCP"
+    assert igd.mappings[41234]["internal"] == "127.0.0.1"
+    pm.close()
+    assert 41234 not in igd.mappings
+
+
+def test_no_gateway_degrades_gracefully():
+    # an SSDP address nothing answers on: map_port returns None, no raise
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    dead = s.getsockname()
+    s.close()
+    pm = upnp.PortMapper(ssdp_addr=dead, timeout=0.3)
+    assert pm.map_port(41235) is None
+
+
+def test_soap_fault_raises():
+    igd = FakeIGD()
+    try:
+        gw = upnp.fetch_gateway(f"http://127.0.0.1:{igd.http_port}/desc.xml")
+        with pytest.raises(upnp.UPnPError):
+            upnp._soap(gw, "NoSuchAction", {})
+    finally:
+        igd.close()
