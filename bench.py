@@ -245,7 +245,9 @@ def run_bench() -> None:
             }
         )
     except Exception as e:  # keep the decode metric even if training OOMs
-        extra["train_error"] = str(e)[:200]
+        # full text: a truncated dtype-mismatch message cost round 2 the
+        # self-contained diagnosis (ADVICE r2)
+        extra["train_error"] = str(e)[:2000]
 
     print(
         json.dumps(

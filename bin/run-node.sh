@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Node launcher (reference bin/run-node.sh: venv bootstrap, self-update,
+# node-type detection, then run the Python entry point).
+#
+# Usage: bin/run-node.sh [config.json] [-- extra run-node args]
+#   TLTPU_VENV=<dir>     venv location (default: .venv next to this script)
+#   TLTPU_NO_UPDATE=1    skip the pip self-update check
+set -euo pipefail
+
+here="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+venv="${TLTPU_VENV:-$here/.venv}"
+config="${1:-$here/config.json}"
+shift || true
+
+# --- venv bootstrap (reference run-node.sh venv section) -------------------
+if [[ ! -x "$venv/bin/python" ]]; then
+    echo "[run-node] creating venv at $venv"
+    python3 -m venv "$venv"
+fi
+# shellcheck disable=SC1091
+source "$venv/bin/activate"
+
+# --- install / self-update -------------------------------------------------
+if ! python -c "import tensorlink_tpu" 2>/dev/null; then
+    echo "[run-node] installing tensorlink_tpu from $here"
+    pip install -q -e "$here"
+elif [[ -z "${TLTPU_NO_UPDATE:-}" ]]; then
+    # refresh the editable install's entry points (cheap no-op when current)
+    pip install -q -e "$here" 2>/dev/null || true
+fi
+
+# --- node-type detection (reference: config-driven) ------------------------
+if [[ -f "$config" ]]; then
+    node_type=$(python - "$config" <<'EOF'
+import json, sys
+print(json.load(open(sys.argv[1])).get("node", {}).get("type", "worker"))
+EOF
+)
+    echo "[run-node] starting $node_type from $config"
+    exec run-node --config "$config" "$@"
+else
+    echo "[run-node] no config at $config — starting a local-test worker"
+    exec run-node --role worker --local "$@"
+fi

@@ -55,6 +55,10 @@ class StageRuntime:
     n_accum: int = 0
     opt: Any = None  # optax transform
     opt_state: Any = None
+    # proof-of-learning log: one chained entry per optimizer step
+    # (platform/proofs.py; the monitor pulls it via PROOF_REQ)
+    proof_log: list = field(default_factory=list)
+    opt_steps: int = 0
 
     @property
     def n_layers(self) -> int:
@@ -158,6 +162,8 @@ class DistributedWorker:
             self._backward(p)
         elif kind == proto.OPTIMIZER:
             self._optimizer(p)
+        elif kind == proto.PROOF_REQ:
+            self._proof_req(p)
         elif kind == proto.CHECKPOINT:
             self._checkpoint(p)
         elif kind == "shutdown_job":
@@ -588,12 +594,47 @@ class DistributedWorker:
             if rt.engine is not None:
                 rt.engine.params = rt.params
             gnorm = float(jax.device_get(optax.global_norm(rt.grad_accum)))
+            self._record_proof(rt, gnorm)
             rt.grad_accum = None
             rt.n_accum = 0
             body = {"ok": True, "op": op, "grad_norm": gnorm}
         else:
             raise ValueError(f"unknown optimizer op {op!r}")
         self._respond(p["peer"], proto.OPTIMIZER_RESP, p["rid"], body)
+
+    # -- proof of learning (platform/proofs.py; reference scaffolding
+    # never wired, ml/proofs.py + job_monitor.py:193-207) -----------------
+    MAX_PROOF_LOG = 256
+    PROOF_WINDOW = 32  # entries shipped per PROOF_REQ
+
+    def _record_proof(self, rt: StageRuntime, grad_norm: float) -> None:
+        from tensorlink_tpu.platform import proofs
+
+        rt.opt_steps += 1
+        try:
+            sketch = proofs.gradient_sketch(
+                rt.grad_accum, seed=int(rt.job_id[:8], 16)
+            )
+        except (ValueError, TypeError):
+            sketch = np.zeros(0)
+        prev = rt.proof_log[-1]["hash"] if rt.proof_log else ""
+        rt.proof_log.append(
+            proofs.proof_entry(rt.opt_steps, grad_norm, sketch, prev)
+        )
+        if len(rt.proof_log) > self.MAX_PROOF_LOG:
+            del rt.proof_log[: -self.MAX_PROOF_LOG]
+
+    def _proof_req(self, p: dict) -> None:
+        rt = self._runtime(p["job_id"])
+        window = [dict(e) for e in rt.proof_log[-self.PROOF_WINDOW:]]
+        if window and len(rt.proof_log) > len(window):
+            # chain root for a truncated window = hash of the entry just
+            # before it, so the verifier can still check integrity
+            window[0]["_chain_root"] = rt.proof_log[-len(window) - 1]["hash"]
+        self._respond(
+            p["peer"], proto.PROOF_RESP, p["rid"],
+            {"ok": True, "log": window, "total_steps": rt.opt_steps},
+        )
 
     # -- checkpoint (net-new vs reference: no mid-training checkpoint
     # exists there, SURVEY §5) -------------------------------------------

@@ -79,6 +79,17 @@ class RoleServer(TensorNode):
     # -- entrypoint (net process main) ----------------------------------
     def main(self) -> None:
         self.start()  # event loop thread + listener
+        self.port_mapper = None
+        if self.cfg.upnp and not self.cfg.local_test:
+            # public-network mode: map the listen port on the NAT gateway
+            # (reference smart_node.py:1200-1312; best-effort — a missing
+            # gateway degrades to a warning, not a dead node)
+            from tensorlink_tpu.p2p.upnp import PortMapper
+
+            self.port_mapper = PortMapper()
+            ext_ip = self.port_mapper.map_port(self.port)
+            if ext_ip:
+                self.capacity["external_addr"] = [ext_ip, self.port]
         info = {"port": self.port, "id": self.node_id, "role": self.role}
         self.bridge.q.resp.put((-1, True, info))
         self.on_started()
@@ -92,6 +103,8 @@ class RoleServer(TensorNode):
                 self.on_shutdown()
             except Exception:
                 self.log.exception("shutdown hook failed")
+            if self.port_mapper is not None:
+                self.port_mapper.close()
             self.stop()
 
     def on_started(self) -> None:
@@ -214,7 +227,7 @@ class WorkerServer(RoleServer):
         for tag in (
             proto.FORWARD, proto.BACKWARD, proto.GENERATE,
             proto.PARAMS_REQ, proto.OPTIMIZER, proto.TRAIN_MODE,
-            proto.CHECKPOINT,
+            proto.CHECKPOINT, proto.PROOF_REQ,
         ):
             self.register(tag, self._relay_to_ml)
 
@@ -271,7 +284,16 @@ class ValidatorServer(RoleServer):
         self._job_requests: dict[str, tuple[Connection, dict]] = {}
         self.keeper = Keeper(Path(cfg.log_dir) / "dht_state.json")
         self.monitor = JobMonitor(self)
-        self.contract = ContractManager(self.node_id)
+        chain = None
+        if not cfg.off_chain:
+            # on-chain mode: EVM submission via the stdlib chain client
+            # (reference builds web3 contracts at startup,
+            # smart_node.py:292-315; missing credentials degrade off-chain)
+            from tensorlink_tpu.core.config import EnvFile
+            from tensorlink_tpu.platform.chain import from_env
+
+            chain = from_env(EnvFile(cfg.env_file))
+        self.contract = ContractManager(self.node_id, chain=chain)
         self.worker_capacity_total = 0.0
         # workers seen disconnecting since the last proposal round —
         # keeper.clean_node prunes addresses/roles, so the proposal's
@@ -297,8 +319,21 @@ class ValidatorServer(RoleServer):
         """Reload persisted DHT entries + stats (reference keeper restore at
         validator startup, validator_thread.py:135-137)."""
         state = self.keeper.load_previous_state()
+        for k, ts in state.get("dht_tombstones", {}).items():
+            try:
+                self.dht.delete(k, ts=float(ts))
+            except (TypeError, ValueError):
+                continue
         for k, v in state.get("dht", {}).items():
-            self.dht.store(k, v.get("value"))
+            # restore with the ORIGIN ts — an untimestamped store would
+            # stamp restart-time and beat every write/delete that happened
+            # while this validator was down (stale-resurrection)
+            try:
+                ts = float(v.get("ts"))
+            except (TypeError, ValueError):
+                ts = None
+            self.dht.store(k, v.get("value"), ts=ts)
+        self.reputation.load_json(state.get("reputation", {}))
         now = time.time()
         for jid, j in state.get("jobs", {}).items():
             j.setdefault("t0_restored", now)  # don't credit downtime
@@ -346,7 +381,18 @@ class ValidatorServer(RoleServer):
         push JOB_UPDATE to the user. Returns the update dict or None."""
         job = self.jobs.get(job_id)
         if job is None:
-            return None
+            # failover: the validator that created the job may be gone, but
+            # its record replicated (dht_store_global + validator sync) —
+            # adopt it and become the monitoring validator
+            record = self.dht.get_local(f"job:{job_id}") or await self.dht_query(
+                f"job:{job_id}"
+            )
+            if not isinstance(record, dict) or "plan" not in record:
+                return None
+            job = dict(record)
+            job["t0_restored"] = time.time()
+            self.jobs[job_id] = job
+            self.log.info("job %s: adopted from replicated DHT record", job_id[:8])
         stages = [
             s for s in job.get("plan", {}).get("stages", [])
             if s["worker_id"] == dead_wid
@@ -390,6 +436,7 @@ class ValidatorServer(RoleServer):
                     await user_conn.send_control(proto.JOB_UPDATE, update)
                 except (ConnectionError, OSError):
                     pass
+            self.reputation.record(dead_wid, "job_failed")
             self.log.info(
                 "job %s: replaced worker %s -> %s", job_id[:8],
                 dead_wid[:8], cand[:8],
@@ -457,7 +504,61 @@ class ValidatorServer(RoleServer):
             )
         except Exception:
             self.log.exception("proposal validation failed")
+        if not ok:
+            self.reputation.record(conn.node_id or "", "proposal_mismatch")
         await self.respond(conn, proto.PROPOSAL_VOTE, body, {"approve": ok})
+
+    # -- proof of learning (monitor pull path; reference job_monitor.py
+    # PoL hooks are commented out, :193-207 — here they enforce) ----------
+    async def collect_job_proofs(self, job_id: str) -> dict:
+        """Pull + verify each worker's PoL log for a job; failed
+        verification flags the job record and dings worker reputation."""
+        from tensorlink_tpu.platform.proofs import verify_proof_log
+
+        job = self.jobs.get(job_id)
+        if job is None:
+            return {"error": "unknown job"}
+
+        async def pull(wid: str) -> tuple[str, dict] | None:
+            conn = self.connections.get(wid)
+            if conn is None:
+                return None  # liveness is the monitor's concern, not PoL's
+            try:
+                reply = await self.request(
+                    conn, proto.PROOF_REQ, {"job_id": job_id}, timeout=10.0
+                )
+            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                return wid, {"ok": False, "reason": "unreachable"}
+            log = reply.get("log", [])
+            total = int(reply.get("total_steps", 0) or 0)
+            ok, detail = verify_proof_log(log)
+            if ok and total > 0 and not log:
+                # claiming optimizer steps while returning no entries is the
+                # trivial bypass of an "empty log passes" rule — flag it
+                ok, detail = False, {"reason": "empty-log-with-steps"}
+            return wid, {"ok": ok, **detail, "total_steps": total}
+
+        results = await asyncio.gather(
+            *(pull(w) for w in list(job.get("workers", {})))
+        )
+        verdicts = dict(r for r in results if r is not None)
+        for wid, v in verdicts.items():
+            if not v["ok"]:
+                # only VERIFICATION failures cost reputation: a busy worker
+                # timing out a PROOF_REQ (first-step compiles easily exceed
+                # 10 s) is a liveness matter, not evidence of faked work —
+                # banning it would eject healthy workers mid-job
+                if v.get("reason") != "unreachable":
+                    self.reputation.record(wid, "proof_failed")
+                self.log.warning(
+                    "job %s: PoL verification failed for %s: %s",
+                    job_id[:8], wid[:8], v,
+                )
+        job["pol"] = {"ts": time.time(), "verdicts": verdicts}
+        return job["pol"]
+
+    async def cmd_job_proofs(self, p) -> dict:
+        return await self.collect_job_proofs(p["job_id"])
 
     async def cmd_run_proposal_round(self, p) -> dict:
         return await self._run_proposal_round()
@@ -486,6 +587,7 @@ class ValidatorServer(RoleServer):
             ip = (self.addresses.get(conn.node_id) or ("?",))[0]
         if not self.job_req_limiter.allow(str(ip)):
             self.log.warning("rate-limiting job requests from %s", ip)
+            self.reputation.record(conn.node_id or "", "spam")
             await self.respond(
                 conn, proto.JOB_DECLINE, body,
                 {"error": "job request rate limit exceeded"},
@@ -604,13 +706,14 @@ class ValidatorServer(RoleServer):
         job = self.jobs.pop(p["job_id"], None)
         if job:
             for wid in job.get("workers", {}):
+                self.reputation.record(wid, "job_completed")
                 try:
                     await self._conn(wid).send_control(
                         proto.JOB_SHUTDOWN, {"job_id": p["job_id"]}
                     )
                 except (ConnectionError, OSError):
                     pass
-            self.dht.delete(f"job:{p['job_id']}")
+            await self.dht_delete_global(f"job:{p['job_id']}")
         return True
 
 

@@ -31,8 +31,14 @@ from tensorlink_tpu.p2p import protocol as proto
 from tensorlink_tpu.p2p.connection import Connection
 from tensorlink_tpu.p2p.dht import DHT, hash_key
 from tensorlink_tpu.p2p.monitor import RateLimiter
+from tensorlink_tpu.p2p.reputation import ReputationTracker
 
 Handler = Callable[[Connection, int, str, Any], Awaitable[None]]
+
+# Record prefixes that replicate across validators: job records (repair
+# depends on job:{id} surviving the storing validator) and proposal bodies
+# (vote lookups). Everything else stays local-first.
+REPLICATED_PREFIXES = ("job:", "proposal:")
 
 
 class HandshakeError(Exception):
@@ -72,6 +78,7 @@ class P2PNode:
         self.addresses: dict[str, tuple[str, int]] = {}  # node_id -> (host, port)
         self.dht = DHT(self.node_id, forward=self._dht_forward)
         self.limiter = RateLimiter()
+        self.reputation = ReputationTracker()
         self.handlers: dict[str, Handler] = {}
         self.started = threading.Event()
         self.terminate = threading.Event()
@@ -86,6 +93,7 @@ class P2PNode:
         self.register(proto.DHT_GET, self._handle_dht_get)
         self.register(proto.DHT_STORE, self._handle_dht_store)
         self.register(proto.DHT_DELETE, self._handle_dht_delete)
+        self.register(proto.DHT_SYNC, self._handle_dht_sync)
         self.register(proto.PEERS, self._handle_peers)
 
     # ------------------------------------------------------------------
@@ -190,6 +198,14 @@ class P2PNode:
             peer_pub = hello["pub"].encode()
             if not crypto.authenticate_public_key(peer_pub):
                 raise HandshakeError("bad public key")
+            peer_id = crypto.node_id_from_public_key(peer_pub)
+            if not self.reputation.allowed(peer_id):
+                # reject before any further protocol steps so the initiator
+                # sees a failed handshake, not a connection that dies later
+                raise HandshakeError(
+                    f"peer {peer_id[:12]} reputation below threshold "
+                    f"({self.reputation.score(peer_id):.1f})"
+                )
             nonce_b = secrets.token_hex(32)
             await self._write_frame(
                 writer,
@@ -259,6 +275,14 @@ class P2PNode:
         node_id = crypto.node_id_from_public_key(peer_pub)
         if node_id == self.node_id:
             raise HandshakeError("connected to self")
+        if not self.reputation.allowed(node_id):
+            # reputation gate at handshake (reference smart_node.py:681-698):
+            # the peer proved its key, and that key's history disqualifies it
+            raise HandshakeError(
+                f"peer {node_id[:12]} reputation below threshold "
+                f"({self.reputation.score(node_id):.1f})"
+            )
+        self.reputation.record(node_id, "handshake_ok")
         old = self.connections.get(node_id)
         if old is not None:
             await old.close()
@@ -275,6 +299,12 @@ class P2PNode:
         self._conn_tasks.add(task)
         task.add_done_callback(lambda t: (self._conn_tasks.discard(t), self._on_disconnect(conn)))
         self.log.info("peer up %s role=%s %s:%s", node_id[:8], peer_role, host, listen_port)
+        if self.role == "validator" and peer_role == "validator":
+            # validators anti-entropy-sync replicated records on connect so a
+            # late-joining validator serves jobs stored before it existed
+            t = asyncio.ensure_future(self.sync_dht(conn))
+            self._conn_tasks.add(t)
+            t.add_done_callback(self._conn_tasks.discard)
         return conn
 
     def _on_disconnect(self, conn: Connection) -> None:
@@ -314,6 +344,7 @@ class P2PNode:
         handler = self.handlers.get(tag)
         if handler is None:
             conn.ghosts += 1
+            self.reputation.record(conn.node_id or "", "ghost")
             self.log.debug("ghost frame tag=%s from %s", tag, conn.node_id and conn.node_id[:8])
             return
         try:
@@ -350,7 +381,9 @@ class P2PNode:
         if conn is None:
             raise ConnectionError(f"no connection to {peer_id[:8]}")
         reply = await self.request(conn, proto.DHT_GET, {"key": key, "hops": hops})
-        return reply.get("value")
+        if reply.get("value") is None:
+            return None
+        return reply.get("value"), reply.get("ts")
 
     async def _handle_dht_get(self, conn, kind, tag, body) -> None:
         key = body["key"]
@@ -360,13 +393,77 @@ class P2PNode:
             pool = [c for c in self.validator_ids() if c != conn.node_id]
             if pool:
                 value = await self.dht.query(key, route_pool=pool, hops=hops + 1)
-        await self.respond(conn, proto.DHT_GET_RESP, body, {"key": key, "value": value})
+        # origin ts rides the reply so the requester's cache keeps LWW
+        # semantics (an untimestamped cache write would beat tombstones)
+        await self.respond(
+            conn, proto.DHT_GET_RESP, body,
+            {"key": key, "value": value, "ts": self.dht.updated_at.get(key)},
+        )
+
+    async def _fanout_validators(
+        self, tag: str, body: dict, exclude: str | None = None
+    ) -> None:
+        """Best-effort control-frame push to every connected validator."""
+        for nid in self.validator_ids():
+            if nid == exclude:
+                continue
+            peer = self.connections.get(nid)
+            if peer is not None:
+                try:
+                    await peer.send_control(tag, body)
+                except (ConnectionError, OSError):
+                    pass
 
     async def _handle_dht_store(self, conn, kind, tag, body) -> None:
-        self.dht.store(body["key"], body["value"])
+        key, ts = body["key"], body.get("ts")
+        if ts is None:
+            self.dht.store(key, body["value"])
+            return
+        # timestamped stores apply last-writer-wins, and a validator relays
+        # accepted replicated records to its other validator peers — the
+        # origin only reaches validators IT is connected to, so single-homed
+        # workers/users still get multi-validator replication. Equal/older
+        # timestamps are rejected, which terminates the relay.
+        accepted = self.dht.merge({key: {"value": body["value"], "ts": float(ts)}})
+        if accepted and self.role == "validator" and key.startswith(REPLICATED_PREFIXES):
+            await self._fanout_validators(proto.DHT_STORE, body, exclude=conn.node_id)
 
     async def _handle_dht_delete(self, conn, kind, tag, body) -> None:
-        self.dht.delete(body["key"])
+        key, ts = body["key"], body.get("ts")
+        changed = self.dht.delete(key, ts=float(ts) if ts else None)
+        # relay replicated deletes exactly like stores — the tombstone makes
+        # re-application a no-op, which terminates the flood
+        if (
+            changed and ts is not None and self.role == "validator"
+            and key.startswith(REPLICATED_PREFIXES)
+        ):
+            await self._fanout_validators(proto.DHT_DELETE, body, exclude=conn.node_id)
+
+    async def _handle_dht_sync(self, conn, kind, tag, body) -> None:
+        """Anti-entropy: peer sent its replicated-key digest; reply with the
+        records it is missing or holds stale (last-writer-wins on ts)."""
+        entries = self.dht.missing_for(
+            body.get("digest", {}), REPLICATED_PREFIXES
+        )
+        await self.respond(conn, proto.DHT_SYNC_RESP, body, {"entries": entries})
+
+    async def sync_dht(self, conn: Connection) -> list[str]:
+        """Pull replicated records this node lacks from ``conn``'s peer.
+        Runs from both ends of a validator-validator connection, so one pull
+        each way yields a full bidirectional sync."""
+        try:
+            reply = await self.request(
+                conn, proto.DHT_SYNC,
+                {"digest": self.dht.digest(REPLICATED_PREFIXES)},
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError, TimeoutError):
+            return []
+        accepted = self.dht.merge(reply.get("entries", {}))
+        if accepted:
+            self.log.info(
+                "dht sync from %s: %d records", conn.node_id[:8], len(accepted)
+            )
+        return accepted
 
     async def _handle_peers(self, conn, kind, tag, body) -> None:
         peers = [
@@ -383,17 +480,25 @@ class P2PNode:
         return await self.dht.query(key, route_pool=self.validator_ids(), timeout=timeout)
 
     async def dht_store_global(self, key: str, value: Any) -> None:
-        """Store locally and push to connected validators (the reference's
-        replication is local-only with a TODO, dht.py:135-137 — we at least
-        fan out to validators)."""
+        """Store locally and push to connected validators, stamped with the
+        origin write time so replicas and later anti-entropy syncs resolve
+        conflicts last-writer-wins (the reference's replication is a TODO,
+        dht.py:135-137)."""
         self.dht.store(key, value)
-        for nid in self.validator_ids():
-            conn = self.connections.get(nid)
-            if conn is not None:
-                try:
-                    await conn.send_control(proto.DHT_STORE, {"key": key, "value": value})
-                except (ConnectionError, OSError):
-                    pass
+        await self._fanout_validators(
+            proto.DHT_STORE,
+            {"key": key, "value": value, "ts": self.dht.updated_at[key]},
+        )
+
+    async def dht_delete_global(self, key: str) -> None:
+        """Delete locally (tombstoned) and push the delete to connected
+        validators so replicas drop their copies too — without this, a
+        shutdown job's record would outlive the job on every replica and be
+        resurrected by the next anti-entropy sync."""
+        self.dht.delete(key)
+        await self._fanout_validators(
+            proto.DHT_DELETE, {"key": key, "ts": self.dht.tombstones.get(key)}
+        )
 
     # ------------------------------------------------------------------
     # bootstrap

@@ -50,6 +50,8 @@ DHT_GET = "dht.get"
 DHT_GET_RESP = "dht.get.resp"
 DHT_STORE = "dht.store"
 DHT_DELETE = "dht.delete"
+DHT_SYNC = "dht.sync"  # anti-entropy: digest of replicated keys
+DHT_SYNC_RESP = "dht.sync.resp"  # entries the requester is missing
 PEERS = "peers"  # bootstrap: list of known validators
 
 # job lifecycle (reference validator_thread.py:150-161, worker_thread.py:128)
@@ -65,6 +67,8 @@ REQUEST_WORKERS = "workers.req"
 WORKERS = "workers.resp"
 PROPOSAL = "proposal"  # contract round: full proposal body for validation
 PROPOSAL_VOTE = "proposal.vote"
+PROOF_REQ = "proof.req"  # monitor pulls a worker's PoL log for a job
+PROOF_RESP = "proof.resp"
 
 # tensor-node layer (reference torch_node.py:119-131)
 MODULE = "module"  # ship a stage assignment (plan + checkpoint ref)

@@ -18,10 +18,12 @@ hooks (job_monitor.py:293-328). Here replacement is a working, tested path
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 FREE_JOB_MAX_TIME = 3600.0  # reference validator_thread.py:19
 OFFLINE_GRACE = 5.0  # seconds a worker may be missing before replacement
+PROOF_INTERVAL = 60.0  # seconds between PoL log pulls per job
 
 
 class JobMonitor:
@@ -47,6 +49,16 @@ class JobMonitor:
                 if status != "active":
                     job["status"] = "active"
                 job.pop("offline_since", None)  # full self-recovery resets grace
+                # healthy job: periodically verify proof-of-learning logs
+                # (reference PoL hooks exist but are commented out,
+                # job_monitor.py:193-207 — here a bad log costs reputation)
+                if now - job.get("pol", {}).get("ts", 0.0) > PROOF_INTERVAL:
+                    # fire-and-forget: the pull awaits per-worker replies
+                    # (10 s timeouts) and must never stall this tick's
+                    # OFFLINE_GRACE liveness handling for other jobs; stamp
+                    # ts first so a slow pull isn't re-fired every tick
+                    job.setdefault("pol", {})["ts"] = now
+                    asyncio.ensure_future(self.server.collect_job_proofs(job_id))
                 continue
             job.setdefault("offline_since", now)
             job["status"] = "pending_offline"

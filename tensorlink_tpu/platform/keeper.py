@@ -48,12 +48,22 @@ class Keeper:
                 }
                 for nid in node.connections
             },
+            # per-key ORIGIN timestamps (not snapshot time): a restored
+            # record must not outrank writes/deletes that happened while
+            # this validator was down; tombstones persist for the same
+            # reason (a restart must not resurrect deleted records)
             "dht": {
-                k: {"value": v, "ts": now}
+                k: {"value": v,
+                    "ts": getattr(node.dht, "updated_at", {}).get(k, now)}
                 for k, v in node.dht.store_map.items()
                 if _json_safe_check(v)
             },
+            "dht_tombstones": dict(getattr(node.dht, "tombstones", {})),
             "jobs": {jid: {**j, "ts": j.get("t0", now)} for jid, j in jobs.items()},
+            "reputation": (
+                node.reputation.to_json()
+                if getattr(node, "reputation", None) is not None else {}
+            ),
             "daily": self.daily,
             "weekly": self.weekly,
             "proposals": self.proposals[-200:],

@@ -55,6 +55,34 @@ def test_rate_limiter_blocks_after_burst():
 
 
 # ---------------------------------------------------------------------------
+# unit: reputation
+# ---------------------------------------------------------------------------
+def test_reputation_scoring_and_decay():
+    from tensorlink_tpu.p2p.reputation import ReputationTracker
+
+    r = ReputationTracker(half_life_s=100.0)
+    nid = "aa" * 32
+    assert r.allowed(nid)  # unknown peers are neutral
+    for _ in range(3):
+        r.record(nid, "job_failed")
+    assert r.score(nid) < -25.0
+    assert not r.allowed(nid)
+    # decay brings it back over ~2 half-lives
+    r._at[nid] -= 250.0
+    assert r.allowed(nid)
+    # goodwill is capped — can't bank unlimited credit before misbehaving
+    good = "bb" * 32
+    for _ in range(1000):
+        r.record(good, "job_completed")
+    assert r.score(good) <= 50.0
+    # persistence round-trip
+    r2 = ReputationTracker()
+    r.record(nid, "job_failed")
+    r2.load_json(r.to_json())
+    assert abs(r2.score(nid) - r.score(nid)) < 0.5
+
+
+# ---------------------------------------------------------------------------
 # unit: DHT
 # ---------------------------------------------------------------------------
 def test_dht_local_store_query():
@@ -75,6 +103,51 @@ def test_dht_xor_routing_metric():
     for i in ids:
         assert d.add_node(i)
     assert d.nearest("f1" * 32)[0] == "f0" * 32
+
+
+def test_dht_tombstones_block_resurrection():
+    """A deleted replicated record must not come back via anti-entropy: the
+    tombstone outlives the record, beats older writes, and ships to peers."""
+    t0 = time.time()
+    d = DHT("00" * 32)
+    d.store("job:x", {"v": 1}, ts=t0 - 30)
+    assert d.delete("job:x", ts=t0 - 20)
+    # an older replicated write loses to the tombstone
+    d.store("job:x", {"v": 1}, ts=t0 - 25)
+    assert d.get_local("job:x") is None
+    # sync from a peer still holding the stale record: merge rejects it
+    assert d.merge({"job:x": {"value": {"v": 1}, "ts": t0 - 25}}) == []
+    # and the tombstone itself replicates to peers that missed the delete
+    entries = d.missing_for({"job:x": t0 - 25}, ("job:",))
+    assert entries == {"job:x": {"deleted": True, "ts": t0 - 20}}
+    peer = DHT("11" * 32)
+    peer.store("job:x", {"v": 1}, ts=t0 - 25)
+    assert peer.merge(entries) == ["job:x"]
+    assert peer.get_local("job:x") is None
+    # a genuinely newer write re-creates the record
+    d.store("job:x", {"v": 2}, ts=t0 - 10)
+    assert d.get_local("job:x") == {"v": 2}
+
+
+def test_dht_query_cache_respects_tombstones():
+    """A stale copy fetched from a lagging peer must not resurrect a
+    tombstoned record: the remote answer caches with its ORIGIN ts, which
+    loses to the newer local tombstone."""
+    t0 = time.time()
+
+    async def forward(peer, key, hops=0):
+        return {"v": "stale"}, t0 - 30  # (value, origin_ts)
+
+    d = DHT("00" * 32, forward=forward)
+    d.store("job:x", {"v": 1}, ts=t0 - 30)
+    d.delete("job:x", ts=t0 - 20)
+
+    async def run():
+        return await d.query("job:x", route_pool=["bb" * 32])
+
+    assert asyncio.run(run()) is None
+    assert d.get_local("job:x") is None
+    assert "job:x" in d.tombstones  # tombstone survived the fetch
 
 
 def test_dht_forward_on_miss():
@@ -181,6 +254,78 @@ def test_dht_store_query_across_nodes(trio):
     # user (not holding the key) queries through the validator
     value = u.call(u.dht_query(key))
     assert value == {"state": "active"}
+
+
+def test_handshake_rejects_banned_peer(trio, tmp_path):
+    """The reputation gate runs at handshake (reference
+    smart_node.py:681-698): a peer whose key has a banned score is refused
+    even though its RSA proof is valid."""
+    v = trio["validator"]
+    banned = P2PNode(
+        "worker", local_test=True,
+        key_dir=tmp_path / "keys_banned", spill_dir=tmp_path / "spill_banned",
+    )
+    banned.start()
+    try:
+        for _ in range(4):
+            v.reputation.record(banned.node_id, "job_failed")
+        assert not v.reputation.allowed(banned.node_id)
+        with pytest.raises(Exception):
+            banned.call(banned.connect(v.host, v.port))
+        assert banned.node_id not in v.connections
+        # a neutral node still gets in (the gate is per-key, not global)
+        ok = P2PNode(
+            "worker", local_test=True,
+            key_dir=tmp_path / "keys_ok", spill_dir=tmp_path / "spill_ok",
+        )
+        ok.start()
+        try:
+            ok.call(ok.connect(v.host, v.port))
+            assert _wait(lambda: ok.node_id in v.connections)
+        finally:
+            ok.stop()
+    finally:
+        banned.stop()
+
+
+def test_dht_replication_survives_validator_death(trio, tmp_path):
+    """Job records replicate across validators (dht_store_global fan-out +
+    anti-entropy sync on validator connect), so the record outlives the
+    validator that stored it — the failure the reference's local-only store
+    TODO leaves open (ref dht.py:135-137)."""
+    v, u = trio["validator"], trio["user"]
+    # v stores a job record BEFORE the second validator exists
+    v.call(v.dht_store_global("job:alpha", {"plan": "p1"}))
+
+    v2 = P2PNode(
+        "validator", local_test=True,
+        key_dir=tmp_path / "keys_v2", spill_dir=tmp_path / "spill_v2",
+    )
+    v2.start()
+    try:
+        v2.call(v2.connect(v.host, v.port))
+        # anti-entropy sync pulls the pre-existing record to the new validator
+        assert _wait(lambda: v2.dht.get_local("job:alpha") == {"plan": "p1"})
+
+        # a record stored after the mesh forms fans out to both immediately
+        u.call(u.dht_store_global("job:beta", {"plan": "p2"}))
+        assert _wait(lambda: v2.dht.get_local("job:beta") is not None)
+
+        # newer write wins over the synced copy
+        v2.call(v2.dht_store_global("job:alpha", {"plan": "p1-updated"}))
+        assert _wait(lambda: v.dht.get_local("job:alpha") == {"plan": "p1-updated"})
+
+        # a replicated delete reaches the other validator's copy too
+        v2.call(v2.dht_delete_global("job:alpha"))
+        assert _wait(lambda: v.dht.get_local("job:alpha") is None)
+
+        # kill the original validator: the user reroutes queries to v2
+        v.stop()
+        u.call(u.connect(v2.host, v2.port))
+        assert _wait(lambda: v2.node_id in u.connections)
+        assert u.call(u.dht_query("job:beta")) == {"plan": "p2"}
+    finally:
+        v2.stop()
 
 
 def test_bulk_frame_roundtrip_and_spill(trio, tmp_path):

@@ -162,6 +162,59 @@ def test_loss_plausibility():
     assert not loss_plausibility([])[0]
 
 
+def test_gradient_sketch_and_proof_log():
+    """PoL v2: sketches estimate continuity; the chained log detects
+    tampering, reordering, junk norms, and anti-correlated gradients."""
+    import numpy as np
+
+    from tensorlink_tpu.platform.proofs import (
+        gradient_sketch, proof_entry, verify_proof_log,
+    )
+
+    rng = np.random.default_rng(0)
+    g = {"w": rng.normal(size=(64, 64)), "b": rng.normal(size=(64,))}
+    # determinism: same seed -> same coordinates
+    s1 = gradient_sketch(g, seed=7)
+    s2 = gradient_sketch(g, seed=7)
+    np.testing.assert_array_equal(s1, s2)
+    assert len(s1) >= 200
+
+    # a realistic training trajectory: slowly drifting gradients
+    log, prev = [], ""
+    cur = {k: v.copy() for k, v in g.items()}
+    for step in range(1, 6):
+        sk = gradient_sketch(cur, seed=7)
+        e = proof_entry(step, float(np.linalg.norm(sk)), sk, prev)
+        log.append(e)
+        prev = e["hash"]
+        cur = {k: v + 0.1 * rng.normal(size=v.shape) for k, v in cur.items()}
+    ok, detail = verify_proof_log(log)
+    assert ok, detail
+    assert detail["median_cosine"] > 0.5
+
+    # tampering with a recorded norm breaks the chain
+    bad = [dict(e) for e in log]
+    bad[2]["grad_norm"] = 0.123
+    assert verify_proof_log(bad)[1]["reason"] == "chain-broken"
+
+    # reordering breaks the chain too
+    assert not verify_proof_log([log[0], log[2], log[1], log[3], log[4]])[0]
+
+    # fabricated anti-correlated gradients fail continuity
+    log2, prev = [], ""
+    for step in range(1, 6):
+        sk = gradient_sketch(g, seed=7) * (-1.0) ** step
+        e = proof_entry(step, 1.0, sk, prev)
+        log2.append(e)
+        prev = e["hash"]
+    assert verify_proof_log(log2)[1]["reason"] == "anti-correlated"
+
+    # a truncated window verifies via its _chain_root
+    window = [dict(e) for e in log[2:]]
+    window[0]["_chain_root"] = log[1]["hash"]
+    assert verify_proof_log(window)[0]
+
+
 def test_validator_job_req_rate_limit():
     """A connected peer spamming JOB_REQ gets declined after the per-IP
     budget (reference validator_thread.py:508-516)."""
@@ -180,8 +233,10 @@ def test_validator_job_req_rate_limit():
         responses = []
 
         from tensorlink_tpu.p2p.monitor import RateLimiter
+        from tensorlink_tpu.p2p.reputation import ReputationTracker
 
         job_req_limiter = RateLimiter(max_per_minute=3, block_s=600.0)
+        reputation = ReputationTracker()
         _job_requests = {}
 
         def post_work(self, kind, item):

@@ -121,6 +121,86 @@ def test_monitor_pushes_replacement(cluster):
     model.shutdown()
 
 
+def test_validator_failover_repair(tmp_path):
+    """The validator that created a job dies along with a stage worker; a
+    second validator adopts the job from the replicated DHT record and
+    serves the user's JOB_REPAIR — the exact loss the reference's
+    local-only DHT store cannot survive (ref dht.py:135-137: validator
+    death orphans job:{id} and repair with it)."""
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import forward, init_params
+    from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
+
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp_path / "keys"),
+        log_dir=str(tmp_path / "logs"),
+        env_file=str(tmp_path / ".env"),
+    )
+    v1 = ValidatorNode(
+        ValidatorConfig(endpoint=False, monitor_interval=0.5,
+                        keeper_interval=1.0, proposal_interval=0.0, **common)
+    ).start()
+    v2 = ValidatorNode(
+        ValidatorConfig(endpoint=False, duplicate="1", monitor_interval=0.5,
+                        keeper_interval=1.0, proposal_interval=0.0,
+                        seed_validators=[["127.0.0.1", v1.port]], **common)
+    ).start()
+    seeds = [["127.0.0.1", v1.port]]
+    w1 = WorkerNode(WorkerConfig(seed_validators=seeds, **common)).start()
+    w2 = WorkerNode(
+        WorkerConfig(seed_validators=seeds, duplicate="1", **common)
+    ).start()
+    user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
+    try:
+        # wait until everyone discovered the second validator via PEERS
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            vs = user.send_request("validators")
+            ws = v2.status()["peers"]
+            if len(vs) >= 2 and sum(
+                1 for p in ws.values() if p["role"] == "worker"
+            ) >= 2:
+                break
+            time.sleep(0.2)
+
+        w1.send_request("set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        w2.send_request("set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+
+        cfg = tiny_cfg()
+        model = DistributedModel(cfg, node=user, seed=13, seq_len=64)
+        assert model.plan.stages[0].worker_id == w1.node_id
+        toks = np.array([[7, 21, 3, 99]], np.int32)
+        out_before = model(toks)
+
+        # the job record must have replicated to v2 before the failover
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if v2.send_request("dht_get", {"key": f"job:{model.job_id}"}):
+                break
+            time.sleep(0.2)
+
+        v1.stop()  # the creating validator dies...
+        w1.stop()  # ...and so does the hosting worker
+        time.sleep(0.5)
+
+        out_after = model(toks)  # JOB_REPAIR now lands on v2
+        assert model.plan.stages[0].worker_id == w2.node_id
+        np.testing.assert_allclose(out_after, out_before, rtol=1e-5, atol=1e-6)
+        params = init_params(cfg, jax.random.PRNGKey(13))
+        ref, _ = forward(params, toks, cfg)
+        np.testing.assert_allclose(out_after, np.asarray(ref), rtol=2e-4, atol=2e-4)
+        model.shutdown()
+    finally:
+        for n in (user, w2, v2):
+            n.stop()
+        for n in (w1, v1):
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
 def test_contract_round_and_claim(cluster):
     from tensorlink_tpu.ml.module import DistributedModel
 

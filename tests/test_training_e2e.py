@@ -105,6 +105,18 @@ def test_single_stage_training_parity(cluster):
         losses = [model.train_step(t)["loss"] for t in batches]
         np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
 
+        # the worker recorded a PoL entry per optimizer step; the validator
+        # pulls and verifies the chained log (reference leaves PoL unwired,
+        # job_monitor.py:193-207)
+        pol = cluster["validator"].send_request(
+            "job_proofs", {"job_id": model.job_id}
+        )
+        verdicts = pol["verdicts"]
+        assert verdicts, pol
+        for wid, v in verdicts.items():
+            assert v["ok"], (wid, v)
+            assert v["total_steps"] == len(batches)
+
         got = model.parameters()[0]
     np.testing.assert_allclose(
         got["embed"]["tok"], np.asarray(ref_params["embed"]["tok"]),
