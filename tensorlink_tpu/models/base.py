@@ -34,6 +34,7 @@ class ModelConfig:
     - ``n_experts>0`` → Mixtral-style sparse MoE.
     - ``embed_scale`` + ``norm_plus_one`` → Gemma.
     - ``parallel_residual`` + ``rope_pct<1`` + layernorm → GPT-NeoX/Pythia.
+    - ``norm_position="post"`` + ``qk_norm_full`` → OLMo-2.
     """
 
     family: str = "llama"
@@ -59,6 +60,12 @@ class ModelConfig:
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     norm_plus_one: bool = False  # Gemma rmsnorm: x * rms * (1 + scale)
     qk_norm: bool = False  # Qwen3 per-head-dim RMSNorm on q and k
+    # OLMo-2: RMSNorm over the FULL q/k projection dim (not per-head),
+    # applied before the head reshape
+    qk_norm_full: bool = False
+    # "pre" (llama-style input norms) | "post" (OLMo-2: norm applied to the
+    # sublayer OUTPUT before the residual add; no input norm)
+    norm_position: str = "pre"
     embed_scale: bool = False  # Gemma: embeddings scaled by sqrt(d_model)
     parallel_residual: bool = False  # GPT-NeoX: x + attn(ln1 x) + mlp(ln2 x)
     tie_embeddings: bool = False

@@ -153,6 +153,15 @@ def _phi3(d: dict) -> ModelConfig:
     )
 
 
+@register_family("olmo2")
+def _olmo2(d: dict) -> ModelConfig:
+    # OLMo-2: llama layout reordered — RMSNorm on sublayer OUTPUTS
+    # (post_attention / post_feedforward), full-projection-dim q/k norms
+    return _llama_like(
+        d, family="olmo2", norm_position="post", qk_norm_full=True
+    )
+
+
 @register_family("gpt_neox")
 def _gpt_neox(d: dict) -> ModelConfig:
     # GPT-NeoX / Pythia: layernorm with biases, parallel attn+mlp residual,
@@ -282,11 +291,16 @@ def hf_name_map(cfg: ModelConfig) -> dict[str, Any]:
             "layers.attn.bk": "layers.{i}.self_attn.k_proj.bias",
             "layers.attn.bv": "layers.{i}.self_attn.v_proj.bias",
         }
-    if cfg.qk_norm:
+    if cfg.qk_norm or cfg.qk_norm_full:
         m |= {
             "layers.attn.q_norm": "layers.{i}.self_attn.q_norm.weight",
             "layers.attn.k_norm": "layers.{i}.self_attn.k_norm.weight",
         }
+    if cfg.family == "olmo2":
+        # post-norm reordering: our ln1 holds post_attention_layernorm, ln2
+        # holds post_feedforward_layernorm (no input norms exist)
+        m["layers.ln1.scale"] = "layers.{i}.post_attention_layernorm.weight"
+        m["layers.ln2.scale"] = "layers.{i}.post_feedforward_layernorm.weight"
     if cfg.moe:
         m |= {
             "layers.mlp.router": "~T layers.{i}.block_sparse_moe.gate.weight",
@@ -483,6 +497,21 @@ def config_presets() -> dict[str, ModelConfig]:
             mlp="fused",
             norm="layernorm",
             parallel_residual=True,
+        ),
+        "olmo2-7b": ModelConfig(
+            family="olmo2",
+            vocab_size=100352,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=32,
+            head_dim=128,
+            d_ff=11008,
+            max_seq_len=4096,
+            norm_eps=1e-6,
+            rope_theta=5e5,
+            norm_position="post",
+            qk_norm_full=True,
         ),
         "mixtral-8x7b": ModelConfig(
             family="mixtral",

@@ -69,6 +69,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> dict:
         attn["bo"] = jnp.zeros((L, d), dt)
     if cfg.qk_norm:
         attn |= {"q_norm": jnp.ones((L, hd), dt), "k_norm": jnp.ones((L, hd), dt)}
+    if cfg.qk_norm_full:  # OLMo-2: norm over the whole projection dim
+        attn |= {
+            "q_norm": jnp.ones((L, cfg.q_dim), dt),
+            "k_norm": jnp.ones((L, cfg.kv_dim), dt),
+        }
 
     if cfg.moe:
         E = cfg.n_experts
@@ -265,13 +270,17 @@ def _block(
     attn_fn=None,  # static override: (q, k, v, mask_bias, scale) -> out
 ):
     B, T, _ = x.shape
-    h = _norm(x, lp["ln1"], cfg)
+    post = cfg.norm_position == "post"  # OLMo-2: norm the sublayer output
+    h = x if post else _norm(x, lp["ln1"], cfg)
     ap = lp["attn"]
     q = h @ ap["wq"]
     k = h @ ap["wk"]
     v = h @ ap["wv"]
     if "bq" in ap:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    if cfg.qk_norm_full:  # OLMo-2: full-projection-dim RMSNorm pre-reshape
+        q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
+        k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
@@ -307,7 +316,10 @@ def _block(
     attn_out = attn_out.reshape(B, T, cfg.q_dim) @ ap["wo"]
     if "bo" in ap:
         attn_out = attn_out + ap["bo"]
-    if cfg.parallel_residual:  # GPT-NeoX: both branches read the block input
+    if post:  # OLMo-2: ln1 == post_attention, ln2 == post_feedforward
+        x = x + _norm(attn_out, lp["ln1"], cfg)
+        x = x + _norm(_mlp(x, lp["mlp"], cfg), lp["ln2"], cfg)
+    elif cfg.parallel_residual:  # GPT-NeoX: both branches read the block input
         x = x + attn_out + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
     else:
         x = x + attn_out
@@ -624,6 +636,8 @@ def partition_specs(
         attn["bo"] = spec(None, None)
     if cfg.qk_norm:
         attn |= {"q_norm": spec(None, None), "k_norm": spec(None, None)}
+    if cfg.qk_norm_full:  # scales align with the column-sharded projections
+        attn |= {"q_norm": spec(None, t), "k_norm": spec(None, t)}
 
     if cfg.moe:
         mlp = {

@@ -110,6 +110,22 @@ FAMILIES = {
             tie_word_embeddings=False,
         ),
     ),
+    "olmo2": dict(
+        cls="Olmo2ForCausalLM",
+        cfg=dict(
+            model_type="olmo2",
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            intermediate_size=64,
+            max_position_embeddings=64,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            tie_word_embeddings=False,
+        ),
+    ),
     "gemma": dict(
         cls="GemmaForCausalLM",
         cfg=dict(
@@ -210,7 +226,7 @@ def test_forward_parity(family, tmp_path):
     assert np.abs(got - ref).mean() < 5e-4
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen3", "gpt_neox", "gemma"])
+@pytest.mark.parametrize("family", ["llama", "qwen3", "gpt_neox", "gemma", "olmo2"])
 def test_prefill_decode_consistency(family, tmp_path):
     """prefill+decode through the KV cache must equal the full forward."""
     from tensorlink_tpu.engine.loader import load_params
@@ -242,7 +258,9 @@ def test_prefill_decode_consistency(family, tmp_path):
     assert int(cache.length[0]) == 10
 
 
-@pytest.mark.parametrize("family", ["qwen2", "phi3", "gpt_neox", "mixtral"])
+@pytest.mark.parametrize(
+    "family", ["qwen2", "phi3", "gpt_neox", "mixtral", "olmo2"]
+)
 def test_export_roundtrip(family, tmp_path):
     """export_hf(load_params(ckpt)) reproduces the original tensors —
     including the fused qkv_proj/gate_up_proj (phi3), per-head interleaved
