@@ -20,7 +20,6 @@ import jax
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,8 +35,15 @@ from tensorlink_tpu.models.transformer import _stage_impl, head_forward
 dev = jax.devices()[0]
 print("device:", dev, dev.device_kind)
 
-cfg = config_presets()["qwen3-4b"].with_(dtype=jnp.bfloat16)
-prompt_len, gen = 128, 128
+if dev.platform == "cpu":  # script-logic smoke mode (tiny config, fp32)
+    cfg = config_presets()["qwen3-1p7b"].with_(
+        dtype=jnp.float32, n_layers=2, d_model=256, d_ff=512,
+        n_heads=4, n_kv_heads=2, head_dim=64, vocab_size=1024,
+    )
+    prompt_len, gen = 16, 16
+else:
+    cfg = config_presets()["qwen3-4b"].with_(dtype=jnp.bfloat16)
+    prompt_len, gen = 128, 128
 max_len = prompt_len + gen
 
 params = init_params(cfg, jax.random.PRNGKey(0))
@@ -99,19 +105,19 @@ dt_step = timeit(step, n=30)
 print(f"[step] host-driven decode step: {dt_step*1e3:.2f} ms/tok")
 
 # -- 3. layers-only (no final norm / logits head) --------------------------
-stage_fwd = partial(
-    jax.jit, static_argnames=("cfg", "first", "last", "remat"),
-    donate_argnames=("cache",),
-)(lambda p, c, cfg, cache: _stage_impl(
-    p, cfg, tokens=jnp.zeros((1, 1), jnp.int32), cache=cache,
-    first=True, last=False, remat=False))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def stage_fwd(p, cfg, cache):
+    return _stage_impl(
+        p, cfg, tokens=jnp.zeros((1, 1), jnp.int32), cache=cache,
+        first=True, last=False, remat=False,
+    )
 
 cache2 = KVCache.init(cfg, 1, max_len=max_len)
-hid, cache2 = stage_fwd(params, None, cfg, cache2)
+hid, cache2 = stage_fwd(params, cfg, cache2)
 
 def layers_only():
     global cache2
-    h, cache2 = stage_fwd(params, None, cfg, cache2)
+    h, cache2 = stage_fwd(params, cfg, cache2)
     return h
 
 dt_layers = timeit(layers_only, n=30)

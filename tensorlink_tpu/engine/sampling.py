@@ -33,6 +33,7 @@ class SamplingParams:
         )
 
 
+@jax.jit
 def sample(
     logits: jax.Array,  # [B, V] float
     key: jax.Array,
@@ -42,6 +43,15 @@ def sample(
 
     Fully vectorized: filters are masks over the sorted distribution, so the
     same program handles any (k, p) at runtime.
+
+    jit at the definition is load-bearing: the ``lax.cond`` below builds
+    fresh branch closures per call, so an EAGER call can never hit jax's
+    trace cache and pays a full XLA compile of the sampled branch (argsort
+    over the vocab) every time — ~0.5 s on CPU, seconds on TPU. That
+    exact miss sat on every ``generate_compiled`` call (the prefill-token
+    sample) and every host-driven decode step, and was the dominant term in
+    the round-2 decode benchmark (25 tok/s vs 101 roofline). Inside an
+    enclosing jit the wrapper inlines and changes nothing.
     """
     logits = logits.astype(jnp.float32)
     B, V = logits.shape

@@ -143,6 +143,7 @@ class DistributedWorker:
                         proto.OPTIMIZER: proto.OPTIMIZER_RESP,
                         proto.PARAMS_REQ: proto.PARAMETERS,
                         proto.CHECKPOINT: proto.CHECKPOINT_RESP,
+                        proto.PROOF_REQ: proto.PROOF_RESP,
                         "load_stage": proto.MODULE_LOADED,
                     }.get(kind, proto.FORWARD_RESP)
                     self._respond(peer, resp_tag, rid, {"error": f"{type(e).__name__}: {e}"})

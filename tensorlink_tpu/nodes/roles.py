@@ -529,6 +529,13 @@ class ValidatorServer(RoleServer):
                 )
             except (TimeoutError, asyncio.TimeoutError, ConnectionError):
                 return wid, {"ok": False, "reason": "unreachable"}
+            if "log" not in reply:
+                # worker-side error (e.g. job released in a shutdown race) —
+                # not a passing verdict, but not evidence of faked work
+                return wid, {
+                    "ok": False, "reason": "no-log",
+                    "error": str(reply.get("error", ""))[:200],
+                }
             log = reply.get("log", [])
             total = int(reply.get("total_steps", 0) or 0)
             ok, detail = verify_proof_log(log)
@@ -536,24 +543,53 @@ class ValidatorServer(RoleServer):
                 # claiming optimizer steps while returning no entries is the
                 # trivial bypass of an "empty log passes" rule — flag it
                 ok, detail = False, {"reason": "empty-log-with-steps"}
-            return wid, {"ok": ok, **detail, "total_steps": total}
+            flag_key = ""
+            if not ok:
+                # identity of the defect for once-per-segment penalties:
+                # the failing entry's hash when the verifier localized it,
+                # else the window's last hash
+                at = detail.get("at")
+                if isinstance(at, int) and 0 <= at < len(log):
+                    flag_key = str(log[at].get("hash", ""))
+                elif log:
+                    flag_key = str(log[-1].get("hash", ""))
+            return wid, {"ok": ok, **detail, "total_steps": total,
+                         "flag_key": flag_key}
 
         results = await asyncio.gather(
             *(pull(w) for w in list(job.get("workers", {})))
         )
         verdicts = dict(r for r in results if r is not None)
+        # SOFT_REASONS are liveness matters (busy worker timing out a pull,
+        # shutdown-race error replies), not evidence of faked work — but a
+        # worker that NEVER verifiably answers is opting out of PoL, so
+        # persistent softness escalates to one penalty per streak.
+        SOFT_REASONS = ("unreachable", "no-log")
+        SOFT_STREAK_LIMIT = 5
+        flagged = job.setdefault("pol_flagged", {})  # wid -> last_hash dinged
+        misses = job.setdefault("pol_misses", {})  # wid -> consecutive softs
         for wid, v in verdicts.items():
-            if not v["ok"]:
-                # only VERIFICATION failures cost reputation: a busy worker
-                # timing out a PROOF_REQ (first-step compiles easily exceed
-                # 10 s) is a liveness matter, not evidence of faked work —
-                # banning it would eject healthy workers mid-job
-                if v.get("reason") != "unreachable":
+            if v["ok"]:
+                misses.pop(wid, None)
+                continue
+            if v.get("reason") in SOFT_REASONS:
+                misses[wid] = misses.get(wid, 0) + 1
+                if misses[wid] >= SOFT_STREAK_LIMIT:
                     self.reputation.record(wid, "proof_failed")
-                self.log.warning(
-                    "job %s: PoL verification failed for %s: %s",
-                    job_id[:8], wid[:8], v,
-                )
+                    misses[wid] = 0
+            else:
+                misses.pop(wid, None)
+                # penalize each defective chain segment ONCE: the same bad
+                # entry stays inside the 32-entry window for many 60 s
+                # pulls, and re-dinging it every pull would escalate one
+                # glitch into a ban within minutes
+                if flagged.get(wid) != v.get("flag_key"):
+                    self.reputation.record(wid, "proof_failed")
+                    flagged[wid] = v.get("flag_key")
+            self.log.warning(
+                "job %s: PoL verification failed for %s: %s",
+                job_id[:8], wid[:8], v,
+            )
         job["pol"] = {"ts": time.time(), "verdicts": verdicts}
         return job["pol"]
 

@@ -121,13 +121,19 @@ class DHT:
     # -- store -------------------------------------------------------------
     def store(self, key: str, value: Any, ts: float | None = None) -> None:
         """``ts`` is the origin write time; replicated stores pass it along
-        so last-writer-wins comparisons use one clock per record."""
+        so last-writer-wins comparisons use one clock per record. A
+        timestamped store loses to BOTH a newer tombstone and a newer live
+        record (e.g. a fanout write that merged while a ``query`` was
+        awaiting a lagging peer's stale copy); an untimestamped store is a
+        fresh local write and always wins."""
         t = time.time() if ts is None else ts
         dead = self.tombstones.get(key)
         if dead is not None:
             if ts is not None and t <= dead:
                 return  # the record was deleted after this write happened
             del self.tombstones[key]  # genuinely re-created
+        if ts is not None and self.updated_at.get(key, -1.0) > t:
+            return  # a newer live record wins
         self.store_map[key] = value
         self.updated_at[key] = t
 

@@ -282,7 +282,11 @@ class P2PNode:
                 f"peer {node_id[:12]} reputation below threshold "
                 f"({self.reputation.score(node_id):.1f})"
             )
-        self.reputation.record(node_id, "handshake_ok")
+        if self.reputation.score(node_id) < 0:
+            # clean handshakes only help a tarnished peer crawl back toward
+            # neutral — a reconnect loop must not FARM positive credit to
+            # absorb later misbehavior (goodwill comes from completed jobs)
+            self.reputation.record(node_id, "handshake_ok")
         old = self.connections.get(node_id)
         if old is not None:
             await old.close()
@@ -417,7 +421,14 @@ class P2PNode:
     async def _handle_dht_store(self, conn, kind, tag, body) -> None:
         key, ts = body["key"], body.get("ts")
         if ts is None:
-            self.dht.store(key, body["value"])
+            # replicated records are LWW-ordered by origin ts; an
+            # untimestamped REMOTE store has no place in that order and
+            # could otherwise clear tombstones or overwrite newer records
+            # (store()'s "local write always wins" rule is for this node's
+            # own writes, not a peer omitting ts). Reject for replicated
+            # prefixes; plain keys keep the legacy behavior.
+            if not key.startswith(REPLICATED_PREFIXES):
+                self.dht.store(key, body["value"])
             return
         # timestamped stores apply last-writer-wins, and a validator relays
         # accepted replicated records to its other validator peers — the

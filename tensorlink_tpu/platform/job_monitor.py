@@ -58,7 +58,14 @@ class JobMonitor:
                     # OFFLINE_GRACE liveness handling for other jobs; stamp
                     # ts first so a slow pull isn't re-fired every tick
                     job.setdefault("pol", {})["ts"] = now
-                    asyncio.ensure_future(self.server.collect_job_proofs(job_id))
+                    # strong ref + done-callback: the loop holds tasks
+                    # weakly, and an unreferenced pull could be GC'd
+                    # mid-await (same pattern as P2PNode.sync_dht)
+                    t = asyncio.ensure_future(
+                        self.server.collect_job_proofs(job_id)
+                    )
+                    self.server._conn_tasks.add(t)
+                    t.add_done_callback(self.server._conn_tasks.discard)
                 continue
             job.setdefault("offline_since", now)
             job["status"] = "pending_offline"

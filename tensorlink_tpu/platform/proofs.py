@@ -125,6 +125,12 @@ def _verify_entries(
 ) -> tuple[bool, dict]:
     prev_hash = str(log[0].get("_chain_root", ""))
     cosines = []
+    # entries contributing no continuity evidence (empty or all-zero
+    # sketch — the worker's documented fallback emits np.zeros(0))
+    sketchless = sum(
+        1 for e in log
+        if not np.any(np.asarray(e.get("sketch", []), np.float64))
+    )
     for i, e in enumerate(log):
         expect = proof_entry(
             e.get("step", -1), e.get("grad_norm", 0.0),
@@ -145,14 +151,23 @@ def _verify_entries(
                                "ratio": gn / prev_gn}
             a = np.asarray(log[i - 1].get("sketch", []), np.float64)
             b = np.asarray(e.get("sketch", []), np.float64)
-            if a.shape != b.shape:
+            if a.size and b.size and a.shape != b.shape:
                 return False, {"reason": "sketch-shape", "at": i}
-            denom = np.linalg.norm(a) * np.linalg.norm(b)
+            denom = (
+                np.linalg.norm(a) * np.linalg.norm(b)
+                if a.shape == b.shape else 0.0
+            )
             if denom > 0:
                 cosines.append(float(a @ b / denom))
     if cosines and float(np.median(cosines)) < min_cosine:
         return False, {"reason": "anti-correlated",
                        "median_cosine": float(np.median(cosines))}
+    # all-empty / all-zero sketches would trivially bypass the continuity
+    # check. The worker's sketch fallback (np.zeros(0) on a sketch error)
+    # makes an occasional sketchless entry legitimate; more than a quarter
+    # of a multi-entry log contributing no evidence is not
+    if len(log) >= 3 and sketchless > max(1, len(log) // 4):
+        return False, {"reason": "sketchless", "n_sketchless": sketchless}
     return True, {
         "n": len(log),
         "median_cosine": float(np.median(cosines)) if cosines else None,

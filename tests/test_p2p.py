@@ -127,6 +127,13 @@ def test_dht_tombstones_block_resurrection():
     # a genuinely newer write re-creates the record
     d.store("job:x", {"v": 2}, ts=t0 - 10)
     assert d.get_local("job:x") == {"v": 2}
+    # live-record LWW: an older timestamped store loses to a newer record
+    # (e.g. a stale query-cache write racing a fanout store)
+    d.store("job:x", {"v": "stale"}, ts=t0 - 15)
+    assert d.get_local("job:x") == {"v": 2}
+    # ...but an untimestamped local write always wins (fresh local state)
+    d.store("job:x", {"v": 3})
+    assert d.get_local("job:x") == {"v": 3}
 
 
 def test_dht_query_cache_respects_tombstones():
@@ -318,6 +325,14 @@ def test_dht_replication_survives_validator_death(trio, tmp_path):
         # a replicated delete reaches the other validator's copy too
         v2.call(v2.dht_delete_global("job:alpha"))
         assert _wait(lambda: v.dht.get_local("job:alpha") is None)
+
+        # an untimestamped remote store must NOT resurrect the tombstoned
+        # record (omitting ts would otherwise bypass last-writer-wins)
+        conn = u.connections[v.node_id]
+        u.call(conn.send_control(proto.DHT_STORE,
+                                 {"key": "job:alpha", "value": {"z": 1}}))
+        time.sleep(0.5)
+        assert v.dht.get_local("job:alpha") is None
 
         # kill the original validator: the user reroutes queries to v2
         v.stop()

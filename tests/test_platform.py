@@ -214,6 +214,34 @@ def test_gradient_sketch_and_proof_log():
     window[0]["_chain_root"] = log[1]["hash"]
     assert verify_proof_log(window)[0]
 
+    # ONE empty sketch (the worker's documented fallback on a sketch error)
+    # is tolerated — an honest glitch must not read as faked work
+    log_glitch, prev = [], ""
+    for step in range(1, 6):
+        sk = gradient_sketch(g, seed=7) if step != 3 else np.zeros(0)
+        e = proof_entry(step, 1.0, sk, prev)
+        log_glitch.append(e)
+        prev = e["hash"]
+    okg, dg = verify_proof_log(log_glitch)
+    assert okg, dg
+
+    # all-empty sketches can't dodge the continuity check
+    log3, prev = [], ""
+    for step in range(1, 6):
+        e = proof_entry(step, 1.0, np.zeros(0), prev)
+        log3.append(e)
+        prev = e["hash"]
+    assert verify_proof_log(log3)[1]["reason"] == "sketchless"
+
+    # malformed adversarial entries fail cleanly, never raise
+    assert verify_proof_log([{"hash": "x"}])[1]["reason"] in (
+        "chain-broken", "malformed",
+    )
+    bad_types = [dict(e) for e in log]
+    bad_types[1]["step"] = "not-a-number"
+    ok3, d3 = verify_proof_log(bad_types)
+    assert not ok3
+
 
 def test_validator_job_req_rate_limit():
     """A connected peer spamming JOB_REQ gets declined after the per-IP
