@@ -47,10 +47,15 @@ class StageRuntime:
     engine: Any = None  # GenerationEngine for whole-model jobs
     sessions: dict[str, Any] = field(default_factory=dict)  # session -> KVCache
     training: bool = False
-    # activation store for cross-host backward: tag -> (vjp_fn, wrt_input)
-    # — the explicit replacement for torch's implicit autograd graph the
-    # reference replays on the worker (ml/worker.py:233-291)
+    # activation store for cross-host backward: tag -> (bwd_key, inputs,
+    # wrt_input) — the explicit replacement for torch's implicit autograd
+    # graph the reference replays on the worker (ml/worker.py:233-291).
+    # Backward runs a COMPILED (params, x, mask, g) -> grads program cached
+    # in ``bwd_cache`` (recomputing the forward inside the program, which
+    # remat was doing anyway) instead of replaying an eager vjp closure
+    # op-by-op per request.
     saved: dict[str, Any] = field(default_factory=dict)
+    bwd_cache: dict[Any, Any] = field(default_factory=dict)
     grad_accum: Any = None  # summed param cotangents across micro-batches
     n_accum: int = 0
     opt: Any = None  # optax transform
@@ -223,13 +228,33 @@ class DistributedWorker:
         mesh = self._build_stage_mesh(cfg, stage)
         if mesh is not None:
             params = self._shard_params(params, cfg, stage, mesh)
+        training = bool(p.get("training", False))
+        quant = p.get("model", {}).get("quant")
+        if quant:
+            # weight-only int8 serving (models/quant.py): quantize the
+            # stage's matmul weights in place — every serving path
+            # (stage_forward, the generation engine) dequantizes on the fly
+            # through quant.matmul. Training needs exact weights for the
+            # optimizer, and a sharded tree has no QTensor partition specs.
+            if quant != "int8":
+                # fail the MODULE load (the user sees the error) rather
+                # than silently serving a mode they didn't ask for
+                raise ValueError(f"unknown quant mode {quant!r}")
+            if training:
+                self.log.warning("quant=%s ignored for a TRAINING job", quant)
+            elif mesh is not None:
+                self.log.warning("quant=%s ignored on a sharded stage", quant)
+            else:
+                from tensorlink_tpu.models.quant import quantize_params
+
+                params = quantize_params(params)
         rt = StageRuntime(
             job_id=job_id,
             cfg=cfg,
             stage=stage,
             params=params,
             mesh=mesh,
-            training=bool(p.get("training", False)),
+            training=training,
         )
         if rt.whole_model:
             from tensorlink_tpu.engine.generate import GenerationEngine
@@ -237,7 +262,7 @@ class DistributedWorker:
             ml_cfg = self.node.config.ml
             rt.engine = GenerationEngine(
                 cfg,
-                params,
+                params,  # already quantized above when quant was requested
                 mesh=mesh,
                 # batch buckets include 1, so never shard cache batch on the
                 # data axis here; kv heads ride the tensor axis
@@ -320,24 +345,25 @@ class DistributedWorker:
         seq_mesh,
         pp_size: int,
         apply_head: bool,
-        kw: dict,
         *,
         remat: bool = False,
+        n_micro: int = 1,
     ):
-        """Build the ``(params, x) -> out`` program for this stage's layer
-        slice, where ``x`` is tokens (first stage) or hidden (later stages).
+        """Build the ``(params, x, attn_mask) -> out`` function for this
+        stage's layer slice, where ``x`` is tokens (first stage) or hidden
+        (later stages). All varying data is an ARGUMENT (not captured) so
+        jitted wrappers of the closure are safely cacheable per shape.
 
         Dispatch, in order: a plan mesh with a ``stage`` axis runs the slice
         through the in-mesh GPipe program (parallel/pipeline.py); a ``seq``
         axis runs ring attention inside ``stage_forward``; otherwise the
-        plain compiled stage program. All three are differentiable, so the
-        training path takes ``jax.vjp`` of the returned closure directly
+        plain compiled stage program. All three are differentiable — the
+        training backward is a cached jit of ``jax.vjp`` over this closure
         (the explicit replacement for the reference's torch-autograd replay,
         ml/worker.py:233-291)."""
         from tensorlink_tpu.models.transformer import stage_forward
 
         first = rt.stage["first"]
-        attn_mask = kw.get("attn_mask")
         cfg = rt.cfg
         axes = rt.stage.get("mesh_axes") or {}
         if cfg.moe and remat and int(axes.get("expert", 1)) > 1:
@@ -352,19 +378,7 @@ class DistributedWorker:
         if pp_size > 1:
             from tensorlink_tpu.parallel.pipeline import pipelined_stage_forward
 
-            x_in = kw["tokens"] if first else kw["hidden"]
-            batch = int(x_in.shape[0])
-            # prefer 2 micro-batches per stage (keeps the bubble small),
-            # degrade to whatever divides the batch; this in-mesh micro
-            # count is sized to THIS stage's mesh, independent of the
-            # cross-worker plan.n_micro grad-accumulation knob
-            n_micro = 1
-            for cand in (2 * pp_size, pp_size, 2, 1):
-                if batch % cand == 0:
-                    n_micro = cand
-                    break
-
-            def fwd(params, x):
+            def fwd(params, x, attn_mask):
                 out, _ = pipelined_stage_forward(
                     params,
                     cfg,
@@ -381,7 +395,7 @@ class DistributedWorker:
 
             return fwd
 
-        def fwd(params, x):
+        def fwd(params, x, attn_mask):
             out, _ = stage_forward(
                 params,
                 cfg,
@@ -396,6 +410,56 @@ class DistributedWorker:
             return out
 
         return fwd
+
+    @staticmethod
+    def _pp_n_micro(pp_size: int, batch: int) -> int:
+        """Prefer 2 micro-batches per stage (keeps the bubble small),
+        degrade to whatever divides the batch; this in-mesh micro count is
+        sized to THIS stage's mesh, independent of the cross-worker
+        plan.n_micro grad-accumulation knob."""
+        for cand in (2 * pp_size, pp_size, 2, 1):
+            if batch % cand == 0:
+                return cand
+        return 1
+
+    def _train_programs(self, rt: StageRuntime, flags: tuple, shapes: tuple):
+        """Cached jitted (fwd, bwd) programs for one training configuration.
+
+        ``bwd(params, x, mask, g)`` takes ``jax.vjp`` of the stage closure
+        INSIDE jit — the forward recomputes within the compiled program
+        (what remat was doing through the eager vjp anyway), so backward is
+        one cached XLA execution instead of an op-by-op eager replay per
+        request."""
+        import jax
+
+        key = (flags, shapes)
+        progs = rt.bwd_cache.get(key)
+        if progs is not None:
+            return progs
+        seq_on, pp_size, apply_head, remat, n_micro, wrt_input = flags
+        fwd = self._stage_fwd_fn(
+            rt,
+            rt.mesh if seq_on else None,
+            pp_size,
+            apply_head,
+            remat=remat,
+            n_micro=n_micro,
+        )
+        if wrt_input:
+
+            def bwd(params, x, mask, g):
+                _, vjp = jax.vjp(lambda p, xx: fwd(p, xx, mask), params, x)
+                return vjp(g)  # (grad_params, grad_x)
+
+        else:  # first stage: tokens are int — grads wrt params only
+
+            def bwd(params, x, mask, g):
+                _, vjp = jax.vjp(lambda p: fwd(p, x, mask), params)
+                return vjp(g)[0], None
+
+        progs = (jax.jit(fwd), jax.jit(bwd))
+        rt.bwd_cache[key] = progs
+        return progs
 
     # -- forward --------------------------------------------------------
     def _forward(self, p: dict) -> None:
@@ -418,13 +482,9 @@ class DistributedWorker:
         tag = p.get("tag", "")
         if op == "head":
             hidden = jnp.asarray(np.asarray(p["hidden"]))
+            logits = head_forward(rt.params, hidden, rt.cfg)
             if train:
-                logits, vjp = jax.vjp(
-                    lambda prm, h: head_forward(prm, h, rt.cfg), rt.params, hidden
-                )
-                rt.saved[tag + ".head"] = (vjp, True)
-            else:
-                logits = head_forward(rt.params, hidden, rt.cfg)
+                rt.saved[tag + ".head"] = ("head", None, hidden, None, True)
             self._respond(
                 p["peer"], proto.FORWARD_RESP, p["rid"],
                 {"out": np.asarray(jax.device_get(logits))},
@@ -456,21 +516,25 @@ class DistributedWorker:
             else None
         )
         pp_size = int(axes.get("stage", 1)) if rt.mesh is not None else 1
-        fwd = self._stage_fwd_fn(
-            rt, seq_mesh, pp_size, apply_head, kw, remat=train
-        )
+        x_in = kw["tokens"] if first else kw["hidden"]
+        mask = kw.get("attn_mask")
+        n_micro = self._pp_n_micro(pp_size, int(x_in.shape[0])) if pp_size > 1 else 1
 
         if train:
-            # no KV cache in training; record the vjp keyed by the driver's
-            # (batch, micro) tag — cotangents arrive via BACKWARD
-            if first:
-                toks = kw["tokens"]
-                out, vjp = jax.vjp(lambda prm: fwd(prm, toks), rt.params)
-                rt.saved[tag] = (vjp, False)
-            else:
-                hid = kw["hidden"]
-                out, vjp = jax.vjp(fwd, rt.params, hid)
-                rt.saved[tag] = (vjp, True)
+            # no KV cache in training; record the inputs keyed by the
+            # driver's (batch, micro) tag — cotangents arrive via BACKWARD
+            # and run the cached compiled bwd program over these inputs
+            flags = (
+                seq_mesh is not None, pp_size, apply_head, True, n_micro,
+                not first,
+            )
+            shapes = (
+                x_in.shape, str(x_in.dtype),
+                None if mask is None else mask.shape,
+            )
+            fwd_prog, _ = self._train_programs(rt, flags, shapes)
+            out = fwd_prog(rt.params, x_in, mask)
+            rt.saved[tag] = ("stage", flags, x_in, mask, not first)
             self._respond(
                 p["peer"], proto.FORWARD_RESP, p["rid"],
                 {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
@@ -478,7 +542,10 @@ class DistributedWorker:
             return
 
         if p.get("session") is None and (pp_size > 1 or seq_mesh is not None):
-            out = fwd(rt.params, kw["tokens"] if first else kw["hidden"])
+            fwd = self._stage_fwd_fn(
+                rt, seq_mesh, pp_size, apply_head, n_micro=n_micro
+            )
+            out = fwd(rt.params, x_in, mask)
             self._respond(
                 p["peer"], proto.FORWARD_RESP, p["rid"],
                 {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
@@ -522,18 +589,41 @@ class DistributedWorker:
         entry = rt.saved.pop(key, None)
         if entry is None:
             raise KeyError(f"no saved activations for tag {key!r}")
-        vjp, wrt_input = entry
+        kind, flags, x_in, mask, wrt_input = entry
         g = jnp.asarray(np.asarray(p["grad"]), rt.cfg.dtype)
-        if wrt_input:
-            grad_params, grad_input = vjp(g)
+        if kind == "head":
+            grad_params, grad_input = self._head_bwd(rt)(rt.params, x_in, g)
         else:
-            (grad_params,) = vjp(g)
-            grad_input = None
+            shapes = (
+                x_in.shape, str(x_in.dtype),
+                None if mask is None else mask.shape,
+            )
+            _, bwd_prog = self._train_programs(rt, flags, shapes)
+            grad_params, grad_input = bwd_prog(rt.params, x_in, mask, g)
         self._accumulate(rt, grad_params)
         body = {"ok": True}
         if grad_input is not None:
             body["grad"] = np.asarray(jax.device_get(grad_input))
         self._respond(p["peer"], proto.BACKWARD_RESP, p["rid"], body)
+
+    def _head_bwd(self, rt: StageRuntime):
+        """Cached jitted backward for the tied-embedding head hop."""
+        import jax
+
+        from tensorlink_tpu.models.transformer import head_forward
+
+        prog = rt.bwd_cache.get("head")
+        if prog is None:
+
+            def bwd(params, h, g):
+                _, vjp = jax.vjp(
+                    lambda prm, hh: head_forward(prm, hh, rt.cfg), params, h
+                )
+                return vjp(g)
+
+            prog = jax.jit(bwd)
+            rt.bwd_cache["head"] = prog
+        return prog
 
     def _accumulate(self, rt: StageRuntime, grads) -> None:
         import jax
@@ -649,7 +739,9 @@ class DistributedWorker:
         path = Path(p["dir"]) / f"stage_{rt.stage['layer_lo']}_{rt.stage['layer_hi']}.tlts"
         if op == "save":
             path.parent.mkdir(parents=True, exist_ok=True)
-            host = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), rt.params)
+            host = jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), self._exact_params(rt)
+            )
             state = {"params": host, "stage": rt.stage}
             if rt.opt_state is not None:
                 state["opt_state"] = jax.tree.map(
@@ -738,6 +830,21 @@ class DistributedWorker:
         )
 
     # -- parameters -----------------------------------------------------
+    @staticmethod
+    def _exact_params(rt: StageRuntime):
+        """rt.params with int8-serving QTensor leaves dequantized — the
+        wire/disk formats carry plain arrays."""
+        from tensorlink_tpu.models.quant import QTensor, dequantize
+
+        def fix(node):
+            if isinstance(node, dict):
+                return {k: fix(v) for k, v in node.items()}
+            if isinstance(node, QTensor):
+                return dequantize(node, rt.cfg.dtype)
+            return node
+
+        return fix(rt.params)
+
     def _params_req(self, p: dict) -> None:
         """Ship this stage's parameters back (reference parameter download,
         ml/worker.py:1394-1413 writes a file; here it is one bulk frame)."""
@@ -745,12 +852,27 @@ class DistributedWorker:
 
         rt = self._runtime(p["job_id"])
         host_params = jax.tree.map(
-            lambda a: np.asarray(jax.device_get(a)), rt.params
+            lambda a: np.asarray(jax.device_get(a)), self._exact_params(rt)
         )
         self._respond(p["peer"], proto.PARAMETERS, p["rid"], {"params": host_params})
 
     def _train_mode(self, p: dict) -> None:
+        import jax
+
+        from tensorlink_tpu.models.quant import QTensor
+
         rt = self._runtime(p["job_id"])
+        quantized = any(
+            isinstance(l, QTensor)
+            for l in jax.tree.leaves(
+                rt.params, is_leaf=lambda x: isinstance(x, QTensor)
+            )
+        )
+        if bool(p.get("training", True)) and quantized:
+            raise ValueError(
+                "int8-quantized serving job cannot switch to training — "
+                "request the job with quant=None for fine-tuning"
+            )
         rt.training = bool(p.get("training", True))
         self._respond(
             p["peer"], proto.TRAIN_MODE_ACK, p["rid"],
