@@ -42,7 +42,12 @@ def _bucket(value: int, buckets: Sequence[int]) -> int:
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig):
-    logits, cache = forward(params, tokens, cfg, cache=cache, attn_mask=attn_mask)
+    # flash_prefill is safe here and only here: the engine always prefills
+    # a FRESH cache (offset 0, right-padded buckets)
+    logits, cache = forward(
+        params, tokens, cfg, cache=cache, attn_mask=attn_mask,
+        flash_prefill=cfg.flash_attention,
+    )
     # logits of the last *real* token per row
     last = jnp.maximum(attn_mask.sum(-1) - 1, 0)
     return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
@@ -119,8 +124,21 @@ class GenerationEngine:
         seq_buckets: Sequence[int] = DEFAULT_SEQ_BUCKETS,
         batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
         cache_dtype=None,
+        quant: str | None = None,
     ):
         self.cfg = cfg
+        if quant == "int8":
+            # weight-only int8 serving: halves the per-token HBM parameter
+            # traffic that bounds B=1 decode (models/quant.py). Single-mesh
+            # only — the quantized tree has no partition-spec mapping.
+            if mesh is not None:
+                raise ValueError("int8 serving does not support a mesh yet")
+            from ..models.quant import quantize_params
+
+            params = quantize_params(params)
+        elif quant:
+            raise ValueError(f"unknown quant mode {quant!r}")
+        self.quant = quant
         self.params = params
         self.mesh = mesh
         self.cache_specs = cache_specs

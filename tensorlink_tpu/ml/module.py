@@ -47,6 +47,7 @@ class DistributedModel:
         parallelism: dict[str, int] | None = None,
         seed: int = 0,
         ckpt: str | None = None,
+        quant: str | None = None,  # "int8" = weight-only quantized serving
         start_session: bool = True,
         **node_kw,
     ):
@@ -70,6 +71,8 @@ class DistributedModel:
             self.model_spec = {"name": str(model)}
         if ckpt:
             self.model_spec["ckpt"] = ckpt
+        if quant:
+            self.model_spec["quant"] = quant
         self.model_spec["seed"] = seed
 
         self.spec = {

@@ -1,0 +1,115 @@
+"""Weight-only int8 quantization for serving.
+
+B=1 decode is HBM-bandwidth bound: every generated token streams all
+parameter bytes (BASELINE.md roofline). Storing matmul weights as int8 with
+per-output-channel scales halves those bytes; XLA fuses the int8→bf16
+upcast and the scale multiply into the matmul read, so the arithmetic stays
+on the MXU and the bandwidth roughly doubles. This is a serving-side
+transform — training and the checkpoint formats never see it (the reference
+has no quantization at all; this is a capability the TPU rebuild adds).
+
+``QTensor`` is a registered pytree, so a quantized parameter tree flows
+through ``lax.scan`` (stacked-layer slicing), jit, and donation untouched;
+the matmul entry points in models/transformer.py route through
+:func:`matmul` which dequantizes on the fly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QTensor:
+    """int8 weight + broadcastable scale; ``q * scale ≈ original``."""
+
+    q: jax.Array  # int8, original shape
+    scale: jax.Array  # f32, shape broadcastable to q (per out-channel)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def quantize_tensor(w: jax.Array) -> QTensor:
+    """Symmetric int8 reducing only the contraction axis (second-to-last):
+    a 2D ``[in, out]`` weight gets per-out-channel scales ``[1, out]``; a
+    layer-stacked ``[L, in, out]`` weight keeps per-(layer, out-channel)
+    scales ``[L, 1, out]`` — layer magnitudes differ too much to share."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=w.ndim - 2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize(t: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (t.q.astype(jnp.float32) * t.scale).astype(dtype)
+
+
+def matmul(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` where ``w`` may be a QTensor (dequantized on the fly —
+    XLA fuses the upcast+scale into the weight read) or a plain array."""
+    if isinstance(w, QTensor):
+        y = x @ w.q.astype(x.dtype)
+        # scale is [..., 1, out] (kept per out-channel); collapse the
+        # contracted axis so it broadcasts over x's leading dims
+        return y * jnp.squeeze(w.scale, axis=-2).astype(x.dtype)
+    return x @ w
+
+
+# Parameter-tree paths quantized for serving: the large matmul weights.
+# Norm scales, biases, and qk-norm vectors stay exact (tiny, and precision
+# there is cheap insurance).
+_QUANT_LEAF_NAMES = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router"}
+)
+
+
+def quantize_params(params: dict, *, min_size: int = 1 << 16) -> dict:
+    """Quantize the matmul weights of a parameter tree for serving.
+
+    Embeddings (gather-read, also the tied head — handled in the logits
+    matmul) and sub-``min_size`` leaves stay full precision. Layer-stacked
+    weights ``[L, in, out]`` keep per-(layer, out-channel) scales.
+    """
+
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            return {k: walk(v, k) for k, v in node.items()}
+        if (
+            name in _QUANT_LEAF_NAMES
+            and getattr(node, "ndim", 0) in (2, 3)  # MoE 4D einsum weights
+            and node.size >= min_size  # stay exact (einsum path, small win)
+        ):
+            return quantize_tensor(node)
+        return node
+
+    out = dict(walk(params))
+    if "lm_head" in params and getattr(params["lm_head"], "ndim", 0) == 2:
+        if params["lm_head"].size >= min_size:
+            out["lm_head"] = quantize_tensor(params["lm_head"])
+    return out
+
+
+def quantized_bytes(params: dict) -> int:
+    """Actual parameter bytes of a (possibly quantized) tree — the
+    numerator the decode roofline should use."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.nbytes if hasattr(leaf, "nbytes") else 0
+    return total
+
+
+__all__ = [
+    "QTensor", "dequantize", "matmul", "quantize_params", "quantize_tensor",
+    "quantized_bytes",
+]

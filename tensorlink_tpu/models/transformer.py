@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .base import KVCache, ModelConfig
+from .quant import matmul as _mm  # dequant-on-the-fly for int8 serving
 
 P = jax.sharding.PartitionSpec
 
@@ -214,16 +215,16 @@ def _mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
     if cfg.moe:
         return _moe_mlp(h, p, cfg)
     if cfg.mlp == "gated":
-        g = h @ p["w_gate"]
-        u = h @ p["w_up"]
+        g = _mm(h, p["w_gate"])
+        u = _mm(h, p["w_up"])
         if "b_gate" in p:
             g = g + p["b_gate"]
             u = u + p["b_up"]
-        out = _act(g, cfg.act) * u @ p["w_down"]
+        out = _mm(_act(g, cfg.act) * u, p["w_down"])
         if "b_down" in p:
             out = out + p["b_down"]
         return out
-    out = _act(h @ p["w_up"] + p["b_up"], cfg.act) @ p["w_down"] + p["b_down"]
+    out = _mm(_act(_mm(h, p["w_up"]) + p["b_up"], cfg.act), p["w_down"]) + p["b_down"]
     return out
 
 
@@ -243,7 +244,7 @@ def _moe_mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
         return sparse_moe_mlp(h, p, cfg)
     B, T, d = h.shape
     E, K = cfg.n_experts, cfg.n_experts_per_tok
-    router_logits = (h @ p["router"]).astype(jnp.float32)  # [B, T, E]
+    router_logits = _mm(h, p["router"]).astype(jnp.float32)  # [B, T, E]
     topw, topi = lax.top_k(router_logits, K)
     topw = jax.nn.softmax(topw, axis=-1)  # normalize over selected experts
     gates = jnp.zeros_like(router_logits).at[
@@ -273,9 +274,9 @@ def _block(
     post = cfg.norm_position == "post"  # OLMo-2: norm the sublayer output
     h = x if post else _norm(x, lp["ln1"], cfg)
     ap = lp["attn"]
-    q = h @ ap["wq"]
-    k = h @ ap["wk"]
-    v = h @ ap["wv"]
+    q = _mm(h, ap["wq"])
+    k = _mm(h, ap["wk"])
+    v = _mm(h, ap["wv"])
     if "bq" in ap:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
     if cfg.qk_norm_full:  # OLMo-2: full-projection-dim RMSNorm pre-reshape
@@ -313,7 +314,7 @@ def _block(
     scale = cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
     impl = attn_fn or attention
     attn_out = impl(q, k_all.astype(q.dtype), v_all.astype(q.dtype), mask_bias, scale)
-    attn_out = attn_out.reshape(B, T, cfg.q_dim) @ ap["wo"]
+    attn_out = _mm(attn_out.reshape(B, T, cfg.q_dim), ap["wo"])
     if "bo" in ap:
         attn_out = attn_out + ap["bo"]
     if post:  # OLMo-2: ln1 == post_attention, ln2 == post_feedforward
@@ -351,7 +352,10 @@ def _mask_bias(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "remat", "return_hidden", "seq_mesh", "seq_axis"),
+    static_argnames=(
+        "cfg", "remat", "return_hidden", "seq_mesh", "seq_axis",
+        "flash_prefill",
+    ),
 )
 def forward(
     params: dict,
@@ -364,6 +368,10 @@ def forward(
     return_hidden: bool = False,
     seq_mesh=None,  # Mesh with a ring axis → sequence-parallel attention
     seq_axis: str = "seq",
+    # static promise that the cache is FRESH (offset 0) — lets the serving
+    # engine's prefill route attention through the Pallas flash kernel
+    # when cfg.flash_attention is set (ops/attention.py)
+    flash_prefill: bool = False,
 ):
     """Full forward. Returns ``(logits, new_cache)``.
 
@@ -381,13 +389,13 @@ def forward(
         x, new_cache = _stage_impl(
             params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
             positions=positions, first=True, last=False, remat=remat,
-            seq_mesh=seq_mesh, seq_axis=seq_axis,
+            seq_mesh=seq_mesh, seq_axis=seq_axis, flash_prefill=flash_prefill,
         )
         return _norm(x, params["final_norm"], cfg), new_cache
     return _stage_impl(
         params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
         positions=positions, first=True, last=True, remat=remat,
-        seq_mesh=seq_mesh, seq_axis=seq_axis,
+        seq_mesh=seq_mesh, seq_axis=seq_axis, flash_prefill=flash_prefill,
     )
 
 
@@ -395,7 +403,7 @@ def _logits(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["tok"].T.astype(cfg.dtype)
     else:
-        logits = x @ params["lm_head"]
+        logits = _mm(x, params["lm_head"])
     if cfg.logit_cap is not None:
         logits = cfg.logit_cap * jnp.tanh(logits / cfg.logit_cap)
     return logits
@@ -468,8 +476,33 @@ def _stage_impl(
     remat: bool,
     seq_mesh=None,
     seq_axis: str = "seq",
+    flash_prefill: bool = False,
 ):
     attn_fn = None
+    T_in = tokens.shape[1] if tokens is not None else (
+        hidden.shape[1] if hidden is not None else 1
+    )
+    if (
+        flash_prefill
+        and cfg.flash_attention
+        and cache is not None
+        and T_in > 1
+        and T_in % min(128, T_in) == 0  # irregular bucket -> einsum, not a
+        and cfg.sliding_window is None  # trace-time crash of serving
+        and seq_mesh is None
+    ):
+        from ..ops.attention import flash_attention
+
+        interp = jax.default_backend() == "cpu"  # tests run interpret mode
+        T_flash = T_in
+
+        def attn_fn(q, k_all, v_all, _bias, scale):
+            # fresh cache (offset 0): keys beyond T are zeros the causal
+            # mask would hide anyway — attend over the written prefix only
+            return flash_attention(
+                q, k_all[:, :T_flash], v_all[:, :T_flash],
+                scale=scale, interpret=interp,
+            )
     if seq_mesh is not None:
         if cache is not None:
             raise ValueError("sequence-parallel attention has no KV cache path")

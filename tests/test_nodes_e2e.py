@@ -108,6 +108,27 @@ def test_single_stage_generate_matches_local(cluster):
     assert seqs[0] == ref.sequences[0]
 
 
+def test_int8_quantized_serving(cluster):
+    """quant='int8' rides the job spec to the worker, which serves through
+    a weight-only-quantized engine (models/quant.py) — its greedy decode
+    must match a local int8 engine exactly."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import init_params
+
+    cfg = tiny_cfg()
+    with DistributedModel(
+        cfg, node=cluster["user"], seed=7, seq_len=128, quant="int8"
+    ) as model:
+        prompt = [3, 14, 15, 92]
+        seqs = model.generate([prompt], max_new_tokens=8)
+
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    engine = GenerationEngine(cfg, params, max_seq_len=128, quant="int8")
+    ref = engine.generate_compiled([prompt], max_new_tokens=8)
+    assert seqs[0] == ref.sequences[0]
+
+
 def test_streaming_generate(cluster):
     from tensorlink_tpu.ml.module import DistributedModel
 

@@ -1,0 +1,116 @@
+"""Weight-only int8 serving (models/quant.py) — a capability the reference
+lacks entirely: halves decode's HBM parameter traffic (the B=1 roofline
+bound, BASELINE.md) at bounded accuracy cost."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.engine.generate import GenerationEngine
+from tensorlink_tpu.engine.sampling import SamplingParams
+from tensorlink_tpu.models import ModelConfig, forward, init_params
+from tensorlink_tpu.models.quant import (
+    QTensor, dequantize, matmul, quantize_params, quantize_tensor,
+    quantized_bytes,
+)
+
+
+def tiny_cfg(**kw):
+    return ModelConfig(
+        family="llama", vocab_size=512, d_model=64, n_layers=3, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq_len=128,
+        dtype=jnp.float32, tie_embeddings=False, **kw,
+    )
+
+
+def test_quantize_roundtrip_error():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 128)
+    err = np.abs(np.asarray(dequantize(qt, jnp.float32)) - np.asarray(w))
+    # symmetric int8: error bounded by scale/2 per channel
+    assert float(err.max()) <= float(np.asarray(qt.scale).max()) * 0.51
+
+
+def test_stacked_weights_keep_per_layer_scales():
+    k = jax.random.PRNGKey(1)
+    w = jax.random.normal(k, (3, 32, 64), jnp.float32)
+    w = w * jnp.asarray([1.0, 10.0, 0.1])[:, None, None]  # layer magnitudes
+    qt = quantize_tensor(w)
+    assert qt.scale.shape == (3, 1, 64)
+    for layer in range(3):
+        got = np.asarray(dequantize(QTensor(qt.q[layer], qt.scale[layer]),
+                                    jnp.float32))
+        np.testing.assert_allclose(got, np.asarray(w[layer]), atol=0.08
+                                   * float(np.abs(w[layer]).max()))
+
+
+def test_matmul_matches_dequantized():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(k1, (4, 64), jnp.float32)
+    w = jax.random.normal(k2, (64, 96), jnp.float32)
+    qt = quantize_tensor(w)
+    np.testing.assert_allclose(
+        np.asarray(matmul(x, qt)),
+        np.asarray(x @ dequantize(qt, jnp.float32)),
+        rtol=1e-5, atol=1e-5,
+    )
+    # plain arrays pass through untouched
+    np.testing.assert_allclose(np.asarray(matmul(x, w)), np.asarray(x @ w))
+
+
+def test_quantized_forward_close_and_halved():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    qparams = quantize_params(params, min_size=0)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    ref, _ = forward(params, toks, cfg)
+    got, _ = forward(qparams, toks, cfg)
+    ref, got = np.asarray(ref, np.float64), np.asarray(got, np.float64)
+    # logits track closely; greedy argmax agrees on the vast majority
+    cos = (ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got))
+    assert cos > 0.999
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree > 0.9, agree
+    # matmul weights halved (embeddings stay exact)
+    assert quantized_bytes(qparams) < 0.65 * quantized_bytes(params)
+
+
+def test_engine_int8_decode():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    prompts = [[5, 9, 2, 7]]
+    kw = dict(seq_buckets=(16, 64), batch_buckets=(1,), max_seq_len=64)
+    ref = GenerationEngine(cfg, params, **kw).generate_compiled(
+        prompts, max_new_tokens=12, sampling=SamplingParams.make())
+    q = GenerationEngine(cfg, params, quant="int8", **kw).generate_compiled(
+        prompts, max_new_tokens=12, sampling=SamplingParams.make())
+    assert len(q.sequences[0]) == len(ref.sequences[0])
+    # greedy decode off random weights is chaotic under perturbation; the
+    # engine-level guarantee is that the int8 path runs the full compiled
+    # loop and emits valid tokens (accuracy is pinned above at logit level)
+    assert all(0 <= t < cfg.vocab_size for t in q.sequences[0])
+    with pytest.raises(ValueError):
+        GenerationEngine(cfg, params, quant="nf4", **kw)
+
+
+def test_quantized_moe_router_and_dense_mlp():
+    cfg = tiny_cfg(n_experts=4, n_experts_per_tok=2)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    qparams = quantize_params(params, min_size=0)
+    # 4D expert weights stay exact (einsum path), router may quantize
+    assert not isinstance(qparams["layers"]["mlp"]["w_gate"], QTensor)
+    toks = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    ref, _ = forward(params, toks, cfg)
+    got, _ = forward(qparams, toks, cfg)
+    cos = float(
+        (np.asarray(ref, np.float64) * np.asarray(got, np.float64)).sum()
+        / (np.linalg.norm(np.asarray(ref, np.float64))
+           * np.linalg.norm(np.asarray(got, np.float64)))
+    )
+    assert cos > 0.99
