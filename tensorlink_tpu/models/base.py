@@ -86,6 +86,10 @@ class ModelConfig:
     dtype: Any = jnp.bfloat16
     # Logit soft-capping (Gemma-style); None = off
     logit_cap: float | None = None
+    # Pallas flash-attention for the serving engine's fresh-cache prefill
+    # (ops/attention.py): blockwise online softmax, no [T, T] score tensor
+    # in HBM. Opt-in; decode and training keep the einsum path.
+    flash_attention: bool = False
 
     @property
     def q_dim(self) -> int:

@@ -543,18 +543,7 @@ class ValidatorServer(RoleServer):
                 # claiming optimizer steps while returning no entries is the
                 # trivial bypass of an "empty log passes" rule — flag it
                 ok, detail = False, {"reason": "empty-log-with-steps"}
-            flag_key = ""
-            if not ok:
-                # identity of the defect for once-per-segment penalties:
-                # the failing entry's hash when the verifier localized it,
-                # else the window's last hash
-                at = detail.get("at")
-                if isinstance(at, int) and 0 <= at < len(log):
-                    flag_key = str(log[at].get("hash", ""))
-                elif log:
-                    flag_key = str(log[-1].get("hash", ""))
-            return wid, {"ok": ok, **detail, "total_steps": total,
-                         "flag_key": flag_key}
+            return wid, {"ok": ok, **detail, "total_steps": total}
 
         results = await asyncio.gather(
             *(pull(w) for w in list(job.get("workers", {})))
@@ -563,11 +552,19 @@ class ValidatorServer(RoleServer):
         # SOFT_REASONS are liveness matters (busy worker timing out a pull,
         # shutdown-race error replies), not evidence of faked work — but a
         # worker that NEVER verifiably answers is opting out of PoL, so
-        # persistent softness escalates to one penalty per streak.
+        # persistent softness escalates to one penalty per streak. Hard
+        # verification failures are rate-limited per worker instead of
+        # keyed by chain position (position keys either collide forever —
+        # the empty-log faker pays once — or churn every pull as the window
+        # slides): one glitch costs one ding that decays, while a
+        # persistent cheat re-dings every cooldown and reaches the ban
+        # threshold in ~3 cooldowns.
         SOFT_REASONS = ("unreachable", "no-log")
         SOFT_STREAK_LIMIT = 5
-        flagged = job.setdefault("pol_flagged", {})  # wid -> last_hash dinged
+        PENALTY_COOLDOWN_S = 600.0
+        dinged = job.setdefault("pol_dinged", {})  # wid -> last penalty ts
         misses = job.setdefault("pol_misses", {})  # wid -> consecutive softs
+        now = time.time()
         for wid, v in verdicts.items():
             if v["ok"]:
                 misses.pop(wid, None)
@@ -579,13 +576,9 @@ class ValidatorServer(RoleServer):
                     misses[wid] = 0
             else:
                 misses.pop(wid, None)
-                # penalize each defective chain segment ONCE: the same bad
-                # entry stays inside the 32-entry window for many 60 s
-                # pulls, and re-dinging it every pull would escalate one
-                # glitch into a ban within minutes
-                if flagged.get(wid) != v.get("flag_key"):
+                if now - dinged.get(wid, 0.0) > PENALTY_COOLDOWN_S:
                     self.reputation.record(wid, "proof_failed")
-                    flagged[wid] = v.get("flag_key")
+                    dinged[wid] = now
             self.log.warning(
                 "job %s: PoL verification failed for %s: %s",
                 job_id[:8], wid[:8], v,

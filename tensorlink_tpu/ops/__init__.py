@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops.
+
+The compute path is mostly XLA-fused jit programs (models/transformer.py);
+kernels live here where hand-tiling beats the compiler — currently the
+flash-attention prefill (:mod:`.attention`). Kernels are opt-in
+(``ModelConfig.flash_attention``) and every one has an interpret-mode parity
+test against the einsum reference so correctness is pinned without TPU
+hardware in CI.
+"""
+
+from .attention import flash_attention
+
+__all__ = ["flash_attention"]

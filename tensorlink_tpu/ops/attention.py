@@ -1,0 +1,177 @@
+"""Flash-attention prefill kernel (Pallas/TPU).
+
+The einsum attention in models/transformer.py materializes the full
+``[B, H, T, S]`` score tensor in HBM — fine for decode (T=1) and short
+prefills, quadratic HBM traffic for long ones. This kernel computes
+attention blockwise with an online softmax so scores never leave VMEM:
+grid ``(batch·kv_head·group, q_blocks, k_blocks)`` with the k loop
+innermost, carrying running max/denominator/accumulator in VMEM scratch
+(the standard FlashAttention recurrence).
+
+Scope: **forward-only, causal, offset-0 prefill** — exactly the serving
+engine's fresh-cache prefill (engine/generate.py::_prefill). Training and
+decode keep the einsum path (training needs the vjp; decode is T=1).
+Right-padded prompt buckets are safe under pure causal masking: a padded
+key column can only be attended by a padded query row, whose logits are
+never read (the engine takes the last *real* row per prompt).
+
+GQA without KV repetition: queries reshape to ``[B·Hkv·G, T, hd]`` and the
+kernel's batch axis runs over (B, Hkv, G) while the k/v block specs index
+``b // G`` — repeated KV heads are never materialized, matching the einsum
+path's memory behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,  # [1, bq, hd]
+    k_ref,  # [1, bk, hd]
+    v_ref,  # [1, bk, hd]
+    o_ref,  # [1, bq, hd]
+    m_ref,  # [bq, 1] running max (VMEM scratch)
+    l_ref,  # [bq, 1] running denominator
+    acc_ref,  # [bq, hd] f32 accumulator
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    n_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: k blocks fully right of this q block's diagonal contribute
+    # nothing — skip their compute entirely
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        causal = k_pos <= q_pos
+        s = jnp.where(causal, s, NEG_INF)
+
+        m_prev = m_ref[:]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # rows with no attendable key yet keep m == NEG_INF; exp(0) there
+        # must not pollute the denominator
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(causal, jnp.exp(s - m_new), 0.0)  # [bq, bk]
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ki == n_k_blocks - 1)
+    def _finalize():
+        # under offset-0 causal masking every q row attends at least its
+        # own key, so l > 0; the floor only guards degenerate inputs
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k: jax.Array,  # [B, T, Hkv, hd]
+    v: jax.Array,  # [B, T, Hkv, hd]
+    *,
+    scale: float,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal offset-0 attention; returns ``[B, T, Hq, hd]``.
+
+    ``interpret=True`` runs the kernel in Pallas interpret mode (CPU) —
+    how the parity tests pin it without TPU hardware.
+    """
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"seq len {T} must divide block sizes ({block_q}, {block_k}) — "
+            "the engine's bucketed prefill shapes guarantee this"
+        )
+
+    # [B, T, Hq, hd] -> [(B Hkv G), T, hd]; kv -> [(B Hkv), T, hd]
+    qg = (
+        q.reshape(B, T, Hkv, G, hd)
+        .transpose(0, 2, 3, 1, 4)
+        .reshape(B * Hkv * G, T, hd)
+    )
+    kg = k.transpose(0, 2, 1, 3).reshape(B * Hkv, T, hd)
+    vg = v.transpose(0, 2, 1, 3).reshape(B * Hkv, T, hd)
+
+    n_q = T // block_q
+    n_k = T // block_k
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        n_k_blocks=n_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * Hkv * G, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j, G=G: (b // G, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, i, j, G=G: (b // G, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * Hkv * G, T, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qg, kg, vg)
+
+    return (
+        out.reshape(B, Hkv, G, T, hd)
+        .transpose(0, 3, 1, 2, 4)
+        .reshape(B, T, Hq, hd)
+    )
+
+
+__all__ = ["flash_attention"]
