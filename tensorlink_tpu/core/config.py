@@ -55,6 +55,9 @@ class MLConfig:
     # TPU-specific: padding buckets to bound XLA recompilation (SURVEY §7.3.5)
     seq_buckets: tuple[int, ...] = (128, 512, 1024, 2048, 4096)
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8)
+    # serving: how many concurrent API requests one batched decode may
+    # coalesce (ml/batching.py); bounded by the largest batch bucket
+    max_serve_batch: int = 8
     # validator: host DEFAULT_CONFIG["default_models"] at startup (reference
     # auto-loads popular/default models, ml/validator.py:169-365); off by
     # default so local tests never pull multi-GB checkpoints

@@ -193,16 +193,20 @@ class GenerationEngine:
         eos_ids: Sequence[int] = (),
         seed: int = 0,
         stream_cb: Callable[[list[int | None]], None] | None = None,
+        budgets: Sequence[int] | None = None,
     ) -> GenerationResult:
         """Host-driven loop (supports per-token streaming callbacks).
 
         ``stream_cb`` receives, per step, one new token id per live row
-        (None for rows already finished)."""
+        (None for rows already finished). ``budgets`` caps rows
+        individually (the serving batcher mixes requests with different
+        max_new_tokens); rows at budget stop emitting and freeze."""
         sampling = sampling or SamplingParams.make()
         logits, cache, lens, B = self.prefill(prompts)
+        sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         n_rows = len(lens)
         room = self.max_seq_len - max(lens)
-        steps = min(max_new_tokens, room)
+        steps = min(max(budgets) if budgets else max_new_tokens, room)
         eos = np.asarray(list(eos_ids) or [-1], np.int32)
 
         key = jax.random.PRNGKey(seed)
@@ -220,6 +224,10 @@ class GenerationEngine:
                 else:
                     emitted.append(None)
             done |= np.isin(tok_host, eos)
+            if budgets:
+                for i in range(n_rows):
+                    if len(seqs[i]) >= budgets[i]:
+                        done[i] = True
             if stream_cb is not None:
                 stream_cb(emitted)
             if done[:n_rows].all() or step == steps - 1:
@@ -246,6 +254,7 @@ class GenerationEngine:
         """Entire token loop on device (lax.while_loop, EOS early-exit)."""
         sampling = sampling or SamplingParams.make()
         logits, cache, lens, B = self.prefill(prompts)
+        sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         room = self.max_seq_len - max(lens)
         total = min(max_new_tokens, room)  # same budget as generate()
         if total <= 0:

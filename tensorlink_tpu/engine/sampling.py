@@ -18,11 +18,15 @@ import jax.numpy as jnp
 @dataclass
 class SamplingParams:
     """Dynamic sampling knobs — pytree leaves so one compiled program serves
-    every request (no recompile per temperature change)."""
+    every request (no recompile per temperature change).
 
-    temperature: jax.Array  # f32 scalar; <=0 → greedy
-    top_k: jax.Array  # int32 scalar; 0 → disabled
-    top_p: jax.Array  # f32 scalar; >=1 → disabled
+    Leaves are scalars for a single request, or ``[B, 1]`` for a batched
+    mix of requests with different knobs (the serving batcher,
+    ml/batching.py) — :func:`sample` broadcasts either shape."""
+
+    temperature: jax.Array  # f32; <=0 → greedy
+    top_k: jax.Array  # int32; 0 → disabled
+    top_p: jax.Array  # f32; >=1 → disabled
 
     @classmethod
     def make(cls, temperature=0.0, top_k=0, top_p=1.0) -> "SamplingParams":
@@ -30,6 +34,42 @@ class SamplingParams:
             temperature=jnp.float32(temperature),
             top_k=jnp.int32(top_k),
             top_p=jnp.float32(top_p),
+        )
+
+    def pad_rows(self, batch: int) -> "SamplingParams":
+        """Pad per-row leaves to the engine's bucketed batch size (extra
+        rows decode greedily); scalar leaves pass through untouched."""
+        if jnp.asarray(self.temperature).ndim == 0:
+            return self
+        n = jnp.asarray(self.temperature).reshape(-1).shape[0]
+        if n == batch:
+            return self
+
+        def pad(leaf, fill, dtype):
+            flat = jnp.asarray(leaf, dtype).reshape(-1)
+            return jnp.concatenate(
+                [flat, jnp.full((batch - n,), fill, dtype)]
+            )[:, None]
+
+        return SamplingParams(
+            temperature=pad(self.temperature, 0.0, jnp.float32),
+            top_k=pad(self.top_k, 0, jnp.int32),
+            top_p=pad(self.top_p, 1.0, jnp.float32),
+        )
+
+    @classmethod
+    def stack(cls, params: "list[SamplingParams]", pad_to: int) -> "SamplingParams":
+        """Per-row knobs for a batched generate; rows past ``len(params)``
+        (bucket padding) decode greedily."""
+        def col(attr, fill, dtype):
+            vals = [float(jnp.asarray(getattr(p, attr))) for p in params]
+            vals += [fill] * (pad_to - len(vals))
+            return jnp.asarray(vals, dtype)[:, None]  # [B, 1]
+
+        return cls(
+            temperature=col("temperature", 0.0, jnp.float32),
+            top_k=col("top_k", 0, jnp.int32),
+            top_p=col("top_p", 1.0, jnp.float32),
         )
 
 
@@ -52,29 +92,38 @@ def sample(
     sample) and every host-driven decode step, and was the dominant term in
     the round-2 decode benchmark (25 tok/s vs 101 roofline). Inside an
     enclosing jit the wrapper inlines and changes nothing.
+
+    Scalar knobs apply to every row (with an all-greedy fast path that
+    skips the vocab argsort); ``[B, 1]`` knobs mix per-row settings in one
+    batch and select greedy/sampled per row.
     """
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
+    temp = jnp.broadcast_to(jnp.atleast_1d(p.temperature).reshape(-1, 1), (B, 1))
+    top_k = jnp.broadcast_to(jnp.atleast_1d(p.top_k).reshape(-1, 1), (B, 1))
+    top_p = jnp.broadcast_to(jnp.atleast_1d(p.top_p).reshape(-1, 1), (B, 1))
 
     def sampled(_):
-        scaled = logits / jnp.maximum(p.temperature, 1e-6)
+        scaled = logits / jnp.maximum(temp, 1e-6)
         sort_idx = jnp.argsort(-scaled, axis=-1)
         sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
         ranks = jnp.arange(V)[None, :]
         # top-k: keep ranks < k (k==0 → keep all)
-        k = jnp.where(p.top_k > 0, p.top_k, V)
+        k = jnp.where(top_k > 0, top_k, V)
         keep = ranks < k
         # top-p: keep the smallest prefix with cumulative prob >= p
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        keep &= (cum - probs) < p.top_p
+        keep &= (cum - probs) < top_p
         masked = jnp.where(keep, sorted_logits, -jnp.inf)
         choice = jax.random.categorical(key, masked, axis=-1)  # [B]
-        return jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0]
+        picks = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0]
+        # per-row greedy/sampled selection for mixed batches
+        return jnp.where(temp[:, 0] > 0.0, picks, logits.argmax(-1))
 
     def greedy(_):
         return logits.argmax(-1)
 
-    return jax.lax.cond(p.temperature > 0.0, sampled, greedy, None).astype(
+    return jax.lax.cond(temp.max() > 0.0, sampled, greedy, None).astype(
         jnp.int32
     )

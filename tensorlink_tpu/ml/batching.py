@@ -1,0 +1,187 @@
+"""Dynamic request batching for the serving path.
+
+The reference serializes generation per hosted model (one request at a
+time through HF ``generate()``); here concurrent API requests coalesce
+into ONE batched decode: the engine's batch buckets already compile
+programs for B ∈ {1, 2, 4, 8}, and a batched decode step costs the same
+HBM parameter stream as a B=1 step — so batching N requests multiplies
+serving throughput by ~N until the MXU, not bandwidth, binds.
+
+Mechanics: requests enqueue; the dispatcher takes the head request, waits
+a short window for more, then issues one ``model.generate`` with per-row
+sampling knobs (SamplingParams.stack) and per-row budgets, demuxing the
+per-row stream callback back to each request. Pipelined (multi-stage)
+jobs fall back to batch size 1 — their session decode samples host-side
+per call — preserving strict request order either way.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class _Pending:
+    ids: list[int]
+    max_new_tokens: int
+    temperature: float
+    top_k: int
+    top_p: float
+    done: threading.Event = field(default_factory=threading.Event)
+    stream_cb: Callable[[list[int]], None] | None = None
+    result: list[int] | None = None
+    error: BaseException | None = None
+
+
+class GenBatcher:
+    """One per hosted model; owns the model's generation serialization."""
+
+    def __init__(
+        self,
+        model: Any,  # DistributedModel (or anything with .generate/.plan)
+        eos_ids: list[int],
+        *,
+        max_batch: int = 8,
+        window_s: float = 0.01,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.eos_ids = list(eos_ids)
+        plan = getattr(model, "plan", None)
+        single_stage = plan is None or plan.n_stages == 1
+        self.max_batch = max_batch if single_stage else 1
+        self.window_s = window_s
+        self.seed = seed
+        self._q: queue.Queue[_Pending | None] = queue.Queue()
+        self._seq = 0
+        self._closed = False
+        self.batch_sizes: list[int] = []  # dispatch history (stats/tests)
+        self._thread = threading.Thread(
+            target=self._loop, name="gen-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side -----------------------------------------------------
+    def generate(
+        self,
+        ids: list[int],
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stream_cb: Callable[[list[int]], None] | None = None,
+        timeout: float = 600.0,
+    ) -> list[int]:
+        """Blocking submit; returns this request's generated ids.
+        ``stream_cb`` receives this request's new tokens as they decode."""
+        if self._closed:
+            raise RuntimeError("model is being unhosted")
+        req = _Pending(
+            ids=list(ids), max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), stream_cb=stream_cb,
+        )
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out in the batcher")
+        if req.error is not None:
+            raise req.error
+        return req.result or []
+
+    def close(self, timeout: float = 600.0) -> None:
+        """Serve everything already queued, then stop. Blocks until the
+        dispatcher drains (unhost must not tear the model down under an
+        in-flight batched decode); anything enqueued after the sentinel
+        (submit/close race) is failed fast rather than left hanging."""
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=timeout)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = RuntimeError("model is being unhosted")
+                req.done.set()
+
+    # -- dispatcher ------------------------------------------------------
+    def _take_batch(self) -> list[_Pending] | None:
+        head = self._q.get()
+        if head is None:
+            return None
+        batch = [head]
+        if self.max_batch > 1:
+            # bounded wait: collect whatever arrives in the window
+            t0 = time.monotonic()
+            while len(batch) < self.max_batch:
+                remaining = self.window_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)  # re-post the shutdown sentinel
+                    break
+                batch.append(nxt)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._run(batch)
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for r in batch:
+                    r.error = e
+                    r.done.set()
+
+    def _run(self, batch: list[_Pending]) -> None:
+        self.batch_sizes.append(len(batch))
+        budgets = [r.max_new_tokens for r in batch]
+        emitted_counts = [0] * len(batch)
+
+        def demux(emitted: list[int | None]) -> None:
+            for i, r in enumerate(batch):
+                if i < len(emitted) and emitted[i] is not None:
+                    if emitted_counts[i] < budgets[i] and r.stream_cb:
+                        r.stream_cb([int(emitted[i])])
+                    emitted_counts[i] += 1
+
+        any_stream = any(r.stream_cb for r in batch)
+        self._seq += 1
+        seqs = self.model.generate(
+            [r.ids for r in batch],
+            max_new_tokens=max(budgets),
+            temperature=[r.temperature for r in batch],
+            top_k=[r.top_k for r in batch],
+            top_p=[r.top_p for r in batch],
+            eos_ids=self.eos_ids,
+            seed=self.seed + self._seq,
+            stream_cb=demux if any_stream else None,
+            budgets=budgets,
+        ) if self.max_batch > 1 else self.model.generate(
+            [batch[0].ids],
+            max_new_tokens=budgets[0],
+            temperature=batch[0].temperature,
+            top_k=batch[0].top_k,
+            top_p=batch[0].top_p,
+            eos_ids=self.eos_ids,
+            seed=self.seed + self._seq,
+            stream_cb=demux if any_stream else None,
+        )
+        for i, r in enumerate(batch):
+            r.result = [int(t) for t in seqs[i][: budgets[i]]]
+            r.done.set()
+
+
+__all__ = ["GenBatcher"]

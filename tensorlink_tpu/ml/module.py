@@ -358,19 +358,32 @@ class DistributedModel:
         prompts: Sequence[Sequence[int]],
         *,
         max_new_tokens: int = 64,
-        temperature: float = 0.0,
-        top_k: int = 0,
-        top_p: float = 1.0,
+        temperature: float | Sequence[float] = 0.0,
+        top_k: int | Sequence[int] = 0,
+        top_p: float | Sequence[float] = 1.0,
         eos_ids: Sequence[int] = (),
         seed: int = 0,
-        stream_cb: Callable[[list[int]], None] | None = None,
+        stream_cb: Callable[[list[int | None]], None] | None = None,
+        budgets: Sequence[int] | None = None,
     ) -> list[list[int]]:
+        """``stream_cb`` receives, per decode step, one new token per row
+        (None for rows already finished) — the engine's contract. Sampling
+        knobs may be per-row sequences and ``budgets`` caps rows
+        individually (both used by the serving batcher, ml/batching.py, to
+        mix concurrent requests in one decode); single-stage jobs only."""
         assert self.plan is not None
         if self.plan.n_stages == 1:
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
-                stream_cb=stream_cb,
+                stream_cb=stream_cb, budgets=budgets,
+            )
+        if budgets or any(
+            isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
+        ):
+            raise ValueError(
+                "per-row sampling/budgets need a single-stage job (the "
+                "pipelined session decode samples host-side per call)"
             )
         return self._generate_pipelined(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -380,20 +393,24 @@ class DistributedModel:
 
     def _generate_remote(
         self, prompts, *, max_new_tokens, temperature, top_k, top_p,
-        eos_ids, seed, stream_cb,
+        eos_ids, seed, stream_cb, budgets=None,
     ) -> list[list[int]]:
         """Whole model on one worker → its compiled engine does the loop."""
         stage = self.plan.stages[0]
+        def _wire(v):
+            return list(v) if isinstance(v, (list, tuple)) else v
         body = {
             "job_id": self.job_id,
             "prompts": [list(map(int, p)) for p in prompts],
             "max_new_tokens": max_new_tokens,
-            "temperature": temperature,
-            "top_k": top_k,
-            "top_p": top_p,
+            "temperature": _wire(temperature),
+            "top_k": _wire(top_k),
+            "top_p": _wire(top_p),
             "eos_ids": list(eos_ids),
             "seed": seed,
         }
+        if budgets:
+            body["budgets"] = [int(b) for b in budgets]
         stream_id = None
         if stream_cb is not None:
             stream_id = secrets.token_hex(8)
@@ -416,6 +433,7 @@ class DistributedModel:
 
         t = threading.Thread(target=issue, daemon=True)
         t.start()
+        B = len(prompts)
         while True:
             tk = self.node.send_request(
                 "next_tokens",
@@ -423,7 +441,17 @@ class DistributedModel:
                 timeout=35.0,
             )
             if tk.get("tokens"):
-                stream_cb(list(tk["tokens"]))
+                # the worker streams (row, token) pairs; the relay buffer
+                # may merge several decode steps into one drain, so start a
+                # fresh emission whenever a row repeats
+                cur: dict[int, int] = {}
+                for r, tok in tk["tokens"]:
+                    if r in cur:
+                        stream_cb([cur.get(i) for i in range(B)])
+                        cur = {}
+                    cur[int(r)] = int(tok)
+                if cur:
+                    stream_cb([cur.get(i) for i in range(B)])
             if tk.get("done"):
                 break
             if tk.get("timeout") and not t.is_alive():
@@ -464,13 +492,15 @@ class DistributedModel:
         done = np.zeros(B, bool)
         tok = _sample_host(step_logits, temperature, rng, top_k=top_k, top_p=top_p)
         for step in range(max_new_tokens):
-            emitted = []
+            emitted: list[int | None] = []
             for i in range(B):
                 if not done[i]:
                     seqs[i].append(int(tok[i]))
                     emitted.append(int(tok[i]))
+                else:
+                    emitted.append(None)
                 done[i] |= int(tok[i]) in eos
-            if stream_cb is not None and emitted:
+            if stream_cb is not None and any(e is not None for e in emitted):
                 stream_cb(emitted)
             if done.all() or step == max_new_tokens - 1:
                 break

@@ -35,6 +35,9 @@ class HostedJob:
     error: str = ""
     t0: float = field(default_factory=time.time)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # dynamic request batching (ml/batching.py): concurrent API requests
+    # coalesce into one batched decode instead of queueing on the lock
+    batcher: Any = None
 
 
 class DistributedValidator:
@@ -299,6 +302,14 @@ class DistributedValidator:
                 self.log.warning("rollback of job %s failed", result["job_id"][:8])
             raise
         job.tokenizer = load_tokenizer(model_spec)
+        from tensorlink_tpu.ml.batching import GenBatcher
+
+        ml_cfg = self.node.config.ml
+        job.batcher = GenBatcher(
+            job.model, job.tokenizer.eos_ids,
+            # a batch can never exceed what the engine's buckets compile for
+            max_batch=min(ml_cfg.max_serve_batch, ml_cfg.batch_buckets[-1]),
+        )
         job.status = "ready"
         self.log.info("hosting %s ready (%d stages)", name, len(result["plan"]["stages"]))
 
@@ -309,6 +320,8 @@ class DistributedValidator:
             job = self.hosted.pop(name, None)
         if job is None:
             return False
+        if job.batcher is not None:
+            job.batcher.close()  # drain the dispatcher first
         if job.model is not None:
             with job.lock:  # let an in-flight generation finish first
                 job.model.shutdown()
@@ -387,11 +400,11 @@ class DistributedValidator:
             if delta:
                 on_delta(delta)
 
-        def stream_cb(new_tokens: list[int]) -> None:
+        def stream_cb(new_tokens: list[int | None]) -> None:
             nonlocal prefix_offset, read_offset
             if on_delta is None:
                 return
-            emitted_ids.extend(new_tokens)
+            emitted_ids.extend(t for t in new_tokens if t is not None)
             prefix_text = tok.decode(emitted_ids[prefix_offset:read_offset])
             new_text = tok.decode(emitted_ids[prefix_offset:])
             if len(new_text) > len(prefix_text) and not new_text.endswith("�"):
@@ -400,17 +413,29 @@ class DistributedValidator:
                 read_offset = len(emitted_ids)
                 _emit(delta)
 
-        with job.lock:  # serialize per-model generation
-            seqs = job.model.generate(
-                [ids],
+        if job.batcher is not None:
+            # concurrent requests coalesce into one batched decode
+            # (ml/batching.py); the batcher demuxes this request's tokens
+            out_ids = job.batcher.generate(
+                ids,
                 max_new_tokens=args["max_new_tokens"],
                 temperature=args["temperature"],
                 top_k=args["top_k"],
                 top_p=args["top_p"],
-                eos_ids=tok.eos_ids,
                 stream_cb=stream_cb if on_delta is not None else None,
             )
-        out_ids = seqs[0]
+        else:
+            with job.lock:  # serialize per-model generation
+                seqs = job.model.generate(
+                    [ids],
+                    max_new_tokens=args["max_new_tokens"],
+                    temperature=args["temperature"],
+                    top_k=args["top_k"],
+                    top_p=args["top_p"],
+                    eos_ids=tok.eos_ids,
+                    stream_cb=stream_cb if on_delta is not None else None,
+                )
+            out_ids = seqs[0]
         if on_delta is not None:
             # flush whatever the offset algorithm still holds (including a
             # trailing partial-UTF8 replacement char — the stream must match

@@ -782,22 +782,44 @@ class DistributedWorker:
         if rt.engine is None:
             raise ValueError("generate requires a whole-model stage")
         prompts = [list(map(int, row)) for row in p["prompts"]]
-        sampling = SamplingParams.make(
-            temperature=float(p.get("temperature", 0.0)),
-            top_k=int(p.get("top_k", 0)),
-            top_p=float(p.get("top_p", 1.0)),
+        knobs = (
+            p.get("temperature", 0.0), p.get("top_k", 0), p.get("top_p", 1.0)
         )
+        if any(isinstance(v, (list, tuple)) for v in knobs):
+            # batched request mix (ml/batching.py): per-row knobs. A scalar
+            # among sequences applies to every row.
+            n = len(prompts)
+
+            def rows(v):
+                return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+            per_row = [
+                SamplingParams.make(
+                    temperature=float(t), top_k=int(k), top_p=float(tp)
+                )
+                for t, k, tp in zip(*(rows(v) for v in knobs))
+            ]
+            sampling = SamplingParams.stack(per_row, pad_to=n)
+        else:
+            sampling = SamplingParams.make(
+                temperature=float(knobs[0]),
+                top_k=int(knobs[1]),
+                top_p=float(knobs[2]),
+            )
+        budgets = p.get("budgets")
         stream_id = p.get("stream")
         peer = p["peer"]
 
         def stream_cb(emitted):
-            toks = [t for t in emitted if t is not None]
-            if toks:
+            # (row, token) pairs keep attribution for batched streams; the
+            # driver reconstructs the per-row emission list
+            pairs = [[i, t] for i, t in enumerate(emitted) if t is not None]
+            if pairs:
                 # fire-and-forget: a blocking round-trip here would add a
                 # full IPC latency to every decode step
                 self.bridge.notify(
                     "send_token",
-                    {"peer": peer, "stream": stream_id, "tokens": toks},
+                    {"peer": peer, "stream": stream_id, "tokens": pairs},
                 )
 
         if stream_id:
@@ -808,13 +830,21 @@ class DistributedWorker:
                 eos_ids=p.get("eos_ids", ()),
                 seed=int(p.get("seed", 0)),
                 stream_cb=stream_cb,
+                budgets=budgets,
             )
             self.bridge.request(
                 "send_token",
                 {"peer": peer, "stream": stream_id, "tokens": [], "done": True},
             )
         else:
-            result = rt.engine.generate_compiled(
+            result = rt.engine.generate(
+                prompts,
+                max_new_tokens=int(p.get("max_new_tokens", 128)),
+                sampling=sampling,
+                eos_ids=p.get("eos_ids", ()),
+                seed=int(p.get("seed", 0)),
+                budgets=budgets,
+            ) if budgets else rt.engine.generate_compiled(
                 prompts,
                 max_new_tokens=int(p.get("max_new_tokens", 128)),
                 sampling=sampling,

@@ -132,6 +132,33 @@ def test_generate_simple(api_cluster):
     assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
 
 
+def test_concurrent_requests_batched(api_cluster):
+    """Concurrent /v1/generate requests complete correctly through the
+    dynamic batcher (ml/batching.py) — the reference would queue them
+    strictly serially behind one model lock."""
+    import threading
+
+    api = api_cluster.api
+    results: list[tuple[int, dict]] = []
+
+    def one(n):
+        results.append(_req(
+            api, "POST", "/v1/generate",
+            {"hf_name": MODEL, "message": f"req {n}", "max_new_tokens": 4 + n,
+             "do_sample": False},
+        ))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert len(results) == 3
+    for status, body in results:
+        assert status == 200, body
+        assert 0 < body["usage"]["completion_tokens"] <= 7
+
+
 def test_generate_openai_format(api_cluster):
     api = api_cluster.api
     status, body = _req(
