@@ -119,6 +119,15 @@ def _hbm_bytes(dev) -> int:
 
 def run_bench() -> None:
     import jax
+
+    # persistent compile cache: the 4B-class decode/train compiles take
+    # minutes over the tunneled chip; re-runs (driver retries, profiling
+    # sessions) should pay them once
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knob — compile fresh
     import jax.numpy as jnp
     import numpy as np
 

@@ -71,17 +71,21 @@ def _decode_loop(
     key,
     sampling: SamplingParams,
     eos_ids,  # int32 [n_eos] (pad with -1)
+    limits,  # int32 [B] — loop tokens allowed per row (after first_tok)
     cfg: ModelConfig,
     n_steps: int,
 ):
     """Fully on-device decode: while_loop with EOS early exit.
 
     Emits ``tokens [B, n_steps]`` (first_tok included at index 0's successor
-    position; i.e. tokens holds the *newly generated* tokens after first_tok).
+    position; i.e. tokens holds the *newly generated* tokens after
+    first_tok). ``limits`` freezes rows individually — batched requests mix
+    different budgets and different cache rooms without a host round-trip
+    per step.
     """
     B = first_tok.shape[0]
     tokens = jnp.zeros((B, n_steps), jnp.int32)
-    done0 = jnp.isin(first_tok, eos_ids)
+    done0 = jnp.isin(first_tok, eos_ids) | (limits <= 0)
 
     def cond(state):
         i, _, _, done, _, _ = state
@@ -93,7 +97,7 @@ def _decode_loop(
         key, sub = jax.random.split(key)
         nxt = sample(logits[:, 0], sub, sampling)
         nxt = jnp.where(done, tok, nxt)  # freeze finished rows
-        done = done | jnp.isin(nxt, eos_ids)
+        done = done | jnp.isin(nxt, eos_ids) | (i + 1 >= limits)
         tokens = tokens.at[:, i].set(nxt)
         return i + 1, nxt, cache, done, key, tokens
 
@@ -127,15 +131,20 @@ class GenerationEngine:
         quant: str | None = None,
     ):
         self.cfg = cfg
-        if quant == "int8":
+        self.cache_quant = False
+        if quant in ("int8", "int8+kv"):
             # weight-only int8 serving: halves the per-token HBM parameter
-            # traffic that bounds B=1 decode (models/quant.py). Single-mesh
-            # only — the quantized tree has no partition-spec mapping.
+            # traffic that bounds B=1 decode (models/quant.py). "+kv" also
+            # stores the KV cache int8 (halves the per-token cache stream
+            # that grows with context, and doubles servable context per
+            # HBM byte). Single-mesh only — the quantized tree has no
+            # partition-spec mapping.
             if mesh is not None:
                 raise ValueError("int8 serving does not support a mesh yet")
             from ..models.quant import quantize_params
 
             params = quantize_params(params)
+            self.cache_quant = quant == "int8+kv"
         elif quant:
             raise ValueError(f"unknown quant mode {quant!r}")
         self.quant = quant
@@ -154,7 +163,8 @@ class GenerationEngine:
     # -- cache ------------------------------------------------------------
     def new_cache(self, batch: int) -> KVCache:
         cache = KVCache.init(
-            self.cfg, batch, max_len=self.max_seq_len, dtype=self.cache_dtype
+            self.cfg, batch, max_len=self.max_seq_len, dtype=self.cache_dtype,
+            quantized=self.cache_quant,
         )
         if self.mesh is not None and self.cache_specs is not None:
             cache = jax.tree.map(
@@ -200,13 +210,14 @@ class GenerationEngine:
         ``stream_cb`` receives, per step, one new token id per live row
         (None for rows already finished). ``budgets`` caps rows
         individually (the serving batcher mixes requests with different
-        max_new_tokens); rows at budget stop emitting and freeze."""
+        max_new_tokens); each row is limited by its OWN budget and cache
+        room, so a long-prompt neighbor never truncates a short one."""
         sampling = sampling or SamplingParams.make()
         logits, cache, lens, B = self.prefill(prompts)
         sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         n_rows = len(lens)
-        room = self.max_seq_len - max(lens)
-        steps = min(max(budgets) if budgets else max_new_tokens, room)
+        eff = self._row_limits(lens, B, max_new_tokens, budgets)
+        steps = max(eff)
         eos = np.asarray(list(eos_ids) or [-1], np.int32)
 
         key = jax.random.PRNGKey(seed)
@@ -214,6 +225,9 @@ class GenerationEngine:
         tok = sample(logits, sub, sampling)
         seqs: list[list[int]] = [[] for _ in range(n_rows)]
         done = np.zeros(B, bool)
+        for i in range(B):
+            if eff[i] <= 0:
+                done[i] = True
         for step in range(steps):
             tok_host = np.asarray(tok)
             emitted: list[int | None] = []
@@ -224,10 +238,9 @@ class GenerationEngine:
                 else:
                     emitted.append(None)
             done |= np.isin(tok_host, eos)
-            if budgets:
-                for i in range(n_rows):
-                    if len(seqs[i]) >= budgets[i]:
-                        done[i] = True
+            for i in range(n_rows):
+                if len(seqs[i]) >= eff[i]:
+                    done[i] = True
             if stream_cb is not None:
                 stream_cb(emitted)
             if done[:n_rows].all() or step == steps - 1:
@@ -242,6 +255,24 @@ class GenerationEngine:
         )
 
     # -- fully-compiled API (throughput / bench) --------------------------
+    def _row_limits(
+        self,
+        lens: list[int],
+        B: int,
+        max_new_tokens: int,
+        budgets: Sequence[int] | None,
+    ) -> list[int]:
+        """Per-row total-token limits: each row is capped by its OWN budget
+        and its OWN cache room — co-batching a long-prompt request must not
+        truncate a short-prompt neighbor (and a row at its room must freeze
+        so neighbors can continue without overrunning its cache slots)."""
+        eff = []
+        for i in range(len(lens)):
+            want = int(budgets[i]) if budgets else max_new_tokens
+            eff.append(max(min(want, self.max_seq_len - lens[i]), 0))
+        eff += [0] * (B - len(lens))  # bucket-pad rows freeze immediately
+        return eff
+
     def generate_compiled(
         self,
         prompts: Iterable[Sequence[int]],
@@ -250,13 +281,16 @@ class GenerationEngine:
         sampling: SamplingParams | None = None,
         eos_ids: Sequence[int] = (),
         seed: int = 0,
+        budgets: Sequence[int] | None = None,
     ) -> GenerationResult:
-        """Entire token loop on device (lax.while_loop, EOS early-exit)."""
+        """Entire token loop on device (lax.while_loop, EOS early-exit).
+        ``budgets`` caps rows individually (batched request mixes) with no
+        host round-trips — limits ride the compiled loop."""
         sampling = sampling or SamplingParams.make()
         logits, cache, lens, B = self.prefill(prompts)
         sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
-        room = self.max_seq_len - max(lens)
-        total = min(max_new_tokens, room)  # same budget as generate()
+        eff = self._row_limits(lens, B, max_new_tokens, budgets)
+        total = max(eff)
         if total <= 0:
             del cache
             return GenerationResult(
@@ -268,8 +302,10 @@ class GenerationEngine:
         key, sub = jax.random.split(key)
         first = sample(logits, sub, sampling)
         eos = jnp.asarray(list(eos_ids) or [-1], np.int32)
+        limits = jnp.asarray([e - 1 for e in eff], jnp.int32)  # after first
         tokens, cache, done, n_exec = _decode_loop(
-            self.params, first, cache, key, sampling, eos, self.cfg, total - 1
+            self.params, first, cache, key, sampling, eos, limits, self.cfg,
+            total - 1,
         )
         del cache
         toks = np.asarray(tokens)
@@ -280,9 +316,13 @@ class GenerationEngine:
         done_host = np.asarray(done)
         eos_set = set(int(e) for e in np.asarray(eos))
         for i in range(len(lens)):
+            if eff[i] <= 0:
+                out.append([])
+                fin.append(False)
+                continue
             row = [int(first_host[i])]
             if row[0] not in eos_set:
-                for t in toks[i, :n_exec]:
+                for t in toks[i, : min(n_exec, eff[i] - 1)]:
                     t = int(t)
                     row.append(t)
                     if t in eos_set:

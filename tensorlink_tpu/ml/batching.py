@@ -59,7 +59,10 @@ class GenBatcher:
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._seq = 0
         self._closed = False
-        self.batch_sizes: list[int] = []  # dispatch history (stats/tests)
+        self._submit_lock = threading.Lock()  # orders submits vs close()
+        from collections import deque
+
+        self.batch_sizes: deque[int] = deque(maxlen=1000)  # dispatch stats
         self._thread = threading.Thread(
             target=self._loop, name="gen-batcher", daemon=True
         )
@@ -79,14 +82,18 @@ class GenBatcher:
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode."""
-        if self._closed:
-            raise RuntimeError("model is being unhosted")
         req = _Pending(
             ids=list(ids), max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), stream_cb=stream_cb,
         )
-        self._q.put(req)
+        # check-and-put under the lock close() drains under — a submit
+        # racing close() must either land before the sentinel or fail fast,
+        # never sit in a dead queue until the timeout
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("model is being unhosted")
+            self._q.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out in the batcher")
         if req.error is not None:
@@ -98,8 +105,9 @@ class GenBatcher:
         dispatcher drains (unhost must not tear the model down under an
         in-flight batched decode); anything enqueued after the sentinel
         (submit/close race) is failed fast rather than left hanging."""
-        self._closed = True
-        self._q.put(None)
+        with self._submit_lock:
+            self._closed = True
+            self._q.put(None)
         self._thread.join(timeout=timeout)
         while True:
             try:

@@ -47,6 +47,7 @@ class StageRuntime:
     engine: Any = None  # GenerationEngine for whole-model jobs
     sessions: dict[str, Any] = field(default_factory=dict)  # session -> KVCache
     training: bool = False
+    cache_quant: bool = False  # int8 decode-session KV caches ("int8+kv")
     # activation store for cross-host backward: tag -> (bwd_key, inputs,
     # wrt_input) — the explicit replacement for torch's implicit autograd
     # graph the reference replays on the worker (ml/worker.py:233-291).
@@ -230,13 +231,15 @@ class DistributedWorker:
             params = self._shard_params(params, cfg, stage, mesh)
         training = bool(p.get("training", False))
         quant = p.get("model", {}).get("quant")
+        cache_quant = False
         if quant:
             # weight-only int8 serving (models/quant.py): quantize the
             # stage's matmul weights in place — every serving path
             # (stage_forward, the generation engine) dequantizes on the fly
-            # through quant.matmul. Training needs exact weights for the
+            # through quant.matmul. "+kv" also stores decode-session and
+            # engine KV caches int8. Training needs exact weights for the
             # optimizer, and a sharded tree has no QTensor partition specs.
-            if quant != "int8":
+            if quant not in ("int8", "int8+kv"):
                 # fail the MODULE load (the user sees the error) rather
                 # than silently serving a mode they didn't ask for
                 raise ValueError(f"unknown quant mode {quant!r}")
@@ -248,6 +251,7 @@ class DistributedWorker:
                 from tensorlink_tpu.models.quant import quantize_params
 
                 params = quantize_params(params)
+                cache_quant = quant == "int8+kv"
         rt = StageRuntime(
             job_id=job_id,
             cfg=cfg,
@@ -255,6 +259,7 @@ class DistributedWorker:
             params=params,
             mesh=mesh,
             training=training,
+            cache_quant=cache_quant,
         )
         if rt.whole_model:
             from tensorlink_tpu.engine.generate import GenerationEngine
@@ -272,6 +277,9 @@ class DistributedWorker:
                 max_seq_len=min(cfg.max_seq_len, ml_cfg.max_seq_len),
                 seq_buckets=ml_cfg.seq_buckets,
                 batch_buckets=ml_cfg.batch_buckets,
+                # params are pre-quantized above (idempotent); this sets
+                # the engine's cache mode for "+kv"
+                quant=quant if cache_quant else None,
             )
         with self._lock:
             self.jobs[job_id] = rt
@@ -560,7 +568,9 @@ class DistributedWorker:
                 batch = (kw.get("tokens") if first else kw["hidden"]).shape[0]
                 scfg = rt.cfg.with_(n_layers=rt.n_layers)
                 cache = KVCache.init(
-                    scfg, batch, max_len=int(p.get("cache_len", rt.cfg.max_seq_len))
+                    scfg, batch,
+                    max_len=int(p.get("cache_len", rt.cfg.max_seq_len)),
+                    quantized=rt.cache_quant,
                 )
                 if rt.mesh is not None:
                     from tensorlink_tpu.parallel.mesh import put
@@ -837,19 +847,16 @@ class DistributedWorker:
                 {"peer": peer, "stream": stream_id, "tokens": [], "done": True},
             )
         else:
-            result = rt.engine.generate(
+            # non-streaming always takes the fully-compiled loop — per-row
+            # budgets ride _decode_loop's limits, so batched mixes stay on
+            # device too
+            result = rt.engine.generate_compiled(
                 prompts,
                 max_new_tokens=int(p.get("max_new_tokens", 128)),
                 sampling=sampling,
                 eos_ids=p.get("eos_ids", ()),
                 seed=int(p.get("seed", 0)),
                 budgets=budgets,
-            ) if budgets else rt.engine.generate_compiled(
-                prompts,
-                max_new_tokens=int(p.get("max_new_tokens", 128)),
-                sampling=sampling,
-                eos_ids=p.get("eos_ids", ()),
-                seed=int(p.get("seed", 0)),
             )
         self._respond(
             peer, proto.GENERATE_RESP, p["rid"],

@@ -147,17 +147,43 @@ class KVCache:
 
     Stored stacked over layers so the decode ``lax.scan`` indexes its layer
     slice, and donated into the decode step so XLA updates it in place.
+
+    **int8 mode** (``quantized=True``): ``k``/``v`` hold int8 with
+    per-(layer, row, position, head) scales in ``k_scale``/``v_scale``
+    ``[L, B, S, n_kv, 1]`` — halves the per-token cache stream that grows
+    with context (the parameter stream is fixed; at 32k context the KV
+    read rivals it) and doubles the servable context per HBM byte.
+    Attention dequantizes on read; writes quantize each step's keys
+    (models/transformer.py::_block).
     """
 
     k: jax.Array
     v: jax.Array
     length: jax.Array  # int32 [B]
+    k_scale: jax.Array | None = None  # f32, present in int8 mode
+    v_scale: jax.Array | None = None
 
     @classmethod
-    def init(cls, cfg: ModelConfig, batch: int, max_len: int | None = None, dtype=None):
+    def init(
+        cls,
+        cfg: ModelConfig,
+        batch: int,
+        max_len: int | None = None,
+        dtype=None,
+        quantized: bool = False,
+    ):
         S = max_len or cfg.max_seq_len
-        dt = dtype or cfg.dtype
         shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+        if quantized:
+            sshape = shape[:-1] + (1,)
+            return cls(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                length=jnp.zeros((batch,), jnp.int32),
+                k_scale=jnp.zeros(sshape, jnp.float32),
+                v_scale=jnp.zeros(sshape, jnp.float32),
+            )
+        dt = dtype or cfg.dtype
         return cls(
             k=jnp.zeros(shape, dt),
             v=jnp.zeros(shape, dt),
@@ -168,16 +194,29 @@ class KVCache:
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 # Wire format support: KV caches cross the P2P boundary when a job migrates
 # between workers (reference ships DynamicCache, ml/utils.py:587-603).
 serialization.register_struct(
     "tensorlink.KVCache",
     KVCache,
-    lambda c: {"k": c.k, "v": c.v, "length": c.length},
+    lambda c: {
+        "k": c.k, "v": c.v, "length": c.length,
+        **({"k_scale": c.k_scale, "v_scale": c.v_scale} if c.quantized else {}),
+    },
     lambda t: KVCache(
         k=jnp.asarray(np.asarray(t["k"])),
         v=jnp.asarray(np.asarray(t["v"])),
         length=jnp.asarray(np.asarray(t["length"])),
+        k_scale=(
+            jnp.asarray(np.asarray(t["k_scale"])) if "k_scale" in t else None
+        ),
+        v_scale=(
+            jnp.asarray(np.asarray(t["v_scale"])) if "v_scale" in t else None
+        ),
     ),
 )

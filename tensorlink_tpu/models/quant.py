@@ -85,6 +85,8 @@ def quantize_params(params: dict, *, min_size: int = 1 << 16) -> dict:
     def walk(node, name=""):
         if isinstance(node, dict):
             return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, QTensor):  # idempotent on already-quantized trees
+            return node
         if (
             name in _QUANT_LEAF_NAMES
             and getattr(node, "ndim", 0) in (2, 3)  # MoE 4D einsum weights
@@ -94,9 +96,14 @@ def quantize_params(params: dict, *, min_size: int = 1 << 16) -> dict:
         return node
 
     out = dict(walk(params))
-    if "lm_head" in params and getattr(params["lm_head"], "ndim", 0) == 2:
-        if params["lm_head"].size >= min_size:
-            out["lm_head"] = quantize_tensor(params["lm_head"])
+    head = params.get("lm_head")
+    if (
+        head is not None
+        and not isinstance(head, QTensor)
+        and getattr(head, "ndim", 0) == 2
+        and head.size >= min_size
+    ):
+        out["lm_head"] = quantize_tensor(head)
     return out
 
 

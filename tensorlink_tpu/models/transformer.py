@@ -258,6 +258,15 @@ def _moe_mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum("bted,bte->btd", y, gates.astype(h.dtype))
 
 
+def _quant_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over head_dim: per-(row, position, head) scales —
+    the int8 KV-cache write path."""
+    tf = t.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(tf), axis=-1, keepdims=True), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(tf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _block(
     x: jax.Array,
     lp: dict,
@@ -265,8 +274,9 @@ def _block(
     cos: jax.Array | None,
     sin: jax.Array | None,
     mask_bias: jax.Array,
-    cache_k: jax.Array | None,  # [B, S, Hkv, hd] this layer's cache
-    cache_v: jax.Array | None,
+    # this layer's cache slice: None | (k, v) | (k, v, k_scale, v_scale)
+    # — the 4-tuple is the int8 cache (see KVCache int8 mode)
+    cache_kv: tuple | None,
     write_at: jax.Array | None,  # [B] int32 write offsets
     attn_fn=None,  # static override: (q, k, v, mask_bias, scale) -> out
 ):
@@ -301,13 +311,30 @@ def _block(
                 [apply_rope(k[..., :rd], cos, sin), k[..., rd:]], axis=-1
             )
 
-    if cache_k is not None:
+    new_cache_kv = cache_kv
+    if cache_kv is not None:
         upd = jax.vmap(
-            lambda c, u, o: lax.dynamic_update_slice(c, u, (o, 0, 0))
+            lambda c, u, o: lax.dynamic_update_slice(
+                c, u, (o,) + (0,) * (c.ndim - 1)
+            )
         )
-        cache_k = upd(cache_k, k.astype(cache_k.dtype), write_at)
-        cache_v = upd(cache_v, v.astype(cache_v.dtype), write_at)
-        k_all, v_all = cache_k, cache_v
+        if len(cache_kv) == 4:  # int8 cache: quantize writes, dequant reads
+            ck, cv, cks, cvs = cache_kv
+            k8, ks = _quant_kv(k)
+            v8, vs = _quant_kv(v)
+            ck = upd(ck, k8, write_at)
+            cv = upd(cv, v8, write_at)
+            cks = upd(cks, ks, write_at)
+            cvs = upd(cvs, vs, write_at)
+            k_all = (ck.astype(jnp.float32) * cks).astype(x.dtype)
+            v_all = (cv.astype(jnp.float32) * cvs).astype(x.dtype)
+            new_cache_kv = (ck, cv, cks, cvs)
+        else:
+            ck, cv = cache_kv
+            ck = upd(ck, k.astype(ck.dtype), write_at)
+            cv = upd(cv, v.astype(cv.dtype), write_at)
+            k_all, v_all = ck, cv
+            new_cache_kv = (ck, cv)
     else:
         k_all, v_all = k, v
 
@@ -325,7 +352,7 @@ def _block(
     else:
         x = x + attn_out
         x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
-    return x, cache_k, cache_v
+    return x, new_cache_kv
 
 
 # ---------------------------------------------------------------------------
@@ -561,32 +588,38 @@ def _stage_impl(
         block = jax.checkpoint(
             _block,
             policy=jax.checkpoint_policies.nothing_saveable,
-            static_argnums=(2, 9),
+            static_argnums=(2, 8),  # cfg, attn_fn
         )
 
     layers = params.get("layers")
     new_cache = cache
     if layers is not None:
         if cache is not None:
+            arrays = (cache.k, cache.v)
+            if cache.quantized:
+                arrays += (cache.k_scale, cache.v_scale)
 
             def scan_fn(carry, xs):
-                lp, ck, cv = xs
-                y, ck, cv = block(
-                    carry, lp, cfg, cos, sin, bias, ck, cv, offset, attn_fn
+                lp = xs[0]
+                y, ckv = block(
+                    carry, lp, cfg, cos, sin, bias, tuple(xs[1:]), offset,
+                    attn_fn,
                 )
-                return y, (ck, cv)
+                return y, ckv
 
-            x, (new_k, new_v) = lax.scan(scan_fn, x, (layers, cache.k, cache.v))
+            x, outs = lax.scan(scan_fn, x, (layers,) + arrays)
             new_cache = KVCache(
-                k=new_k,
-                v=new_v,
+                k=outs[0],
+                v=outs[1],
                 length=offset + attn_mask.sum(-1).astype(jnp.int32),
+                k_scale=outs[2] if cache.quantized else None,
+                v_scale=outs[3] if cache.quantized else None,
             )
         else:
 
             def scan_fn(carry, lp):
-                y, _, _ = block(
-                    carry, lp, cfg, cos, sin, bias, None, None, None, attn_fn
+                y, _ = block(
+                    carry, lp, cfg, cos, sin, bias, None, None, attn_fn
                 )
                 return y, None
 
@@ -712,11 +745,17 @@ def partition_specs(
     return specs
 
 
-def cache_specs(cfg: ModelConfig, *, data_axis="data", tensor_axis="tensor"):
+def cache_specs(
+    cfg: ModelConfig, *, data_axis="data", tensor_axis="tensor",
+    quantized: bool = False,
+):
     """KV cache sharding: batch on data, kv heads on tensor (when they
     divide; the planner degrades to replicated heads otherwise)."""
+    kv = P(None, data_axis, None, tensor_axis, None)
     return KVCache(
-        k=P(None, data_axis, None, tensor_axis, None),
-        v=P(None, data_axis, None, tensor_axis, None),
+        k=kv,
+        v=kv,
         length=P(data_axis),
+        k_scale=kv if quantized else None,
+        v_scale=kv if quantized else None,
     )

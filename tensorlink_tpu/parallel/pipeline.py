@@ -185,14 +185,14 @@ def pipelined_stage_forward(
         block = jax.checkpoint(
             _block,
             policy=jax.checkpoint_policies.nothing_saveable,
-            static_argnums=(2, 9),
+            static_argnums=(2, 8),  # cfg, attn_fn
         )
 
     def stage_fn(layer_slice, x_in):
         act, b = x_in
 
         def scan_fn(carry, lp):
-            y, _, _ = block(carry, lp, cfg, cos, sin, b, None, None, None, None)
+            y, _ = block(carry, lp, cfg, cos, sin, b, None, None, None)
             return y, None
 
         y, _ = lax.scan(scan_fn, act, layer_slice)

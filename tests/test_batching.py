@@ -65,6 +65,37 @@ def test_engine_per_row_budgets():
     )
     assert len(r.sequences[0]) == 3
     assert len(r.sequences[1]) == 9
+    # the fully-compiled loop honors the same per-row budgets on device
+    rc = eng.generate_compiled(
+        [[1, 2, 3], [4, 5]], max_new_tokens=16, budgets=[3, 9]
+    )
+    assert len(rc.sequences[0]) == 3
+    assert len(rc.sequences[1]) == 9
+
+
+def test_per_row_room_no_cross_truncation():
+    """A long-prompt request co-batched with a short one must not shrink
+    the short one's completion: each row is clamped by its OWN cache room
+    (pre-fix: steps were clamped by max(lens) for the whole batch)."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    eng = GenerationEngine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0)),
+        seq_buckets=(64,), batch_buckets=(2,), max_seq_len=64,
+    )
+    long_prompt = list(range(1, 61))  # room = 4
+    short_prompt = [1, 2, 3]  # room = 61
+    for gen_fn in (eng.generate, eng.generate_compiled):
+        r = gen_fn([long_prompt, short_prompt], max_new_tokens=50,
+                   budgets=[50, 20])
+        assert len(r.sequences[0]) == 4  # clamped by ITS room
+        assert len(r.sequences[1]) == 20  # full budget, not truncated
 
 
 # ---------------------------------------------------------------------------
@@ -146,7 +177,7 @@ def test_batcher_serial_when_idle_and_error_fanout():
     r1 = b.generate([7], max_new_tokens=2)
     r2 = b.generate([8], max_new_tokens=1)
     assert r1 == [700, 701] and r2 == [800]
-    assert b.batch_sizes == [1, 1]  # idle queue -> no artificial batching
+    assert list(b.batch_sizes) == [1, 1]  # idle queue -> no artificial batching
 
     class Boom(FakeModel):
         def generate(self, *a, **k):

@@ -99,6 +99,79 @@ def test_engine_int8_decode():
         GenerationEngine(cfg, params, quant="nf4", **kw)
 
 
+def test_int8_kv_cache_prefill_decode():
+    """int8 KV cache: prefill+decode logits stay close to the full-precision
+    cache path, the cache stores int8 + scales, and bytes roughly halve."""
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(6))
+    from tensorlink_tpu.models import forward
+    from tensorlink_tpu.models.base import KVCache
+
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(1, cfg.vocab_size, (2, 12)),
+        jnp.int32,
+    )
+    ref_cache = KVCache.init(cfg, 2, max_len=32)
+    q_cache = KVCache.init(cfg, 2, max_len=32, quantized=True)
+    assert q_cache.quantized and q_cache.k.dtype == jnp.int8
+    kv_bytes = lambda c: c.k.nbytes + c.v.nbytes + (
+        (c.k_scale.nbytes + c.v_scale.nbytes) if c.quantized else 0
+    )
+    # fp32 reference cache vs int8+scales: ~72% smaller here; vs the bf16
+    # cache real configs use it is ~47%
+    assert kv_bytes(q_cache) < 0.5 * kv_bytes(ref_cache)
+
+    ref_lg, ref_cache = forward(params, toks, cfg, cache=ref_cache)
+    q_lg, q_cache = forward(params, toks, cfg, cache=q_cache)
+    np.testing.assert_allclose(
+        np.asarray(q_lg), np.asarray(ref_lg), rtol=0.15, atol=0.08
+    )
+    # random-init logits are nearly flat, so near-ties may flip under int8
+    # noise — require strong (not perfect) argmax agreement
+    agree = (
+        np.asarray(ref_lg).argmax(-1) == np.asarray(q_lg).argmax(-1)
+    ).mean()
+    assert agree > 0.8, agree
+
+    # decode steps through the quantized cache track the reference
+    step = jnp.asarray([[7], [9]], jnp.int32)
+    ref_lg2, _ = forward(params, step, cfg, cache=ref_cache)
+    q_lg2, _ = forward(params, step, cfg, cache=q_cache)
+    np.testing.assert_allclose(
+        np.asarray(q_lg2), np.asarray(ref_lg2), rtol=0.2, atol=0.1
+    )
+
+
+def test_engine_int8_kv_mode():
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    prompts = [[5, 9, 2, 7]]
+    kw = dict(seq_buckets=(16, 64), batch_buckets=(1,), max_seq_len=64)
+    ref = GenerationEngine(cfg, params, **kw).generate_compiled(
+        prompts, max_new_tokens=12, sampling=SamplingParams.make())
+    q = GenerationEngine(cfg, params, quant="int8+kv", **kw)
+    assert q.cache_quant
+    r = q.generate_compiled(prompts, max_new_tokens=12,
+                            sampling=SamplingParams.make())
+    assert len(r.sequences[0]) == len(ref.sequences[0])
+    assert all(0 <= t < cfg.vocab_size for t in r.sequences[0])
+
+
+def test_kv_cache_serialization_roundtrip():
+    from tensorlink_tpu.core import serialization as ser
+    from tensorlink_tpu.models.base import KVCache
+
+    cfg = tiny_cfg()
+    c = KVCache.init(cfg, 1, max_len=8, quantized=True)
+    c2 = ser.decode(ser.encode(c))
+    assert c2.quantized
+    np.testing.assert_array_equal(np.asarray(c2.k), np.asarray(c.k))
+    np.testing.assert_array_equal(np.asarray(c2.k_scale), np.asarray(c.k_scale))
+    plain = KVCache.init(cfg, 1, max_len=8)
+    p2 = ser.decode(ser.encode(plain))
+    assert not p2.quantized
+
+
 def test_quantized_moe_router_and_dense_mlp():
     cfg = tiny_cfg(n_experts=4, n_experts_per_tok=2)
     params = init_params(cfg, jax.random.PRNGKey(5))
