@@ -296,16 +296,24 @@ class GenerationEngine:
             return GenerationResult(
                 sequences=[[] for _ in lens],
                 prompt_lens=lens,
-                finished=[False] * len(lens),
+                finished=[True] * len(lens),  # zero room = nothing left
             )
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         first = sample(logits, sub, sampling)
         eos = jnp.asarray(list(eos_ids) or [-1], np.int32)
         limits = jnp.asarray([e - 1 for e in eff], jnp.int32)  # after first
+        # n_steps is a STATIC arg of the compiled loop — bucket it to powers
+        # of two so a serving batcher's varying budget mixes reuse a handful
+        # of programs instead of compiling per distinct max(eff) (the loop
+        # exits early once every row hits its limit, so the padding is free)
+        n_steps = 1
+        while n_steps < total - 1:
+            n_steps <<= 1
+        n_steps = max(min(n_steps, self.max_seq_len), 1)
         tokens, cache, done, n_exec = _decode_loop(
             self.params, first, cache, key, sampling, eos, limits, self.cfg,
-            total - 1,
+            n_steps,
         )
         del cache
         toks = np.asarray(tokens)
@@ -318,7 +326,7 @@ class GenerationEngine:
         for i in range(len(lens)):
             if eff[i] <= 0:
                 out.append([])
-                fin.append(False)
+                fin.append(True)  # matches generate(): zero-room rows are done
                 continue
             row = [int(first_host[i])]
             if row[0] not in eos_set:

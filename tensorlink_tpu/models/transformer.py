@@ -515,20 +515,20 @@ def _stage_impl(
         and cache is not None
         and T_in > 1
         and T_in % min(128, T_in) == 0  # irregular bucket -> einsum, not a
-        and cfg.sliding_window is None  # trace-time crash of serving
-        and seq_mesh is None
+        and seq_mesh is None  # trace-time crash of serving
     ):
         from ..ops.attention import flash_attention
 
         interp = jax.default_backend() == "cpu"  # tests run interpret mode
         T_flash = T_in
+        win = cfg.sliding_window
 
         def attn_fn(q, k_all, v_all, _bias, scale):
             # fresh cache (offset 0): keys beyond T are zeros the causal
             # mask would hide anyway — attend over the written prefix only
             return flash_attention(
                 q, k_all[:, :T_flash], v_all[:, :T_flash],
-                scale=scale, interpret=interp,
+                scale=scale, interpret=interp, window=win,
             )
     if seq_mesh is not None:
         if cache is not None:

@@ -46,6 +46,7 @@ def _flash_kernel(
     block_q: int,
     block_k: int,
     n_k_blocks: int,
+    window: int | None,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -57,8 +58,14 @@ def _flash_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # causal: k blocks fully right of this q block's diagonal contribute
-    # nothing — skip their compute entirely
-    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    # nothing — skip their compute entirely. A sliding window also skips
+    # blocks fully left of the earliest visible position
+    # (k_pos > q_pos - window required).
+    in_reach = ki * block_k <= qi * block_q + block_q - 1
+    if window is not None:
+        in_reach &= ki * block_k + block_k - 1 > qi * block_q - window
+
+    @pl.when(in_reach)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
@@ -74,6 +81,8 @@ def _flash_kernel(
             jnp.int32, (block_q, block_k), 1
         )
         causal = k_pos <= q_pos
+        if window is not None:  # Mistral sliding window (models/base.py)
+            causal &= k_pos > q_pos - window
         s = jnp.where(causal, s, NEG_INF)
 
         m_prev = m_ref[:]  # [bq, 1]
@@ -100,7 +109,8 @@ def _flash_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_k", "interpret", "window"),
 )
 def flash_attention(
     q: jax.Array,  # [B, T, Hq, hd]
@@ -111,11 +121,15 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Causal offset-0 attention; returns ``[B, T, Hq, hd]``.
 
-    ``interpret=True`` runs the kernel in Pallas interpret mode (CPU) —
-    how the parity tests pin it without TPU hardware.
+    ``window`` applies Mistral-style sliding-window masking (position j
+    visible from i iff ``i - window < j <= i``); out-of-window k blocks
+    skip compute entirely. ``interpret=True`` runs the kernel in Pallas
+    interpret mode (CPU) — how the parity tests pin it without TPU
+    hardware.
     """
     B, T, Hq, hd = q.shape
     Hkv = k.shape[2]
@@ -145,6 +159,7 @@ def flash_attention(
         block_q=block_q,
         block_k=block_k,
         n_k_blocks=n_k,
+        window=int(window) if window is not None else None,
     )
     out = pl.pallas_call(
         kernel,

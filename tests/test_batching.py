@@ -73,6 +73,29 @@ def test_engine_per_row_budgets():
     assert len(rc.sequences[1]) == 9
 
 
+def test_zero_room_rows_report_finished_consistently():
+    """A prompt filling the whole context reports finished=True with an
+    empty completion on BOTH decode paths (they diverged once: streaming
+    said done, compiled said not)."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=32,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    eng = GenerationEngine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0)),
+        seq_buckets=(32,), batch_buckets=(1,), max_seq_len=32,
+    )
+    full = list(range(1, 33))  # room 0
+    for gen_fn in (eng.generate, eng.generate_compiled):
+        r = gen_fn([full], max_new_tokens=8)
+        assert r.sequences == [[]]
+        assert r.finished == [True]
+
+
 def test_per_row_room_no_cross_truncation():
     """A long-prompt request co-batched with a short one must not shrink
     the short one's completion: each row is clamped by its OWN cache room

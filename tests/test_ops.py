@@ -45,12 +45,56 @@ def test_flash_matches_einsum(B, T, Hq, Hkv, hd, bq, bk):
     )
 
 
+@pytest.mark.parametrize("window", [8, 64, 200])
+def test_flash_sliding_window_matches_einsum(window):
+    """Mistral-style sliding window: parity vs the einsum mask, including
+    windows smaller than / equal to / larger than the block size."""
+    B, T, Hq, Hkv, hd = 1, 128, 4, 2, 32
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(k1, (B, T, Hq, hd), jnp.float32)
+    k = jax.random.normal(k2, (B, T, Hkv, hd), jnp.float32)
+    v = jax.random.normal(k3, (B, T, Hkv, hd), jnp.float32)
+    scale = hd**-0.5
+    got = flash_attention(
+        q, k, v, scale=scale, block_q=32, block_k=32, interpret=True,
+        window=window,
+    )
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    bias = _mask_bias(pos, T, jnp.ones((B, T), bool), window)
+    ref = attention(q, k, v, bias, scale)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_flash_rejects_indivisible_seq():
     q = jnp.zeros((1, 100, 4, 32))
     k = v = jnp.zeros((1, 100, 2, 32))
     with pytest.raises(ValueError):
         flash_attention(q, k, v, scale=1.0, block_q=64, block_k=64,
                         interpret=True)
+
+
+def test_engine_flash_windowed_prefill_matches_dense():
+    """A sliding-window (mistral-style) config takes the flash path too."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.engine.sampling import SamplingParams
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="mistral", vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq_len=128,
+        dtype=jnp.float32, tie_embeddings=False, sliding_window=16,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    kw = dict(seq_buckets=(32, 128), batch_buckets=(1,), max_seq_len=128)
+    prompts = [list(range(1, 33))]  # one full bucket, window < prompt
+    greedy = SamplingParams.make()
+    dense = GenerationEngine(cfg, params, **kw)
+    flash = GenerationEngine(cfg.with_(flash_attention=True), params, **kw)
+    r_d = dense.generate_compiled(prompts, max_new_tokens=8, sampling=greedy)
+    r_f = flash.generate_compiled(prompts, max_new_tokens=8, sampling=greedy)
+    assert r_f.sequences == r_d.sequences
 
 
 def test_engine_flash_prefill_matches_dense():
