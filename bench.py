@@ -177,23 +177,25 @@ def run_bench() -> None:
     ]
     greedy = SamplingParams.make()
 
-    # warmup with the SAME max_new_tokens: _decode_loop's n_steps is a static
-    # jit arg, so a different step count would compile a different program
-    # and the timed run would pay compilation.
-    eng.generate_compiled(prompts, max_new_tokens=gen_tokens, sampling=greedy)
+    def timed_decode(engine, ps):
+        """Pure decode tokens/s: warm up with the SAME max_new_tokens
+        (_decode_loop's n_steps is static — a different count compiles a
+        different program), then measure end-to-end minus a warmed prefill.
+        Shared by the B=1 headline, the B=8, and the int8 measurements so
+        the timing protocol can't drift between them."""
+        engine.generate_compiled(ps, max_new_tokens=gen_tokens, sampling=greedy)
+        jax.block_until_ready(engine.prefill(ps)[:2])
+        t0 = time.perf_counter()
+        jax.block_until_ready(engine.prefill(ps)[:2])
+        prefill_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = engine.generate_compiled(
+            ps, max_new_tokens=gen_tokens, sampling=greedy
+        )
+        dt = max(time.perf_counter() - t0 - prefill_dt, 1e-9)
+        return sum(len(s) for s in r.sequences) / dt
 
-    # the metric is pure decode throughput, so measure the prefill share
-    # separately (warmed) and subtract it from the end-to-end time
-    jax.block_until_ready(eng.prefill(prompts)[:2])
-    t0 = time.perf_counter()
-    jax.block_until_ready(eng.prefill(prompts)[:2])
-    prefill_dt = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    r = eng.generate_compiled(prompts, max_new_tokens=gen_tokens, sampling=greedy)
-    dt = max(time.perf_counter() - t0 - prefill_dt, 1e-9)
-    n_tokens = sum(len(s) for s in r.sequences)
-    toks_per_s = n_tokens / dt
+    toks_per_s = timed_decode(eng, prompts)
 
     pbytes = cfg.param_count() * (2 if cfg.dtype == jnp.bfloat16 else 4)
     kv_per_tok = (
@@ -202,6 +204,33 @@ def run_bench() -> None:
     )
     avg_len = prompt_len + gen_tokens / 2
     roofline = hbm_bw / (pbytes + kv_per_tok * avg_len)
+
+    # ---- batched decode (serving batcher's regime; reported in extra) -----
+    # aggregate tokens/s at B=8: a batched step streams the same parameter
+    # bytes as B=1, so this shows the near-free ~8x the dynamic batcher
+    # (ml/batching.py) buys concurrent requests
+    batch_extra = {}
+    if on_tpu:
+        try:
+            B8 = 8
+            eng8 = GenerationEngine(
+                cfg, params,
+                seq_buckets=(prompt_len, prompt_len + gen_tokens),
+                batch_buckets=(B8,),
+                max_seq_len=prompt_len + gen_tokens,
+            )
+            prompts8 = [
+                rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                for _ in range(B8)
+            ]
+            tps8 = timed_decode(eng8, prompts8)
+            batch_extra = {
+                "batch8_toks_s": round(tps8, 2),
+                "batch8_speedup_vs_b1": round(tps8 / toks_per_s, 2),
+            }
+            del eng8
+        except Exception as e:
+            batch_extra = {"batch8_error": str(e)[:300]}
 
     # ---- int8 weight-only decode (same prompts; reported in extra) --------
     # halves the parameter stream that bounds B=1 decode — can beat the
@@ -216,28 +245,16 @@ def run_bench() -> None:
                 batch_buckets=(batch,),
                 max_seq_len=prompt_len + gen_tokens,
             )
-            qeng.generate_compiled(
-                prompts, max_new_tokens=gen_tokens, sampling=greedy
-            )  # compile
-            jax.block_until_ready(qeng.prefill(prompts)[:2])
-            t0 = time.perf_counter()
-            jax.block_until_ready(qeng.prefill(prompts)[:2])
-            q_prefill = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            qr = qeng.generate_compiled(
-                prompts, max_new_tokens=gen_tokens, sampling=greedy
-            )
-            qdt = max(time.perf_counter() - t0 - q_prefill, 1e-9)
-            qn = sum(len(s) for s in qr.sequences)
+            tps_q = timed_decode(qeng, prompts)
             from tensorlink_tpu.models.quant import quantized_bytes
 
             qbytes = quantized_bytes(qeng.params)
             q_roofline = hbm_bw / (qbytes + kv_per_tok * avg_len)
             int8_extra = {
-                "int8_toks_s": round(qn / qdt, 2),
+                "int8_toks_s": round(tps_q, 2),
                 "int8_param_bytes": qbytes,
-                "int8_vs_bf16_roofline": round(qn / qdt / roofline, 4),
-                "int8_vs_int8_roofline": round(qn / qdt / q_roofline, 4),
+                "int8_vs_bf16_roofline": round(tps_q / roofline, 4),
+                "int8_vs_int8_roofline": round(tps_q / q_roofline, 4),
             }
             del qeng
         except Exception as e:
@@ -252,6 +269,7 @@ def run_bench() -> None:
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", ""),
         "decode_roofline_toks_s": round(roofline, 2),
+        **batch_extra,
         **int8_extra,
     }
     try:

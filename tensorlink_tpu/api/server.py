@@ -212,6 +212,18 @@ class TensorlinkAPI:
                 return await self._send_json(writer, 200, {"status": "ok"})
             if path == "/models":
                 return await self._send_json(writer, 200, self._models())
+            if path == "/v1/models":
+                # OpenAI-compatible listing so off-the-shelf clients
+                # pointed at this endpoint can enumerate models
+                return await self._send_json(writer, 200, {
+                    "object": "list",
+                    "data": [
+                        {"id": j["name"], "object": "model",
+                         "owned_by": "tensorlink"}
+                        for j in self.executor.hosted_snapshot()
+                        if j.get("status") == "ready"
+                    ],
+                })
             if path == "/model-demand":
                 return await self._send_json(
                     writer, 200, {"demand": dict(self.executor.demand)}

@@ -53,6 +53,29 @@ def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig):
     return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
 
 
+@partial(
+    jax.jit, static_argnames=("cfg", "first"), donate_argnames=("cache",)
+)
+def _prefill_chunk(params, tokens, attn_mask, cache, cfg: ModelConfig, first):
+    """One chunk of a long-prompt prefill: returns the final-norm hidden
+    states (the vocab head runs ONCE at the end of chunking, not per
+    chunk) and the grown cache. Flash only on the first chunk (offset 0)."""
+    hidden, cache = forward(
+        params, tokens, cfg, cache=cache, attn_mask=attn_mask,
+        return_hidden=True,
+        flash_prefill=cfg.flash_attention and first,
+    )
+    return hidden, cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _head_from_hidden(params, hidden, cfg: ModelConfig):
+    from ..models.transformer import _logits
+
+    # hidden is already final-normed (forward(return_hidden=True))
+    return _logits(params, hidden[:, None], cfg)[:, 0]
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def _decode_step(params, tok, cache, cfg: ModelConfig):
     logits, cache = forward(params, tok[:, None], cfg, cache=cache)
@@ -179,20 +202,75 @@ class GenerationEngine:
     # -- host-driven API --------------------------------------------------
     def prefill(self, prompts: Iterable[Sequence[int]]):
         """Pad prompts into (batch, seq) buckets; returns
-        (last_logits [B,V], cache, prompt_lens, batch_pad)."""
+        (last_logits [B,V], cache, prompt_lens, batch_pad).
+
+        Prompts longer than the largest seq bucket prefill in bucket-sized
+        CHUNKS through the cache (each chunk attends everything before it),
+        with the vocab head applied once to each row's last-token hidden —
+        so long-prompt cost is chunks·(layers) plus ONE head, and the
+        compiled-program set stays bounded."""
         prompts = [list(p) for p in prompts]
         B = _bucket(len(prompts), self.batch_buckets)
-        T = _bucket(max(len(p) for p in prompts), self.seq_buckets)
-        toks = np.zeros((B, T), np.int32)
-        mask = np.zeros((B, T), bool)
-        for i, p in enumerate(prompts):
-            toks[i, : len(p)] = p
-            mask[i, : len(p)] = True
+        lens = [len(p) for p in prompts]
+        T_max = max(lens)
+        if T_max > self.max_seq_len:
+            raise ValueError(
+                f"prompt length {T_max} exceeds max_seq_len {self.max_seq_len}"
+            )
+        if T_max <= self.seq_buckets[-1]:
+            T = _bucket(T_max, self.seq_buckets)
+            toks = np.zeros((B, T), np.int32)
+            mask = np.zeros((B, T), bool)
+            for i, p in enumerate(prompts):
+                toks[i, : len(p)] = p
+                mask[i, : len(p)] = True
+            cache = self.new_cache(B)
+            logits, cache = _prefill(
+                self.params, jnp.asarray(toks), jnp.asarray(mask), cache,
+                self.cfg,
+            )
+            return logits, cache, lens, B
+        return self._prefill_chunked(prompts, lens, B)
+
+    def _prefill_chunked(self, prompts, lens, B):
+        C = self.seq_buckets[-1]
+        T_max = max(lens)
         cache = self.new_cache(B)
-        logits, cache = _prefill(
-            self.params, jnp.asarray(toks), jnp.asarray(mask), cache, self.cfg
-        )
-        return logits, cache, [len(p) for p in prompts], B
+        lens_a = np.asarray(lens + [0] * (B - len(lens)))
+        hidden_last = None
+        off = 0
+        while off < T_max:
+            span = min(C, T_max - off)
+            # the bucketed chunk may not overrun the cache: a clamped
+            # dynamic_update_slice would shift the write backward over
+            # already-written real keys (max_seq_len need not be
+            # bucket-aligned, so the tail chunk can be an odd size — one
+            # extra compiled shape, bounded per engine)
+            Tc = min(_bucket(span, self.seq_buckets), self.max_seq_len - off)
+            toks = np.zeros((B, Tc), np.int32)
+            mask = np.zeros((B, Tc), bool)
+            for i, p in enumerate(prompts):
+                part = p[off : off + Tc]
+                toks[i, : len(part)] = part
+                mask[i, : len(part)] = True
+            hid, cache = _prefill_chunk(
+                self.params, jnp.asarray(toks), jnp.asarray(mask), cache,
+                self.cfg, off == 0,
+            )
+            if hidden_last is None:
+                hidden_last = jnp.zeros((B, hid.shape[-1]), hid.dtype)
+            # rows whose last real token falls inside this chunk grab its
+            # (already final-normed) hidden state
+            last_idx = lens_a - 1
+            in_chunk = (last_idx >= off) & (last_idx < off + Tc)
+            local = np.clip(last_idx - off, 0, Tc - 1)
+            gathered = hid[jnp.arange(B), jnp.asarray(local)]
+            hidden_last = jnp.where(
+                jnp.asarray(in_chunk)[:, None], gathered, hidden_last
+            )
+            off += Tc
+        logits = _head_from_hidden(self.params, hidden_last, self.cfg)
+        return logits, cache, lens, B
 
     def generate(
         self,

@@ -331,7 +331,19 @@ class DistributedValidator:
         """Consistent view for API threads (the hosted dict is mutated by
         pool threads under _host_lock; readers must take it too)."""
         with self._host_lock:
-            return [{"name": j.name, "status": j.status} for j in self.hosted.values()]
+            out = []
+            for j in self.hosted.values():
+                entry = {"name": j.name, "status": j.status}
+                if j.batcher is not None and j.batcher.batch_sizes:
+                    sizes = list(j.batcher.batch_sizes)
+                    entry["serving"] = {
+                        "dispatches": len(sizes),
+                        "requests": sum(sizes),
+                        "mean_batch": round(sum(sizes) / len(sizes), 2),
+                        "max_batch": max(sizes),
+                    }
+                out.append(entry)
+            return out
 
     def model_status(self, name: str) -> dict:
         job = self.hosted.get(name)

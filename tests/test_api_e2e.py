@@ -116,6 +116,10 @@ def test_health_and_preload(api_cluster):
     assert body["status"] == "ready"
     status, body = _req(api, "GET", "/models")
     assert {"name": MODEL, "status": "ready"} in body["models"]
+    # OpenAI-compatible listing
+    status, body = _req(api, "GET", "/v1/models")
+    assert status == 200 and body["object"] == "list"
+    assert any(m["id"] == MODEL for m in body["data"])
 
 
 def test_generate_simple(api_cluster):
