@@ -38,7 +38,7 @@ _DEFAULT_TPU = (819e9, 197e12)  # assume v5e-class if unrecognized
 _CPU_NOMINAL = (50e9, 1e12)
 
 
-def _probe(timeout: float = 120.0) -> str | None:
+def _probe(timeout: float = 240.0) -> str | None:
     """Initialize the inherited JAX backend in a subprocess with a deadline.
 
     Returns the platform string, or None if init fails/hangs."""
@@ -115,6 +115,17 @@ def _hbm_bytes(dev) -> int:
         return int(stats.get("bytes_limit", 0)) or 16 << 30
     except Exception:
         return 16 << 30
+
+
+# The driver gives the child ~55 min; optional measurements (B=8, int8,
+# training) are skipped when the elapsed budget runs low so a slow-tunnel
+# compile never times out the whole child and loses the HEADLINE number.
+_CHILD_BUDGET_S = 3100.0
+_T_CHILD_START = time.time()
+
+
+def _budget_left() -> float:
+    return _CHILD_BUDGET_S - (time.time() - _T_CHILD_START)
 
 
 def run_bench() -> None:
@@ -210,7 +221,9 @@ def run_bench() -> None:
     # bytes as B=1, so this shows the near-free ~8x the dynamic batcher
     # (ml/batching.py) buys concurrent requests
     batch_extra = {}
-    if on_tpu:
+    if on_tpu and _budget_left() < 900:
+        batch_extra = {"batch8_skipped": "low time budget"}
+    elif on_tpu:
         try:
             B8 = 8
             eng8 = GenerationEngine(
@@ -236,7 +249,10 @@ def run_bench() -> None:
     # halves the parameter stream that bounds B=1 decode — can beat the
     # bf16 roofline the headline is normalized against
     int8_extra = {}
-    if on_tpu:
+    if on_tpu and _budget_left() < 700:
+        int8_extra = {"int8_skipped": "low time budget"}
+        del eng
+    elif on_tpu:
         try:
             del eng  # free the bf16 engine's cache first
             qeng = GenerationEngine(
@@ -272,6 +288,13 @@ def run_bench() -> None:
         **batch_extra,
         **int8_extra,
     }
+    if on_tpu and _budget_left() < 500:
+        # emit the headline rather than dying in a slow train compile;
+        # the decode number is the metric the driver records
+        extra["train_skipped"] = "low time budget"
+        _emit_result(decode_name, on_tpu, batch, prompt_len, toks_per_s,
+                     roofline, extra)
+        return
     try:
         if on_tpu:
             train_name = "qwen3-0p6b"
@@ -319,6 +342,13 @@ def run_bench() -> None:
         # self-contained diagnosis (ADVICE r2)
         extra["train_error"] = str(e)[:2000]
 
+    _emit_result(decode_name, on_tpu, batch, prompt_len, toks_per_s,
+                 roofline, extra)
+
+
+def _emit_result(decode_name, on_tpu, batch, prompt_len, toks_per_s,
+                 roofline, extra) -> None:
+    """The ONE JSON line the driver records — single emit site."""
     print(
         json.dumps(
             {

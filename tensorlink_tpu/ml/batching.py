@@ -62,6 +62,7 @@ class GenBatcher:
         self._submit_lock = threading.Lock()  # orders submits vs close()
         from collections import deque
 
+        self._stats_lock = threading.Lock()
         self.batch_sizes: deque[int] = deque(maxlen=1000)  # dispatch stats
         self._thread = threading.Thread(
             target=self._loop, name="gen-batcher", daemon=True
@@ -153,8 +154,23 @@ class GenBatcher:
                     r.error = e
                     r.done.set()
 
+    def stats(self) -> dict | None:
+        """Dispatch stats snapshot, safe against the dispatcher's appends
+        (iterating a deque mutated concurrently raises RuntimeError)."""
+        with self._stats_lock:
+            sizes = list(self.batch_sizes)
+        if not sizes:
+            return None
+        return {
+            "dispatches": len(sizes),
+            "requests": sum(sizes),
+            "mean_batch": round(sum(sizes) / len(sizes), 2),
+            "max_batch": max(sizes),
+        }
+
     def _run(self, batch: list[_Pending]) -> None:
-        self.batch_sizes.append(len(batch))
+        with self._stats_lock:
+            self.batch_sizes.append(len(batch))
         budgets = [r.max_new_tokens for r in batch]
         emitted_counts = [0] * len(batch)
 

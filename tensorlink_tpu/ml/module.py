@@ -47,7 +47,8 @@ class DistributedModel:
         parallelism: dict[str, int] | None = None,
         seed: int = 0,
         ckpt: str | None = None,
-        quant: str | None = None,  # "int8" = weight-only quantized serving
+        quant: str | None = None,  # "int8" | "int8+kv" quantized serving
+        flash_attention: bool = False,  # Pallas flash prefill on workers
         start_session: bool = True,
         **node_kw,
     ):
@@ -73,6 +74,8 @@ class DistributedModel:
             self.model_spec["ckpt"] = ckpt
         if quant:
             self.model_spec["quant"] = quant
+        if flash_attention:
+            self.model_spec["flash"] = True
         self.model_spec["seed"] = seed
 
         self.spec = {

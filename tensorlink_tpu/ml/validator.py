@@ -334,14 +334,9 @@ class DistributedValidator:
             out = []
             for j in self.hosted.values():
                 entry = {"name": j.name, "status": j.status}
-                if j.batcher is not None and j.batcher.batch_sizes:
-                    sizes = list(j.batcher.batch_sizes)
-                    entry["serving"] = {
-                        "dispatches": len(sizes),
-                        "requests": sum(sizes),
-                        "mean_batch": round(sum(sizes) / len(sizes), 2),
-                        "max_batch": max(sizes),
-                    }
+                stats = j.batcher.stats() if j.batcher is not None else None
+                if stats:
+                    entry["serving"] = stats
                 out.append(entry)
             return out
 

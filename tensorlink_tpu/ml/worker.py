@@ -231,6 +231,15 @@ class DistributedWorker:
             params = self._shard_params(params, cfg, stage, mesh)
         training = bool(p.get("training", False))
         quant = p.get("model", {}).get("quant")
+        if p.get("model", {}).get("flash"):
+            # Pallas flash prefill for this job's serving engine
+            # (ops/attention.py; the engine gates it to fresh-cache
+            # prefills). The kernel has no sharding rule, so sharded
+            # stages keep the einsum path — same degrade policy as quant.
+            if mesh is not None:
+                self.log.warning("flash_attention ignored on a sharded stage")
+            else:
+                cfg = cfg.with_(flash_attention=True)
         cache_quant = False
         if quant:
             # weight-only int8 serving (models/quant.py): quantize the

@@ -129,6 +129,23 @@ def test_int8_quantized_serving(cluster):
     assert seqs[0] == ref.sequences[0]
 
 
+def test_flash_serving_matches_dense(cluster):
+    """flash_attention=True rides the job spec; the worker's engine runs
+    the Pallas prefill (interpret mode on CPU) and greedy decode matches
+    the dense path token-for-token."""
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    prompt = [3, 14, 15, 92]
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as m:
+        dense = m.generate([prompt], max_new_tokens=8)
+    with DistributedModel(
+        cfg, node=cluster["user"], seed=7, seq_len=128, flash_attention=True
+    ) as m:
+        flash = m.generate([prompt], max_new_tokens=8)
+    assert flash == dense
+
+
 def test_streaming_generate(cluster):
     from tensorlink_tpu.ml.module import DistributedModel
 
