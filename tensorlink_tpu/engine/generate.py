@@ -53,6 +53,18 @@ def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig):
     return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _prefill_continue(params, tokens, attn_mask, cache, cfg: ModelConfig):
+    """Prefill a suffix over a NON-empty cache (prefix reuse): positions
+    come from cache.length, so the flash offset-0 promise does not hold —
+    einsum attention over the whole cache."""
+    logits, cache = forward(
+        params, tokens, cfg, cache=cache, attn_mask=attn_mask
+    )
+    last = jnp.maximum(attn_mask.sum(-1) - 1, 0)
+    return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
+
+
 @partial(
     jax.jit, static_argnames=("cfg", "first"), donate_argnames=("cache",)
 )
@@ -182,6 +194,13 @@ class GenerationEngine:
             self.seq_buckets = (self.max_seq_len,)
         self.batch_buckets = tuple(batch_buckets)
         self.cache_dtype = cache_dtype or cfg.dtype
+        # prompt-prefix cache (reuse_prefix=True): host-side LRU of
+        # (token-tuple -> per-position cache arrays), so conversation turns
+        # re-prefill only the suffix beyond the previous turn
+        from collections import OrderedDict
+
+        self._prefix_lru: OrderedDict[tuple, dict] = OrderedDict()
+        self.prefix_lru_size = 4
 
     # -- cache ------------------------------------------------------------
     def new_cache(self, batch: int) -> KVCache:
@@ -199,8 +218,113 @@ class GenerationEngine:
             )
         return cache
 
+    def _chunk_shape(self, span: int, room: int) -> int:
+        """Padded shape for a prefill piece of ``span`` tokens with ``room``
+        cache slots left: always a bucket value (bounded compile set) except
+        when room is below the smallest bucket (≤ smallest-bucket distinct
+        shapes, ever)."""
+        usable = [b for b in self.seq_buckets if b <= room]
+        if not usable:
+            return room
+        if span >= usable[-1]:
+            return usable[-1]
+        return next(b for b in usable if b >= span)
+
+    # -- prompt-prefix cache ---------------------------------------------
+    def _prefix_store(
+        self,
+        prompt: list[int],
+        cache: KVCache,
+        base_entry: dict | None = None,
+        base_len: int = 0,
+    ) -> None:
+        """Keep this prompt's per-position cache rows (host copies — HBM
+        stays free) as a reusable prefix for a later turn extending it. On
+        a hit, only the NEW rows transfer device→host; the matched entry's
+        arrays are reused for the shared prefix (per-turn cost stays
+        O(delta), which is the point of the feature)."""
+        L = len(prompt)
+
+        def rows(arr, base):
+            new = np.asarray(arr[:, 0, base_len:L])
+            return np.concatenate([base[:, :base_len], new], axis=1) \
+                if base is not None else np.asarray(arr[:, 0, :L])
+
+        b = base_entry or {}
+        entry = {"k": rows(cache.k, b.get("k")),
+                 "v": rows(cache.v, b.get("v"))}
+        if cache.quantized:
+            entry["k_scale"] = rows(cache.k_scale, b.get("k_scale"))
+            entry["v_scale"] = rows(cache.v_scale, b.get("v_scale"))
+        key = tuple(prompt)
+        self._prefix_lru[key] = entry
+        self._prefix_lru.move_to_end(key)
+        while len(self._prefix_lru) > self.prefix_lru_size:
+            self._prefix_lru.popitem(last=False)
+
+    def _prefix_match(self, prompt: list[int]) -> tuple[int, dict] | None:
+        """Longest stored key that is a prefix of ``prompt``, used up to
+        len(prompt)-1 positions (a repeated prompt still needs one real
+        token prefilled to produce logits). A hit refreshes the entry's
+        LRU recency — a hot shared prefix must not be evicted by colder
+        stores."""
+        best = None
+        best_key = None
+        p = tuple(prompt)
+        for key, entry in self._prefix_lru.items():
+            if p[: len(key)] == key:
+                L_use = min(len(key), len(prompt) - 1)
+                if L_use > 0 and (best is None or L_use > best[0]):
+                    best = (L_use, entry)
+                    best_key = key
+        if best_key is not None:
+            self._prefix_lru.move_to_end(best_key)
+        return best
+
+    def _prefill_with_prefix(self, prompt: list[int], L: int, entry: dict):
+        """Seed a fresh B=1-bucket cache with the stored prefix rows, then
+        prefill only the suffix (cache offsets handle positions), chunked
+        like the cold path so any suffix length works."""
+        B = _bucket(1, self.batch_buckets)
+        cache = self.new_cache(B)
+        k = cache.k.at[:, 0, :L].set(jnp.asarray(entry["k"][:, :L]))
+        v = cache.v.at[:, 0, :L].set(jnp.asarray(entry["v"][:, :L]))
+        ks = vs = None
+        if cache.quantized:
+            ks = cache.k_scale.at[:, 0, :L].set(
+                jnp.asarray(entry["k_scale"][:, :L])
+            )
+            vs = cache.v_scale.at[:, 0, :L].set(
+                jnp.asarray(entry["v_scale"][:, :L])
+            )
+        length = jnp.zeros((B,), jnp.int32).at[0].set(L)
+        cache = KVCache(k=k, v=v, length=length, k_scale=ks, v_scale=vs)
+
+        rest = prompt[L:]
+        off = 0
+        hidden_last = None
+        while off < len(rest):
+            span = min(len(rest) - off, self.seq_buckets[-1])
+            Tc = self._chunk_shape(span, self.max_seq_len - L - off)
+            span = min(span, Tc)
+            toks = np.zeros((B, Tc), np.int32)
+            mask = np.zeros((B, Tc), bool)
+            toks[0, :span] = rest[off : off + span]
+            mask[0, :span] = True
+            hid, cache = _prefill_chunk(
+                self.params, jnp.asarray(toks), jnp.asarray(mask), cache,
+                self.cfg, False,  # offset != 0 — never flash
+            )
+            if off + span >= len(rest):
+                hidden_last = hid[:, span - 1]
+            off += span
+        logits = _head_from_hidden(self.params, hidden_last, self.cfg)
+        return logits, cache, [len(prompt)], B
+
     # -- host-driven API --------------------------------------------------
-    def prefill(self, prompts: Iterable[Sequence[int]]):
+    def prefill(
+        self, prompts: Iterable[Sequence[int]], *, reuse_prefix: bool = False
+    ):
         """Pad prompts into (batch, seq) buckets; returns
         (last_logits [B,V], cache, prompt_lens, batch_pad).
 
@@ -208,8 +332,31 @@ class GenerationEngine:
         CHUNKS through the cache (each chunk attends everything before it),
         with the vocab head applied once to each row's last-token hidden —
         so long-prompt cost is chunks·(layers) plus ONE head, and the
-        compiled-program set stays bounded."""
+        compiled-program set stays bounded.
+
+        ``reuse_prefix`` (B=1 only): seed the cache from the longest stored
+        prompt prefix and prefill only the suffix — a conversation turn
+        extending the previous one re-pays just the delta; the full prompt's
+        cache rows are stored back for the next turn."""
         prompts = [list(p) for p in prompts]
+        if reuse_prefix and len(prompts) == 1:
+            prompt = prompts[0]
+            if len(prompt) > self.max_seq_len:
+                raise ValueError(
+                    f"prompt length {len(prompt)} exceeds max_seq_len "
+                    f"{self.max_seq_len}"
+                )
+            hit = self._prefix_match(prompt)
+            if hit is not None:
+                L_use, entry = hit
+                out = self._prefill_with_prefix(prompt, L_use, entry)
+                self._prefix_store(
+                    prompt, out[1], base_entry=entry, base_len=L_use
+                )
+                return out
+            out = self.prefill(prompts)
+            self._prefix_store(prompt, out[1])
+            return out
         B = _bucket(len(prompts), self.batch_buckets)
         lens = [len(p) for p in prompts]
         T_max = max(lens)
@@ -241,12 +388,11 @@ class GenerationEngine:
         off = 0
         while off < T_max:
             span = min(C, T_max - off)
-            # the bucketed chunk may not overrun the cache: a clamped
+            # the chunk may not overrun the cache (a clamped
             # dynamic_update_slice would shift the write backward over
-            # already-written real keys (max_seq_len need not be
-            # bucket-aligned, so the tail chunk can be an odd size — one
-            # extra compiled shape, bounded per engine)
-            Tc = min(_bucket(span, self.seq_buckets), self.max_seq_len - off)
+            # already-written real keys), and its padded shape comes from
+            # the bucket set so the compile set stays bounded
+            Tc = self._chunk_shape(span, self.max_seq_len - off)
             toks = np.zeros((B, Tc), np.int32)
             mask = np.zeros((B, Tc), bool)
             for i, p in enumerate(prompts):
@@ -282,6 +428,7 @@ class GenerationEngine:
         seed: int = 0,
         stream_cb: Callable[[list[int | None]], None] | None = None,
         budgets: Sequence[int] | None = None,
+        reuse_prefix: bool = False,
     ) -> GenerationResult:
         """Host-driven loop (supports per-token streaming callbacks).
 
@@ -291,7 +438,7 @@ class GenerationEngine:
         max_new_tokens); each row is limited by its OWN budget and cache
         room, so a long-prompt neighbor never truncates a short one."""
         sampling = sampling or SamplingParams.make()
-        logits, cache, lens, B = self.prefill(prompts)
+        logits, cache, lens, B = self.prefill(prompts, reuse_prefix=reuse_prefix)
         sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         n_rows = len(lens)
         eff = self._row_limits(lens, B, max_new_tokens, budgets)
@@ -360,12 +507,13 @@ class GenerationEngine:
         eos_ids: Sequence[int] = (),
         seed: int = 0,
         budgets: Sequence[int] | None = None,
+        reuse_prefix: bool = False,
     ) -> GenerationResult:
         """Entire token loop on device (lax.while_loop, EOS early-exit).
         ``budgets`` caps rows individually (batched request mixes) with no
         host round-trips — limits ride the compiled loop."""
         sampling = sampling or SamplingParams.make()
-        logits, cache, lens, B = self.prefill(prompts)
+        logits, cache, lens, B = self.prefill(prompts, reuse_prefix=reuse_prefix)
         sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         eff = self._row_limits(lens, B, max_new_tokens, budgets)
         total = max(eff)

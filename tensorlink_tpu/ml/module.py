@@ -368,8 +368,13 @@ class DistributedModel:
         seed: int = 0,
         stream_cb: Callable[[list[int | None]], None] | None = None,
         budgets: Sequence[int] | None = None,
+        reuse_prefix: bool = False,
     ) -> list[list[int]]:
-        """``stream_cb`` receives, per decode step, one new token per row
+        """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
+        the cache from the longest stored prompt prefix and prefills only
+        the suffix — conversation turns re-pay just the delta.
+
+        ``stream_cb`` receives, per decode step, one new token per row
         (None for rows already finished) — the engine's contract. Sampling
         knobs may be per-row sequences and ``budgets`` caps rows
         individually (both used by the serving batcher, ml/batching.py, to
@@ -380,6 +385,7 @@ class DistributedModel:
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
                 stream_cb=stream_cb, budgets=budgets,
+                reuse_prefix=reuse_prefix,
             )
         if budgets or any(
             isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
@@ -396,7 +402,7 @@ class DistributedModel:
 
     def _generate_remote(
         self, prompts, *, max_new_tokens, temperature, top_k, top_p,
-        eos_ids, seed, stream_cb, budgets=None,
+        eos_ids, seed, stream_cb, budgets=None, reuse_prefix=False,
     ) -> list[list[int]]:
         """Whole model on one worker → its compiled engine does the loop."""
         stage = self.plan.stages[0]
@@ -414,6 +420,8 @@ class DistributedModel:
         }
         if budgets:
             body["budgets"] = [int(b) for b in budgets]
+        if reuse_prefix:
+            body["reuse_prefix"] = True
         stream_id = None
         if stream_cb is not None:
             stream_id = secrets.token_hex(8)

@@ -826,6 +826,7 @@ class DistributedWorker:
                 top_p=float(knobs[2]),
             )
         budgets = p.get("budgets")
+        reuse_prefix = bool(p.get("reuse_prefix", False)) and len(prompts) == 1
         stream_id = p.get("stream")
         peer = p["peer"]
 
@@ -850,6 +851,7 @@ class DistributedWorker:
                 seed=int(p.get("seed", 0)),
                 stream_cb=stream_cb,
                 budgets=budgets,
+                reuse_prefix=reuse_prefix,
             )
             self.bridge.request(
                 "send_token",
@@ -866,6 +868,7 @@ class DistributedWorker:
                 eos_ids=p.get("eos_ids", ()),
                 seed=int(p.get("seed", 0)),
                 budgets=budgets,
+                reuse_prefix=reuse_prefix,
             )
         self._respond(
             peer, proto.GENERATE_RESP, p["rid"],

@@ -140,6 +140,71 @@ def test_chunked_prefill_matches_one_shot(tiny_model):
     )
 
 
+def test_prefix_cache_reuse(tiny_model):
+    """reuse_prefix: a conversation turn extending the previous prompt
+    prefills only the suffix off the stored cache — tokens must match a
+    cold prefill exactly, across both decode paths and after LRU churn."""
+    cfg, params = tiny_model
+    rng = np.random.default_rng(9)
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(8, 16, 32), batch_buckets=(1,),
+        max_seq_len=64,
+    )
+    turn1 = rng.integers(1, cfg.vocab_size, 12).tolist()
+    r1 = eng.generate_compiled(
+        [turn1], max_new_tokens=6, reuse_prefix=True
+    )
+    assert tuple(turn1) in eng._prefix_lru
+
+    # turn 2 extends turn 1 (as a conversation would)
+    turn2 = turn1 + r1.sequences[0] + rng.integers(1, cfg.vocab_size, 5).tolist()
+    cold = GenerationEngine(
+        cfg, params, seq_buckets=(8, 16, 32), batch_buckets=(1,),
+        max_seq_len=64,
+    )
+    for gen_fn, cold_fn in (
+        (eng.generate_compiled, cold.generate_compiled),
+        (eng.generate, cold.generate),
+    ):
+        warm = gen_fn([turn2], max_new_tokens=6, reuse_prefix=True)
+        ref = cold_fn([turn2], max_new_tokens=6)
+        assert warm.sequences == ref.sequences
+
+    # identical prompt re-ask also works (uses len-1 of the stored prefix)
+    again = eng.generate_compiled([turn2], max_new_tokens=6, reuse_prefix=True)
+    ref = cold.generate_compiled([turn2], max_new_tokens=6)
+    assert again.sequences == ref.sequences
+
+    # suffix longer than the largest seq bucket chunks through (live-repro
+    # regression: this raised 'exceeds largest bucket')
+    turn3 = turn2 + rng.integers(1, cfg.vocab_size, 40).tolist()
+    warm3 = eng.generate_compiled([turn3], max_new_tokens=4, reuse_prefix=True)
+    ref3 = cold.generate_compiled([turn3], max_new_tokens=4)
+    assert warm3.sequences == ref3.sequences
+
+    # LRU stays bounded, and a HOT prefix survives colder stores (match
+    # refreshes recency)
+    for _ in range(6):
+        p = turn1 + rng.integers(1, cfg.vocab_size, 6).tolist()
+        eng.generate_compiled([p], max_new_tokens=2, reuse_prefix=True)
+    assert len(eng._prefix_lru) <= eng.prefix_lru_size
+    assert tuple(turn1) in eng._prefix_lru  # hot shared prefix not evicted
+
+    # int8 KV cache mode round-trips its scales through the prefix store
+    qeng = GenerationEngine(
+        cfg, params, quant="int8+kv", seq_buckets=(8, 16, 32),
+        batch_buckets=(1,), max_seq_len=64,
+    )
+    qcold = GenerationEngine(
+        cfg, params, quant="int8+kv", seq_buckets=(8, 16, 32),
+        batch_buckets=(1,), max_seq_len=64,
+    )
+    qeng.generate_compiled([turn1], max_new_tokens=4, reuse_prefix=True)
+    qw = qeng.generate_compiled([turn2], max_new_tokens=6, reuse_prefix=True)
+    qr = qcold.generate_compiled([turn2], max_new_tokens=6)
+    assert qw.sequences == qr.sequences
+
+
 def test_train_step_reduces_loss(tiny_model):
     cfg, params = tiny_model
     opt = make_optimizer("adamw", lr=5e-3)

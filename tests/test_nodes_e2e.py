@@ -146,6 +146,21 @@ def test_flash_serving_matches_dense(cluster):
     assert flash == dense
 
 
+def test_prefix_reuse_serving(cluster):
+    """reuse_prefix rides GENERATE to the worker engine: a second turn
+    extending the first matches a cold generation token-for-token."""
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as m:
+        t1 = [3, 14, 15, 92, 65]
+        a1 = m.generate([t1], max_new_tokens=6, reuse_prefix=True)
+        t2 = t1 + a1[0] + [35, 89]
+        warm = m.generate([t2], max_new_tokens=6, reuse_prefix=True)
+        cold = m.generate([t2], max_new_tokens=6)
+    assert warm == cold
+
+
 def test_streaming_generate(cluster):
     from tensorlink_tpu.ml.module import DistributedModel
 
