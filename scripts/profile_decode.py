@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensorlink_tpu.engine.generate import (
-    GenerationEngine, _decode_step, _decode_loop,
+    GenerationEngine, _decode_step,
 )
 from tensorlink_tpu.engine.sampling import SamplingParams, sample
 from tensorlink_tpu.models import init_params

@@ -15,7 +15,7 @@ import asyncio
 import json
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Awaitable, Callable
+from typing import Any, Callable
 from urllib.parse import unquote, urlparse
 
 from tensorlink_tpu.api.formatter import (

@@ -9,7 +9,7 @@ serializes over the wire (ml/utils.py:569-660).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Any
 
 import jax

@@ -19,8 +19,6 @@ ml/worker.py:297-357):
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
-
 import jax
 import jax.numpy as jnp
 from jax import lax
