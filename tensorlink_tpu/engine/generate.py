@@ -94,6 +94,17 @@ def _decode_step(params, tok, cache, cfg: ModelConfig):
     return logits[:, 0], cache
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _verify_step(params, toks, cache, cfg: ModelConfig):
+    """Speculative verification: one forward over [tok, draft...] returns
+    greedy targets at every position. The cache absorbs all positions;
+    rejected ones are rolled back by resetting ``length`` — attention masks
+    by length, so stale writes are invisible and simply overwritten later
+    (no copy, the reason speculation is cheap in this engine)."""
+    logits, cache = forward(params, toks, cfg, cache=cache)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps"),
@@ -478,6 +489,98 @@ class GenerationEngine:
         return GenerationResult(
             sequences=seqs, prompt_lens=lens, finished=list(done[:n_rows])
         )
+
+    # -- speculative decode (prompt-lookup) -------------------------------
+    @staticmethod
+    def _lookup_draft(history: list[int], n_draft: int, ngram: int = 3) -> list[int]:
+        """Prompt-lookup drafting: if the trailing n-gram occurred earlier
+        in the token history, propose the tokens that followed it. Free —
+        no draft model; strong on repetitive/extractive text."""
+        for n in range(min(ngram, len(history) - 1), 0, -1):
+            tail = history[-n:]
+            # most recent earlier occurrence
+            for start in range(len(history) - n - 1, -1, -1):
+                if history[start : start + n] == tail:
+                    nxt = history[start + n : start + n + n_draft]
+                    if nxt:
+                        return nxt
+                    break
+        return []
+
+    def generate_lookahead(
+        self,
+        prompts: Iterable[Sequence[int]],
+        *,
+        max_new_tokens: int = 128,
+        eos_ids: Sequence[int] = (),
+        n_draft: int = 8,
+        reuse_prefix: bool = False,
+        stream_cb: Callable[[list[int | None]], None] | None = None,
+    ) -> GenerationResult:
+        """Greedy decode with prompt-lookup speculation (B=1): draft up to
+        ``n_draft`` tokens from the prompt's own n-grams, verify them in ONE
+        forward, keep the matched prefix plus the model's correction token.
+        Emits EXACTLY the vanilla greedy sequence — speculation only changes
+        how many decode steps it takes — so acceptance is pure speedup
+        (1 + accepted tokens per model pass on repetitive/extractive text,
+        never slower than one token per pass)."""
+        prompts = [list(p) for p in prompts]
+        if len(prompts) != 1:
+            raise ValueError("lookahead decode is B=1 (serving conversations)")
+        logits, cache, lens, B = self.prefill(
+            prompts, reuse_prefix=reuse_prefix
+        )
+        eos_set = set(int(e) for e in eos_ids)
+        history = list(prompts[0])
+        tok = int(np.asarray(logits)[0].argmax())
+        seq: list[int] = [tok]
+        history.append(tok)
+        if stream_cb is not None:
+            stream_cb([tok])
+        room = self.max_seq_len - lens[0]
+
+        while len(seq) < min(max_new_tokens, room) and tok not in eos_set:
+            remaining = min(max_new_tokens, room) - len(seq)
+            k = min(n_draft, remaining - 1, self.max_seq_len - lens[0] - len(seq))
+            draft = self._lookup_draft(history, k) if k > 0 else []
+            toks = np.zeros((B, 1 + len(draft)), np.int32)
+            toks[0, 0] = tok
+            toks[0, 1:] = draft
+            base_len = int(np.asarray(cache.length)[0])
+            targets, cache = _verify_step(
+                self.params, jnp.asarray(toks), cache, self.cfg
+            )
+            t_host = np.asarray(targets)[0]
+            accepted = 0
+            while accepted < len(draft) and draft[accepted] == int(t_host[accepted]):
+                if draft[accepted] in eos_set:
+                    break
+                accepted += 1
+            emitted = list(draft[:accepted]) + [int(t_host[accepted])]
+            # roll back rejected cache positions by resetting length only
+            new_len = base_len + 1 + accepted
+            cache = KVCache(
+                k=cache.k, v=cache.v,
+                length=jnp.full_like(cache.length, new_len),
+                k_scale=cache.k_scale, v_scale=cache.v_scale,
+            )
+            taken: list[int] = []
+            for t in emitted:
+                seq.append(t)
+                history.append(t)
+                taken.append(t)
+                tok = t
+                if t in eos_set or len(seq) >= min(max_new_tokens, room):
+                    break
+            if stream_cb is not None and taken:
+                for t in taken:  # per-token, matching the host-loop contract
+                    stream_cb([t])
+            if tok in eos_set:
+                break
+        del cache
+        seq = seq[: min(max_new_tokens, room)]
+        fin = bool(seq and seq[-1] in eos_set)
+        return GenerationResult(sequences=[seq], prompt_lens=lens, finished=[fin])
 
     # -- fully-compiled API (throughput / bench) --------------------------
     def _row_limits(

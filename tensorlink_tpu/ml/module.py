@@ -369,6 +369,7 @@ class DistributedModel:
         stream_cb: Callable[[list[int | None]], None] | None = None,
         budgets: Sequence[int] | None = None,
         reuse_prefix: bool = False,
+        lookahead: bool = False,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -385,7 +386,7 @@ class DistributedModel:
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
                 stream_cb=stream_cb, budgets=budgets,
-                reuse_prefix=reuse_prefix,
+                reuse_prefix=reuse_prefix, lookahead=lookahead,
             )
         if budgets or any(
             isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
@@ -403,6 +404,7 @@ class DistributedModel:
     def _generate_remote(
         self, prompts, *, max_new_tokens, temperature, top_k, top_p,
         eos_ids, seed, stream_cb, budgets=None, reuse_prefix=False,
+        lookahead=False,
     ) -> list[list[int]]:
         """Whole model on one worker → its compiled engine does the loop."""
         stage = self.plan.stages[0]
@@ -422,6 +424,8 @@ class DistributedModel:
             body["budgets"] = [int(b) for b in budgets]
         if reuse_prefix:
             body["reuse_prefix"] = True
+        if lookahead:
+            body["lookahead"] = True
         stream_id = None
         if stream_cb is not None:
             stream_id = secrets.token_hex(8)

@@ -827,6 +827,13 @@ class DistributedWorker:
             )
         budgets = p.get("budgets")
         reuse_prefix = bool(p.get("reuse_prefix", False)) and len(prompts) == 1
+        # prompt-lookup speculation: greedy B=1 only (it IS vanilla greedy,
+        # in fewer model passes)
+        greedy = not isinstance(p.get("temperature", 0.0), (list, tuple)) \
+            and float(p.get("temperature", 0.0)) <= 0.0
+        lookahead = (
+            bool(p.get("lookahead", False)) and len(prompts) == 1 and greedy
+        )
         stream_id = p.get("stream")
         peer = p["peer"]
 
@@ -842,7 +849,21 @@ class DistributedWorker:
                     {"peer": peer, "stream": stream_id, "tokens": pairs},
                 )
 
-        if stream_id:
+        if lookahead:
+            result = rt.engine.generate_lookahead(
+                prompts,
+                max_new_tokens=int(p.get("max_new_tokens", 128)),
+                eos_ids=p.get("eos_ids", ()),
+                reuse_prefix=reuse_prefix,
+                stream_cb=stream_cb if stream_id else None,
+            )
+            if stream_id:
+                self.bridge.request(
+                    "send_token",
+                    {"peer": peer, "stream": stream_id, "tokens": [],
+                     "done": True},
+                )
+        elif stream_id:
             result = rt.engine.generate(
                 prompts,
                 max_new_tokens=int(p.get("max_new_tokens", 128)),

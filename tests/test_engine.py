@@ -205,6 +205,43 @@ def test_prefix_cache_reuse(tiny_model):
     assert qw.sequences == qr.sequences
 
 
+def test_lookahead_decode_matches_greedy(tiny_model):
+    """Prompt-lookup speculation must emit EXACTLY the vanilla greedy
+    sequence — acceptance only changes how many model passes it takes."""
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16, 32, 64), batch_buckets=(1,),
+        max_seq_len=128,
+    )
+    rng = np.random.default_rng(11)
+    # repetitive prompt (drafts accept) and a random one (drafts miss)
+    rep = ([5, 9, 2, 7] * 6)[:22]
+    rand = rng.integers(1, cfg.vocab_size, 20).tolist()
+    for prompt in (rep, rand):
+        ref = eng.generate_compiled([prompt], max_new_tokens=24)
+        spec = eng.generate_lookahead([prompt], max_new_tokens=24)
+        assert spec.sequences == ref.sequences, prompt
+
+    # EOS semantics match too: pick the first generated token as "EOS"
+    ref = eng.generate_compiled([rep], max_new_tokens=24)
+    eos = ref.sequences[0][3]
+    ref_eos = eng.generate_compiled([rep], max_new_tokens=24, eos_ids=[eos])
+    spec_eos = eng.generate_lookahead([rep], max_new_tokens=24, eos_ids=[eos])
+    assert spec_eos.sequences == ref_eos.sequences
+
+    # and through a prefix-cache hit
+    spec2 = eng.generate_lookahead(
+        [rep], max_new_tokens=24, reuse_prefix=True
+    )
+    spec3 = eng.generate_lookahead(
+        [rep + spec2.sequences[0][:4]], max_new_tokens=12, reuse_prefix=True
+    )
+    cold = eng.generate_compiled(
+        [rep + spec2.sequences[0][:4]], max_new_tokens=12
+    )
+    assert spec3.sequences == cold.sequences
+
+
 def test_train_step_reduces_loss(tiny_model):
     cfg, params = tiny_model
     opt = make_optimizer("adamw", lr=5e-3)

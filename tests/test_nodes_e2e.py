@@ -161,6 +161,27 @@ def test_prefix_reuse_serving(cluster):
     assert warm == cold
 
 
+def test_lookahead_serving_matches_greedy(cluster):
+    """lookahead=True rides GENERATE: speculative serving emits exactly
+    the vanilla greedy tokens (here with a repetitive prompt that drafts
+    accept on), streaming included."""
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    cfg = tiny_cfg()
+    prompt = ([3, 14, 15, 92] * 5)[:18]
+    with DistributedModel(cfg, node=cluster["user"], seed=7, seq_len=128) as m:
+        ref = m.generate([prompt], max_new_tokens=10)
+        spec = m.generate([prompt], max_new_tokens=10, lookahead=True)
+        got: list[int] = []
+        spec_stream = m.generate(
+            [prompt], max_new_tokens=10, lookahead=True,
+            stream_cb=lambda ts: got.extend(t for t in ts if t is not None),
+        )
+    assert spec == ref
+    assert spec_stream == ref
+    assert got == ref[0]
+
+
 def test_streaming_generate(cluster):
     from tensorlink_tpu.ml.module import DistributedModel
 
