@@ -245,6 +245,31 @@ def run_bench() -> None:
         except Exception as e:
             batch_extra = {"batch8_error": str(e)[:300]}
 
+    # ---- speculative decode (prompt-lookup) on repetitive text ------------
+    # product path: /v1/generate {"lookahead": true}. One fixed-shape verify
+    # program (drafts pad to n_draft); acceptance-rate + tok/s vs the
+    # headline show what repetition buys
+    spec_extra = {}
+    if on_tpu and _budget_left() < 800:
+        spec_extra = {"lookahead_skipped": "low time budget"}
+    else:
+        try:
+            rep = prompts[0][:16] * 4  # strongly repetitive 64-token prompt
+            eng.generate_lookahead([rep], max_new_tokens=32)  # warm/compile
+            t0 = time.perf_counter()
+            r = eng.generate_lookahead([rep], max_new_tokens=min(gen_tokens, 128))
+            dt = max(time.perf_counter() - t0, 1e-9)
+            st = getattr(eng, "last_lookahead_stats", {})
+            spec_extra = {
+                "lookahead_toks_s": round(len(r.sequences[0]) / dt, 2),
+                "lookahead_tokens_per_pass": st.get("tokens_per_pass"),
+                "lookahead_vs_b1": round(
+                    len(r.sequences[0]) / dt / max(toks_per_s, 1e-9), 2
+                ),
+            }
+        except Exception as e:
+            spec_extra = {"lookahead_error": str(e)[:300]}
+
     # ---- int8 weight-only decode (same prompts; reported in extra) --------
     # halves the parameter stream that bounds B=1 decode — can beat the
     # bf16 roofline the headline is normalized against
@@ -255,8 +280,17 @@ def run_bench() -> None:
     elif on_tpu:
         try:
             del eng  # free the bf16 engine's cache first
+            # run the int8 engine THROUGH the mesh path (1-device Mesh):
+            # exercises quant+mesh serving (r3 gap: it raised) on real
+            # hardware at no sharding cost
+            from jax.sharding import Mesh
+
+            from tensorlink_tpu.models.transformer import cache_specs as _cs
+
             qeng = GenerationEngine(
                 cfg, params, quant="int8",
+                mesh=Mesh(np.array(jax.devices()[:1]), ("data",)),
+                cache_specs=_cs(cfg, data_axis=None, tensor_axis=None),
                 seq_buckets=(prompt_len, prompt_len + gen_tokens),
                 batch_buckets=(batch,),
                 max_seq_len=prompt_len + gen_tokens,
@@ -286,6 +320,7 @@ def run_bench() -> None:
         "device_kind": getattr(dev, "device_kind", ""),
         "decode_roofline_toks_s": round(roofline, 2),
         **batch_extra,
+        **spec_extra,
         **int8_extra,
     }
     if on_tpu and _budget_left() < 500:

@@ -39,6 +39,10 @@ class GenerationRequest:
     stream: bool = False
     output_format: str = "simple"  # "simple" | "openai" | "raw"
     enable_thinking: bool = False
+    # opt-in speculative decode (prompt-lookup drafting; greedy B=1 —
+    # engine/generate.py::generate_lookahead). Emits exactly the vanilla
+    # greedy tokens, so honoring it is always safe; ignored when sampling.
+    lookahead: bool = False
 
     @classmethod
     def parse(cls, d: dict) -> "GenerationRequest":
@@ -56,6 +60,7 @@ class GenerationRequest:
             stream=bool(d.get("stream", False)),
             output_format=str(d.get("output_format", "simple")),
             enable_thinking=bool(d.get("enable_thinking", False)),
+            lookahead=bool(d.get("lookahead", False)),
         )
         _require(req.max_new_tokens > 0, "max_new_tokens must be positive")
         _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")

@@ -49,6 +49,15 @@ class MLConfig:
 
     max_memory_gb: float | None = None  # cap on HBM the node offers
     max_module_bytes: float | None = None  # force sharding below this size
+    # ICI-slice identity this worker advertises; co-slice workers merge into
+    # one planned mesh (parallel/planner.py::_merge_co_slice). Auto-detected
+    # from device.slice_index on TPU when unset (and TPU_NAME identifies the
+    # pod — without it the index alone would collide across pods).
+    slice_id: str = ""
+    # validator: enable co-slice merging at plan time. Off by default — a
+    # merged plan needs a runtime where one worker process addresses the
+    # whole slice's devices (see plan_sharding docstring).
+    co_slice_planning: bool = False
     trusted: bool = False  # reference: pickle mode. Here: may run user jax code
     dtype: str = "bfloat16"
     max_seq_len: int = 4096

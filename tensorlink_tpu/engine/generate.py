@@ -53,18 +53,6 @@ def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig):
     return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _prefill_continue(params, tokens, attn_mask, cache, cfg: ModelConfig):
-    """Prefill a suffix over a NON-empty cache (prefix reuse): positions
-    come from cache.length, so the flash offset-0 promise does not hold —
-    einsum attention over the whole cache."""
-    logits, cache = forward(
-        params, tokens, cfg, cache=cache, attn_mask=attn_mask
-    )
-    last = jnp.maximum(attn_mask.sum(-1) - 1, 0)
-    return jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0], cache
-
-
 @partial(
     jax.jit, static_argnames=("cfg", "first"), donate_argnames=("cache",)
 )
@@ -139,7 +127,20 @@ def _decode_loop(
 
     def body(state):
         i, tok, cache, done, key, tokens = state
+        prev_len = cache.length
         logits, cache = forward(params, tok[:, None], cfg, cache=cache)
+        # freeze the per-row write offset for finished rows: their re-fed
+        # token writes one scratch KV slot at prev_len (invisible — attention
+        # masks by length) instead of marching toward the cache end and
+        # clamping over real entries. Residual: a row frozen exactly at full
+        # room (length == max_len) still clamp-writes its last slot, so the
+        # post-loop cache is only valid for rows with room left — every
+        # caller deletes the cache after the loop.
+        cache = KVCache(
+            k=cache.k, v=cache.v,
+            length=jnp.where(done, prev_len, cache.length),
+            k_scale=cache.k_scale, v_scale=cache.v_scale,
+        )
         key, sub = jax.random.split(key)
         nxt = sample(logits[:, 0], sub, sampling)
         nxt = jnp.where(done, tok, nxt)  # freeze finished rows
@@ -183,16 +184,26 @@ class GenerationEngine:
             # traffic that bounds B=1 decode (models/quant.py). "+kv" also
             # stores the KV cache int8 (halves the per-token cache stream
             # that grows with context, and doubles servable context per
-            # HBM byte). Single-mesh only — the quantized tree has no
-            # partition-spec mapping.
-            if mesh is not None:
-                raise ValueError("int8 serving does not support a mesh yet")
+            # HBM byte). Composes with a mesh: quantization is elementwise
+            # per weight, so quantizing an ALREADY-SHARDED tree yields
+            # QTensors whose q/scale inherit the weight's GSPMD sharding —
+            # no explicit QTensor partition specs needed.
             from ..models.quant import quantize_params
 
             params = quantize_params(params)
             self.cache_quant = quant == "int8+kv"
         elif quant:
             raise ValueError(f"unknown quant mode {quant!r}")
+        if self.cache_quant and cache_specs is not None and getattr(
+            cache_specs, "k_scale", None
+        ) is None:
+            # widen plain KV specs to the int8 cache layout: per-position
+            # scales shard exactly like their payload (trailing size-1 axis
+            # is unsharded either way)
+            cache_specs = KVCache(
+                k=cache_specs.k, v=cache_specs.v, length=cache_specs.length,
+                k_scale=cache_specs.k, v_scale=cache_specs.v,
+            )
         self.quant = quant
         self.params = params
         self.mesh = mesh
@@ -212,6 +223,11 @@ class GenerationEngine:
 
         self._prefix_lru: OrderedDict[tuple, dict] = OrderedDict()
         self.prefix_lru_size = 4
+        # byte budget for the host-side prefix store: a 4k-token prompt on
+        # an 8B model is 100s of MB of KV per entry, so eviction must be by
+        # bytes, not count — and an entry above the whole budget is never
+        # worth the device_get that storing it would cost
+        self.prefix_lru_bytes = 512 << 20
 
     # -- cache ------------------------------------------------------------
     def new_cache(self, batch: int) -> KVCache:
@@ -255,6 +271,8 @@ class GenerationEngine:
         arrays are reused for the shared prefix (per-turn cost stays
         O(delta), which is the point of the feature)."""
         L = len(prompt)
+        if self._entry_nbytes_for(L) > self.prefix_lru_bytes:
+            return  # larger than the whole budget: skip the device_get
 
         def rows(arr, base):
             new = np.asarray(arr[:, 0, base_len:L])
@@ -270,8 +288,31 @@ class GenerationEngine:
         key = tuple(prompt)
         self._prefix_lru[key] = entry
         self._prefix_lru.move_to_end(key)
-        while len(self._prefix_lru) > self.prefix_lru_size:
+        while len(self._prefix_lru) > self.prefix_lru_size or (
+            len(self._prefix_lru) > 1
+            and self._prefix_total_bytes() > self.prefix_lru_bytes
+        ):
             self._prefix_lru.popitem(last=False)
+
+    @staticmethod
+    def _entry_nbytes(entry: dict) -> int:
+        return sum(a.nbytes for a in entry.values())
+
+    def _entry_nbytes_for(self, n_tokens: int) -> int:
+        """Bytes a stored prefix of ``n_tokens`` positions would occupy,
+        computed WITHOUT the device transfer (the whole point of the
+        pre-check): layers × positions × kv-heads × head-dim × 2 (k+v)."""
+        c = self.cfg
+        per_pos = c.n_layers * c.n_kv_heads * c.head_dim * 2
+        if self.cache_quant:
+            # int8 payload + f32 per-(pos, head) scales
+            per_pos_bytes = per_pos + c.n_layers * c.n_kv_heads * 2 * 4
+        else:
+            per_pos_bytes = per_pos * jnp.dtype(self.cache_dtype).itemsize
+        return n_tokens * per_pos_bytes
+
+    def _prefix_total_bytes(self) -> int:
+        return sum(self._entry_nbytes(e) for e in self._prefix_lru.values())
 
     def _prefix_match(self, prompt: list[int]) -> tuple[int, dict] | None:
         """Longest stored key that is a prefix of ``prompt``, used up to
@@ -530,6 +571,7 @@ class GenerationEngine:
         logits, cache, lens, B = self.prefill(
             prompts, reuse_prefix=reuse_prefix
         )
+        n_passes = 1  # the prefill pass produced the first token
         eos_set = set(int(e) for e in eos_ids)
         history = list(prompts[0])
         tok = int(np.asarray(logits)[0].argmax())
@@ -543,13 +585,23 @@ class GenerationEngine:
             remaining = min(max_new_tokens, room) - len(seq)
             k = min(n_draft, remaining - 1, self.max_seq_len - lens[0] - len(seq))
             draft = self._lookup_draft(history, k) if k > 0 else []
-            toks = np.zeros((B, 1 + len(draft)), np.int32)
-            toks[0, 0] = tok
-            toks[0, 1:] = draft
             base_len = int(np.asarray(cache.length)[0])
+            # pad the verify call to a FIXED [1, 1+n_draft] shape whenever
+            # the cache has room: variable draft lengths would compile one
+            # XLA program per length (minutes each over a tunneled chip).
+            # Padded positions write garbage KV that the same length-reset
+            # rollback below discards, and acceptance only reads the real
+            # draft prefix.
+            pad_to = len(draft)
+            if base_len + 1 + n_draft <= self.max_seq_len:
+                pad_to = n_draft
+            toks = np.zeros((B, 1 + pad_to), np.int32)
+            toks[0, 0] = tok
+            toks[0, 1 : 1 + len(draft)] = draft
             targets, cache = _verify_step(
                 self.params, jnp.asarray(toks), cache, self.cfg
             )
+            n_passes += 1
             t_host = np.asarray(targets)[0]
             accepted = 0
             while accepted < len(draft) and draft[accepted] == int(t_host[accepted]):
@@ -579,6 +631,13 @@ class GenerationEngine:
                 break
         del cache
         seq = seq[: min(max_new_tokens, room)]
+        # acceptance telemetry for the bench / serving metrics: mean tokens
+        # emitted per model pass (1.0 = vanilla decode, >1 = speculation won)
+        self.last_lookahead_stats = {
+            "tokens": len(seq),
+            "passes": n_passes,
+            "tokens_per_pass": round(len(seq) / max(n_passes, 1), 3),
+        }
         fin = bool(seq and seq[-1] in eos_set)
         return GenerationResult(sequences=[seq], prompt_lens=lens, finished=[fin])
 

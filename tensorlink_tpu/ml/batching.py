@@ -31,6 +31,10 @@ class _Pending:
     temperature: float
     top_k: int
     top_p: float
+    # speculative decode wish (greedy B=1 only): honored when the request
+    # dispatches ALONE; in a co-batch it decodes vanilla — the emitted
+    # tokens are identical either way, so this is purely a speed hint
+    lookahead: bool = False
     done: threading.Event = field(default_factory=threading.Event)
     stream_cb: Callable[[list[int]], None] | None = None
     result: list[int] | None = None
@@ -80,6 +84,7 @@ class GenBatcher:
         top_p: float = 1.0,
         stream_cb: Callable[[list[int]], None] | None = None,
         timeout: float = 600.0,
+        lookahead: bool = False,
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode."""
@@ -87,6 +92,7 @@ class GenBatcher:
             ids=list(ids), max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), stream_cb=stream_cb,
+            lookahead=bool(lookahead) and float(temperature) == 0.0,
         )
         # check-and-put under the lock close() drains under — a submit
         # racing close() must either land before the sentinel or fail fast,
@@ -110,6 +116,16 @@ class GenBatcher:
             self._closed = True
             self._q.put(None)
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # the dispatcher is still driving a decode on this model; the
+            # caller is about to shut the model down under it — say so
+            # instead of silently proceeding
+            from tensorlink_tpu.core.logging import get_logger
+
+            get_logger("ml.batching").warning(
+                "GenBatcher.close(): dispatcher did not drain within %.0fs; "
+                "a batched decode may still be in flight", timeout,
+            )
         while True:
             try:
                 req = self._q.get_nowait()
@@ -183,6 +199,21 @@ class GenBatcher:
 
         any_stream = any(r.stream_cb for r in batch)
         self._seq += 1
+        if len(batch) == 1 and batch[0].lookahead:
+            # quiet moment + speculative wish: run the prompt-lookup decode
+            # (greedy B=1; same tokens as vanilla, fewer model passes)
+            r = batch[0]
+            seqs = self.model.generate(
+                [r.ids],
+                max_new_tokens=budgets[0],
+                temperature=0.0,
+                eos_ids=self.eos_ids,
+                stream_cb=demux if any_stream else None,
+                lookahead=True,
+            )
+            r.result = [int(t) for t in seqs[0][: budgets[0]]]
+            r.done.set()
+            return
         seqs = self.model.generate(
             [r.ids for r in batch],
             max_new_tokens=max(budgets),

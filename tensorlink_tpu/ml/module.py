@@ -315,11 +315,19 @@ class DistributedModel:
         *,
         session: str | None = None,
         cache_len: int | None = None,
+        sample: dict | None = None,
+        last_idx: np.ndarray | None = None,
     ) -> np.ndarray:
         """Chain the pipeline stages; returns logits ``[B, T, V]``.
 
         ``session`` keeps per-stage KV caches alive on the workers between
         calls (decode); omit it for stateless forward.
+
+        ``sample`` ({temperature, top_k, top_p, seed, step}): the stage
+        holding the head samples ON-WORKER and this returns token ids
+        ``[B]`` instead of logits — the pipelined-decode path, which
+        otherwise ships full-vocab logits host-side every token
+        (``last_idx`` names each row's final real position at prefill).
         """
         assert self.plan is not None
         x = np.asarray(tokens, np.int32)
@@ -330,6 +338,15 @@ class DistributedModel:
         if attn_mask is not None:
             body_common["attn_mask"] = np.asarray(attn_mask, bool)
 
+        def samp_body(base: dict) -> dict:
+            if sample is not None:
+                base["sample"] = sample
+                if last_idx is not None:
+                    base["last_idx"] = np.asarray(last_idx, np.int32)
+            return base
+
+        last = self.plan.stages[-1]
+        head_on_last = last.last and last.holds_head
         out: np.ndarray | None = None
         for stage in self.plan.stages:
             body = dict(body_common, op="stage")
@@ -337,17 +354,22 @@ class DistributedModel:
                 body["tokens"] = x
             else:
                 body["hidden"] = out
+            if head_on_last and stage is last:
+                body = samp_body(body)
             resp = self._request(stage.worker_id, proto.FORWARD, body)
+            if "token" in resp:
+                return np.asarray(resp["token"], np.int32)
             out = np.asarray(resp["out"])
 
-        last = self.plan.stages[-1]
-        if not (last.last and last.holds_head):
+        if not head_on_last:
             head_stage = next(s for s in self.plan.stages if s.holds_head)
             resp = self._request(
                 head_stage.worker_id,
                 proto.FORWARD,
-                {"job_id": self.job_id, "op": "head", "hidden": out},
+                samp_body({"job_id": self.job_id, "op": "head", "hidden": out}),
             )
+            if "token" in resp:
+                return np.asarray(resp["token"], np.int32)
             out = np.asarray(resp["out"])
         return out
 
@@ -494,18 +516,23 @@ class DistributedModel:
 
         session = secrets.token_hex(8)
         cache_len = min(self.spec["seq_len"], T + max_new_tokens)
-        rng = np.random.default_rng(seed)
         eos = set(int(e) for e in eos_ids)
 
-        logits = self.forward(
-            toks, mask, session=session, cache_len=cache_len
-        )
+        # the head-holding worker samples on-device and ships ONE token id
+        # per row per step — not [B, vocab] logits across every hop (at a
+        # 151k vocab that transfer alone was ~600 KB/token)
+        samp = {
+            "temperature": float(temperature), "top_k": int(top_k),
+            "top_p": float(top_p), "seed": int(seed),
+        }
         last_idx = mask.sum(-1) - 1
-        step_logits = logits[np.arange(B), last_idx]
+        tok = self.forward(
+            toks, mask, session=session, cache_len=cache_len,
+            sample=dict(samp, step=0), last_idx=last_idx,
+        )
 
         seqs: list[list[int]] = [[] for _ in range(B)]
         done = np.zeros(B, bool)
-        tok = _sample_host(step_logits, temperature, rng, top_k=top_k, top_p=top_p)
         for step in range(max_new_tokens):
             emitted: list[int | None] = []
             for i in range(B):
@@ -519,12 +546,12 @@ class DistributedModel:
                 stream_cb(emitted)
             if done.all() or step == max_new_tokens - 1:
                 break
-            logits = self.forward(
+            tok = self.forward(
                 tok[:, None].astype(np.int32),
                 session=session,
                 cache_len=cache_len,
+                sample=dict(samp, step=step + 1),
             )
-            tok = _sample_host(logits[:, 0], temperature, rng, top_k=top_k, top_p=top_p)
 
         # drop the session caches on the workers
         for stage in self.plan.stages:
@@ -869,33 +896,3 @@ def _ce_sum_and_grad(logits, tokens, loss_mask):
     nll_sum, dlogits = jax.value_and_grad(loss_fn)(logits)
     n_tok = np.asarray(mask[:, 1:].sum())
     return np.asarray(nll_sum), np.asarray(dlogits), n_tok
-
-
-def _sample_host(
-    logits: np.ndarray, temperature: float, rng, *, top_k: int = 0,
-    top_p: float = 1.0,
-) -> np.ndarray:
-    """Greedy / temperature / top-k / top-p sampling on host (pipelined
-    decode only; the single-stage path samples on device, engine/sampling.py
-    — same filtering order: top-k then top-p)."""
-    if temperature <= 0.0:
-        return np.argmax(logits, -1).astype(np.int32)
-    x = logits.astype(np.float64) / temperature
-    x -= x.max(-1, keepdims=True)
-    p = np.exp(x)
-    p /= p.sum(-1, keepdims=True)
-    out = np.empty(p.shape[0], np.int32)
-    for i, row in enumerate(p):
-        if top_k and top_k < len(row):
-            kth = np.partition(row, -top_k)[-top_k]
-            row = np.where(row >= kth, row, 0.0)
-        if top_p < 1.0:
-            order = np.argsort(-row)
-            csum = np.cumsum(row[order])
-            keep_n = max(int(np.searchsorted(csum, top_p * csum[-1]) + 1), 1)
-            mask = np.zeros_like(row, bool)
-            mask[order[:keep_n]] = True
-            row = np.where(mask, row, 0.0)
-        row = row / row.sum()
-        out[i] = rng.choice(len(row), p=row)
-    return out

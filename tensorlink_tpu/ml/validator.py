@@ -175,6 +175,7 @@ class DistributedValidator:
                 node_id=s["id"],
                 hbm_bytes=float(s.get("free_bytes", s.get("hbm_bytes", 0.0))),
                 n_devices=int(s.get("n_devices", 1)),
+                slice_id=str(s.get("slice_id", "") or ""),
             )
             for s in stats
         ]
@@ -182,6 +183,7 @@ class DistributedValidator:
             cfg, workers, model_name=name, batch=batch,
             seq_len=seq_len, training=training, n_micro=n_micro,
             mesh_hints=mesh_hints,
+            merge_co_slice=self.node.config.ml.co_slice_planning,
         )
         total_layers = max(cfg.n_layers, 1)
         job = {
@@ -420,6 +422,9 @@ class DistributedValidator:
                 read_offset = len(emitted_ids)
                 _emit(delta)
 
+        # speculative decode is greedy-only; the emitted tokens are identical
+        # to vanilla greedy, so the flag is a pure speed hint
+        spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0
         if job.batcher is not None:
             # concurrent requests coalesce into one batched decode
             # (ml/batching.py); the batcher demuxes this request's tokens
@@ -430,6 +435,7 @@ class DistributedValidator:
                 top_k=args["top_k"],
                 top_p=args["top_p"],
                 stream_cb=stream_cb if on_delta is not None else None,
+                lookahead=spec,
             )
         else:
             with job.lock:  # serialize per-model generation
@@ -441,6 +447,7 @@ class DistributedValidator:
                     top_p=args["top_p"],
                     eos_ids=tok.eos_ids,
                     stream_cb=stream_cb if on_delta is not None else None,
+                    lookahead=spec,
                 )
             out_ids = seqs[0]
         if on_delta is not None:

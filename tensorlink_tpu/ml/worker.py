@@ -122,6 +122,21 @@ class DistributedWorker:
             "platform": probe.platform,
             "training": True,
         }
+        # hosts of one TPU slice share an ICI domain: advertise the slice so
+        # the planner can merge co-slice workers into one mesh
+        # (parallel/planner.py::_merge_co_slice). Configurable override for
+        # deployments where the runtime does not expose slice topology.
+        sid = self.node.config.ml.slice_id or ""
+        if not sid and devs:
+            # auto-detect only when TPU_NAME names the pod: a bare
+            # slice_index collides across unrelated pods and would merge
+            # workers that share no ICI
+            sidx = getattr(devs[0], "slice_index", None)
+            pod = os.environ.get("TPU_NAME")
+            if sidx is not None and probe.platform == "tpu" and pod:
+                sid = f"{pod}:{sidx}"
+        if sid:
+            out["slice_id"] = sid
         if probe.degraded:
             out["degraded"] = True
             out["device_error"] = probe.error
@@ -247,15 +262,14 @@ class DistributedWorker:
             # (stage_forward, the generation engine) dequantizes on the fly
             # through quant.matmul. "+kv" also stores decode-session and
             # engine KV caches int8. Training needs exact weights for the
-            # optimizer, and a sharded tree has no QTensor partition specs.
+            # optimizer. Sharded stages compose: quantizing the
+            # already-sharded tree keeps GSPMD shardings on q and scale.
             if quant not in ("int8", "int8+kv"):
                 # fail the MODULE load (the user sees the error) rather
                 # than silently serving a mode they didn't ask for
                 raise ValueError(f"unknown quant mode {quant!r}")
             if training:
                 self.log.warning("quant=%s ignored for a TRAINING job", quant)
-            elif mesh is not None:
-                self.log.warning("quant=%s ignored on a sharded stage", quant)
             else:
                 from tensorlink_tpu.models.quant import quantize_params
 
@@ -348,6 +362,7 @@ class DistributedWorker:
             rt.cfg,
             data_axis="data" if dp > 1 and batch % dp == 0 else None,
             tensor_axis="tensor" if tp > 1 and rt.cfg.n_kv_heads % tp == 0 else None,
+            quantized=rt.cache_quant,
         )
 
     def _runtime(self, job_id: str) -> StageRuntime:
@@ -502,6 +517,16 @@ class DistributedWorker:
             logits = head_forward(rt.params, hidden, rt.cfg)
             if train:
                 rt.saved[tag + ".head"] = ("head", None, hidden, None, True)
+            if p.get("sample") is not None and not train:
+                # pipelined decode: sample HERE and ship one token id per
+                # row instead of [B, T, 151k-vocab] logits across the hop
+                tok = self._sample_from_logits(
+                    logits, p.get("last_idx"), p["sample"]
+                )
+                self._respond(
+                    p["peer"], proto.FORWARD_RESP, p["rid"], {"token": tok}
+                )
+                return
             self._respond(
                 p["peer"], proto.FORWARD_RESP, p["rid"],
                 {"out": np.asarray(jax.device_get(logits))},
@@ -590,10 +615,49 @@ class DistributedWorker:
         )
         if session is not None:
             rt.sessions[session] = new_cache
+        if p.get("sample") is not None and apply_head:
+            # final pipeline stage of a decode session: sample on-worker and
+            # return the token ids — the per-token logits transfer
+            # (~600 KB at a 151k vocab) never leaves the device host
+            tok = self._sample_from_logits(out, p.get("last_idx"), p["sample"])
+            self._respond(
+                p["peer"], proto.FORWARD_RESP, p["rid"], {"token": tok}
+            )
+            return
         self._respond(
             p["peer"], proto.FORWARD_RESP, p["rid"],
             {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
         )
+
+    def _sample_from_logits(self, logits, last_idx, samp: dict) -> np.ndarray:
+        """Worker-side sampling for pipelined decode (ml/module.py
+        _generate_pipelined): gather each row's last real position (prefill)
+        or the single decode position, then run the jitted sampler with a
+        deterministic (seed, step)-derived key."""
+        import jax
+        import jax.numpy as jnp
+
+        from tensorlink_tpu.engine.sampling import SamplingParams, sample
+
+        if logits.ndim == 3:
+            B = logits.shape[0]
+            if last_idx is not None:
+                idx = jnp.asarray(np.asarray(last_idx, np.int32))
+            else:
+                idx = jnp.full((B,), logits.shape[1] - 1, jnp.int32)
+            step_logits = logits[jnp.arange(B), idx]
+        else:
+            step_logits = logits
+        sp = SamplingParams.make(
+            temperature=float(samp.get("temperature", 0.0)),
+            top_k=int(samp.get("top_k", 0)),
+            top_p=float(samp.get("top_p", 1.0)),
+        )
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(int(samp.get("seed", 0))),
+            int(samp.get("step", 0)),
+        )
+        return np.asarray(jax.device_get(sample(step_logits, key, sp)))
 
     # -- backward (reference _handle_backward replays torch autograd,
     # ml/worker.py:233-291; here it applies the recorded vjp) -------------

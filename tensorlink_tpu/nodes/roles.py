@@ -140,6 +140,20 @@ class RoleServer(TensorNode):
         conn = await self.connect(p["host"], p["port"])
         return conn.node_id
 
+    async def cmd_disconnect(self, p) -> bool:
+        """Close the connection to a peer by id (or unique id prefix) —
+        ops/testing surface for pruning a mesh link. An ambiguous prefix
+        matches nothing rather than severing an arbitrary peer."""
+        pid = p.get("peer", "")
+        if not pid:
+            return False
+        matches = [c for nid, c in self.connections.items()
+                   if nid.startswith(pid)]
+        if len(matches) != 1:
+            return False
+        await matches[0].close()
+        return True
+
     async def cmd_dht_get(self, p):
         return await self.dht_query(p["key"])
 
@@ -293,6 +307,14 @@ class ValidatorServer(RoleServer):
             from tensorlink_tpu.platform.chain import from_env
 
             chain = from_env(EnvFile(cfg.env_file))
+            if chain is not None:
+                # Sybil gate: a fresh key starts clean with LOCAL reputation,
+                # so on-chain mode also requires peers claiming validator/
+                # worker roles to be chain-registered before the handshake
+                # completes (reference smart_node.py:708-739)
+                from tensorlink_tpu.platform.chain import make_credential_check
+
+                self.credential_check = make_credential_check(chain.client)
         self.contract = ContractManager(self.node_id, chain=chain)
         self.worker_capacity_total = 0.0
         # workers seen disconnecting since the last proposal round —
@@ -314,6 +336,11 @@ class ValidatorServer(RoleServer):
         self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
         self.register(proto.JOB_REPAIR, self._handle_job_repair)
         self.register(proto.PROPOSAL, self._handle_proposal)
+        self.register(proto.REQUEST_WORKERS, self._handle_request_workers)
+        # workers advertised by OTHER validators (id -> [host, port]) so a
+        # plan can place stages on them; connections are made lazily at
+        # recruit time (reference REQUEST-WORKERS, validator_thread.py:889-928)
+        self.remote_workers: dict[str, list] = {}
 
     def _restore_state(self) -> None:
         """Reload persisted DHT entries + stats (reference keeper restore at
@@ -436,7 +463,7 @@ class ValidatorServer(RoleServer):
                     await user_conn.send_control(proto.JOB_UPDATE, update)
                 except (ConnectionError, OSError):
                     pass
-            self.reputation.record(dead_wid, "job_failed")
+            self.reputation.record(dead_wid, "worker_dropped")
             self.log.info(
                 "job %s: replaced worker %s -> %s", job_id[:8],
                 dead_wid[:8], cand[:8],
@@ -630,25 +657,108 @@ class ValidatorServer(RoleServer):
              "req_id": req_id},
         )
 
-    async def cmd_stats_workers(self, p) -> list[dict]:
-        """Fan STATS_REQUEST out to connected workers (reference
-        validator_thread.py:889-928)."""
-        out = []
-        for nid in list(self.connections):
-            if self.roles.get(nid) != "worker":
-                continue
+    async def _own_worker_stats(self) -> list[dict]:
+        """Fan STATS_REQUEST out to this validator's connected workers
+        CONCURRENTLY (one slow worker must not serialize the sweep — the
+        peer validator asking via REQUEST-WORKERS waits on the total),
+        tagging each with its reachable listen address."""
+
+        async def one(nid: str) -> dict | None:
             try:
                 reply = await self.request(
                     self._conn(nid), proto.STATS_REQUEST, {}, timeout=5.0
                 )
-                out.append({k: v for k, v in reply.items()
-                            if k not in ("_rid", "_resp")})
             except (TimeoutError, asyncio.TimeoutError, ConnectionError):
-                continue
+                return None
+            stat = {k: v for k, v in reply.items()
+                    if k not in ("_rid", "_resp")}
+            addr = self.addresses.get(nid)
+            if addr:
+                stat["addr"] = list(addr)
+            return stat
+
+        wids = [nid for nid in list(self.connections)
+                if self.roles.get(nid) == "worker"]
+        replies = await asyncio.gather(*(one(n) for n in wids))
+        return [s for s in replies if s is not None]
+
+    async def cmd_stats_workers(self, p) -> list[dict]:
+        """Worker pool for planning: this validator's own workers PLUS the
+        pools of its validator peers (reference REQUEST-WORKERS,
+        validator_thread.py:889-928) — so a job can be placed on a worker
+        known only to another validator. Own stats win on id collision (a
+        worker connected to several validators)."""
+        out = await self._own_worker_stats()
+        seen = {s.get("id") for s in out}
+
+        async def ask(nid: str) -> list[dict]:
+            try:
+                reply = await self.request(
+                    self._conn(nid), proto.REQUEST_WORKERS, {}, timeout=7.0
+                )
+            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                return []
+            return list(reply.get("workers", []))
+
+        vids = [nid for nid in list(self.connections)
+                if self.roles.get(nid) == "validator"]
+        peer_pools = await asyncio.gather(*(ask(n) for n in vids))
+        advertised: dict[str, list] = {}
+        for pool in peer_pools:
+            for stat in pool:
+                wid = stat.get("id")
+                if not wid or wid in seen:
+                    continue
+                seen.add(wid)
+                if stat.get("addr"):
+                    advertised[wid] = list(stat["addr"])
+                out.append(stat)
+        # rebuilt wholesale each sweep so departed workers' addresses are
+        # pruned rather than accumulating for the process lifetime
+        self.remote_workers = advertised
         self.worker_capacity_total = sum(
             float(s.get("hbm_bytes", 0.0)) for s in out
         )
         return out
+
+    async def _handle_request_workers(self, conn, kind, tag, body) -> None:
+        """A validator peer asks for this validator's spare workers. Answer
+        with OWN workers only — never relayed ones — so a two-validator
+        cycle cannot amplify into a request storm. The stats sweep runs as
+        a task: handlers are awaited inline on the connection's read loop
+        (p2p/node.py::_on_frame), and a multi-second fan-out must not
+        head-of-line-block every other frame on this link."""
+        if self.roles.get(conn.node_id) != "validator":
+            await self.respond(conn, proto.WORKERS, body, {"workers": []})
+            return
+
+        async def answer() -> None:
+            stats = await self._own_worker_stats()
+            try:
+                await self.respond(conn, proto.WORKERS, body, {"workers": stats})
+            except (ConnectionError, OSError):
+                pass
+
+        t = asyncio.ensure_future(answer())
+        self._conn_tasks.add(t)
+        t.add_done_callback(self._conn_tasks.discard)
+
+    async def _worker_conn(self, wid: str) -> Connection:
+        """Connection to a worker, dialing out lazily when the worker is
+        known only via another validator's REQUEST-WORKERS advertisement."""
+        conn = self.connections.get(wid)
+        if conn is not None:
+            return conn
+        addr = self.remote_workers.get(wid)
+        if not addr:
+            raise ConnectionError(f"no connection to {wid[:12]}")
+        conn = await self.connect(addr[0], int(addr[1]))
+        if conn.node_id != wid:
+            raise ConnectionError(
+                f"worker at {addr[0]}:{addr[1]} is {conn.node_id[:12]}, "
+                f"not {wid[:12]}"
+            )
+        return conn
 
     async def cmd_create_job(self, p) -> dict:
         """Recruit the planned workers, store the job, answer the user.
@@ -664,26 +774,35 @@ class ValidatorServer(RoleServer):
         declined: list[str] = []
         for stage in plan["stages"]:
             wid = stage["worker_id"]
-            if wid in accepted:
-                continue
-            try:
-                reply = await self.request(
-                    self._conn(wid), proto.JOB_REQ,
-                    {"job_id": job_id, "stage": stage,
-                     "est_bytes": job.get("stage_bytes", {}).get(wid, 0.0)},
-                    timeout=RECRUIT_TIMEOUT,
-                )
-            except (TimeoutError, asyncio.TimeoutError, ConnectionError):
-                declined.append(wid)
-                continue
-            if "addr" not in reply:  # decline replies carry no address
-                declined.append(wid)
-            else:
-                # the worker reports its *bind* host (may be 0.0.0.0); the
-                # routable address is the one this validator observed at
-                # handshake (P2PNode.addresses) + the advertised listen port
-                host, _ = self.addresses.get(wid, (None, None))
-                accepted[wid] = [host or reply["addr"][0], reply["addr"][1]]
+            # co-slice members share the stage's reservation — each must
+            # accept (and reserve its share) or the whole recruit fails
+            members = [wid] + [
+                c for c in stage.get("coworkers", []) if c not in accepted
+            ]
+            est = job.get("stage_bytes", {}).get(wid, 0.0) / max(len(members), 1)
+            for member in members:
+                if member in accepted:
+                    continue
+                try:
+                    reply = await self.request(
+                        await self._worker_conn(member), proto.JOB_REQ,
+                        {"job_id": job_id, "stage": stage, "est_bytes": est},
+                        timeout=RECRUIT_TIMEOUT,
+                    )
+                except (TimeoutError, asyncio.TimeoutError, ConnectionError):
+                    declined.append(member)
+                    continue
+                if "addr" not in reply:  # decline replies carry no address
+                    declined.append(member)
+                else:
+                    # the worker reports its *bind* host (may be 0.0.0.0);
+                    # the routable address is the one this validator observed
+                    # at handshake (P2PNode.addresses) + the advertised
+                    # listen port
+                    host, _ = self.addresses.get(member, (None, None))
+                    accepted[member] = [
+                        host or reply["addr"][0], reply["addr"][1]
+                    ]
 
         ok = not declined
         if not ok:

@@ -79,6 +79,11 @@ class P2PNode:
         self.dht = DHT(self.node_id, forward=self._dht_forward)
         self.limiter = RateLimiter()
         self.reputation = ReputationTracker()
+        # optional Sybil gate (reference smart_node.py:708-739 checks a
+        # peer's chain-registered identity before accepting its role):
+        # (node_id, role) -> bool, called off-loop (it may do blocking RPC).
+        # None = local reputation only.
+        self.credential_check: Callable[[str, str], bool] | None = None
         self.handlers: dict[str, Handler] = {}
         self.started = threading.Event()
         self.terminate = threading.Event()
@@ -181,6 +186,24 @@ class P2PNode:
             "id": self.node_id,
         }
 
+    async def _check_credentials(self, node_id: str, role: str) -> None:
+        """On-chain (or otherwise external) identity gate: a fresh Sybil key
+        starts clean with every validator's LOCAL reputation, so role
+        acceptance must also consult the shared registry (reference
+        smart_node.py:708-739). Runs in a worker thread — the check is
+        typically a blocking RPC — and BEFORE the handshake completes, so
+        the refused peer sees a failed handshake on its own side."""
+        if self.credential_check is None:
+            return
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self.credential_check, node_id, role
+        )
+        if not ok:
+            raise HandshakeError(
+                f"peer {node_id[:12]} role={role} not registered "
+                "with the credential registry"
+            )
+
     async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         ip = (writer.get_extra_info("peername") or ("?",))[0]
         if not self.limiter.allow(ip):
@@ -221,6 +244,11 @@ class P2PNode:
             proof = proto.parse_control(payload)
             if not crypto.verify(peer_pub, bytes.fromhex(proof["sig"]), nonce_b.encode()):
                 raise HandshakeError("bad proof signature")
+            # registry gate AFTER the proof: the peer has demonstrated key
+            # possession, so an attacker cannot turn unauthenticated HELLOs
+            # into blocking chain RPCs (the refused peer still sees a failed
+            # handshake — no WELCOME was sent)
+            await self._check_credentials(peer_id, hello.get("role", ""))
             await self._write_frame(writer, proto.WELCOME, {"id": self.node_id})
             await self._register_peer(
                 reader, writer, peer_pub, hello["role"], ip, int(hello.get("port", 0))
@@ -248,6 +276,9 @@ class P2PNode:
                 raise HandshakeError("bad public key")
             if not crypto.verify(peer_pub, bytes.fromhex(ch["sig"]), nonce_a.encode()):
                 raise HandshakeError("bad challenge signature")
+            await self._check_credentials(
+                crypto.node_id_from_public_key(peer_pub), ch.get("role", "")
+            )
             await self._write_frame(
                 writer,
                 proto.PROOF,
@@ -441,7 +472,7 @@ class P2PNode:
 
     async def _handle_dht_delete(self, conn, kind, tag, body) -> None:
         key, ts = body["key"], body.get("ts")
-        changed = self.dht.delete(key, ts=float(ts) if ts else None)
+        changed = self.dht.delete(key, ts=float(ts) if ts is not None else None)
         # relay replicated deletes exactly like stores — the tombstone makes
         # re-application a no-op, which terminates the flood
         if (

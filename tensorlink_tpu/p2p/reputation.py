@@ -24,6 +24,9 @@ EVENT_WEIGHTS = {
     "spam": -8.0,  # rate-limit violation after authentication
     "job_completed": 5.0,
     "job_failed": -10.0,  # died mid-job / failed to deliver
+    "worker_dropped": -3.0,  # liveness replacement — may be a network blip,
+    # so three in a day (half-life) must NOT cross BAN_THRESHOLD the way
+    # three verified job failures do
     "proof_failed": -12.0,  # PoL log that didn't verify (platform/proofs.py)
     "proposal_mismatch": -15.0,  # contract-round hash that didn't validate
 }

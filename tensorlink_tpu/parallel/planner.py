@@ -52,8 +52,9 @@ class WorkerCapacity:
     node_id: str
     hbm_bytes: float
     n_devices: int = 1
-    # per-device ICI connectivity implies which axes are cheap; workers on one
-    # slice report the same slice_id so the planner knows TP/FSDP stay on ICI
+    # workers advertising the same nonempty slice_id share one ICI domain
+    # and are merged into a single planned mesh (_merge_co_slice) — TP/FSDP
+    # between them rides ICI instead of a TCP stage hop
     slice_id: str = ""
 
 
@@ -82,12 +83,20 @@ class MemoryEstimate:
         grads = n * pb if training else 0
         # adam: m+v in fp32 (reference ml/utils.py:75-78); sgd: 0
         opt = 2 * n * 4 if (training and optimizer.startswith("adam")) else 0
+        # recompute working set of ONE layer (only one alive under remat):
+        # qkv/o projections (~4 d_model tensors), the two mlp streams
+        # (d_ff), and — on the einsum attention path — the materialized
+        # [B, heads, S, S] probabilities (flash never materializes them)
+        layer_ws = batch * seq_len * (4 * cfg.d_model + 2 * cfg.d_ff) * pb
+        if not cfg.flash_attention:
+            layer_ws += batch * cfg.n_heads * seq_len * seq_len * pb
         if training:
-            # under remat we keep one residual per layer boundary plus the
-            # per-layer recompute working set (~4 live d_model tensors)
-            act = batch * seq_len * cfg.d_model * pb * (cfg.n_layers + 8)
+            # one residual per layer boundary (saved under remat) + the
+            # per-layer recompute working set
+            act = batch * seq_len * cfg.d_model * pb * (cfg.n_layers + 4)
+            act += layer_ws
         else:
-            act = batch * seq_len * cfg.d_model * pb * 4
+            act = batch * seq_len * cfg.d_model * pb * 4 + layer_ws
         kv = (
             2
             * cfg.n_layers
@@ -121,6 +130,10 @@ class StagePlan:
     last: bool  # final pipeline position — its output feeds the head
     holds_head: bool = False  # params include final_norm (+ lm_head)
     mesh_axes: dict[str, int] = field(default_factory=dict)
+    # other workers on the same ICI slice merged into this stage's mesh
+    # (co-slice planning): they join the primary's multi-host mesh instead
+    # of receiving a TCP stage hop of their own
+    coworkers: list[str] = field(default_factory=list)
 
     @property
     def layer_range(self) -> tuple[int, int]:
@@ -294,6 +307,80 @@ def _mesh_axes_for(
     return axes
 
 
+def _per_device_bytes(
+    est: MemoryEstimate,
+    axes: dict[str, int],
+    *,
+    frac: float = 1.0,
+    cfg: ModelConfig | None = None,
+    batch: int = 1,
+    exclude_model_bytes: float = 0.0,
+) -> float:
+    """Bytes each device must hold for (a ``frac`` layer-fraction of) the
+    estimate under ``axes``. Sharding geometry: params/grads/optimizer shard
+    over tensor×fsdp×expert×stage but REPLICATE over data (the r3 bug: a
+    4-device worker "fit" a model each chip could not hold — aggregate HBM
+    is only reachable for axes that actually shard the tensor). Activations
+    and KV shard over the data axis only when the batch divides it, and KV
+    over tensor only when the kv heads divide it — mirroring the worker's
+    runtime degrade rules (ml/worker.py::_cache_specs_for), which otherwise
+    REPLICATE those arrays per device."""
+
+    def ax(name: str) -> int:
+        return max(int(axes.get(name, 1)), 1)
+
+    dp = ax("data")
+    dp_eff = dp if batch % dp == 0 else 1
+    tp_kv = ax("tensor")
+    if cfg is not None and cfg.n_kv_heads % tp_kv:
+        tp_kv = 1
+    shard_model = ax("tensor") * ax("fsdp") * ax("expert") * ax("stage")
+    shard_act = ax("fsdp") * dp_eff * ax("seq")
+    shard_kv = dp_eff * tp_kv
+    model_bytes = max(
+        est.params + est.grads + est.optimizer - exclude_model_bytes, 0.0
+    )
+    model = model_bytes * frac / shard_model
+    act = est.activations * frac / shard_act
+    kv = est.kv_cache * frac / shard_kv
+    return (model + act + kv) * 1.1
+
+
+def _merge_co_slice(
+    workers: list[WorkerCapacity],
+) -> tuple[list[WorkerCapacity], dict[str, list[str]]]:
+    """Workers advertising the same nonempty ``slice_id`` share one ICI
+    domain (hosts of one TPU slice): merge each group into a single logical
+    capacity — pooled HBM, pooled devices — so planning emits ONE mesh whose
+    TP/FSDP axes ride ICI instead of a TCP stage hop between the hosts. The
+    largest-HBM member (id tiebreak) is the primary/executor; the rest ride
+    the emitted stage's ``coworkers`` list."""
+    groups: dict[str, list[WorkerCapacity]] = {}
+    out: list[WorkerCapacity] = []
+    for w in workers:
+        if w.slice_id:
+            groups.setdefault(w.slice_id, []).append(w)
+        else:
+            out.append(w)
+    co: dict[str, list[str]] = {}
+    for sid, grp in groups.items():
+        if len(grp) == 1:
+            out.append(grp[0])
+            continue
+        grp = sorted(grp, key=lambda g: (-g.hbm_bytes, g.node_id))
+        primary = grp[0]
+        out.append(
+            WorkerCapacity(
+                node_id=primary.node_id,
+                hbm_bytes=sum(g.hbm_bytes for g in grp),
+                n_devices=sum(g.n_devices for g in grp),
+                slice_id=sid,
+            )
+        )
+        co[primary.node_id] = [g.node_id for g in grp[1:]]
+    return out, co
+
+
 def plan_sharding(
     cfg: ModelConfig,
     workers: list[WorkerCapacity],
@@ -304,6 +391,7 @@ def plan_sharding(
     training: bool = False,
     n_micro: int | None = None,
     mesh_hints: dict[str, int] | None = None,
+    merge_co_slice: bool = False,
 ) -> ShardingPlan:
     """Assign the model to workers.
 
@@ -312,40 +400,57 @@ def plan_sharding(
     worker capacity — best-fit ordering, largest worker first (reference
     best-fit prefers the previous worker, graphing.py:730-761; contiguity is
     what matters on TPU since stage boundaries are the only cross-node hops).
+
+    ``merge_co_slice`` (opt-in, MLConfig.co_slice_planning): pool same-
+    slice_id workers into one planned mesh. Requires a runtime where the
+    primary worker's JAX process can address the whole slice's devices
+    (single-controller over the slice; the coworker entries let the
+    validator reserve capacity on every member) — with the default
+    per-process runtime such a plan cannot execute, so the merge is off
+    unless the deployment asserts support.
     """
     if not workers:
         raise AssignmentError("no workers available")
+    co_slice: dict[str, list[str]] = {}
+    if merge_co_slice:
+        workers, co_slice = _merge_co_slice(workers)
     est = MemoryEstimate.build(
         cfg, batch=batch, seq_len=seq_len, training=training
     )
     ranked = sorted(workers, key=lambda w: -w.hbm_bytes)
 
-    # 1) whole-model fit on the single best worker
+    # 1) whole-model fit on the single best worker — both in aggregate AND
+    # per device under the mesh that would actually be emitted (replicated
+    # tensors cannot borrow a neighbor chip's HBM)
     best = ranked[0]
     if est.total <= best.hbm_bytes:
-        stage = StagePlan(
-            worker_id=best.node_id,
-            layer_lo=0,
-            layer_hi=cfg.n_layers,
-            first=True,
-            last=True,
-            holds_head=True,
-            mesh_axes=_mesh_axes_for(
-                cfg, best, training,
-                seq_len=seq_len,
-                stage_layers=cfg.n_layers,
-                mesh_hints=mesh_hints,
-            ),
-        )
-        return ShardingPlan(
-            model_name=model_name,
-            stages=[stage],
-            n_micro=n_micro or 1,
-            batch=batch,
+        axes = _mesh_axes_for(
+            cfg, best, training,
             seq_len=seq_len,
-            training=training,
-            estimate=est,
+            stage_layers=cfg.n_layers,
+            mesh_hints=mesh_hints,
         )
+        per_dev_hbm = best.hbm_bytes / max(best.n_devices, 1)
+        if _per_device_bytes(est, axes, cfg=cfg, batch=batch) <= per_dev_hbm:
+            stage = StagePlan(
+                worker_id=best.node_id,
+                layer_lo=0,
+                layer_hi=cfg.n_layers,
+                first=True,
+                last=True,
+                holds_head=True,
+                mesh_axes=axes,
+                coworkers=co_slice.get(best.node_id, []),
+            )
+            return ShardingPlan(
+                model_name=model_name,
+                stages=[stage],
+                n_micro=n_micro or 1,
+                batch=batch,
+                seq_len=seq_len,
+                training=training,
+                estimate=est,
+            )
 
     # 2) pipeline split: per-layer cost + embedding/head overheads
     pb = _dtype_bytes(cfg.dtype)
@@ -359,9 +464,27 @@ def plan_sharding(
     remaining = cfg.n_layers
     for i, w in enumerate(ranked[:MAX_STAGES]):
         budget = w.hbm_bytes
+        # per-device constraint for this worker's would-be mesh
+        # (stage_layers=0 sidesteps the stage-divisibility hint check, which
+        # re-runs for real at emission time below)
+        axes = _mesh_axes_for(
+            cfg, w, training, seq_len=seq_len, stage_layers=0,
+            mesh_hints=mesh_hints,
+        )
+        shard_model = 1
+        for name in ("tensor", "fsdp", "expert", "stage"):
+            shard_model *= max(int(axes.get(name, 1)), 1)
+        dev_budget = w.hbm_bytes / max(w.n_devices, 1)
         if i == 0:
             budget -= emb_bytes  # embeddings (tied → head too) pin to stage 0
-        fit = int(budget // per_layer)
+            dev_budget -= emb_bytes / shard_model
+        # embeddings are accounted against stage 0's budget above, so the
+        # per-layer cost must exclude them just like the aggregate term does
+        per_layer_dev = _per_device_bytes(
+            est, axes, frac=1.0 / max(cfg.n_layers, 1), cfg=cfg, batch=batch,
+            exclude_model_bytes=2 * cfg.vocab_size * cfg.d_model * pb,
+        )
+        fit = min(int(budget // per_layer), int(dev_budget // per_layer_dev))
         if fit <= 0:
             continue
         take = min(fit, remaining)
@@ -394,6 +517,7 @@ def plan_sharding(
                     stage_layers=n_l,
                     mesh_hints=mesh_hints,
                 ),
+                coworkers=co_slice.get(w.node_id, []),
             )
         )
         lo += n_l

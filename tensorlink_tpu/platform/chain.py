@@ -284,30 +284,71 @@ def selector(fn_sig: str) -> bytes:
     return keccak256(fn_sig.encode())[:4]
 
 
+def _abi_static_word(t: str, a: Any) -> bytes:
+    """One 32-byte word for a static type."""
+    if t == "bytes32":
+        b = bytes.fromhex(a[2:]) if isinstance(a, str) else bytes(a)
+        if len(b) != 32:
+            raise ValueError(f"bytes32 arg of length {len(b)}")
+        return b
+    if t.startswith("uint") or t.startswith("int"):
+        v = int(a)
+        return (v % (1 << 256)).to_bytes(32, "big")
+    if t == "address":
+        h = a[2:] if isinstance(a, str) and a.startswith("0x") else a
+        return bytes.fromhex(h).rjust(32, b"\x00")
+    if t == "bool":
+        return int(bool(a)).to_bytes(32, "big")
+    raise ValueError(f"unsupported ABI type {t}")
+
+
+def _abi_is_dynamic(t: str) -> bool:
+    return t.endswith("[]") or t in ("bytes", "string")
+
+
+def _abi_tail(t: str, a: Any) -> bytes:
+    """Tail encoding of a dynamic value (length word + padded payload)."""
+    if t.endswith("[]"):
+        base = t[:-2]
+        if _abi_is_dynamic(base):
+            raise ValueError(f"nested dynamic ABI type {t} not supported")
+        items = list(a)
+        return len(items).to_bytes(32, "big") + b"".join(
+            _abi_static_word(base, x) for x in items
+        )
+    if t in ("bytes", "string"):
+        b = a.encode() if t == "string" else (
+            bytes.fromhex(a[2:]) if isinstance(a, str) else bytes(a)
+        )
+        pad = (-len(b)) % 32
+        return len(b).to_bytes(32, "big") + b + b"\x00" * pad
+    raise ValueError(f"unsupported dynamic ABI type {t}")
+
+
 def abi_encode_args(fn_sig: str, args: Sequence[Any]) -> bytes:
-    """Static-type encoding (bytes32 / uintN / address / bool) — the only
-    types the Smartnodes surface uses (proposal hashes, rounds, addresses)."""
+    """Solidity ABI argument encoding with standard head/tail layout:
+    static types inline, dynamic types (``T[]`` of static T, ``bytes``,
+    ``string``) as head offsets into a shared tail — enough for the full
+    Smartnodes surface, including reward claims whose ``bytes32[]`` merkle
+    proof arrays the previous static-only encoder could not express
+    (reference claim machinery, contract_manager.py:911-1000)."""
     types = fn_sig[fn_sig.index("(") + 1 : fn_sig.rindex(")")]
     type_list = [t for t in types.split(",") if t]
     if len(type_list) != len(args):
         raise ValueError(f"{fn_sig}: {len(args)} args for {len(type_list)} types")
-    out = b""
+    head_len = 32 * len(type_list)
+    heads: list[bytes] = []
+    tails: list[bytes] = []
+    tail_off = 0
     for t, a in zip(type_list, args):
-        if t == "bytes32":
-            b = bytes.fromhex(a[2:]) if isinstance(a, str) else bytes(a)
-            if len(b) != 32:
-                raise ValueError(f"bytes32 arg of length {len(b)}")
-            out += b
-        elif t.startswith("uint") or t.startswith("int"):
-            out += int(a).to_bytes(32, "big")
-        elif t == "address":
-            h = a[2:] if isinstance(a, str) and a.startswith("0x") else a
-            out += bytes.fromhex(h).rjust(32, b"\x00")
-        elif t == "bool":
-            out += int(bool(a)).to_bytes(32, "big")
+        if _abi_is_dynamic(t):
+            heads.append((head_len + tail_off).to_bytes(32, "big"))
+            tail = _abi_tail(t, a)
+            tails.append(tail)
+            tail_off += len(tail)
         else:
-            raise ValueError(f"unsupported ABI type {t}")
-    return out
+            heads.append(_abi_static_word(t, a))
+    return b"".join(heads) + b"".join(tails)
 
 
 def call_data(fn_sig: str, args: Sequence[Any]) -> bytes:
@@ -446,6 +487,47 @@ class ChainSubmitter:
 
     def execute_proposal(self, round_: int) -> str | None:
         return self._guarded("executeProposal(uint256)", [round_])
+
+    def submit_claim(self, round_: int, claim: dict) -> str | None:
+        """Submit a worker's reward claim (reference claim flow,
+        contract_manager.py:911-1000: distribution id + capacity + merkle
+        proof; the contract recomputes the leaf from ``msg.sender`` and
+        folds the proof to the executed round's stored root). ``claim`` is
+        ``ContractManager.claim_data``'s dict: the proof's sibling hashes
+        ride as ``bytes32[]``, and the leaf index lets the contract derive
+        each fold's side (sib = index ^ 1 per level)."""
+        proof = ["0x" + h for _side, h in claim["proof"]]
+        return self._guarded(
+            "claimRewards(uint256,uint256,uint256,bytes32[])",
+            [round_, claim["capacity"], claim["index"], proof],
+        )
+
+
+def make_credential_check(client: ChainClient):
+    """Handshake Sybil gate backed by the chain registry (reference
+    smart_node.py:708-739: ``getValidatorInfo(addr)`` must say active and
+    match the peer's key hash). Node ids here ARE sha256(pubkey) hex — a
+    natural ``bytes32`` — so the registry views key on the id directly:
+    ``isActiveValidator(bytes32)`` / ``isActiveWorker(bytes32)`` return a
+    nonzero word for registered nodes. Users are not registry-gated (the
+    reference accepts "U" roles without a chain check). A failed RPC
+    REJECTS (fail closed, like the reference's contract-query-error path)."""
+
+    def check(node_id: str, role: str) -> bool:
+        view = {
+            "validator": "isActiveValidator(bytes32)",
+            "worker": "isActiveWorker(bytes32)",
+        }.get(role)
+        if view is None:
+            return True
+        try:
+            out = client.call_view(view, ["0x" + node_id])
+        except ChainError as e:
+            log.warning("credential check for %s failed: %s", node_id[:12], e)
+            return False
+        return any(out)
+
+    return check
 
 
 def from_env(env, *, default_chain_id: int | None = None) -> ChainSubmitter | None:

@@ -196,9 +196,22 @@ class ContractManager:
         return {
             "worker": worker_id,
             "capacity": ordered[idx][1],
+            "index": idx,  # leaf position — the on-chain fold derives
+            # sibling sides from it (chain.py submit_claim)
             "root": root.hex(),
+            "round": prop.round,
             "proof": [(s, h.hex()) for s, h in proof],
         }
+
+    def submit_claim(self, prop_hash: str, worker_id: str) -> str | None:
+        """On-chain reward claim for a worker's share of an executed
+        proposal (reference get_worker_claim_data + claim submission,
+        contract_manager.py:911-1000). Returns the tx hash, or None when
+        there is nothing to claim / no chain configured / RPC failed."""
+        claim = self.claim_data(prop_hash, worker_id)
+        if claim is None or self.chain is None:
+            return None
+        return self.chain.submit_claim(claim["round"], claim)
 
     @staticmethod
     def verify_claim(claim: dict) -> bool:

@@ -163,6 +163,30 @@ def test_concurrent_requests_batched(api_cluster):
         assert 0 < body["usage"]["completion_tokens"] <= 7
 
 
+def test_generate_lookahead_matches_vanilla(api_cluster):
+    """lookahead:true on /v1/generate (speculative decode, greedy) must
+    return EXACTLY the vanilla greedy text — speculation is a speed hint,
+    never a semantic one — and the request round-trips the full product
+    path (API -> batcher -> worker -> engine.generate_lookahead)."""
+    api = api_cluster.api
+    base = {"hf_name": MODEL, "message": "repeat repeat repeat repeat",
+            "max_new_tokens": 12, "do_sample": False}
+    status, vanilla = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, vanilla
+    status, spec = _req(
+        api, "POST", "/v1/generate", {**base, "lookahead": True}
+    )
+    assert status == 200, spec
+    assert spec["response"] == vanilla["response"]
+    assert spec["usage"]["completion_tokens"] == vanilla["usage"]["completion_tokens"]
+    # sampling requests ignore the hint rather than failing
+    status, body = _req(
+        api, "POST", "/v1/generate",
+        {**base, "lookahead": True, "do_sample": True, "temperature": 0.8},
+    )
+    assert status == 200, body
+
+
 def test_generate_openai_format(api_cluster):
     api = api_cluster.api
     status, body = _req(

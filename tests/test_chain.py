@@ -63,7 +63,43 @@ def test_abi_encoding():
     with pytest.raises(ValueError):
         C.abi_encode_args("f(bytes32)", ["0xabcd"])  # wrong length
     with pytest.raises(ValueError):
-        C.abi_encode_args("f(string)", ["x"])  # dynamic types unsupported
+        C.abi_encode_args("f(string[])", [["x"]])  # nested dynamic
+
+
+def test_abi_dynamic_encoding():
+    """Head/tail layout for dynamic types, pinned word by word against the
+    Solidity ABI spec (the claim path's bytes32[] proofs ride this)."""
+    h1, h2 = "aa" * 32, "bb" * 32
+    data = C.abi_encode_args(
+        "claimRewards(uint256,uint256,uint256,bytes32[])",
+        [7, 1000, 2, ["0x" + h1, "0x" + h2]],
+    )
+    words = [data[i : i + 32] for i in range(0, len(data), 32)]
+    assert int.from_bytes(words[0], "big") == 7
+    assert int.from_bytes(words[1], "big") == 1000
+    assert int.from_bytes(words[2], "big") == 2
+    assert int.from_bytes(words[3], "big") == 128  # offset past 4-word head
+    assert int.from_bytes(words[4], "big") == 2  # array length
+    assert words[5].hex() == h1 and words[6].hex() == h2
+    assert len(data) == 7 * 32
+
+    # two dynamic args: each head offset points at its own tail
+    data = C.abi_encode_args(
+        "f(bytes,uint256[])", [b"\x01\x02\x03", [5, 6]]
+    )
+    words = [data[i : i + 32] for i in range(0, len(data), 32)]
+    assert int.from_bytes(words[0], "big") == 64  # bytes tail after head
+    assert int.from_bytes(words[1], "big") == 128  # skips 2-word bytes tail
+    assert int.from_bytes(words[2], "big") == 3  # bytes length
+    assert words[3][:3] == b"\x01\x02\x03" and words[3][3:] == b"\x00" * 29
+    assert int.from_bytes(words[4], "big") == 2  # array length
+    assert [int.from_bytes(w, "big") for w in words[5:]] == [5, 6]
+
+    # string
+    s = C.abi_encode_args("f(string)", ["hi"])
+    assert int.from_bytes(s[:32], "big") == 32
+    assert int.from_bytes(s[32:64], "big") == 2
+    assert s[64:66] == b"hi"
 
 
 # ---------------------------------------------------------------------------
@@ -175,7 +211,7 @@ def test_contract_manager_submits_on_chain(eth, tmp_path):
 
     sub = C.ChainSubmitter(C.ChainClient(eth.url, CONTRACT, PRIV))
     cm = ContractManager("aa" * 32, chain=sub)
-    cm.usage = {"worker1": 1000.0}
+    cm.usage = {"worker1": 1000.0, "worker2": 500.0}
     prop = cm.create_proposal()
     h = prop.hash()
     assert len(eth.raw_txs) == 1  # createProposal
@@ -189,6 +225,26 @@ def test_contract_manager_submits_on_chain(eth, tmp_path):
     # off-chain claim artifacts unchanged by chain wiring
     claim = cm.claim_data(h, "worker1")
     assert ContractManager.verify_claim(claim)
+
+    # the worker's reward claim round-trips the stub as a real transaction
+    # whose calldata carries the merkle proof as bytes32[] (the piece the
+    # static-only encoder could not express)
+    txh = cm.submit_claim(h, "worker1")
+    assert txh and txh.startswith("0x")
+    assert len(eth.raw_txs) == 4
+    _, _, _, _, _, data, _, _, _ = C.rlp_decode(eth.raw_txs[3])
+    sig = "claimRewards(uint256,uint256,uint256,bytes32[])"
+    assert data[:4] == C.selector(sig)
+    words = [data[4 + i : 4 + i + 32] for i in range(0, len(data) - 4, 32)]
+    assert int.from_bytes(words[0], "big") == prop.round
+    assert int.from_bytes(words[1], "big") == claim["capacity"]
+    assert int.from_bytes(words[2], "big") == claim["index"]
+    assert int.from_bytes(words[3], "big") == 128
+    assert int.from_bytes(words[4], "big") == len(claim["proof"])
+    for w, (_side, hh) in zip(words[5:], claim["proof"]):
+        assert w.hex() == hh
+    # nothing to claim / unknown worker stays a clean None
+    assert cm.submit_claim(h, "nobody") is None
 
 
 def test_from_env_degrades_without_credentials(tmp_path):

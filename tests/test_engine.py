@@ -205,6 +205,53 @@ def test_prefix_cache_reuse(tiny_model):
     assert qw.sequences == qr.sequences
 
 
+def test_prefix_cache_byte_budget(tiny_model):
+    """The prefix store is bounded by BYTES, not just entry count: storing
+    past the budget evicts oldest-first, and a prompt whose entry alone
+    exceeds the budget is never device_get at all."""
+    cfg, params = tiny_model
+    rng = np.random.default_rng(11)
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(8, 16, 32), batch_buckets=(1,),
+        max_seq_len=64,
+    )
+    # size a budget that holds ~2 of our 12-token entries but not 3
+    per = eng._entry_nbytes_for(12 + 2)  # prompt + a couple decode tokens
+    eng.prefix_lru_bytes = int(per * 2.5)
+    prompts = [rng.integers(1, cfg.vocab_size, 12).tolist() for _ in range(4)]
+    for p in prompts:
+        eng.generate_compiled([p], max_new_tokens=2, reuse_prefix=True)
+    assert eng._prefix_total_bytes() <= eng.prefix_lru_bytes
+    assert len(eng._prefix_lru) < 4  # byte bound evicted below the count bound
+    # the newest entry always survives eviction
+    assert any(tuple(p) == k[: len(p)] for p in prompts[-1:]
+               for k in eng._prefix_lru)
+
+    # an entry larger than the whole budget is skipped without storing
+    eng.prefix_lru_bytes = eng._entry_nbytes_for(4)  # smaller than any prompt
+    before = set(eng._prefix_lru)
+    big = rng.integers(1, cfg.vocab_size, 20).tolist()
+    eng.generate_compiled([big], max_new_tokens=2, reuse_prefix=True)
+    assert tuple(big) not in eng._prefix_lru
+    assert set(eng._prefix_lru) == before  # and nothing was evicted for it
+
+    # no-regression: reuse still returns cold-path tokens under a budget
+    eng2 = GenerationEngine(
+        cfg, params, seq_buckets=(8, 16, 32), batch_buckets=(1,),
+        max_seq_len=64,
+    )
+    cold = GenerationEngine(
+        cfg, params, seq_buckets=(8, 16, 32), batch_buckets=(1,),
+        max_seq_len=64,
+    )
+    t1 = prompts[0]
+    r1 = eng2.generate_compiled([t1], max_new_tokens=4, reuse_prefix=True)
+    t2 = t1 + r1.sequences[0]
+    warm = eng2.generate_compiled([t2], max_new_tokens=4, reuse_prefix=True)
+    ref = cold.generate_compiled([t2], max_new_tokens=4)
+    assert warm.sequences == ref.sequences
+
+
 def test_lookahead_decode_matches_greedy(tiny_model):
     """Prompt-lookup speculation must emit EXACTLY the vanilla greedy
     sequence — acceptance only changes how many model passes it takes."""

@@ -246,3 +246,74 @@ def test_parameters_download(cluster):
     tree = trees[0]
     assert "layers" in tree and "embed" in tree
     assert tree["embed"]["tok"].shape == (cfg.vocab_size, cfg.d_model)
+
+
+def test_job_placed_via_second_validator(tmp_path):
+    """Cross-validator worker aggregation (reference REQUEST-WORKERS,
+    validator_thread.py:889-928): the user's validator has NO workers of its
+    own — planning must see the pool of its validator peer, and recruiting
+    must dial that worker lazily."""
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.models.transformer import forward, init_params
+    from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
+
+    def common(name):
+        return dict(
+            local_test=True,
+            key_dir=str(tmp_path / f"keys_{name}"),
+            log_dir=str(tmp_path / f"logs_{name}"),
+            env_file=str(tmp_path / f".env_{name}"),
+        )
+
+    v1 = ValidatorNode(ValidatorConfig(endpoint=False, **common("v1"))).start()
+    v2 = ValidatorNode(
+        ValidatorConfig(
+            endpoint=False, duplicate="1",
+            seed_validators=[["127.0.0.1", v1.port]], **common("v2"),
+        )
+    ).start()
+    # the only worker connects to v2 ONLY; the user to v1 ONLY
+    w = WorkerNode(
+        WorkerConfig(seed_validators=[["127.0.0.1", v2.port]], **common("w"))
+    ).start()
+    user = UserNode(
+        UserConfig(seed_validators=[["127.0.0.1", v1.port]], **common("u"))
+    ).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            peers = v2.status()["peers"]
+            if len(peers) >= 2:  # v1 + worker
+                break
+            time.sleep(0.2)
+        # bootstrap's PEERS gossip also connected the worker to v1 — sever
+        # that link so v1 genuinely has no workers of its own
+        for pid, p in v1.status()["peers"].items():
+            if p["role"] == "worker":
+                assert v1.send_request("disconnect", {"peer": pid})
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+            p["role"] == "worker" for p in v1.status()["peers"].values()
+        ):
+            time.sleep(0.1)
+        assert not any(
+            p["role"] == "worker" for p in v1.status()["peers"].values()
+        ), "test premise broken: v1 must know no workers directly"
+
+        cfg = tiny_cfg()
+        with DistributedModel(
+            cfg, node=user, seed=7, seq_len=128
+        ) as model:
+            assert model.plan.n_stages == 1
+            toks = np.array([[5, 9, 2, 77]], np.int32)
+            out = model(toks)
+        params = init_params(cfg, jax.random.PRNGKey(7))
+        ref, _ = forward(params, toks, cfg)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-4, atol=2e-4)
+        # v1 recruited the worker it learned from v2
+        assert any(
+            p["role"] == "worker" for p in v1.status()["peers"].values()
+        )
+    finally:
+        for n in (user, w, v2, v1):
+            n.stop()

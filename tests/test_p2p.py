@@ -295,6 +295,80 @@ def test_handshake_rejects_banned_peer(trio, tmp_path):
         banned.stop()
 
 
+def test_handshake_credential_registry_gate(trio, tmp_path):
+    """On-chain Sybil gate (reference smart_node.py:708-739): with a
+    credential_check installed, a peer claiming a worker/validator role must
+    be registry-listed — a fresh key with clean LOCAL reputation is refused;
+    users pass ungated."""
+    v = trio["validator"]
+    registry: set[str] = set()
+    checked: list[tuple[str, str]] = []
+
+    def check(node_id: str, role: str) -> bool:
+        checked.append((node_id, role))
+        return role not in ("validator", "worker") or node_id in registry
+
+    v.credential_check = check
+    try:
+        sybil = P2PNode(
+            "worker", local_test=True,
+            key_dir=tmp_path / "keys_sybil", spill_dir=tmp_path / "spill_sybil",
+        )
+        sybil.start()
+        try:
+            assert v.reputation.allowed(sybil.node_id)  # clean local rep...
+            with pytest.raises(Exception):
+                sybil.call(sybil.connect(v.host, v.port))  # ...still refused
+            assert sybil.node_id not in v.connections
+            assert (sybil.node_id, "worker") in checked
+            # registering the key flips the verdict
+            registry.add(sybil.node_id)
+            sybil.call(sybil.connect(v.host, v.port))
+            assert _wait(lambda: sybil.node_id in v.connections)
+        finally:
+            sybil.stop()
+        # a user role is not registry-gated
+        usr = P2PNode(
+            "user", local_test=True,
+            key_dir=tmp_path / "keys_usr2", spill_dir=tmp_path / "spill_usr2",
+        )
+        usr.start()
+        try:
+            usr.call(usr.connect(v.host, v.port))
+            assert _wait(lambda: usr.node_id in v.connections)
+        finally:
+            usr.stop()
+    finally:
+        v.credential_check = None
+
+
+def test_chain_credential_check_views():
+    """make_credential_check keys the registry views on the node-id hash and
+    fails CLOSED on RPC errors (reference contract-query-error path)."""
+    from tensorlink_tpu.platform.chain import ChainError, make_credential_check
+
+    calls: list[tuple[str, list]] = []
+
+    class StubClient:
+        def call_view(self, sig, args):
+            calls.append((sig, args))
+            if "fail" in args[0]:
+                raise ChainError("rpc down")
+            word = (1 if "ok" in args[0] else 0).to_bytes(32, "big")
+            return word
+
+    check = make_credential_check(StubClient())
+    assert check("ok" * 32, "validator")
+    assert calls[-1][0] == "isActiveValidator(bytes32)"
+    assert calls[-1][1] == ["0x" + "ok" * 32]
+    assert check("ok" * 32, "worker")
+    assert calls[-1][0] == "isActiveWorker(bytes32)"
+    assert not check("no" * 32, "validator")  # zero word = unregistered
+    assert not check("fail" + "x" * 60, "worker")  # RPC error = fail closed
+    assert check("no" * 32, "user")  # users ungated, no RPC
+    assert calls[-1][0] != "isActiveUser(bytes32)"
+
+
 def test_dht_replication_survives_validator_death(trio, tmp_path):
     """Job records replicate across validators (dht_store_global fan-out +
     anti-entropy sync on validator connect), so the record outlives the

@@ -187,3 +187,56 @@ def test_quantized_moe_router_and_dense_mlp():
            * np.linalg.norm(np.asarray(got, np.float64)))
     )
     assert cos > 0.99
+
+
+def test_int8_sharded_mesh_parity(cpu_devices):
+    """int8 (+int8 KV) composes with a tensor/data mesh (r3 weak #4): the
+    sharded engine's greedy decode must match the single-device int8 engine
+    token for token — quantization is elementwise, so sharding commutes
+    with it up to matmul reduction order."""
+    from jax.sharding import Mesh, NamedSharding
+    from tensorlink_tpu.models.transformer import cache_specs, partition_specs
+    from tensorlink_tpu.parallel.mesh import build_mesh
+
+    # dims sized so the stacked layer weights clear quantize_params'
+    # min_size and actually quantize
+    cfg = ModelConfig(
+        family="llama", vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+        n_kv_heads=2, head_dim=32, d_ff=256, max_seq_len=128,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(6))
+    prompts = [[5, 9, 2, 7, 11, 3]]
+    kw = dict(seq_buckets=(16, 64), batch_buckets=(1,), max_seq_len=64)
+
+    for quant in ("int8", "int8+kv"):
+        ref = GenerationEngine(cfg, params, quant=quant, **kw)
+        r = ref.generate_compiled(prompts, max_new_tokens=10)
+
+        mesh = build_mesh({"data": 2, "tensor": 2}, cpu_devices[:4])
+        specs = partition_specs(cfg, tensor_axis="tensor")
+        sharded = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs,
+        )
+        eng = GenerationEngine(
+            cfg, sharded, quant=quant, mesh=mesh,
+            cache_specs=cache_specs(cfg, data_axis=None, tensor_axis="tensor"),
+            **kw,
+        )
+        # quantized-on-sharded: QTensor leaves carry GSPMD shardings
+        from tensorlink_tpu.models.quant import QTensor
+
+        qleaves = [
+            l for l in jax.tree.leaves(
+                eng.params, is_leaf=lambda x: isinstance(x, QTensor)
+            )
+            if isinstance(l, QTensor)
+        ]
+        assert qleaves, "sharded engine must hold quantized weights"
+        assert any(
+            "tensor" in str(l.q.sharding.spec) for l in qleaves
+        ), "q payloads must stay tensor-sharded"
+
+        g = eng.generate_compiled(prompts, max_new_tokens=10)
+        assert g.sequences == r.sequences, (quant, g.sequences, r.sequences)
