@@ -90,11 +90,18 @@ def main() -> None:
     plat = _probe()
     env = dict(os.environ)
     if plat is None or plat == "cpu":
+        if plat is None:
+            # the accelerator runtime didn't come up — make the fallback
+            # LOUD in the emitted line (VERDICT r3: a CPU number must never
+            # masquerade as a TPU measurement)
+            env["TLTPU_TUNNEL_DOWN"] = "1"
         _force_cpu(env)
     rc = _run_child(env, timeout=3300)
     if rc != 0 and plat is not None and plat != "cpu":
         # Accelerator path ran but died mid-bench — one CPU retry so the
-        # driver still gets a real number.
+        # driver still gets a real number, flagged as a fallback like the
+        # probe-failure path (a CPU number must never look like TPU).
+        env["TLTPU_TUNNEL_DOWN"] = "1"
         rc = _run_child(_force_cpu(env), timeout=1800)
     if rc != 0:
         _emit_error(f"rc={rc} probe_platform={plat}")
@@ -245,6 +252,39 @@ def run_bench() -> None:
         except Exception as e:
             batch_extra = {"batch8_error": str(e)[:300]}
 
+    # ---- flash vs einsum prefill (the Pallas kernel's actual TPU win) -----
+    flash_extra = {}
+    if on_tpu and _budget_left() > 1200:
+        try:
+            # flash pays off on LONG prompts (attention is O(S^2) and the
+            # einsum path materializes [B, h, S, S]); time a 2k-token
+            # prefill both ways
+            fl_len = 2048
+            fl_prompt = [rng.integers(1, cfg.vocab_size, fl_len).tolist()]
+
+            def prefill_ms(fcfg_):
+                engine = GenerationEngine(
+                    fcfg_, params, seq_buckets=(fl_len,),
+                    batch_buckets=(1,), max_seq_len=fl_len,
+                )
+                jax.block_until_ready(engine.prefill(fl_prompt)[:2])  # compile
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    jax.block_until_ready(engine.prefill(fl_prompt)[:2])
+                dt = (time.perf_counter() - t0) / 5 * 1e3
+                del engine
+                return dt
+
+            einsum_ms = prefill_ms(cfg)
+            flash_ms = prefill_ms(cfg.with_(flash_attention=True))
+            flash_extra = {
+                "prefill2k_einsum_ms": round(einsum_ms, 2),
+                "prefill2k_flash_ms": round(flash_ms, 2),
+                "flash_prefill_speedup": round(einsum_ms / max(flash_ms, 1e-9), 2),
+            }
+        except Exception as e:
+            flash_extra = {"flash_error": str(e)[:300]}
+
     # ---- speculative decode (prompt-lookup) on repetitive text ------------
     # product path: /v1/generate {"lookahead": true}. One fixed-shape verify
     # program (drafts pad to n_draft); acceptance-rate + tok/s vs the
@@ -318,8 +358,14 @@ def run_bench() -> None:
     extra: dict = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", ""),
+        **(
+            {"tpu_tunnel_down": True}
+            if os.environ.get("TLTPU_TUNNEL_DOWN")
+            else {}
+        ),
         "decode_roofline_toks_s": round(roofline, 2),
         **batch_extra,
+        **flash_extra,
         **spec_extra,
         **int8_extra,
     }
@@ -339,24 +385,46 @@ def run_bench() -> None:
             train_name = "qwen3-tiny-cpu"
             tcfg = cfg.with_(max_seq_len=256)
             tbatch, tseq, n_micro = 4, 128, 2
-        tparams = init_params(tcfg, jax.random.PRNGKey(1))
         opt = make_optimizer("adamw", lr=1e-4)
-        ts = make_train_step(tcfg, opt, n_micro=n_micro, remat=True, donate=True)
-        state = opt.init(tparams)
         tokens = jnp.asarray(
             np.random.default_rng(1).integers(
                 1, tcfg.vocab_size, (tbatch, tseq), dtype=np.int64
             ).astype(np.int32)
         )
-        # warmup/compile
-        tparams, state, m = ts.step_fn(tparams, state, {"tokens": tokens})
-        jax.block_until_ready(m["loss"])
-        n_steps = 5 if on_tpu else 2
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            tparams, state, m = ts.step_fn(tparams, state, {"tokens": tokens})
-        jax.block_until_ready(m["loss"])
-        step_dt = (time.perf_counter() - t0) / n_steps
+
+        def run_train(remat: bool):
+            tparams = init_params(tcfg, jax.random.PRNGKey(1))
+            ts = make_train_step(
+                tcfg, opt, n_micro=n_micro, remat=remat, donate=True
+            )
+            state = opt.init(tparams)
+            # warmup/compile
+            tparams_, state_, m = ts.step_fn(tparams, state, {"tokens": tokens})
+            jax.block_until_ready(m["loss"])
+            n_steps = 5 if on_tpu else 2
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                tparams_, state_, m = ts.step_fn(
+                    tparams_, state_, {"tokens": tokens}
+                )
+            jax.block_until_ready(m["loss"])
+            return (time.perf_counter() - t0) / n_steps
+
+        # remat trades an extra forward (~25-33% of step FLOPs) for
+        # activation memory — when this config fits HBM without it, the
+        # no-remat step is strictly faster. Try that first; ONLY a memory
+        # failure falls back (any other error must surface, not be masked
+        # by a valid-looking remat number).
+        try:
+            step_dt = run_train(remat=False)
+            remat_used = False
+        except Exception as e:
+            msg = str(e).upper()
+            if not any(s in msg for s in ("RESOURCE_EXHAUSTED", "OOM",
+                                          "OUT OF MEMORY", "ALLOCAT")):
+                raise
+            step_dt = run_train(remat=True)
+            remat_used = True
         # standard 6·N·D convention (remat's extra forward eats into MFU)
         train_flops = 6.0 * tcfg.param_count() * tbatch * tseq
         mfu = train_flops / step_dt / peak_flops
@@ -370,6 +438,7 @@ def run_bench() -> None:
                 "train_step_s": round(step_dt, 4),
                 "train_tokens_s": round(tbatch * tseq / step_dt, 2),
                 "train_mfu": round(mfu, 4),
+                "train_remat": remat_used,
             }
         )
     except Exception as e:  # keep the decode metric even if training OOMs

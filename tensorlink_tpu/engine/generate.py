@@ -40,13 +40,16 @@ def _bucket(value: int, buckets: Sequence[int]) -> int:
     return buckets[i]
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig):
+@partial(
+    jax.jit, static_argnames=("cfg", "fmesh"), donate_argnames=("cache",)
+)
+def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig, fmesh=None):
     # flash_prefill is safe here and only here: the engine always prefills
-    # a FRESH cache (offset 0, right-padded buckets)
+    # a FRESH cache (offset 0, right-padded buckets); fmesh routes the
+    # kernel through shard_map on sharded engines
     logits, cache = forward(
         params, tokens, cfg, cache=cache, attn_mask=attn_mask,
-        flash_prefill=cfg.flash_attention,
+        flash_prefill=cfg.flash_attention, flash_mesh=fmesh,
     )
     # logits of the last *real* token per row
     last = jnp.maximum(attn_mask.sum(-1) - 1, 0)
@@ -54,9 +57,13 @@ def _prefill(params, tokens, attn_mask, cache, cfg: ModelConfig):
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "first"), donate_argnames=("cache",)
+    jax.jit,
+    static_argnames=("cfg", "first", "fmesh"),
+    donate_argnames=("cache",),
 )
-def _prefill_chunk(params, tokens, attn_mask, cache, cfg: ModelConfig, first):
+def _prefill_chunk(
+    params, tokens, attn_mask, cache, cfg: ModelConfig, first, fmesh=None
+):
     """One chunk of a long-prompt prefill: returns the final-norm hidden
     states (the vocab head runs ONCE at the end of chunking, not per
     chunk) and the grown cache. Flash only on the first chunk (offset 0)."""
@@ -64,6 +71,7 @@ def _prefill_chunk(params, tokens, attn_mask, cache, cfg: ModelConfig, first):
         params, tokens, cfg, cache=cache, attn_mask=attn_mask,
         return_hidden=True,
         flash_prefill=cfg.flash_attention and first,
+        flash_mesh=fmesh,
     )
     return hidden, cache
 
@@ -207,6 +215,10 @@ class GenerationEngine:
         self.quant = quant
         self.params = params
         self.mesh = mesh
+        # mesh handle for the Pallas flash prefill: GSPMD cannot partition
+        # a pallas_call, so sharded engines route it through shard_map
+        # (models/transformer.py flash gate)
+        self._fmesh = mesh if cfg.flash_attention else None
         self.cache_specs = cache_specs
         self.max_seq_len = max_seq_len or min(cfg.max_seq_len, seq_buckets[-1])
         self.seq_buckets = tuple(b for b in seq_buckets if b <= self.max_seq_len)
@@ -426,7 +438,7 @@ class GenerationEngine:
             cache = self.new_cache(B)
             logits, cache = _prefill(
                 self.params, jnp.asarray(toks), jnp.asarray(mask), cache,
-                self.cfg,
+                self.cfg, self._fmesh,
             )
             return logits, cache, lens, B
         return self._prefill_chunked(prompts, lens, B)
@@ -453,7 +465,7 @@ class GenerationEngine:
                 mask[i, : len(part)] = True
             hid, cache = _prefill_chunk(
                 self.params, jnp.asarray(toks), jnp.asarray(mask), cache,
-                self.cfg, off == 0,
+                self.cfg, off == 0, self._fmesh,
             )
             if hidden_last is None:
                 hidden_last = jnp.zeros((B, hid.shape[-1]), hid.dtype)

@@ -11,8 +11,8 @@ Mechanics: requests enqueue; the dispatcher takes the head request, waits
 a short window for more, then issues one ``model.generate`` with per-row
 sampling knobs (SamplingParams.stack) and per-row budgets, demuxing the
 per-row stream callback back to each request. Pipelined (multi-stage)
-jobs fall back to batch size 1 — their session decode samples host-side
-per call — preserving strict request order either way.
+jobs co-batch too: their session decode samples per-row on the
+head-holding worker (ml/worker.py::_sample_from_logits).
 """
 
 from __future__ import annotations
@@ -55,9 +55,7 @@ class GenBatcher:
     ):
         self.model = model
         self.eos_ids = list(eos_ids)
-        plan = getattr(model, "plan", None)
-        single_stage = plan is None or plan.n_stages == 1
-        self.max_batch = max_batch if single_stage else 1
+        self.max_batch = max_batch
         self.window_s = window_s
         self.seed = seed
         self._q: queue.Queue[_Pending | None] = queue.Queue()

@@ -401,7 +401,9 @@ class DistributedModel:
         (None for rows already finished) — the engine's contract. Sampling
         knobs may be per-row sequences and ``budgets`` caps rows
         individually (both used by the serving batcher, ml/batching.py, to
-        mix concurrent requests in one decode); single-stage jobs only."""
+        mix concurrent requests in one decode) — on single-stage jobs via
+        the engine's bucketed batch, on pipelined jobs via the head
+        worker's per-row sampler."""
         assert self.plan is not None
         if self.plan.n_stages == 1:
             return self._generate_remote(
@@ -410,17 +412,10 @@ class DistributedModel:
                 stream_cb=stream_cb, budgets=budgets,
                 reuse_prefix=reuse_prefix, lookahead=lookahead,
             )
-        if budgets or any(
-            isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
-        ):
-            raise ValueError(
-                "per-row sampling/budgets need a single-stage job (the "
-                "pipelined session decode samples host-side per call)"
-            )
         return self._generate_pipelined(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
-            stream_cb=stream_cb,
+            stream_cb=stream_cb, budgets=budgets,
         )
 
     def _generate_remote(
@@ -500,11 +495,13 @@ class DistributedModel:
 
     def _generate_pipelined(
         self, prompts, *, max_new_tokens, temperature, top_k=0, top_p=1.0,
-        eos_ids=(), seed=0, stream_cb=None,
+        eos_ids=(), seed=0, stream_cb=None, budgets=None,
     ) -> list[list[int]]:
         """Host-driven decode across stages with per-stage session caches
         (net-new vs the reference, which cannot generate across shards
-        without re-running the full forward per token)."""
+        without re-running the full forward per token). Sampling knobs may
+        be per-row sequences and ``budgets`` caps rows individually — the
+        serving batcher co-batches mixed requests on pipelined jobs too."""
         prompts = [list(map(int, p)) for p in prompts]
         B = len(prompts)
         T = max(len(p) for p in prompts)
@@ -517,13 +514,42 @@ class DistributedModel:
         session = secrets.token_hex(8)
         cache_len = min(self.spec["seq_len"], T + max_new_tokens)
         eos = set(int(e) for e in eos_ids)
+        # per-row effective budgets, each capped by its OWN cache room so a
+        # long-prompt neighbor can't overrun a short one's slots
+        eff = []
+        for i, p in enumerate(prompts):
+            want = int(budgets[i]) if budgets else int(max_new_tokens)
+            eff.append(max(min(want, cache_len - len(p)), 0))
+        steps = max(eff) if eff else 0
+
+        per_row = any(
+            isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
+        )
+        for name, v in (("temperature", temperature), ("top_k", top_k),
+                        ("top_p", top_p), ("budgets", budgets)):
+            if isinstance(v, (list, tuple)) and len(v) != B:
+                raise ValueError(
+                    f"per-row {name} has {len(v)} entries for {B} prompts"
+                )
+
+        def rows(v, cast):
+            # all-or-none: if ANY knob is per-row, normalize EVERY knob to a
+            # length-B list so the worker builds aligned [B, 1] leaves
+            if not per_row:
+                return cast(v)
+            if isinstance(v, (list, tuple)):
+                return [cast(x) for x in v]
+            return [cast(v)] * B
 
         # the head-holding worker samples on-device and ships ONE token id
         # per row per step — not [B, vocab] logits across every hop (at a
-        # 151k vocab that transfer alone was ~600 KB/token)
+        # 151k vocab that transfer alone was ~600 KB/token). Per-row knobs
+        # ride as lists (worker builds [B, 1] SamplingParams leaves).
         samp = {
-            "temperature": float(temperature), "top_k": int(top_k),
-            "top_p": float(top_p), "seed": int(seed),
+            "temperature": rows(temperature, float),
+            "top_k": rows(top_k, int),
+            "top_p": rows(top_p, float),
+            "seed": int(seed),
         }
         last_idx = mask.sum(-1) - 1
         tok = self.forward(
@@ -532,8 +558,8 @@ class DistributedModel:
         )
 
         seqs: list[list[int]] = [[] for _ in range(B)]
-        done = np.zeros(B, bool)
-        for step in range(max_new_tokens):
+        done = np.asarray([e <= 0 for e in eff], bool)
+        for step in range(steps):
             emitted: list[int | None] = []
             for i in range(B):
                 if not done[i]:
@@ -541,10 +567,10 @@ class DistributedModel:
                     emitted.append(int(tok[i]))
                 else:
                     emitted.append(None)
-                done[i] |= int(tok[i]) in eos
+                done[i] |= int(tok[i]) in eos or len(seqs[i]) >= eff[i]
             if stream_cb is not None and any(e is not None for e in emitted):
                 stream_cb(emitted)
-            if done.all() or step == max_new_tokens - 1:
+            if done.all() or step == steps - 1:
                 break
             tok = self.forward(
                 tok[:, None].astype(np.int32),

@@ -247,14 +247,23 @@ class DistributedWorker:
         training = bool(p.get("training", False))
         quant = p.get("model", {}).get("quant")
         if p.get("model", {}).get("flash"):
-            # Pallas flash prefill for this job's serving engine
-            # (ops/attention.py; the engine gates it to fresh-cache
-            # prefills). The kernel has no sharding rule, so sharded
-            # stages keep the einsum path — same degrade policy as quant.
-            if mesh is not None:
-                self.log.warning("flash_attention ignored on a sharded stage")
-            else:
+            # Pallas flash prefill for this job's serving ENGINE — i.e.
+            # whole-model stages only (ops/attention.py; the engine gates it
+            # to fresh-cache prefills, and a sharded engine routes the
+            # kernel through shard_map over data/tensor since GSPMD has no
+            # partitioning rule for a pallas_call). The multi-stage session
+            # path never reaches the flash gate — say so instead of
+            # silently serving einsum.
+            if (
+                stage["first"] and stage["last"] and stage["holds_head"]
+            ):
                 cfg = cfg.with_(flash_attention=True)
+            else:
+                self.log.warning(
+                    "flash_attention ignored on a pipelined (multi-stage) "
+                    "job — only whole-model serving engines take the "
+                    "flash prefill path"
+                )
         cache_quant = False
         if quant:
             # weight-only int8 serving (models/quant.py): quantize the
@@ -648,11 +657,25 @@ class DistributedWorker:
             step_logits = logits[jnp.arange(B), idx]
         else:
             step_logits = logits
-        sp = SamplingParams.make(
-            temperature=float(samp.get("temperature", 0.0)),
-            top_k=int(samp.get("top_k", 0)),
-            top_p=float(samp.get("top_p", 1.0)),
-        )
+        t = samp.get("temperature", 0.0)
+        if isinstance(t, (list, tuple, np.ndarray)):
+            # batched serving mixes requests with different knobs: [B, 1]
+            # leaves ride ONE compiled sampler (engine/sampling.py contract)
+            sp = SamplingParams(
+                temperature=jnp.asarray(t, jnp.float32).reshape(-1)[:, None],
+                top_k=jnp.asarray(
+                    samp.get("top_k", [0] * len(t)), jnp.int32
+                ).reshape(-1)[:, None],
+                top_p=jnp.asarray(
+                    samp.get("top_p", [1.0] * len(t)), jnp.float32
+                ).reshape(-1)[:, None],
+            )
+        else:
+            sp = SamplingParams.make(
+                temperature=float(t),
+                top_k=int(samp.get("top_k", 0)),
+                top_p=float(samp.get("top_p", 1.0)),
+            )
         key = jax.random.fold_in(
             jax.random.PRNGKey(int(samp.get("seed", 0))),
             int(samp.get("step", 0)),

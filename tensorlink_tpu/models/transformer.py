@@ -379,7 +379,7 @@ def _mask_bias(
     jax.jit,
     static_argnames=(
         "cfg", "remat", "return_hidden", "seq_mesh", "seq_axis",
-        "flash_prefill",
+        "flash_prefill", "flash_mesh",
     ),
 )
 def forward(
@@ -397,6 +397,10 @@ def forward(
     # engine's prefill route attention through the Pallas flash kernel
     # when cfg.flash_attention is set (ops/attention.py)
     flash_prefill: bool = False,
+    # serving mesh (GSPMD has no partitioning rule for the Pallas kernel, so
+    # under a mesh the flash call runs inside shard_map over data/tensor —
+    # attention is independent per (batch, head), no collectives needed)
+    flash_mesh=None,
 ):
     """Full forward. Returns ``(logits, new_cache)``.
 
@@ -415,12 +419,14 @@ def forward(
             params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
             positions=positions, first=True, last=False, remat=remat,
             seq_mesh=seq_mesh, seq_axis=seq_axis, flash_prefill=flash_prefill,
+            flash_mesh=flash_mesh,
         )
         return _norm(x, params["final_norm"], cfg), new_cache
     return _stage_impl(
         params, cfg, tokens=tokens, cache=cache, attn_mask=attn_mask,
         positions=positions, first=True, last=True, remat=remat,
         seq_mesh=seq_mesh, seq_axis=seq_axis, flash_prefill=flash_prefill,
+        flash_mesh=flash_mesh,
     )
 
 
@@ -502,10 +508,14 @@ def _stage_impl(
     seq_mesh=None,
     seq_axis: str = "seq",
     flash_prefill: bool = False,
+    flash_mesh=None,
 ):
     attn_fn = None
     T_in = tokens.shape[1] if tokens is not None else (
         hidden.shape[1] if hidden is not None else 1
+    )
+    B_in = tokens.shape[0] if tokens is not None else (
+        hidden.shape[0] if hidden is not None else 1
     )
     if (
         flash_prefill
@@ -521,13 +531,57 @@ def _stage_impl(
         T_flash = T_in
         win = cfg.sliding_window
 
-        def attn_fn(q, k_all, v_all, _bias, scale):
+        def _flash(q, k_all, v_all, scale):
             # fresh cache (offset 0): keys beyond T are zeros the causal
             # mask would hide anyway — attend over the written prefix only
             return flash_attention(
                 q, k_all[:, :T_flash], v_all[:, :T_flash],
                 scale=scale, interpret=interp, window=win,
             )
+
+        if flash_mesh is None:
+            def attn_fn(q, k_all, v_all, _bias, scale):
+                return _flash(q, k_all, v_all, scale)
+        else:
+            # GSPMD cannot partition a pallas_call, so run it manually via
+            # shard_map: batch shards on data, heads on tensor — attention
+            # is independent per (batch, head), so no collectives
+            try:
+                from jax import shard_map
+
+                # the pallas_call's out_shape carries no varying-axis
+                # metadata; the output sharding is fully described by
+                # out_specs
+                _sm_kw = {"check_vma": False}
+            except ImportError:  # pre-0.8 jax
+                from jax.experimental.shard_map import shard_map
+
+                _sm_kw = {"check_rep": False}
+            from jax.sharding import PartitionSpec as _P
+
+            sizes = dict(flash_mesh.shape)
+            dp = (
+                "data"
+                if sizes.get("data", 1) > 1 and B_in % sizes["data"] == 0
+                else None
+            )
+            tp = (
+                "tensor"
+                if sizes.get("tensor", 1) > 1
+                and cfg.n_heads % sizes["tensor"] == 0
+                and cfg.n_kv_heads % sizes["tensor"] == 0
+                else None
+            )
+            spec = _P(dp, None, tp, None)
+
+            def attn_fn(q, k_all, v_all, _bias, scale):
+                return shard_map(
+                    lambda ql, kl, vl: _flash(ql, kl, vl, scale),
+                    mesh=flash_mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    **_sm_kw,
+                )(q, k_all, v_all)
     if seq_mesh is not None:
         if cache is not None:
             raise ValueError("sequence-parallel attention has no KV cache path")

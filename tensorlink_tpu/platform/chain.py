@@ -21,6 +21,12 @@ Submission is *guarded*: every entry point raises :class:`ChainError` on
 RPC failure, and the contract manager treats that as "stay off-chain this
 round" rather than dying (the reference behaves the same when its RPC is
 flaky).
+
+**Validation status**: the wire artifacts (keccak vectors, RLP round-trips,
+EIP-155 signature recovery, ABI word layout incl. dynamic types) are pinned
+against known vectors and a local fake JSON-RPC node (tests/test_chain.py).
+No transaction has been attempted against a live testnet from this
+environment (zero egress) — treat the layer as stub-tested until one has.
 """
 
 from __future__ import annotations

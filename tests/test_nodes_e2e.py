@@ -227,6 +227,21 @@ def test_pipelined_forward_and_generate_parity(cluster):
         engine = GenerationEngine(cfg, params, max_seq_len=64)
         refgen = engine.generate_compiled([prompt], max_new_tokens=6)
         assert seqs[0] == refgen.sequences[0]
+
+        # BATCHED pipelined decode with per-row budgets + per-row knobs
+        # (what the serving batcher now issues on multi-stage jobs): greedy
+        # rows must match their individual-engine decodes, and each row
+        # honors its own budget
+        p2 = [5, 9, 100, 7]
+        seqs2 = model.generate(
+            [prompt, p2], max_new_tokens=6,
+            temperature=[0.0, 0.0], top_k=[0, 0], top_p=[1.0, 1.0],
+            budgets=[6, 3],
+        )
+        assert seqs2[0] == refgen.sequences[0][:6]
+        ref2 = engine.generate_compiled([p2], max_new_tokens=3)
+        assert seqs2[1] == ref2.sequences[0][:3]
+        assert len(seqs2[1]) <= 3
     finally:
         try:
             model.shutdown()

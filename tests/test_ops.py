@@ -129,3 +129,47 @@ def test_engine_flash_prefill_matches_dense():
     np.testing.assert_allclose(
         np.asarray(lg_f), np.asarray(lg_d), rtol=2e-4, atol=2e-4
     )
+
+
+def test_engine_flash_sharded_mesh_matches_dense(cpu_devices):
+    """Flash prefill composes with a tensor/data mesh (r3 weak: it was
+    silently ignored on sharded stages): the kernel runs inside shard_map
+    over data/tensor, and the sharded flash engine's tokens match the
+    unsharded einsum engine exactly."""
+    from jax.sharding import NamedSharding
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.engine.sampling import SamplingParams
+    from tensorlink_tpu.models import ModelConfig, init_params
+    from tensorlink_tpu.models.transformer import cache_specs, partition_specs
+    from tensorlink_tpu.parallel.mesh import build_mesh
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq_len=128,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    kw = dict(seq_buckets=(32, 128), batch_buckets=(2,), max_seq_len=128)
+    prompts = [[7, 3, 9, 11, 2], [5, 1, 8]]
+    greedy = SamplingParams.make()
+    dense = GenerationEngine(cfg, params, **kw)
+
+    mesh = build_mesh({"data": 2, "tensor": 2}, cpu_devices[:4])
+    specs = partition_specs(cfg, tensor_axis="tensor")
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+    flash = GenerationEngine(
+        cfg.with_(flash_attention=True), sharded, mesh=mesh,
+        cache_specs=cache_specs(cfg, data_axis="data", tensor_axis="tensor"),
+        **kw,
+    )
+    assert flash._fmesh is mesh  # the kernel really takes the shard_map path
+    r_d = dense.generate_compiled(prompts, max_new_tokens=10, sampling=greedy)
+    r_f = flash.generate_compiled(prompts, max_new_tokens=10, sampling=greedy)
+    assert r_f.sequences == r_d.sequences
+    lg_d = dense.prefill(prompts)[0]
+    lg_f = flash.prefill(prompts)[0]
+    np.testing.assert_allclose(
+        np.asarray(lg_f), np.asarray(lg_d), rtol=2e-4, atol=2e-4
+    )
