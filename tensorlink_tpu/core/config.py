@@ -67,6 +67,11 @@ class MLConfig:
     # serving: how many concurrent API requests one batched decode may
     # coalesce (ml/batching.py); bounded by the largest batch bucket
     max_serve_batch: int = 8
+    # pre-compile the serving engine at host time for this many decode
+    # tokens (engine.warmup) — 0 skips; when set, "ready" means every batch
+    # bucket's smallest-prompt prefill + this token budget's decode loop is
+    # compiled (other prompt/budget buckets still compile on first use)
+    warmup_tokens: int = 0
     # validator: host DEFAULT_CONFIG["default_models"] at startup (reference
     # auto-loads popular/default models, ml/validator.py:169-365); off by
     # default so local tests never pull multi-GB checkpoints

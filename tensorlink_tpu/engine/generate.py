@@ -385,6 +385,24 @@ class GenerationEngine:
         logits = _head_from_hidden(self.params, hidden_last, self.cfg)
         return logits, cache, [len(prompt)], B
 
+    def warmup(self, *, max_new_tokens: int = 128) -> float:
+        """Pre-compile the hot serving programs — for EVERY batch bucket
+        (the batcher coalesces a first burst straight into B>1), the
+        smallest-seq-bucket prefill + the decode loop at
+        ``max_new_tokens``'s n_steps bucket. Hosting calls this when
+        ``MLConfig.warmup_tokens`` is set. A request whose budget maps to a
+        different pow2 n_steps bucket (or a longer prompt bucket) still
+        compiles on first use. Returns elapsed seconds."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        span = max(self.seq_buckets[0] // 2, 1)
+        for b in self.batch_buckets:
+            self.generate_compiled(
+                [[1] * span] * b, max_new_tokens=max_new_tokens,
+            )
+        return _t.perf_counter() - t0
+
     # -- host-driven API --------------------------------------------------
     def prefill(
         self, prompts: Iterable[Sequence[int]], *, reuse_prefix: bool = False

@@ -514,6 +514,17 @@ class DistributedModel:
         session = secrets.token_hex(8)
         cache_len = min(self.spec["seq_len"], T + max_new_tokens)
         eos = set(int(e) for e in eos_ids)
+        per_row = any(
+            isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
+        )
+        # validate BEFORE anything indexes per-row lists (a short budgets
+        # list must raise this message, not an IndexError below)
+        for name, v in (("temperature", temperature), ("top_k", top_k),
+                        ("top_p", top_p), ("budgets", budgets)):
+            if isinstance(v, (list, tuple)) and len(v) != B:
+                raise ValueError(
+                    f"per-row {name} has {len(v)} entries for {B} prompts"
+                )
         # per-row effective budgets, each capped by its OWN cache room so a
         # long-prompt neighbor can't overrun a short one's slots
         eff = []
@@ -521,16 +532,6 @@ class DistributedModel:
             want = int(budgets[i]) if budgets else int(max_new_tokens)
             eff.append(max(min(want, cache_len - len(p)), 0))
         steps = max(eff) if eff else 0
-
-        per_row = any(
-            isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
-        )
-        for name, v in (("temperature", temperature), ("top_k", top_k),
-                        ("top_p", top_p), ("budgets", budgets)):
-            if isinstance(v, (list, tuple)) and len(v) != B:
-                raise ValueError(
-                    f"per-row {name} has {len(v)} entries for {B} prompts"
-                )
 
         def rows(v, cast):
             # all-or-none: if ANY knob is per-row, normalize EVERY knob to a

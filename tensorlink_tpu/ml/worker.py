@@ -313,6 +313,12 @@ class DistributedWorker:
                 # the engine's cache mode for "+kv"
                 quant=quant if cache_quant else None,
             )
+            if ml_cfg.warmup_tokens and not training:
+                dt = rt.engine.warmup(max_new_tokens=ml_cfg.warmup_tokens)
+                self.log.info(
+                    "warmed serving programs in %.1fs (%d tokens)",
+                    dt, ml_cfg.warmup_tokens,
+                )
         with self._lock:
             self.jobs[job_id] = rt
         self.log.info(

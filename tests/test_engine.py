@@ -385,3 +385,20 @@ def test_microbatch_divisibility_error(tiny_model):
     batch = {"tokens": jnp.zeros((4, 8), jnp.int32)}
     with pytest.raises(ValueError, match="divisible"):
         ts.step_fn(params, ts.init_state(params), batch)
+
+
+def test_engine_warmup_compiles_serving_programs(tiny_model):
+    """warmup() pre-runs the smallest-bucket prefill + decode loop; tokens
+    after warmup match a cold engine exactly (it must not perturb state —
+    in particular the prefix store stays empty)."""
+    cfg, params = tiny_model
+    kw = dict(seq_buckets=(16, 64), batch_buckets=(1,), max_seq_len=64)
+    warm = GenerationEngine(cfg, params, **kw)
+    dt = warm.warmup(max_new_tokens=8)
+    assert dt > 0
+    assert not warm._prefix_lru
+    cold = GenerationEngine(cfg, params, **kw)
+    prompts = [[5, 9, 2, 7]]
+    a = warm.generate_compiled(prompts, max_new_tokens=8)
+    b = cold.generate_compiled(prompts, max_new_tokens=8)
+    assert a.sequences == b.sequences

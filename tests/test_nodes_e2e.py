@@ -242,6 +242,20 @@ def test_pipelined_forward_and_generate_parity(cluster):
         ref2 = engine.generate_compiled([p2], max_new_tokens=3)
         assert seqs2[1] == ref2.sequences[0][:3]
         assert len(seqs2[1]) <= 3
+
+        # quant rides the job spec onto PIPELINED stages too (each stage
+        # quantizes its slice; per-layer scales make slice-then-quantize ==
+        # quantize-then-slice) — at this test size quantization no-ops
+        # below min_size, so this pins the dispatch path, with the math
+        # pinned in tests/test_quant.py at real sizes
+        model.shutdown()
+        model = DistributedModel(
+            cfg, node=cluster["user"], seed=11, seq_len=64, batch=1,
+            quant="int8",
+        )
+        assert model.plan.n_stages == 2
+        qseqs = model.generate([prompt], max_new_tokens=6)
+        assert qseqs[0] == refgen.sequences[0]
     finally:
         try:
             model.shutdown()
