@@ -92,6 +92,8 @@ class DistributedModel:
         self.plan = None
         self.cfg = None
         self.workers: dict[str, str] = {}  # worker plan id -> connected node id
+        self.worker_addrs: dict[str, list] = {}  # worker id -> [host, port]
+        self.chain_forwards = 0  # completed worker-to-worker chained calls
         import threading
 
         self._repair_lock = threading.Lock()
@@ -142,6 +144,9 @@ class DistributedModel:
             host, port = reply["workers"][wid]
             conn_id = self.node.connect_to(host, int(port))
             self.workers[wid] = conn_id
+            # kept for chained forwards: each hop dials the NEXT stage's
+            # worker by address (worker-to-worker, no user transit)
+            self.worker_addrs[wid] = [host, int(port)]
         for stage in self.plan.stages:
             resp = self._request(
                 stage.worker_id,
@@ -185,7 +190,13 @@ class DistributedModel:
             new_id = self._repair(worker_plan_id)
             return self._request(new_id, tag, body, timeout, _repaired=True)
         if isinstance(resp, dict) and resp.get("error"):
-            raise RuntimeError(f"{tag} failed on worker: {resp['error']}")
+            # chained hops attribute the failing worker (ml/worker.py run
+            # loop ships "worker" alongside the error)
+            who = str(resp.get("worker", ""))[:12]
+            raise RuntimeError(
+                f"{tag} failed on worker{' ' + who if who else ''}: "
+                f"{resp['error']}"
+            )
         return resp
 
     # ------------------------------------------------------------------
@@ -233,6 +244,7 @@ class DistributedModel:
         new_id = update["worker"]["id"]
         host, port = update["worker"]["addr"]
         conn_id = self.node.connect_to(host, int(port))
+        self.worker_addrs[new_id] = [host, int(port)]
         # order matters for concurrent readers: the new mapping must exist
         # before any stage names it; the old mapping stays (its connection
         # is dead, so a straggler request on it re-enters repair and gets
@@ -345,6 +357,36 @@ class DistributedModel:
                     base["last_idx"] = np.asarray(last_idx, np.int32)
             return base
 
+        if len(self.plan.stages) > 1 and all(
+            s.worker_id in self.worker_addrs for s in self.plan.stages
+        ):
+            # worker-to-worker chain: ONE request; activations hop straight
+            # between stage workers and only the final result (token ids or
+            # logits) returns here. Stateless calls fall back to the per-hop
+            # path (which repairs workers) on transport failure; session
+            # calls surface the error — a partially-prefilled session must
+            # not be silently re-driven (double KV writes).
+            try:
+                return self._forward_chain(x, body_common, samp_body)
+            except Exception as e:
+                # transport failures cross the IPC bridge as RemoteError
+                # (stringified "TimeoutError: ..."/"ConnectionError: ...",
+                # nodes/ipc.py) — match on text as well as type. Compute
+                # errors and session calls re-raise: a partially-prefilled
+                # session must not be silently re-driven (double KV writes).
+                transport = isinstance(
+                    e, (TimeoutError, ConnectionError)
+                ) or any(
+                    s in str(e)
+                    for s in ("TimeoutError", "ConnectionError",
+                              "no connection", "IncompleteReadError")
+                )
+                if not transport or session is not None:
+                    raise
+                self.log.warning(
+                    "chained forward failed (%s); per-hop fallback", e
+                )
+
         last = self.plan.stages[-1]
         head_on_last = last.last and last.holds_head
         out: np.ndarray | None = None
@@ -372,6 +414,36 @@ class DistributedModel:
                 return np.asarray(resp["token"], np.int32)
             out = np.asarray(resp["out"])
         return out
+
+    def _forward_chain(self, x, body_common: dict, samp_body) -> np.ndarray:
+        """One request drives the whole pipeline: each stage worker computes
+        its slice and ships the hidden state DIRECTLY to the next stage's
+        worker (nodes/roles.py::cmd_chain_send); the final hop (the head
+        holder — looping back to stage 0 for tied embeddings) responds to
+        this user. Per token that is stages+1 one-way transfers instead of
+        2·stages, and the [B, T, d_model] activations never transit the
+        user's link at all."""
+        stages = self.plan.stages
+        entries = [
+            {"addr": list(self.worker_addrs[s.worker_id]), "head": False}
+            for s in stages[1:]
+        ]
+        last = stages[-1]
+        if not (last.last and last.holds_head):
+            head_stage = next(s for s in stages if s.holds_head)
+            entries.append(
+                {"addr": list(self.worker_addrs[head_stage.worker_id]),
+                 "head": True}
+            )
+        body = samp_body(dict(
+            body_common, op="chain", chain=entries,
+            reply_to=self.node.node_id, tokens=x,
+        ))
+        resp = self._request(stages[0].worker_id, proto.FORWARD, body)
+        self.chain_forwards += 1
+        if "token" in resp:
+            return np.asarray(resp["token"], np.int32)
+        return np.asarray(resp["out"])
 
     __call__ = forward
 

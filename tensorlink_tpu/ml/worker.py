@@ -167,7 +167,15 @@ class DistributedWorker:
                         proto.PROOF_REQ: proto.PROOF_RESP,
                         "load_stage": proto.MODULE_LOADED,
                     }.get(kind, proto.FORWARD_RESP)
-                    self._respond(peer, resp_tag, rid, {"error": f"{type(e).__name__}: {e}"})
+                    # a chained hop's requester is the ORIGINATOR, not the
+                    # previous worker — route the error to it (it holds the
+                    # rid future) and name the failing worker for repair
+                    err_peer = payload.get("reply_to") or peer
+                    self._respond(
+                        err_peer, resp_tag, rid,
+                        {"error": f"{type(e).__name__}: {e}",
+                         "worker": self.node.node_id},
+                    )
 
     def _handle(self, kind: str, p: dict) -> None:
         if kind == "load_stage":
@@ -313,12 +321,6 @@ class DistributedWorker:
                 # the engine's cache mode for "+kv"
                 quant=quant if cache_quant else None,
             )
-            if ml_cfg.warmup_tokens and not training:
-                dt = rt.engine.warmup(max_new_tokens=ml_cfg.warmup_tokens)
-                self.log.info(
-                    "warmed serving programs in %.1fs (%d tokens)",
-                    dt, ml_cfg.warmup_tokens,
-                )
         with self._lock:
             self.jobs[job_id] = rt
         self.log.info(
@@ -329,6 +331,21 @@ class DistributedWorker:
             p["peer"], proto.MODULE_LOADED, p["rid"],
             {"job_id": job_id, "ok": True, "n_layers": hi - lo},
         )
+        warm_toks = self.node.config.ml.warmup_tokens
+        if getattr(rt, "engine", None) is not None and warm_toks and not training:
+            # AFTER the ack: XLA warmup can take minutes on a real chip and
+            # must not time out the deploy (MODULE waits MAX_WAIT_TIME).
+            # The run loop is serial, so the first request simply queues
+            # behind the warm compile it would otherwise have paid itself;
+            # a warmup failure must not double-respond on this rid.
+            try:
+                dt = rt.engine.warmup(max_new_tokens=warm_toks)
+                self.log.info(
+                    "warmed serving programs in %.1fs (%d tokens)",
+                    dt, warm_toks,
+                )
+            except Exception:
+                self.log.exception("serving warmup failed (serving anyway)")
 
     def _build_stage_mesh(self, cfg, stage: dict):
         """Build this stage's local device mesh from the plan's axis sizes
@@ -527,25 +544,24 @@ class DistributedWorker:
             return
         train = bool(p.get("train", False))
         tag = p.get("tag", "")
+        if op == "chain" and p.get("head_hop"):
+            # final hop of a worker-to-worker chain looping back for the
+            # tied-embedding head (ml/module.py::_forward_chain)
+            hidden = jnp.asarray(np.asarray(p["hidden"]))
+            logits = head_forward(rt.params, hidden, rt.cfg)
+            self._finish_fwd(rt, p, logits, True)
+            return
         if op == "head":
             hidden = jnp.asarray(np.asarray(p["hidden"]))
             logits = head_forward(rt.params, hidden, rt.cfg)
             if train:
                 rt.saved[tag + ".head"] = ("head", None, hidden, None, True)
-            if p.get("sample") is not None and not train:
-                # pipelined decode: sample HERE and ship one token id per
-                # row instead of [B, T, 151k-vocab] logits across the hop
-                tok = self._sample_from_logits(
-                    logits, p.get("last_idx"), p["sample"]
-                )
                 self._respond(
-                    p["peer"], proto.FORWARD_RESP, p["rid"], {"token": tok}
+                    p["peer"], proto.FORWARD_RESP, p["rid"],
+                    {"out": np.asarray(jax.device_get(logits))},
                 )
                 return
-            self._respond(
-                p["peer"], proto.FORWARD_RESP, p["rid"],
-                {"out": np.asarray(jax.device_get(logits))},
-            )
+            self._finish_fwd(rt, p, logits, True)
             return
 
         stage = rt.stage
@@ -603,10 +619,7 @@ class DistributedWorker:
                 rt, seq_mesh, pp_size, apply_head, n_micro=n_micro
             )
             out = fwd(rt.params, x_in, mask)
-            self._respond(
-                p["peer"], proto.FORWARD_RESP, p["rid"],
-                {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
-            )
+            self._finish_fwd(rt, p, out, apply_head)
             return
 
         session = p.get("session")
@@ -630,18 +643,56 @@ class DistributedWorker:
         )
         if session is not None:
             rt.sessions[session] = new_cache
-        if p.get("sample") is not None and apply_head:
-            # final pipeline stage of a decode session: sample on-worker and
-            # return the token ids — the per-token logits transfer
-            # (~600 KB at a 151k vocab) never leaves the device host
+        self._finish_fwd(rt, p, out, apply_head)
+
+    # chain fields every forwarded hop must carry onward
+    _CHAIN_KEYS = (
+        "job_id", "session", "cache_len", "attn_mask", "sample",
+        "last_idx", "reply_to",
+    )
+
+    def _finish_fwd(self, rt: "StageRuntime", p: dict, out, is_logits: bool) -> None:
+        """Deliver a (non-training) forward result: forward to the next
+        chain hop worker-to-worker (ml/module.py::_forward_chain — the
+        activation never transits the user), sample on-device when this hop
+        produced the final logits of a decode step, or respond with the
+        array. ``reply_to`` names the chain's originator; per-hop requests
+        have none and answer their direct peer."""
+        import jax
+        import numpy as np
+
+        chain = p.get("chain") or []
+        if p.get("op") == "chain" and chain:
+            nxt = chain[0]
+            body = {
+                k: p[k] for k in self._CHAIN_KEYS if p.get(k) is not None
+            }
+            body.update(
+                op="chain",
+                chain=chain[1:],
+                head_hop=bool(nxt.get("head")),
+                hidden=np.asarray(jax.device_get(out)),
+                _rid=p["rid"],  # the originator's future resolves on this
+            )
+            self.bridge.request(
+                "chain_send",
+                {"addr": list(nxt["addr"]), "tag": proto.FORWARD,
+                 "body": body},
+            )
+            return
+        reply_peer = p.get("reply_to") or p["peer"]
+        if p.get("sample") is not None and is_logits:
+            # final logits of a decode step: sample on-worker and ship one
+            # token id per row — the per-token logits transfer (~600 KB at
+            # a 151k vocab) never leaves the device host
             tok = self._sample_from_logits(out, p.get("last_idx"), p["sample"])
             self._respond(
-                p["peer"], proto.FORWARD_RESP, p["rid"], {"token": tok}
+                reply_peer, proto.FORWARD_RESP, p["rid"], {"token": tok}
             )
             return
         self._respond(
-            p["peer"], proto.FORWARD_RESP, p["rid"],
-            {"out": np.asarray(jax.device_get(out)), "is_logits": apply_head},
+            reply_peer, proto.FORWARD_RESP, p["rid"],
+            {"out": np.asarray(jax.device_get(out)), "is_logits": is_logits},
         )
 
     def _sample_from_logits(self, logits, last_idx, samp: dict) -> np.ndarray:

@@ -179,6 +179,15 @@ class RoleServer(TensorNode):
         await self.send_tensor(self._conn(p["peer"]), p["tag"], p.get("body", {}))
         return True
 
+    async def cmd_chain_send(self, p) -> bool:
+        """Forward a chained-stage frame to the NEXT stage's worker by
+        address, dialing on demand (ml/worker.py::_finish_fwd — worker-to-
+        worker pipelined forward; connect() dedupes by address)."""
+        addr = p["addr"]
+        conn = await self.connect(addr[0], int(addr[1]))
+        await self.send_tensor(conn, p["tag"], p.get("body", {}))
+        return True
+
     async def cmd_respond(self, p) -> bool:
         """Resolve an earlier inbound tensor request (ML finished the work)."""
         await self.tensor_respond(

@@ -256,6 +256,10 @@ def test_pipelined_forward_and_generate_parity(cluster):
         assert model.plan.n_stages == 2
         qseqs = model.generate([prompt], max_new_tokens=6)
         assert qseqs[0] == refgen.sequences[0]
+        # and all of the above really rode the worker-to-worker chain (one
+        # request per forward; activations never transited the user) — not
+        # the per-hop fallback
+        assert model.chain_forwards > 0
     finally:
         try:
             model.shutdown()

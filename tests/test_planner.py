@@ -248,3 +248,32 @@ def test_memory_estimate_matches_real_arrays():
     cache = KVCache.init(cfg, 2, max_len=256, dtype=cfg.dtype)
     kv_bytes = cache.k.nbytes + cache.v.nbytes
     assert abs(inf.kv_cache - kv_bytes) / kv_bytes < 0.30
+
+
+def test_llama70b_multiworker_plan():
+    """BASELINE config 4: Llama-3-70B sharded across four v5p-8 workers
+    (8 chips x 95 GB each) — a contiguous pipeline whose stages each fit
+    their worker per-device, with TP spanning each worker's ICI."""
+    cfg = config_presets()["llama3-70b"]
+    ws = [
+        WorkerCapacity(f"w{i}", 8 * 95 * GB, n_devices=8) for i in range(4)
+    ]
+    plan = plan_sharding(cfg, ws, seq_len=4096)
+    assert 1 <= plan.n_stages <= 4
+    lo = 0
+    for s in plan.stages:
+        assert s.layer_lo == lo
+        lo = s.layer_hi
+        assert s.mesh_axes.get("tensor", 1) > 1  # ICI-wide TP per worker
+    assert lo == cfg.n_layers
+
+
+def test_mixtral_expert_parallel_plan():
+    """BASELINE config 5: Mixtral-8x7B on an 8-chip worker claims an
+    expert axis (8 experts / 8 chips) plus TP for the attention heads."""
+    cfg = config_presets()["mixtral-8x7b"]
+    est = MemoryEstimate.build(cfg, batch=1, seq_len=2048, training=False)
+    w = [WorkerCapacity("w0", est.total * 1.3, n_devices=8)]
+    plan = plan_sharding(cfg, w, seq_len=2048)
+    assert plan.n_stages == 1
+    assert plan.stages[0].mesh_axes.get("expert") == 8

@@ -194,7 +194,7 @@ def test_int8_sharded_mesh_parity(cpu_devices):
     sharded engine's greedy decode must match the single-device int8 engine
     token for token — quantization is elementwise, so sharding commutes
     with it up to matmul reduction order."""
-    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import NamedSharding
     from tensorlink_tpu.models.transformer import cache_specs, partition_specs
     from tensorlink_tpu.parallel.mesh import build_mesh
 
