@@ -88,6 +88,7 @@ class ChatCompletionRequest:
     temperature: float = 0.6
     top_p: float = 0.95
     stream: bool = False
+    lookahead: bool = False  # speculative decode hint (greedy only)
 
     @classmethod
     def parse(cls, d: dict) -> "ChatCompletionRequest":
@@ -106,6 +107,7 @@ class ChatCompletionRequest:
             temperature=float(d.get("temperature", 0.6)),
             top_p=float(d.get("top_p", 0.95)),
             stream=bool(d.get("stream", False)),
+            lookahead=bool(d.get("lookahead", False)),
         )
         _require(req.max_tokens > 0, "max_tokens must be positive")
         return req
@@ -127,6 +129,7 @@ class ChatCompletionRequest:
             top_p=self.top_p,
             stream=self.stream,
             output_format="openai",
+            lookahead=self.lookahead,
         )
 
 

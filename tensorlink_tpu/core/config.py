@@ -58,6 +58,12 @@ class MLConfig:
     # merged plan needs a runtime where one worker process addresses the
     # whole slice's devices (see plan_sharding docstring).
     co_slice_planning: bool = False
+    # multi-controller runtime (parallel/multihost.py): set on every host of
+    # a slice to join one jax.distributed job; jax.devices() then spans the
+    # slice. Env fallbacks: TLTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID.
+    coordinator_address: str = ""
+    num_processes: int = 0
+    process_id: int = -1
     trusted: bool = False  # reference: pickle mode. Here: may run user jax code
     dtype: str = "bfloat16"
     max_seq_len: int = 4096

@@ -168,7 +168,7 @@ class DistributedModel:
 
     def _request(
         self, worker_plan_id: str, tag: str, body: dict, timeout=MAX_WAIT_TIME,
-        _repaired: bool = False,
+        _repaired: bool = False, no_repair: bool = False,
     ):
         try:
             resp = self.node.send_request(
@@ -184,8 +184,11 @@ class DistributedModel:
         except Exception as e:
             # connection to the worker died mid-request → pull a replacement
             # from the validator and retry once (the reference's
-            # "request another worker" TODO, module.py:510-511, made real)
-            if _repaired or "no connection" not in str(e):
+            # "request another worker" TODO, module.py:510-511, made real).
+            # ``no_repair``: a SESSION chain must never be silently re-sent —
+            # downstream stages may already have absorbed this call's KV
+            # writes, and a retry would append them twice.
+            if _repaired or no_repair or "no connection" not in str(e):
                 raise
             new_id = self._repair(worker_plan_id)
             return self._request(new_id, tag, body, timeout, _repaired=True)
@@ -439,7 +442,10 @@ class DistributedModel:
             body_common, op="chain", chain=entries,
             reply_to=self.node.node_id, tokens=x,
         ))
-        resp = self._request(stages[0].worker_id, proto.FORWARD, body)
+        resp = self._request(
+            stages[0].worker_id, proto.FORWARD, body,
+            no_repair=body_common.get("session") is not None,
+        )
         self.chain_forwards += 1
         if "token" in resp:
             return np.asarray(resp["token"], np.int32)

@@ -88,6 +88,15 @@ class DistributedWorker:
         self.log = get_logger(f"ml.worker{node.config.duplicate}")
         self.jobs: dict[str, StageRuntime] = {}
         self._lock = threading.Lock()
+        # join the multi-controller runtime BEFORE first device use when the
+        # deployment spans hosts of one slice (parallel/multihost.py) — then
+        # jax.devices() is global and planned meshes may span the slice
+        ml = node.config.ml
+        from tensorlink_tpu.parallel.multihost import maybe_initialize
+
+        maybe_initialize(
+            ml.coordinator_address, ml.num_processes, ml.process_id
+        )
 
     # -- capacity -------------------------------------------------------
     def capacity(self) -> dict:
@@ -678,6 +687,10 @@ class DistributedWorker:
                 "chain_send",
                 {"addr": list(nxt["addr"]), "tag": proto.FORWARD,
                  "body": body},
+                # generous: a multi-GB activation over DCN outlives the
+                # 30 s IPC default, and a spurious timeout here would race
+                # an error reply against the still-progressing chain
+                timeout=150.0,
             )
             return
         reply_peer = p.get("reply_to") or p["peer"]

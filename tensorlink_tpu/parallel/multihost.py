@@ -1,0 +1,87 @@
+"""Multi-host runtime glue (jax.distributed).
+
+The reference scales across hosts with NCCL/MPI process groups
+(/root/reference/tensorlink docs position workers as independent GPU
+processes wired by torch distributed primitives). The TPU-native analogue
+is JAX's multi-controller runtime: every process of a pod slice calls
+``jax.distributed.initialize`` against one coordinator, after which
+``jax.devices()`` is the GLOBAL device list and any jit over a mesh built
+from it runs SPMD across hosts — XLA lowers the very same ``psum`` /
+``all_gather`` / ``ppermute`` collectives onto ICI/DCN that the in-process
+mesh path uses on one host. No NCCL bootstrap, no rank plumbing inside the
+model: sharding stays declarative (parallel/planner.py PartitionSpecs) and
+the runtime carries it across hosts.
+
+Wiring: a worker deployment that owns several hosts of one slice sets
+``MLConfig.coordinator_address`` / ``num_processes`` / ``process_id`` (or
+the TLTPU_COORDINATOR / TLTPU_NUM_PROCESSES / TLTPU_PROCESS_ID env vars)
+on each host. The ML engine calls :func:`maybe_initialize` before first
+device use; co-slice planning (``MLConfig.co_slice_planning``,
+parallel/planner.py::_merge_co_slice) can then emit one mesh over the
+pooled devices.
+
+Caveat (documented, deliberate): the multi-controller model requires every
+process to LAUNCH the same computations. The compiled training step and the
+dryrun path are SPMD-clean; the serving engine's host-driven loops are
+driven from one controller and are not lockstep-mirrored yet — co-slice
+planning therefore stays opt-in.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tensorlink_tpu.core.logging import get_logger
+
+log = get_logger("parallel.multihost")
+
+_initialized = False
+
+
+def maybe_initialize(
+    coordinator: str = "",
+    num_processes: int = 0,
+    process_id: int = -1,
+) -> bool:
+    """Join the multi-controller runtime when configured; returns whether
+    this process is (now) part of one. Safe to call repeatedly. Arguments
+    fall back to ``TLTPU_COORDINATOR`` / ``TLTPU_NUM_PROCESSES`` /
+    ``TLTPU_PROCESS_ID``; unset means single-process (the default)."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("TLTPU_COORDINATOR", "")
+    if not coordinator:
+        return False
+    num_processes = num_processes or int(
+        os.environ.get("TLTPU_NUM_PROCESSES", "0")
+    )
+    if process_id < 0:
+        process_id = int(os.environ.get("TLTPU_PROCESS_ID", "-1"))
+    if num_processes <= 1 or process_id < 0:
+        log.warning(
+            "multihost coordinator %s set but num_processes/process_id "
+            "incomplete — staying single-process", coordinator,
+        )
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "joined multihost runtime %s as process %d/%d: %d global / %d "
+        "local devices", coordinator, process_id, num_processes,
+        len(jax.devices()), len(jax.local_devices()),
+    )
+    return True
+
+
+def is_multihost() -> bool:
+    return _initialized
+
+
+__all__ = ["is_multihost", "maybe_initialize"]

@@ -260,6 +260,18 @@ def test_pipelined_forward_and_generate_parity(cluster):
         # request per forward; activations never transited the user) — not
         # the per-hop fallback
         assert model.chain_forwards > 0
+
+        # sampled decode is seed-deterministic end-to-end: the head worker
+        # derives its PRNG key from (seed, step), so identical requests
+        # reproduce identical tokens — across sessions and processes
+        s1 = model.generate([prompt], max_new_tokens=6, temperature=0.8,
+                            seed=123)
+        s2 = model.generate([prompt], max_new_tokens=6, temperature=0.8,
+                            seed=123)
+        assert s1 == s2
+        s3 = model.generate([prompt], max_new_tokens=6, temperature=0.8,
+                            seed=124)
+        assert s1 != s3  # astronomically unlikely to collide over 6 tokens
     finally:
         try:
             model.shutdown()
