@@ -37,6 +37,7 @@ class RingChannel:
         self._wlock = threading.Lock()
         self._rlock = threading.Lock()
         if _name is None:
+            sweep_orphans()  # SIGKILLed owners can't unlink; reap them here
             self.name = f"/tlring-{os.getpid()}-{secrets.token_hex(6)}"
             self._h = self._lib.tlring_create(self.name.encode(), capacity)
             self.owner = True
@@ -53,17 +54,28 @@ class RingChannel:
 
     # -- queue interface -------------------------------------------------
     def put(self, obj, timeout: float = 120.0) -> None:
+        import ctypes
+
         blob = ser.encode(obj)
         if len(blob) + 8 > self.capacity // 2:
-            # oversized → spill file + tiny marker message
+            # oversized → spill the ALREADY-BUILT frame + tiny marker
+            # message (re-encoding here would pay the whole frame assembly
+            # twice on exactly the large-payload path)
             fd, path = tempfile.mkstemp(prefix="tlring-", suffix=".tlts")
             os.close(fd)
-            ser.encode_to_file(obj, path)
+            with open(path, "wb") as f:
+                f.write(blob)
             blob = _FILE_MARKER + path.encode()
+        if isinstance(blob, bytes):
+            carg = blob
+        else:
+            # write straight from encode()'s buffer — no bytes() copy on
+            # the hot IPC path (tlring_write takes c_void_p)
+            carg = (ctypes.c_char * len(blob)).from_buffer(blob)
         with self._wlock:
             if self._h is None:
                 raise OSError(f"ring {self.name} released")
-            rc = self._lib.tlring_write(self._h, blob, len(blob), timeout)
+            rc = self._lib.tlring_write(self._h, carg, len(blob), timeout)
         if rc == -1:
             raise queue_mod.Full(f"ring {self.name} full after {timeout}s")
         if rc == -2:
@@ -127,6 +139,35 @@ class RingChannel:
 
 def _attach(name: str, capacity: int) -> RingChannel:
     return RingChannel(capacity, _name=name)
+
+
+def sweep_orphans() -> int:
+    """Unlink shm segments whose creating process is gone. Ring names embed
+    the creator pid (``tlring-<pid>-<token>``); a SIGKILLed node can never
+    unlink its segments, and a long-lived host would otherwise exhaust
+    /dev/shm. Attachers of a dead creator are orphaned regardless, so
+    reaping by creator-liveness is safe. Returns segments removed."""
+    import re
+
+    n = 0
+    shm = Path("/dev/shm")
+    if not shm.is_dir():
+        return 0
+    for p in shm.glob("tlring-*"):
+        m = re.match(r"tlring-(\d+)-", p.name)
+        if not m:
+            continue
+        try:
+            os.kill(int(m.group(1)), 0)
+        except ProcessLookupError:
+            try:
+                p.unlink()
+                n += 1
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # pid exists under another uid — leave it
+    return n
 
 
 def ring_supported() -> bool:

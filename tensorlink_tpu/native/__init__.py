@@ -72,8 +72,11 @@ def load_tlring():
     lib.tlring_attach.restype = ctypes.c_void_p
     lib.tlring_attach.argtypes = [ctypes.c_char_p]
     lib.tlring_write.restype = ctypes.c_int
+    # payload as c_void_p: accepts bytes AND writable buffers
+    # ((c_char * n).from_buffer(...)) so callers can write straight from a
+    # serialization buffer without a bytes() copy (core/ring.py::put)
     lib.tlring_write.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_double,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double,
     ]
     lib.tlring_next_size.restype = ctypes.c_int64
     lib.tlring_next_size.argtypes = [ctypes.c_void_p, ctypes.c_double]

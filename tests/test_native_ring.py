@@ -128,3 +128,32 @@ def test_wrap_around_many_messages():
             np.testing.assert_array_equal(got["p"], payload)
     finally:
         ch.release()
+
+
+def test_sweep_orphans_reaps_dead_creators(tmp_path):
+    """A SIGKILLed owner can't unlink its shm segment; creating a new ring
+    reaps segments whose embedded creator pid is gone — and never touches a
+    live creator's segment."""
+    import os
+    from pathlib import Path
+
+    from tensorlink_tpu.core.ring import RingChannel, ring_supported, sweep_orphans
+
+    if not ring_supported():
+        import pytest
+
+        pytest.skip("native ring unavailable")
+    shm = Path("/dev/shm")
+    # fabricate an orphan: a segment named for a pid that cannot exist
+    orphan = shm / "tlring-999999999-deadbeef0000"
+    orphan.write_bytes(b"\x00" * 64)
+    live = RingChannel(1 << 16)  # triggers a sweep on creation
+    try:
+        assert not orphan.exists()
+        # the live ring's own segment survived its creation-time sweep
+        assert (shm / live.name.lstrip("/")).exists()
+        sweep_orphans()  # explicit call with a live creator: still safe
+        assert (shm / live.name.lstrip("/")).exists()
+    finally:
+        live.release()
+    assert not (shm / live.name.lstrip("/")).exists()  # owner unlinked
