@@ -11,7 +11,6 @@ lives in :class:`~tensorlink_tpu.api.server.PendingRequest`.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any
 
 
 class ValidationError(ValueError):

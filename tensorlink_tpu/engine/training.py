@@ -13,8 +13,7 @@ the compiled analogue of the reference's micro-batch threads
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

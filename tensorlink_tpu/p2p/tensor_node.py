@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import asyncio
 from pathlib import Path
-from typing import Any
 
 from tensorlink_tpu.core import serialization as ser
 from tensorlink_tpu.p2p import protocol as proto
