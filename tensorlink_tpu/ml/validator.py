@@ -339,6 +339,12 @@ class DistributedValidator:
                 stats = j.batcher.stats() if j.batcher is not None else None
                 if stats:
                     entry["serving"] = stats
+                model = j.model
+                if model is not None and getattr(model, "plan", None):
+                    entry["stages"] = model.plan.n_stages
+                    cf = getattr(model, "chain_forwards", 0)
+                    if cf:  # worker-to-worker chained calls completed
+                        entry["chain_forwards"] = cf
                 out.append(entry)
             return out
 

@@ -115,7 +115,9 @@ def test_health_and_preload(api_cluster):
     status, body = _req(api, "GET", f"/model-status/{MODEL}")
     assert body["status"] == "ready"
     status, body = _req(api, "GET", "/models")
-    assert {"name": MODEL, "status": "ready"} in body["models"]
+    assert any(
+        m["name"] == MODEL and m["status"] == "ready" for m in body["models"]
+    )
     # OpenAI-compatible listing
     status, body = _req(api, "GET", "/v1/models")
     assert status == 200 and body["object"] == "list"
@@ -255,6 +257,11 @@ def test_stats_and_node_info(api_cluster):
     api = api_cluster.api
     status, body = _req(api, "GET", "/stats")
     assert status == 200 and "peers" in body
+    # hosted entries surface their plan topology (pipelined jobs also
+    # report chain_forwards once the worker-to-worker chain has run)
+    status, body = _req(api, "GET", "/models")
+    hosted = {m["name"]: m for m in body["models"]}
+    assert hosted[MODEL].get("stages") == 1
     status, body = _req(api, "GET", "/node-info")
     assert body["role"] == "validator" and MODEL in body["hosted_models"]
     status, body = _req(api, "GET", "/model-demand")
