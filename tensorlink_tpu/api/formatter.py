@@ -131,6 +131,60 @@ def extract_reasoning_and_answer(text: str) -> tuple[str, str]:
     return "", text.strip()
 
 
+class StopStream:
+    """Streaming stop-sequence filter with OpenAI earliest-START semantics.
+
+    The subtlety: with overlapping stops (e.g. ``["X", "bXY"]`` on
+    ``"abXY…"``) the first COMPLETED match ("X") is not necessarily the
+    earliest-STARTING one ("bXY") — cutting eagerly would emit different
+    text than the non-stream path's ``min(find(s))`` truncation. So the
+    filter never emits past the earliest position where any stop could
+    still start (exact prefix check), and only cuts once no earlier
+    candidate remains open. ``flush()`` resolves pending prefixes at end of
+    stream (an unfinished prefix is NOT a match)."""
+
+    def __init__(self, stops: list[str], emit):
+        self.stops = list(stops)
+        self.emit = emit
+        self.hold = ""
+        self.stopped = False
+
+    def _earliest_open_prefix(self) -> int | None:
+        for j in range(len(self.hold)):
+            tail = self.hold[j:]
+            if any(s.startswith(tail) and len(tail) < len(s)
+                   for s in self.stops):
+                return j
+        return None
+
+    def _scan(self, final: bool) -> None:
+        if self.stopped:
+            return
+        hits = [i for i in (self.hold.find(s) for s in self.stops)
+                if i != -1]
+        best = min(hits) if hits else None
+        pending = None if final else self._earliest_open_prefix()
+        if best is not None and (pending is None or pending >= best):
+            if best:
+                self.emit(self.hold[:best])
+            self.hold = ""
+            self.stopped = True
+            return
+        boundary = pending if pending is not None else len(self.hold)
+        if boundary:
+            self.emit(self.hold[:boundary])
+            self.hold = self.hold[boundary:]
+
+    def feed(self, delta: str) -> None:
+        if self.stopped or not delta:
+            return
+        self.hold += delta
+        self._scan(final=False)
+
+    def flush(self) -> None:
+        self._scan(final=True)
+
+
 class ThinkStripStream:
     """Incremental ``<think>`` stripper for SSE streams (reference strips
     think blocks in-stream, ml/validator.py:782-808). Feed decoded text

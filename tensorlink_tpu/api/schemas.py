@@ -42,6 +42,26 @@ class GenerationRequest:
     # engine/generate.py::generate_lookahead). Emits exactly the vanilla
     # greedy tokens, so honoring it is always safe; ignored when sampling.
     lookahead: bool = False
+    # OpenAI-style stop sequences (the reference declares this field,
+    # api/models.py:70, but never applies it — here output is truncated at
+    # the earliest occurrence, streaming included via api/formatter.py
+    # StopStream). Decoding itself still runs to its token budget (no
+    # mid-loop cancel), so completion_tokens counts decoded tokens, not
+    # the truncated text; with enable_thinking=true the live stream is
+    # unfiltered (raw think text) and only the final answer is truncated.
+    stop: list[str] = field(default_factory=list)
+
+    @staticmethod
+    def _parse_stop(v) -> list[str]:
+        if v is None:
+            return []
+        if isinstance(v, str):
+            v = [v]
+        _require(isinstance(v, list) and len(v) <= 4, "stop: up to 4 strings")
+        for s in v:
+            _require(isinstance(s, str) and s, "stop entries must be "
+                     "non-empty strings")
+        return list(v)
 
     @classmethod
     def parse(cls, d: dict) -> "GenerationRequest":
@@ -60,6 +80,7 @@ class GenerationRequest:
             output_format=str(d.get("output_format", "simple")),
             enable_thinking=bool(d.get("enable_thinking", False)),
             lookahead=bool(d.get("lookahead", False)),
+            stop=cls._parse_stop(d.get("stop")),
         )
         _require(req.max_new_tokens > 0, "max_new_tokens must be positive")
         _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")
@@ -88,6 +109,7 @@ class ChatCompletionRequest:
     top_p: float = 0.95
     stream: bool = False
     lookahead: bool = False  # speculative decode hint (greedy only)
+    stop: list[str] = field(default_factory=list)
 
     @classmethod
     def parse(cls, d: dict) -> "ChatCompletionRequest":
@@ -107,6 +129,7 @@ class ChatCompletionRequest:
             top_p=float(d.get("top_p", 0.95)),
             stream=bool(d.get("stream", False)),
             lookahead=bool(d.get("lookahead", False)),
+            stop=GenerationRequest._parse_stop(d.get("stop")),
         )
         _require(req.max_tokens > 0, "max_tokens must be positive")
         return req
@@ -129,6 +152,7 @@ class ChatCompletionRequest:
             stream=self.stream,
             output_format="openai",
             lookahead=self.lookahead,
+            stop=self.stop,
         )
 
 

@@ -370,6 +370,7 @@ class DistributedValidator:
         ``{text, reasoning, prompt_tokens, completion_tokens, finish_reason}``.
         ``on_delta`` receives visible-answer text pieces as they decode."""
         from tensorlink_tpu.api.formatter import (
+            StopStream,
             ThinkStripStream,
             extract_reasoning_and_answer,
             format_chat_prompt,
@@ -409,11 +410,30 @@ class DistributedValidator:
         prefix_offset = 0
         read_offset = 0
 
+        # OpenAI-style stop sequences (applied HERE, not just declared like
+        # the reference's schema field). Stream-side filtering runs only
+        # when the deltas are ANSWER text (think blocks stripped) — with
+        # enable_thinking=true the raw reasoning streams through unfiltered
+        # and only the final answer field is truncated, since a stop match
+        # inside the think block must not silence the whole stream.
+        stop_list = list(getattr(req, "stop", []) or [])
+        stream_stops = (
+            StopStream(stop_list, on_delta)
+            if stop_list and stripper is not None and on_delta is not None
+            else None
+        )
+
+        def _deliver(delta: str) -> None:
+            if stream_stops is not None:
+                stream_stops.feed(delta)
+            else:
+                on_delta(delta)
+
         def _emit(delta: str) -> None:
             if stripper is not None:
                 delta = stripper.feed(delta)
             if delta:
-                on_delta(delta)
+                _deliver(delta)
 
         def stream_cb(new_tokens: list[int | None]) -> None:
             nonlocal prefix_offset, read_offset
@@ -467,17 +487,24 @@ class DistributedValidator:
             if stripper is not None:
                 tail = stripper.flush()
                 if tail:
-                    on_delta(tail)
+                    _deliver(tail)
+            if stream_stops is not None:
+                stream_stops.flush()  # resolve pending prefixes / holdback
         eos = set(tok.eos_ids)
         full_text = tok.decode([i for i in out_ids if i not in eos])
         reasoning, answer = extract_reasoning_and_answer(full_text)
         hit_eos = bool(out_ids) and out_ids[-1] in eos
+        finish = "stop" if hit_eos else "length"
+        hits = [i for i in (answer.find(s) for s in stop_list) if i != -1]
+        if hits:
+            answer = answer[: min(hits)]
+            finish = "stop"
         return {
             "text": answer,
             "reasoning": reasoning,
             "prompt_tokens": len(ids),
             "completion_tokens": len(out_ids),
-            "finish_reason": "stop" if hit_eos else "length",
+            "finish_reason": finish,
         }
 
 

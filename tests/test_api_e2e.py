@@ -189,6 +189,51 @@ def test_generate_lookahead_matches_vanilla(api_cluster):
     assert status == 200, body
 
 
+def test_stop_sequences_truncate_and_stream(api_cluster):
+    """OpenAI-style stop sequences are APPLIED (the reference only declares
+    the field): the answer cuts at the earliest occurrence, finish_reason
+    is "stop", and the SSE stream never emits past the match even when the
+    stop spans delta boundaries."""
+    api = api_cluster.api
+    base = {"hf_name": MODEL, "message": "tell", "max_new_tokens": 16,
+            "do_sample": False}
+    status, ref = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, ref
+    text = ref["response"]
+    if len(text) < 4:
+        pytest.skip("reference output too short to carve a stop from")
+    stop_s = text[2:4]
+    expected = text[: text.find(stop_s)]
+
+    status, body = _req(api, "POST", "/v1/generate", {**base, "stop": stop_s})
+    assert status == 200, body
+    assert body["response"] == expected
+
+    # finish_reason rides the OpenAI format
+    status, body = _req(
+        api, "POST", "/v1/generate",
+        {**base, "stop": stop_s, "output_format": "openai"},
+    )
+    assert status == 200, body
+    choice = body["choices"][0]
+    assert choice["message"]["content"] == expected
+    assert choice["finish_reason"] == "stop"
+
+    # streaming: joined deltas equal the truncated text, nothing beyond
+    status, events = _sse(
+        api, "/v1/generate", {**base, "stop": [stop_s], "stream": True}
+    )
+    assert status == 200
+    pieces = [json.loads(e).get("token", "") for e in events if e != "[DONE]"]
+    assert "".join(pieces) == expected
+
+    # validation: >4 stops rejected
+    status, body = _req(
+        api, "POST", "/v1/generate", {**base, "stop": ["a"] * 5}
+    )
+    assert status == 400
+
+
 def test_generate_openai_format(api_cluster):
     api = api_cluster.api
     status, body = _req(

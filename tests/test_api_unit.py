@@ -188,3 +188,33 @@ def test_sse_encoding():
     assert SSE_DONE == b"data: [DONE]\n\n"
     payload = json.loads(ev[len(b"data: "):].strip())
     assert payload == {"a": 1}
+
+
+def test_stop_stream_semantics():
+    """StopStream matches the non-stream earliest-START truncation even
+    when a shorter stop COMPLETES before an earlier-starting longer one,
+    when a stop spans delta boundaries, and an unfinished prefix at end of
+    stream is not a match."""
+    from tensorlink_tpu.api.formatter import StopStream
+
+    def run(stops, deltas):
+        out = []
+        ss = StopStream(stops, out.append)
+        for d in deltas:
+            ss.feed(d)
+        ss.flush()
+        return "".join(out), ss.stopped
+
+    # overlapping stops: "bXY" starts at 1 before "X" completes at 2 —
+    # must cut at 1 like the non-stream min(find) rule
+    assert run(["X", "bXY"], ["a", "b", "X", "Y", "tail"]) == ("a", True)
+    # same text, only the short stop: cut at its start
+    assert run(["X"], ["ab", "XY"]) == ("ab", True)
+    # stop spanning three deltas
+    assert run(["STOP"], ["hello S", "TO", "P world"]) == ("hello ", True)
+    # prefix never completes: everything flushes at end of stream
+    assert run(["STOP"], ["abc ST", "O"]) == ("abc STO", False)
+    # stop at position 0 silences the whole stream
+    assert run(["h"], ["hello"]) == ("", True)
+    # no stops configured behaves as passthrough
+    assert run([], ["a", "b"]) == ("ab", False)
