@@ -43,6 +43,10 @@ def normalize_generate_args(
         "temperature": temperature,
         "top_p": top_p,
         "top_k": top_k,
+        # range-validated at parse time ([-2, 2] → 400), passed through
+        # like temperature/top_p
+        "presence_penalty": float(getattr(req, "presence_penalty", 0.0)),
+        "frequency_penalty": float(getattr(req, "frequency_penalty", 0.0)),
     }
 
 

@@ -35,6 +35,11 @@ class GenerationRequest:
     top_p: float = 0.95
     top_k: int = 0
     do_sample: bool = True
+    # OpenAI repetition control, APPLIED in the compiled sampler
+    # (engine/sampling.py; the reference declares these, api/models.py:73-74,
+    # but never uses them). Single-stage jobs only.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     stream: bool = False
     output_format: str = "simple"  # "simple" | "openai" | "raw"
     enable_thinking: bool = False
@@ -76,6 +81,8 @@ class GenerationRequest:
             top_p=float(d.get("top_p", 0.95)),
             top_k=int(d.get("top_k", 0)),
             do_sample=bool(d.get("do_sample", True)),
+            presence_penalty=float(d.get("presence_penalty", 0.0)),
+            frequency_penalty=float(d.get("frequency_penalty", 0.0)),
             stream=bool(d.get("stream", False)),
             output_format=str(d.get("output_format", "simple")),
             enable_thinking=bool(d.get("enable_thinking", False)),
@@ -86,6 +93,9 @@ class GenerationRequest:
         _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")
         _require(0.0 < req.top_p <= 1.0, "top_p must be in (0, 1]")
         _require(req.top_k >= 0, "top_k must be >= 0")
+        for nm, v in (("presence_penalty", req.presence_penalty),
+                      ("frequency_penalty", req.frequency_penalty)):
+            _require(-2.0 <= v <= 2.0, f"{nm} must be in [-2, 2]")
         _require(
             req.output_format in ("simple", "openai", "raw"),
             "output_format must be simple|openai|raw",
@@ -110,6 +120,8 @@ class ChatCompletionRequest:
     stream: bool = False
     lookahead: bool = False  # speculative decode hint (greedy only)
     stop: list[str] = field(default_factory=list)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
     @classmethod
     def parse(cls, d: dict) -> "ChatCompletionRequest":
@@ -130,8 +142,13 @@ class ChatCompletionRequest:
             stream=bool(d.get("stream", False)),
             lookahead=bool(d.get("lookahead", False)),
             stop=GenerationRequest._parse_stop(d.get("stop")),
+            presence_penalty=float(d.get("presence_penalty", 0.0)),
+            frequency_penalty=float(d.get("frequency_penalty", 0.0)),
         )
         _require(req.max_tokens > 0, "max_tokens must be positive")
+        for nm, v in (("presence_penalty", req.presence_penalty),
+                      ("frequency_penalty", req.frequency_penalty)):
+            _require(-2.0 <= v <= 2.0, f"{nm} must be in [-2, 2]")
         return req
 
     def to_generation_request(self) -> GenerationRequest:
@@ -153,6 +170,8 @@ class ChatCompletionRequest:
             output_format="openai",
             lookahead=self.lookahead,
             stop=self.stop,
+            presence_penalty=self.presence_penalty,
+            frequency_penalty=self.frequency_penalty,
         )
 
 

@@ -359,6 +359,10 @@ class TensorlinkAPI:
                     )
                 except ModelNotReady as e:
                     raise HTTPError(503, str(e))
+                except ValidationError as e:
+                    # request-vs-model mismatch detected past parse time
+                    # (e.g. penalties on a multi-stage model): client error
+                    raise HTTPError(400, str(e))
                 return await self._send_json(
                     writer, 200,
                     fmt.complete(

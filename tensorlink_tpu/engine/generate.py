@@ -103,7 +103,7 @@ def _verify_step(params, toks, cache, cfg: ModelConfig):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps"),
+    static_argnames=("cfg", "n_steps", "penalize"),
     donate_argnames=("cache",),
 )
 def _decode_loop(
@@ -114,8 +114,10 @@ def _decode_loop(
     sampling: SamplingParams,
     eos_ids,  # int32 [n_eos] (pad with -1)
     limits,  # int32 [B] — loop tokens allowed per row (after first_tok)
+    counts,  # int32 [B, V] context token counts (dummy when not penalize)
     cfg: ModelConfig,
     n_steps: int,
+    penalize: bool = False,
 ):
     """Fully on-device decode: while_loop with EOS early exit.
 
@@ -123,18 +125,23 @@ def _decode_loop(
     position; i.e. tokens holds the *newly generated* tokens after
     first_tok). ``limits`` freezes rows individually — batched requests mix
     different budgets and different cache rooms without a host round-trip
-    per step.
+    per step. ``penalize`` (static) threads per-token context counts
+    through the loop for presence/frequency penalties — a separate program
+    so the penalty-free path never pays the [B, V] carry.
     """
     B = first_tok.shape[0]
     tokens = jnp.zeros((B, n_steps), jnp.int32)
     done0 = jnp.isin(first_tok, eos_ids) | (limits <= 0)
 
     def cond(state):
-        i, _, _, done, _, _ = state
-        return (i < n_steps) & ~done.all()
+        return (state[0] < n_steps) & ~state[3].all()
 
     def body(state):
-        i, tok, cache, done, key, tokens = state
+        if penalize:
+            i, tok, cache, done, key, tokens, counts = state
+        else:
+            i, tok, cache, done, key, tokens = state
+            counts = None
         prev_len = cache.length
         logits, cache = forward(params, tok[:, None], cfg, cache=cache)
         # freeze the per-row write offset for finished rows: their re-fed
@@ -150,15 +157,24 @@ def _decode_loop(
             k_scale=cache.k_scale, v_scale=cache.v_scale,
         )
         key, sub = jax.random.split(key)
-        nxt = sample(logits[:, 0], sub, sampling)
+        nxt = sample(logits[:, 0], sub, sampling, counts)
         nxt = jnp.where(done, tok, nxt)  # freeze finished rows
-        done = done | jnp.isin(nxt, eos_ids) | (i + 1 >= limits)
-        tokens = tokens.at[:, i].set(nxt)
-        return i + 1, nxt, cache, done, key, tokens
+        out = (i + 1, nxt, cache,
+               done | jnp.isin(nxt, eos_ids) | (i + 1 >= limits),
+               key, tokens.at[:, i].set(nxt))
+        if penalize:
+            # frozen rows re-feed the same token — don't recount it
+            counts = counts.at[jnp.arange(B), nxt].add(
+                jnp.where(done, 0, 1)
+            )
+            out = out + (counts,)
+        return out
 
-    n_exec, _, cache, done, _, tokens = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), first_tok, cache, done0, key, tokens)
-    )
+    init = (jnp.int32(0), first_tok, cache, done0, key, tokens)
+    if penalize:
+        init = init + (counts,)
+    final = jax.lax.while_loop(cond, body, init)
+    n_exec, _, cache, done, _, tokens = final[:6]
     return tokens, cache, done, n_exec
 
 
@@ -520,6 +536,8 @@ class GenerationEngine:
         max_new_tokens); each row is limited by its OWN budget and cache
         room, so a long-prompt neighbor never truncates a short one."""
         sampling = sampling or SamplingParams.make()
+        prompts = [list(p) for p in prompts]  # materialize: iterated again
+        # below for the penalty counts, and a generator would be spent
         logits, cache, lens, B = self.prefill(prompts, reuse_prefix=reuse_prefix)
         sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         n_rows = len(lens)
@@ -529,7 +547,9 @@ class GenerationEngine:
 
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
-        tok = sample(logits, sub, sampling)
+        pen = self._penalized(sampling)
+        counts = self._prompt_counts(prompts, B) if pen else None
+        tok = sample(logits, sub, sampling, counts)
         seqs: list[list[int]] = [[] for _ in range(n_rows)]
         done = np.zeros(B, bool)
         for i in range(B):
@@ -544,6 +564,15 @@ class GenerationEngine:
                     emitted.append(int(tok_host[i]))
                 else:
                     emitted.append(None)
+            if pen:
+                # fold the just-emitted token into the context counts (rows
+                # that emitted nothing this step add nothing)
+                live = np.array(
+                    [i < n_rows and emitted[i] is not None for i in range(B)]
+                )
+                counts = counts.at[jnp.arange(B), tok].add(
+                    jnp.asarray(live.astype(np.int32))
+                )
             done |= np.isin(tok_host, eos)
             for i in range(n_rows):
                 if len(seqs[i]) >= eff[i]:
@@ -554,7 +583,7 @@ class GenerationEngine:
                 break
             key, sub = jax.random.split(key)
             logits, cache = _decode_step(self.params, tok, cache, self.cfg)
-            nxt = sample(logits, sub, sampling)
+            nxt = sample(logits, sub, sampling, counts)
             tok = jnp.where(jnp.asarray(done), tok, nxt)
         del cache
         return GenerationResult(
@@ -671,6 +700,23 @@ class GenerationEngine:
         fin = bool(seq and seq[-1] in eos_set)
         return GenerationResult(sequences=[seq], prompt_lens=lens, finished=[fin])
 
+    # -- repetition penalties --------------------------------------------
+    @staticmethod
+    def _penalized(sampling: SamplingParams) -> bool:
+        return bool(
+            np.any(np.asarray(sampling.presence_penalty))
+            or np.any(np.asarray(sampling.frequency_penalty))
+        )
+
+    def _prompt_counts(self, prompts, B: int) -> jax.Array:
+        """Per-row token counts over the prompt — the context the OpenAI
+        presence/frequency penalties score against (generated tokens are
+        folded in as they decode)."""
+        c = np.zeros((B, self.cfg.vocab_size), np.int32)
+        for i, p in enumerate(prompts):
+            np.add.at(c[i], np.asarray(list(p), np.int64), 1)
+        return jnp.asarray(c)
+
     # -- fully-compiled API (throughput / bench) --------------------------
     def _row_limits(
         self,
@@ -705,6 +751,8 @@ class GenerationEngine:
         ``budgets`` caps rows individually (batched request mixes) with no
         host round-trips — limits ride the compiled loop."""
         sampling = sampling or SamplingParams.make()
+        prompts = [list(p) for p in prompts]  # materialize: iterated again
+        # below for the penalty counts, and a generator would be spent
         logits, cache, lens, B = self.prefill(prompts, reuse_prefix=reuse_prefix)
         sampling = sampling.pad_rows(B)  # per-row knobs -> bucketed batch
         eff = self._row_limits(lens, B, max_new_tokens, budgets)
@@ -718,9 +766,19 @@ class GenerationEngine:
             )
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
-        first = sample(logits, sub, sampling)
+        pen = self._penalized(sampling)
+        counts = (
+            self._prompt_counts(prompts, B) if pen
+            else jnp.zeros((1, 1), jnp.int32)  # dummy; static penalize=False
+        )
+        first = sample(logits, sub, sampling, counts if pen else None)
         eos = jnp.asarray(list(eos_ids) or [-1], np.int32)
         limits = jnp.asarray([e - 1 for e in eff], jnp.int32)  # after first
+        if pen:
+            live = jnp.asarray([e > 0 for e in eff])
+            counts = counts.at[jnp.arange(B), first].add(
+                live.astype(jnp.int32)
+            )
         # n_steps is a STATIC arg of the compiled loop — bucket it to powers
         # of two so a serving batcher's varying budget mixes reuse a handful
         # of programs instead of compiling per distinct max(eff) (the loop
@@ -730,8 +788,8 @@ class GenerationEngine:
             n_steps <<= 1
         n_steps = max(min(n_steps, self.max_seq_len), 1)
         tokens, cache, done, n_exec = _decode_loop(
-            self.params, first, cache, key, sampling, eos, limits, self.cfg,
-            n_steps,
+            self.params, first, cache, key, sampling, eos, limits, counts,
+            self.cfg, n_steps, penalize=pen,
         )
         del cache
         toks = np.asarray(tokens)

@@ -27,13 +27,32 @@ class SamplingParams:
     temperature: jax.Array  # f32; <=0 → greedy
     top_k: jax.Array  # int32; 0 → disabled
     top_p: jax.Array  # f32; >=1 → disabled
+    # OpenAI-style repetition control (0 → disabled): logits of tokens seen
+    # in the context so far are shifted by
+    #   -presence·1[count>0] - frequency·count
+    # (applied in :func:`sample` when the caller supplies token counts —
+    # the reference declares these fields, api/models.py:73-74, but never
+    # applies them)
+    presence_penalty: jax.Array = None  # type: ignore[assignment]
+    frequency_penalty: jax.Array = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.presence_penalty is None:
+            object.__setattr__(self, "presence_penalty", jnp.float32(0.0))
+        if self.frequency_penalty is None:
+            object.__setattr__(self, "frequency_penalty", jnp.float32(0.0))
 
     @classmethod
-    def make(cls, temperature=0.0, top_k=0, top_p=1.0) -> "SamplingParams":
+    def make(
+        cls, temperature=0.0, top_k=0, top_p=1.0,
+        presence_penalty=0.0, frequency_penalty=0.0,
+    ) -> "SamplingParams":
         return cls(
             temperature=jnp.float32(temperature),
             top_k=jnp.int32(top_k),
             top_p=jnp.float32(top_p),
+            presence_penalty=jnp.float32(presence_penalty),
+            frequency_penalty=jnp.float32(frequency_penalty),
         )
 
     def pad_rows(self, batch: int) -> "SamplingParams":
@@ -55,6 +74,8 @@ class SamplingParams:
             temperature=pad(self.temperature, 0.0, jnp.float32),
             top_k=pad(self.top_k, 0, jnp.int32),
             top_p=pad(self.top_p, 1.0, jnp.float32),
+            presence_penalty=pad(self.presence_penalty, 0.0, jnp.float32),
+            frequency_penalty=pad(self.frequency_penalty, 0.0, jnp.float32),
         )
 
     @classmethod
@@ -70,6 +91,8 @@ class SamplingParams:
             temperature=col("temperature", 0.0, jnp.float32),
             top_k=col("top_k", 0, jnp.int32),
             top_p=col("top_p", 1.0, jnp.float32),
+            presence_penalty=col("presence_penalty", 0.0, jnp.float32),
+            frequency_penalty=col("frequency_penalty", 0.0, jnp.float32),
         )
 
 
@@ -78,6 +101,7 @@ def sample(
     logits: jax.Array,  # [B, V] float
     key: jax.Array,
     p: SamplingParams,
+    counts: jax.Array | None = None,  # int32 [B, V] context token counts
 ) -> jax.Array:
     """Temperature / top-k / top-p sampling, greedy when temperature<=0.
 
@@ -99,6 +123,16 @@ def sample(
     """
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
+    if counts is not None:
+        # OpenAI-style repetition control over the context so far
+        pres = jnp.broadcast_to(
+            jnp.atleast_1d(p.presence_penalty).reshape(-1, 1), (B, 1)
+        )
+        freq = jnp.broadcast_to(
+            jnp.atleast_1d(p.frequency_penalty).reshape(-1, 1), (B, 1)
+        )
+        cf = counts.astype(jnp.float32)
+        logits = logits - pres * (cf > 0) - freq * cf
     temp = jnp.broadcast_to(jnp.atleast_1d(p.temperature).reshape(-1, 1), (B, 1))
     top_k = jnp.broadcast_to(jnp.atleast_1d(p.top_k).reshape(-1, 1), (B, 1))
     top_p = jnp.broadcast_to(jnp.atleast_1d(p.top_p).reshape(-1, 1), (B, 1))

@@ -31,6 +31,8 @@ class _Pending:
     temperature: float
     top_k: int
     top_p: float
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     # speculative decode wish (greedy B=1 only): honored when the request
     # dispatches ALONE; in a co-batch it decodes vanilla — the emitted
     # tokens are identical either way, so this is purely a speed hint
@@ -83,6 +85,8 @@ class GenBatcher:
         stream_cb: Callable[[list[int]], None] | None = None,
         timeout: float = 600.0,
         lookahead: bool = False,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode."""
@@ -90,7 +94,12 @@ class GenBatcher:
             ids=list(ids), max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), stream_cb=stream_cb,
-            lookahead=bool(lookahead) and float(temperature) == 0.0,
+            presence_penalty=float(presence_penalty),
+            frequency_penalty=float(frequency_penalty),
+            # speculation emits exactly vanilla greedy — penalties change
+            # greedy's choices, so a penalized request takes the normal loop
+            lookahead=bool(lookahead) and float(temperature) == 0.0
+            and not presence_penalty and not frequency_penalty,
         )
         # check-and-put under the lock close() drains under — a submit
         # racing close() must either land before the sentinel or fail fast,
@@ -218,6 +227,8 @@ class GenBatcher:
             temperature=[r.temperature for r in batch],
             top_k=[r.top_k for r in batch],
             top_p=[r.top_p for r in batch],
+            presence_penalty=[r.presence_penalty for r in batch],
+            frequency_penalty=[r.frequency_penalty for r in batch],
             eos_ids=self.eos_ids,
             seed=self.seed + self._seq,
             stream_cb=demux if any_stream else None,
@@ -228,6 +239,8 @@ class GenBatcher:
             temperature=batch[0].temperature,
             top_k=batch[0].top_k,
             top_p=batch[0].top_p,
+            presence_penalty=batch[0].presence_penalty,
+            frequency_penalty=batch[0].frequency_penalty,
             eos_ids=self.eos_ids,
             seed=self.seed + self._seq,
             stream_cb=demux if any_stream else None,

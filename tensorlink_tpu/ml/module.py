@@ -470,6 +470,8 @@ class DistributedModel:
         budgets: Sequence[int] | None = None,
         reuse_prefix: bool = False,
         lookahead: bool = False,
+        presence_penalty: float | Sequence[float] = 0.0,
+        frequency_penalty: float | Sequence[float] = 0.0,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -489,6 +491,19 @@ class DistributedModel:
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
                 stream_cb=stream_cb, budgets=budgets,
                 reuse_prefix=reuse_prefix, lookahead=lookahead,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty,
+            )
+        def nonzero(v):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            return any(float(x or 0.0) != 0.0 for x in vals)
+
+        if nonzero(presence_penalty) or nonzero(frequency_penalty):
+            # the pipelined head-worker sampler is stateless per step (no
+            # context counts ride the session) — refuse rather than
+            # silently ignore a knob that changes output
+            raise ValueError(
+                "presence/frequency penalties need a single-stage job"
             )
         return self._generate_pipelined(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -499,7 +514,7 @@ class DistributedModel:
     def _generate_remote(
         self, prompts, *, max_new_tokens, temperature, top_k, top_p,
         eos_ids, seed, stream_cb, budgets=None, reuse_prefix=False,
-        lookahead=False,
+        lookahead=False, presence_penalty=0.0, frequency_penalty=0.0,
     ) -> list[list[int]]:
         """Whole model on one worker → its compiled engine does the loop."""
         stage = self.plan.stages[0]
@@ -509,6 +524,8 @@ class DistributedModel:
             "job_id": self.job_id,
             "prompts": [list(map(int, p)) for p in prompts],
             "max_new_tokens": max_new_tokens,
+            "presence_penalty": _wire(presence_penalty),
+            "frequency_penalty": _wire(frequency_penalty),
             "temperature": _wire(temperature),
             "top_k": _wire(top_k),
             "top_p": _wire(top_p),

@@ -448,6 +448,20 @@ class DistributedValidator:
                 read_offset = len(emitted_ids)
                 _emit(delta)
 
+        if (args["presence_penalty"] or args["frequency_penalty"]) and (
+            job.model is not None
+            and getattr(job.model, "plan", None) is not None
+            and job.model.plan.n_stages > 1
+        ):
+            # reject BEFORE enqueueing: a penalized request inside a
+            # co-batched pipelined dispatch would error every neighbor.
+            # ValidationError so the API maps it to a 400 with the message
+            # (a bare ValueError would surface as an opaque 500).
+            from tensorlink_tpu.api.schemas import ValidationError
+
+            raise ValidationError(
+                "presence/frequency penalties need a single-stage model"
+            )
         # speculative decode is greedy-only; the emitted tokens are identical
         # to vanilla greedy, so the flag is a pure speed hint
         spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0
@@ -460,6 +474,8 @@ class DistributedValidator:
                 temperature=args["temperature"],
                 top_k=args["top_k"],
                 top_p=args["top_p"],
+                presence_penalty=args["presence_penalty"],
+                frequency_penalty=args["frequency_penalty"],
                 stream_cb=stream_cb if on_delta is not None else None,
                 lookahead=spec,
             )
@@ -471,6 +487,8 @@ class DistributedValidator:
                     temperature=args["temperature"],
                     top_k=args["top_k"],
                     top_p=args["top_p"],
+                    presence_penalty=args["presence_penalty"],
+                    frequency_penalty=args["frequency_penalty"],
                     eos_ids=tok.eos_ids,
                     stream_cb=stream_cb if on_delta is not None else None,
                     lookahead=spec,

@@ -959,7 +959,8 @@ class DistributedWorker:
             raise ValueError("generate requires a whole-model stage")
         prompts = [list(map(int, row)) for row in p["prompts"]]
         knobs = (
-            p.get("temperature", 0.0), p.get("top_k", 0), p.get("top_p", 1.0)
+            p.get("temperature", 0.0), p.get("top_k", 0), p.get("top_p", 1.0),
+            p.get("presence_penalty", 0.0), p.get("frequency_penalty", 0.0),
         )
         if any(isinstance(v, (list, tuple)) for v in knobs):
             # batched request mix (ml/batching.py): per-row knobs. A scalar
@@ -971,9 +972,10 @@ class DistributedWorker:
 
             per_row = [
                 SamplingParams.make(
-                    temperature=float(t), top_k=int(k), top_p=float(tp)
+                    temperature=float(t), top_k=int(k), top_p=float(tp),
+                    presence_penalty=float(pp), frequency_penalty=float(fp),
                 )
-                for t, k, tp in zip(*(rows(v) for v in knobs))
+                for t, k, tp, pp, fp in zip(*(rows(v) for v in knobs))
             ]
             sampling = SamplingParams.stack(per_row, pad_to=n)
         else:
@@ -981,15 +983,22 @@ class DistributedWorker:
                 temperature=float(knobs[0]),
                 top_k=int(knobs[1]),
                 top_p=float(knobs[2]),
+                presence_penalty=float(knobs[3]),
+                frequency_penalty=float(knobs[4]),
             )
         budgets = p.get("budgets")
         reuse_prefix = bool(p.get("reuse_prefix", False)) and len(prompts) == 1
         # prompt-lookup speculation: greedy B=1 only (it IS vanilla greedy,
-        # in fewer model passes)
+        # in fewer model passes) — and penalties change greedy's choices,
+        # so a penalized request must take the vanilla loop
         greedy = not isinstance(p.get("temperature", 0.0), (list, tuple)) \
             and float(p.get("temperature", 0.0)) <= 0.0
         lookahead = (
             bool(p.get("lookahead", False)) and len(prompts) == 1 and greedy
+            and not any(
+                isinstance(v, (list, tuple)) or float(v or 0.0) != 0.0
+                for v in knobs[3:]
+            )
         )
         stream_id = p.get("stream")
         peer = p["peer"]

@@ -234,6 +234,29 @@ def test_stop_sequences_truncate_and_stream(api_cluster):
     assert status == 400
 
 
+def test_repetition_penalties_over_api(api_cluster):
+    """presence/frequency penalties ride /v1/generate into the compiled
+    sampler (the reference declares the fields but never applies them): a
+    maximal presence penalty forces greedy decode to emit pairwise-distinct
+    tokens, where the unpenalized greedy repeats eventually; invalid ranges
+    are rejected."""
+    api = api_cluster.api
+    base = {"hf_name": MODEL, "message": "aa", "max_new_tokens": 24,
+            "do_sample": False}
+    status, plain = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, plain
+    status, pen = _req(
+        api, "POST", "/v1/generate", {**base, "presence_penalty": 2.0},
+    )
+    assert status == 200, pen
+    assert pen["response"] != plain["response"]  # the knob bites
+
+    status, body = _req(
+        api, "POST", "/v1/generate", {**base, "frequency_penalty": 3.0},
+    )
+    assert status == 400  # out of [-2, 2]
+
+
 def test_generate_openai_format(api_cluster):
     api = api_cluster.api
     status, body = _req(

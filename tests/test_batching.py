@@ -134,7 +134,8 @@ class FakeModel:
         self.step_delay = step_delay
 
     def generate(self, prompts, *, max_new_tokens, temperature, top_k,
-                 top_p, eos_ids, seed, stream_cb=None, budgets=None):
+                 top_p, eos_ids, seed, stream_cb=None, budgets=None,
+                 presence_penalty=0.0, frequency_penalty=0.0):
         self.calls.append({
             "n": len(prompts), "temperature": temperature,
             "budgets": budgets, "max": max_new_tokens,

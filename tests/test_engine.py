@@ -402,3 +402,37 @@ def test_engine_warmup_compiles_serving_programs(tiny_model):
     a = warm.generate_compiled(prompts, max_new_tokens=8)
     b = cold.generate_compiled(prompts, max_new_tokens=8)
     assert a.sequences == b.sequences
+
+
+def test_repetition_penalties(tiny_model):
+    """presence/frequency penalties (the reference declares the fields,
+    api/models.py:73-74, but never applies them): host and compiled decode
+    agree, and a huge presence penalty makes greedy decode never repeat any
+    context token."""
+    cfg, params = tiny_model
+    kw = dict(seq_buckets=(16, 32), batch_buckets=(1, 2), max_seq_len=32)
+    eng = GenerationEngine(cfg, params, **kw)
+    prompts = [[1, 2, 3, 4], [7, 8]]
+    sp = SamplingParams.make(frequency_penalty=1.5, presence_penalty=0.5)
+    r_host = eng.generate(prompts, max_new_tokens=8, sampling=sp)
+    r_comp = eng.generate_compiled(prompts, max_new_tokens=8, sampling=sp)
+    assert r_host.sequences == r_comp.sequences
+
+    # penalties actually bite: greedy with an overwhelming presence penalty
+    # emits pairwise-distinct tokens that also avoid the prompt
+    huge = SamplingParams.make(presence_penalty=1e9)
+    r = eng.generate_compiled([[5]], max_new_tokens=10, sampling=huge)
+    seq = r.sequences[0]
+    assert len(seq) == 10
+    assert len(set(seq)) == len(seq) and 5 not in seq
+
+    # per-row mix: row 0 penalized, row 1 plain greedy must match the
+    # unpenalized engine's row
+    mix = SamplingParams.stack(
+        [SamplingParams.make(presence_penalty=1e9), SamplingParams.make()],
+        pad_to=2,
+    )
+    rm = eng.generate_compiled(prompts, max_new_tokens=6, sampling=mix)
+    base = eng.generate_compiled(prompts, max_new_tokens=6)
+    assert rm.sequences[1] == base.sequences[1]
+    assert len(set(rm.sequences[0])) == len(rm.sequences[0])
