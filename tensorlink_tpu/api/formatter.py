@@ -289,6 +289,32 @@ class ResponseFormatter:
             body["reasoning"] = reasoning
         return body
 
+    def complete_multi(self, results: list[dict]) -> dict:
+        """OpenAI ``n``-choice completion body: one choice per generated
+        result dict ({text, reasoning, finish_reason, prompt_tokens,
+        completion_tokens}). Usage counts the prompt once (every choice
+        shares it) and sums completions — OpenAI's convention."""
+        choices = []
+        for i, r in enumerate(results):
+            msg = {"role": "assistant", "content": r["text"]}
+            if r.get("reasoning"):
+                msg["reasoning_content"] = r["reasoning"]
+            choices.append(
+                {"index": i, "message": msg,
+                 "finish_reason": r.get("finish_reason", "stop")}
+            )
+        prompt = results[0]["prompt_tokens"] if results else 0
+        return {
+            "id": self.id,
+            "object": "chat.completion",
+            "created": self.created,
+            "model": self.model,
+            "choices": choices,
+            "usage": self._usage(
+                prompt, sum(r.get("completion_tokens", 0) for r in results)
+            ),
+        }
+
     def stream_chunk(self, delta_text: str) -> dict:
         """One SSE chunk (reference formatter.py:409-450)."""
         if self.fmt == "openai":

@@ -71,24 +71,31 @@ class GenerationRequest:
     @classmethod
     def parse(cls, d: dict) -> "GenerationRequest":
         _require(isinstance(d.get("hf_name"), str) and d["hf_name"], "hf_name required")
-        req = cls(
-            hf_name=d["hf_name"],
-            message=str(d.get("message", "")),
-            history=list(d.get("history", [])),
-            max_length=d.get("max_length"),
-            max_new_tokens=int(d.get("max_new_tokens", 256)),
-            temperature=float(d.get("temperature", 0.6)),
-            top_p=float(d.get("top_p", 0.95)),
-            top_k=int(d.get("top_k", 0)),
-            do_sample=bool(d.get("do_sample", True)),
-            presence_penalty=float(d.get("presence_penalty", 0.0)),
-            frequency_penalty=float(d.get("frequency_penalty", 0.0)),
-            stream=bool(d.get("stream", False)),
-            output_format=str(d.get("output_format", "simple")),
-            enable_thinking=bool(d.get("enable_thinking", False)),
-            lookahead=bool(d.get("lookahead", False)),
-            stop=cls._parse_stop(d.get("stop")),
-        )
+        try:
+            req = cls(
+                hf_name=d["hf_name"],
+                message=str(d.get("message", "")),
+                history=list(d.get("history", [])),
+                max_length=d.get("max_length"),
+                max_new_tokens=int(d.get("max_new_tokens", 256)),
+                temperature=float(d.get("temperature", 0.6)),
+                top_p=float(d.get("top_p", 0.95)),
+                top_k=int(d.get("top_k", 0)),
+                do_sample=bool(d.get("do_sample", True)),
+                presence_penalty=float(d.get("presence_penalty", 0.0)),
+                frequency_penalty=float(d.get("frequency_penalty", 0.0)),
+                stream=bool(d.get("stream", False)),
+                output_format=str(d.get("output_format", "simple")),
+                enable_thinking=bool(d.get("enable_thinking", False)),
+                lookahead=bool(d.get("lookahead", False)),
+                stop=cls._parse_stop(d.get("stop")),
+            )
+        except ValidationError:
+            raise
+        except (TypeError, ValueError) as e:
+            # null / non-numeric values in numeric fields must be a 400,
+            # not an int()/float() TypeError surfacing as a 500
+            raise ValidationError(f"invalid field value: {e}")
         _require(req.max_new_tokens > 0, "max_new_tokens must be positive")
         _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")
         _require(0.0 < req.top_p <= 1.0, "top_p must be in (0, 1]")
@@ -122,6 +129,9 @@ class ChatCompletionRequest:
     stop: list[str] = field(default_factory=list)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # number of choices (OpenAI ``n``; non-streaming only — the n requests
+    # dispatch concurrently and the batcher coalesces them into one decode)
+    n: int = 1
 
     @classmethod
     def parse(cls, d: dict) -> "ChatCompletionRequest":
@@ -133,19 +143,29 @@ class ChatCompletionRequest:
                 isinstance(m, dict) and "role" in m and "content" in m,
                 "each message needs role+content",
             )
-        req = cls(
-            model=d["model"],
-            messages=msgs,
-            max_tokens=int(d.get("max_tokens", d.get("max_completion_tokens", 256))),
-            temperature=float(d.get("temperature", 0.6)),
-            top_p=float(d.get("top_p", 0.95)),
-            stream=bool(d.get("stream", False)),
-            lookahead=bool(d.get("lookahead", False)),
-            stop=GenerationRequest._parse_stop(d.get("stop")),
-            presence_penalty=float(d.get("presence_penalty", 0.0)),
-            frequency_penalty=float(d.get("frequency_penalty", 0.0)),
-        )
+        try:
+            req = cls(
+                model=d["model"],
+                messages=msgs,
+                max_tokens=int(d.get("max_tokens", d.get("max_completion_tokens", 256))),
+                temperature=float(d.get("temperature", 0.6)),
+                top_p=float(d.get("top_p", 0.95)),
+                stream=bool(d.get("stream", False)),
+                lookahead=bool(d.get("lookahead", False)),
+                stop=GenerationRequest._parse_stop(d.get("stop")),
+                presence_penalty=float(d.get("presence_penalty", 0.0)),
+                frequency_penalty=float(d.get("frequency_penalty", 0.0)),
+                n=int(d.get("n", 1)),
+            )
+        except ValidationError:
+            raise
+        except (TypeError, ValueError) as e:
+            raise ValidationError(f"invalid field value: {e}")
         _require(req.max_tokens > 0, "max_tokens must be positive")
+        _require(1 <= req.n <= 8, "n must be in [1, 8]")
+        _require(
+            req.n == 1 or not req.stream, "n > 1 requires stream=false"
+        )
         for nm, v in (("presence_penalty", req.presence_penalty),
                       ("frequency_penalty", req.frequency_penalty)):
             _require(-2.0 <= v <= 2.0, f"{nm} must be in [-2, 2]")
@@ -193,11 +213,11 @@ class JobRequest:
         cfg = d.get("config")
         _require(cfg is None or isinstance(cfg, dict), "config must be an object")
         req = cls(
-            hf_name=d["hf_name"],
-            batch=int(d.get("batch", 1)),
-            seq_len=int(d.get("seq_len", 2048)),
-            training=bool(d.get("training", False)),
-            config=cfg,
+                hf_name=d["hf_name"],
+                batch=int(d.get("batch", 1)),
+                seq_len=int(d.get("seq_len", 2048)),
+                training=bool(d.get("training", False)),
+                config=cfg,
         )
         _require(req.batch >= 1, "batch must be >= 1")
         _require(req.seq_len >= 1, "seq_len must be >= 1")

@@ -269,7 +269,7 @@ class TensorlinkAPI:
             except ValidationError as e:
                 raise HTTPError(400, str(e))
             gen = chat.to_generation_request()
-            return await self._generate_common(gen, writer)
+            return await self._generate_common(gen, writer, n=chat.n)
         if path == "/request-model":
             return await self._request_model(data, writer)
         raise HTTPError(404, f"no route {path}")
@@ -330,10 +330,12 @@ class TensorlinkAPI:
             raise HTTPError(400, str(e))
         await self._generate_common(gen, writer)
 
-    async def _generate_common(self, gen: GenerationRequest, writer) -> None:
+    async def _generate_common(
+        self, gen: GenerationRequest, writer, n: int = 1
+    ) -> None:
         from tensorlink_tpu.ml.validator import ModelNotReady
 
-        if self._inflight >= MAX_CONCURRENT:
+        if self._inflight + n > MAX_CONCURRENT:
             raise HTTPError(429, "too many concurrent requests")
         job = self.executor.hosted.get(gen.hf_name)
         if job is None or job.status != "ready":
@@ -349,12 +351,15 @@ class TensorlinkAPI:
             )
 
         fmt = ResponseFormatter(gen.hf_name, gen.output_format)
-        self._inflight += 1
+        self._inflight += n
         try:
             if not gen.stream:
                 try:
-                    result = await asyncio.wait_for(
-                        self._ml(self.executor.generate_api, gen),
+                    results = await asyncio.wait_for(
+                        asyncio.gather(
+                            *(self._ml(self.executor.generate_api, gen)
+                              for _ in range(n))
+                        ),
                         REQUEST_TIMEOUT,
                     )
                 except ModelNotReady as e:
@@ -363,6 +368,13 @@ class TensorlinkAPI:
                     # request-vs-model mismatch detected past parse time
                     # (e.g. penalties on a multi-stage model): client error
                     raise HTTPError(400, str(e))
+                if n > 1:
+                    # the n concurrent dispatches coalesced in the batcher;
+                    # shape one chat.completion with n choices
+                    return await self._send_json(
+                        writer, 200, fmt.complete_multi(list(results))
+                    )
+                result = results[0]
                 return await self._send_json(
                     writer, 200,
                     fmt.complete(
@@ -375,7 +387,7 @@ class TensorlinkAPI:
                 )
             await self._stream_generate(gen, fmt, writer)
         finally:
-            self._inflight -= 1
+            self._inflight -= n
 
     async def _stream_generate(self, gen, fmt, writer) -> None:
         """SSE: ML thread pushes deltas through call_soon_threadsafe."""

@@ -321,6 +321,34 @@ def test_validation_errors(api_cluster):
     assert status == 404
 
 
+def test_chat_completions_n_choices(api_cluster):
+    """OpenAI ``n``: one request returns n choices (dispatched concurrently
+    so the batcher coalesces them into one decode); sampled choices differ,
+    validation rejects n with streaming and out-of-range n."""
+    api = api_cluster.api
+    body = {
+        "model": MODEL,
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 12, "temperature": 0.9, "n": 3,
+    }
+    status, resp = _req(api, "POST", "/v1/chat/completions", body)
+    assert status == 200, resp
+    choices = resp["choices"]
+    assert [c["index"] for c in choices] == [0, 1, 2]
+    texts = [c["message"]["content"] for c in choices]
+    assert len(set(texts)) >= 2  # sampling: near-certainly distinct
+    assert resp["usage"]["completion_tokens"] >= 3
+
+    status, resp = _req(
+        api, "POST", "/v1/chat/completions", {**body, "stream": True}
+    )
+    assert status == 400
+    status, resp = _req(
+        api, "POST", "/v1/chat/completions", {**body, "n": 9}
+    )
+    assert status == 400
+
+
 def test_stats_and_node_info(api_cluster):
     api = api_cluster.api
     status, body = _req(api, "GET", "/stats")
