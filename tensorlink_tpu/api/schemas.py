@@ -47,6 +47,11 @@ class GenerationRequest:
     # engine/generate.py::generate_lookahead). Emits exactly the vanilla
     # greedy tokens, so honoring it is always safe; ignored when sampling.
     lookahead: bool = False
+    # beam search width (the reference forwards num_beams to HF generate,
+    # ml/formatter.py:88-92; here engine/generate.py::generate_beam).
+    # >1: deterministic beam decode — sampling knobs are ignored, streaming
+    # is rejected, single-stage models only.
+    num_beams: int = 1
     # OpenAI-style stop sequences (the reference declares this field,
     # api/models.py:70, but never applies it — here output is truncated at
     # the earliest occurrence, streaming included via api/formatter.py
@@ -88,6 +93,7 @@ class GenerationRequest:
                 output_format=str(d.get("output_format", "simple")),
                 enable_thinking=bool(d.get("enable_thinking", False)),
                 lookahead=bool(d.get("lookahead", False)),
+                num_beams=int(d.get("num_beams", 1)),
                 stop=cls._parse_stop(d.get("stop")),
             )
         except ValidationError:
@@ -100,6 +106,20 @@ class GenerationRequest:
         _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")
         _require(0.0 < req.top_p <= 1.0, "top_p must be in (0, 1]")
         _require(req.top_k >= 0, "top_k must be >= 0")
+        _require(1 <= req.num_beams <= 8, "num_beams must be in [1, 8]")
+        _require(
+            req.num_beams == 1 or not req.stream,
+            "num_beams > 1 requires stream=false",
+        )
+        _require(
+            req.num_beams == 1 or not req.do_sample,
+            "num_beams > 1 is deterministic: set do_sample=false",
+        )
+        _require(
+            req.num_beams == 1
+            or (req.presence_penalty == 0 and req.frequency_penalty == 0),
+            "num_beams > 1 does not support repetition penalties",
+        )
         for nm, v in (("presence_penalty", req.presence_penalty),
                       ("frequency_penalty", req.frequency_penalty)):
             _require(-2.0 <= v <= 2.0, f"{nm} must be in [-2, 2]")
@@ -212,13 +232,18 @@ class JobRequest:
         _require(isinstance(d.get("hf_name"), str) and d["hf_name"], "hf_name required")
         cfg = d.get("config")
         _require(cfg is None or isinstance(cfg, dict), "config must be an object")
-        req = cls(
+        try:
+            req = cls(
                 hf_name=d["hf_name"],
                 batch=int(d.get("batch", 1)),
                 seq_len=int(d.get("seq_len", 2048)),
                 training=bool(d.get("training", False)),
                 config=cfg,
-        )
+            )
+        except ValidationError:
+            raise
+        except (TypeError, ValueError) as e:
+            raise ValidationError(f"invalid field value: {e}")
         _require(req.batch >= 1, "batch must be >= 1")
         _require(req.seq_len >= 1, "seq_len must be >= 1")
         return req

@@ -354,20 +354,27 @@ class TensorlinkAPI:
         self._inflight += n
         try:
             if not gen.stream:
-                try:
-                    results = await asyncio.wait_for(
-                        asyncio.gather(
-                            *(self._ml(self.executor.generate_api, gen)
-                              for _ in range(n))
-                        ),
-                        REQUEST_TIMEOUT,
-                    )
-                except ModelNotReady as e:
-                    raise HTTPError(503, str(e))
-                except ValidationError as e:
-                    # request-vs-model mismatch detected past parse time
-                    # (e.g. penalties on a multi-stage model): client error
-                    raise HTTPError(400, str(e))
+                # return_exceptions: every sibling dispatch completes before
+                # an error propagates — otherwise one failed choice would
+                # orphan n-1 running generations while _inflight is already
+                # decremented for all n (silent 429-gate erosion)
+                results = await asyncio.wait_for(
+                    asyncio.gather(
+                        *(self._ml(self.executor.generate_api, gen)
+                          for _ in range(n)),
+                        return_exceptions=True,
+                    ),
+                    REQUEST_TIMEOUT,
+                )
+                for r in results:
+                    if isinstance(r, ModelNotReady):
+                        raise HTTPError(503, str(r))
+                    if isinstance(r, ValidationError):
+                        # request-vs-model mismatch detected past parse time
+                        # (e.g. penalties on a multi-stage model)
+                        raise HTTPError(400, str(r))
+                    if isinstance(r, BaseException):
+                        raise r
                 if n > 1:
                     # the n concurrent dispatches coalesced in the batcher;
                     # shape one chat.completion with n choices

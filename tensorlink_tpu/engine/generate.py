@@ -590,6 +590,134 @@ class GenerationEngine:
             sequences=seqs, prompt_lens=lens, finished=list(done[:n_rows])
         )
 
+    # -- beam search ------------------------------------------------------
+    def generate_beam(
+        self,
+        prompts: Iterable[Sequence[int]],
+        *,
+        num_beams: int = 4,
+        max_new_tokens: int = 128,
+        eos_ids: Sequence[int] = (),
+        length_penalty: float = 1.0,
+    ) -> GenerationResult:
+        """Beam-search decode (B=1): beams ride the engine's BATCH axis, so
+        each step is one batched decode (same parameter stream as B=1) plus
+        a per-step cache reorder — a [L, K, S, H, hd] gather that is noise
+        next to the parameter read. The reference exposes ``num_beams``
+        through HF ``generate`` (ml/formatter.py:88-92); here it is a
+        first-class engine path. Returns the best finished beam by
+        length-normalized log-probability (GNMT ``len**length_penalty``)."""
+        prompts = [list(p) for p in prompts]
+        if len(prompts) != 1:
+            raise ValueError("beam search is B=1")
+        K = int(num_beams)
+        if K < 1:
+            raise ValueError("num_beams must be >= 1")
+        if K > max(self.batch_buckets):
+            raise ValueError(
+                f"num_beams {K} exceeds the largest batch bucket "
+                f"{max(self.batch_buckets)}"
+            )
+        prompt = prompts[0]
+        eos_set = set(int(e) for e in eos_ids)
+        room = min(max_new_tokens, self.max_seq_len - len(prompt))
+        if room <= 0:
+            return GenerationResult(
+                sequences=[[]], prompt_lens=[len(prompt)], finished=[True]
+            )
+        # prefill ONCE at B=1 and tile the cache rows to K — the same
+        # [:, idx] gather the per-step reorder uses, instead of paying the
+        # prompt forward K times for byte-identical caches
+        logits1, cache1, lens, _ = self.prefill([prompt])
+        B = _bucket(K, self.batch_buckets)
+        tile = jnp.zeros((B,), jnp.int32)  # every row copies row 0
+        cache = KVCache(
+            k=cache1.k[:, tile], v=cache1.v[:, tile],
+            length=cache1.length[tile],
+            k_scale=None if cache1.k_scale is None else cache1.k_scale[:, tile],
+            v_scale=None if cache1.v_scale is None else cache1.v_scale[:, tile],
+        )
+        del cache1
+        logp = jax.nn.log_softmax(logits1.astype(jnp.float32), axis=-1)
+        row0 = np.asarray(logp[0])
+        first = np.argsort(-row0)[:K]
+        scores = row0[first]  # [K] cumulative log-probs
+        beams: list[list[int]] = [[int(t)] for t in first]
+        alive = [t not in eos_set for (t,) in (b[-1:] for b in beams)]
+        done_pool: list[tuple[float, list[int]]] = []
+        for k, b in enumerate(beams):
+            if not alive[k]:
+                done_pool.append((scores[k] / (1 ** length_penalty), b))
+        tok = jnp.asarray(
+            np.resize(np.asarray(first, np.int32), (B,)), jnp.int32
+        )
+
+        for step in range(1, room):
+            if not any(alive):
+                break
+            logits, cache = _decode_step(self.params, tok, cache, self.cfg)
+            logp = np.asarray(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            )[:K]
+            # candidates: every alive beam × vocab; dead rows excluded
+            cand: list[tuple[float, int, int]] = []  # (score, beam, token)
+            for k in range(K):
+                if not alive[k]:
+                    continue
+                top = np.argsort(-logp[k])[: K + len(eos_set)]
+                for t in top:
+                    cand.append((scores[k] + float(logp[k][t]), k, int(t)))
+            cand.sort(key=lambda c: -c[0])
+            new_beams, new_scores, new_alive, src = [], [], [], []
+            for sc, k, t in cand:
+                if len(new_beams) >= K:
+                    break
+                seq = beams[k] + [t]
+                if t in eos_set or len(seq) >= room:
+                    done_pool.append(
+                        (sc / (len(seq) ** length_penalty), seq)
+                    )
+                    if t in eos_set:
+                        continue  # finished beams leave the frontier
+                new_beams.append(seq)
+                new_scores.append(sc)
+                new_alive.append(t not in eos_set and len(seq) < room)
+                src.append(k)
+            if not new_beams:
+                break
+            # pad the frontier back to K rows (duplicates of row 0 — they
+            # are masked out by alive=False)
+            while len(new_beams) < K:
+                new_beams.append(new_beams[0])
+                new_scores.append(-np.inf)
+                new_alive.append(False)
+                src.append(src[0])
+            beams, scores, alive = new_beams, np.asarray(new_scores), new_alive
+            # reorder every beam's cache row to follow its source beam
+            idx = np.resize(np.asarray(src, np.int32), (B,))
+            gidx = jnp.asarray(idx)
+            cache = KVCache(
+                k=cache.k[:, gidx], v=cache.v[:, gidx],
+                length=cache.length[gidx],
+                k_scale=None if cache.k_scale is None else cache.k_scale[:, gidx],
+                v_scale=None if cache.v_scale is None else cache.v_scale[:, gidx],
+            )
+            tok = jnp.asarray(
+                np.resize(np.asarray([b[-1] for b in beams], np.int32), (B,)),
+                jnp.int32,
+            )
+        del cache
+        for k in range(K):
+            if alive[k]:
+                done_pool.append(
+                    (scores[k] / (len(beams[k]) ** length_penalty), beams[k])
+                )
+        best_score, best = max(done_pool, key=lambda d: d[0])
+        fin = bool(best and best[-1] in eos_set)
+        return GenerationResult(
+            sequences=[best], prompt_lens=[len(prompt)], finished=[fin]
+        )
+
     # -- speculative decode (prompt-lookup) -------------------------------
     @staticmethod
     def _lookup_draft(history: list[int], n_draft: int, ngram: int = 3) -> list[int]:

@@ -472,6 +472,7 @@ class DistributedModel:
         lookahead: bool = False,
         presence_penalty: float | Sequence[float] = 0.0,
         frequency_penalty: float | Sequence[float] = 0.0,
+        num_beams: int = 1,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -493,7 +494,10 @@ class DistributedModel:
                 reuse_prefix=reuse_prefix, lookahead=lookahead,
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
+                num_beams=num_beams,
             )
+        if int(num_beams) > 1:
+            raise ValueError("beam search needs a single-stage job")
         def nonzero(v):
             vals = v if isinstance(v, (list, tuple)) else [v]
             return any(float(x or 0.0) != 0.0 for x in vals)
@@ -515,6 +519,7 @@ class DistributedModel:
         self, prompts, *, max_new_tokens, temperature, top_k, top_p,
         eos_ids, seed, stream_cb, budgets=None, reuse_prefix=False,
         lookahead=False, presence_penalty=0.0, frequency_penalty=0.0,
+        num_beams=1,
     ) -> list[list[int]]:
         """Whole model on one worker → its compiled engine does the loop."""
         stage = self.plan.stages[0]
@@ -524,6 +529,7 @@ class DistributedModel:
             "job_id": self.job_id,
             "prompts": [list(map(int, p)) for p in prompts],
             "max_new_tokens": max_new_tokens,
+            "num_beams": int(num_beams),
             "presence_penalty": _wire(presence_penalty),
             "frequency_penalty": _wire(frequency_penalty),
             "temperature": _wire(temperature),

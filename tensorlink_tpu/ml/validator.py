@@ -448,11 +448,17 @@ class DistributedValidator:
                 read_offset = len(emitted_ids)
                 _emit(delta)
 
-        if (args["presence_penalty"] or args["frequency_penalty"]) and (
+        n_beams = int(getattr(req, "num_beams", 1) or 1)
+        multi_stage = (
             job.model is not None
             and getattr(job.model, "plan", None) is not None
             and job.model.plan.n_stages > 1
-        ):
+        )
+        if n_beams > 1 and multi_stage:
+            from tensorlink_tpu.api.schemas import ValidationError
+
+            raise ValidationError("beam search needs a single-stage model")
+        if (args["presence_penalty"] or args["frequency_penalty"]) and multi_stage:
             # reject BEFORE enqueueing: a penalized request inside a
             # co-batched pipelined dispatch would error every neighbor.
             # ValidationError so the API maps it to a 400 with the message
@@ -465,7 +471,20 @@ class DistributedValidator:
         # speculative decode is greedy-only; the emitted tokens are identical
         # to vanilla greedy, so the flag is a pure speed hint
         spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0
-        if job.batcher is not None:
+        if n_beams > 1:
+            # deterministic beam decode: bypass the batcher (beams cannot
+            # co-batch with other requests — they ARE the batch rows) and
+            # serialize on the model lock like the non-batcher path; the
+            # shared post-processing tail below handles eos/stop/finish
+            with job.lock:
+                seqs = job.model.generate(
+                    [ids],
+                    max_new_tokens=args["max_new_tokens"],
+                    eos_ids=tok.eos_ids,
+                    num_beams=n_beams,
+                )
+            out_ids = seqs[0]
+        elif job.batcher is not None:
             # concurrent requests coalesce into one batched decode
             # (ml/batching.py); the batcher demuxes this request's tokens
             out_ids = job.batcher.generate(

@@ -1015,6 +1015,31 @@ class DistributedWorker:
                     {"peer": peer, "stream": stream_id, "tokens": pairs},
                 )
 
+        if int(p.get("num_beams", 1)) > 1:
+            # beams ride the engine's batch axis — clamp to the largest
+            # compiled bucket (a deployment-config mismatch must degrade,
+            # not surface as an opaque 500)
+            k = min(int(p["num_beams"]), max(rt.engine.batch_buckets))
+            result = rt.engine.generate_beam(
+                prompts,
+                num_beams=k,
+                max_new_tokens=int(p.get("max_new_tokens", 128)),
+                eos_ids=p.get("eos_ids", ()),
+            )
+            if stream_id:
+                # beams emit nothing until the search completes; close the
+                # relay so a streaming caller never stalls on the drain
+                self.bridge.request(
+                    "send_token",
+                    {"peer": peer, "stream": stream_id, "tokens": [],
+                     "done": True},
+                )
+            self._respond(
+                peer, proto.GENERATE_RESP, p["rid"],
+                {"sequences": [list(map(int, s)) for s in result.sequences],
+                 "finished": list(map(bool, result.finished))},
+            )
+            return
         if lookahead:
             result = rt.engine.generate_lookahead(
                 prompts,

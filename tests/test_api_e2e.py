@@ -321,6 +321,30 @@ def test_validation_errors(api_cluster):
     assert status == 404
 
 
+def test_beam_search_over_api(api_cluster):
+    """num_beams rides /v1/generate into the engine's beam decode (the
+    reference forwards it to HF generate): num_beams=1 equals plain greedy,
+    num_beams=4 answers successfully, and invalid combos are 400s."""
+    api = api_cluster.api
+    base = {"hf_name": MODEL, "message": "beam", "max_new_tokens": 10,
+            "do_sample": False}
+    status, plain = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, plain
+    status, b1 = _req(api, "POST", "/v1/generate", {**base, "num_beams": 1})
+    assert status == 200 and b1["response"] == plain["response"]
+    status, b4 = _req(api, "POST", "/v1/generate", {**base, "num_beams": 4})
+    assert status == 200, b4
+    assert b4["usage"]["completion_tokens"] > 0
+
+    status, _ = _req(api, "POST", "/v1/generate", {**base, "num_beams": 9})
+    assert status == 400
+    status, _ = _req(
+        api, "POST", "/v1/generate",
+        {**base, "num_beams": 2, "stream": True},
+    )
+    assert status == 400
+
+
 def test_chat_completions_n_choices(api_cluster):
     """OpenAI ``n``: one request returns n choices (dispatched concurrently
     so the batcher coalesces them into one decode); sampled choices differ,

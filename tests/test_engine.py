@@ -436,3 +436,39 @@ def test_repetition_penalties(tiny_model):
     base = eng.generate_compiled(prompts, max_new_tokens=6)
     assert rm.sequences[1] == base.sequences[1]
     assert len(set(rm.sequences[0])) == len(rm.sequences[0])
+
+
+def test_beam_search(tiny_model):
+    """Beam search (the reference exposes num_beams through HF generate):
+    K=1 reproduces greedy exactly, and K=4's best beam scores at least as
+    well as greedy under the same length-normalized log-probability."""
+    from tensorlink_tpu.models import forward
+
+    cfg, params = tiny_model
+    kw = dict(seq_buckets=(16, 32), batch_buckets=(1, 2, 4), max_seq_len=32)
+    eng = GenerationEngine(cfg, params, **kw)
+    prompt = [3, 7, 11]
+
+    greedy = eng.generate_compiled([prompt], max_new_tokens=8)
+    b1 = eng.generate_beam([prompt], num_beams=1, max_new_tokens=8)
+    assert b1.sequences[0] == greedy.sequences[0]
+
+    b4 = eng.generate_beam([prompt], num_beams=4, max_new_tokens=8)
+
+    def norm_logprob(seq):
+        toks = jnp.asarray([prompt + seq], jnp.int32)
+        logits, _ = forward(params, toks, cfg)
+        lp = np.asarray(jax.nn.log_softmax(
+            jnp.asarray(logits, jnp.float32), axis=-1
+        ))[0]
+        total = sum(
+            float(lp[len(prompt) - 1 + i, t]) for i, t in enumerate(seq)
+        )
+        return total / len(seq)
+
+    assert norm_logprob(b4.sequences[0]) >= norm_logprob(greedy.sequences[0]) - 1e-5
+
+    with pytest.raises(ValueError):
+        eng.generate_beam([prompt], num_beams=8, max_new_tokens=4)  # > bucket
+    with pytest.raises(ValueError):
+        eng.generate_beam([prompt, prompt], num_beams=2)  # B=1 only
