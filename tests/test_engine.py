@@ -472,3 +472,36 @@ def test_beam_search(tiny_model):
         eng.generate_beam([prompt], num_beams=8, max_new_tokens=4)  # > bucket
     with pytest.raises(ValueError):
         eng.generate_beam([prompt, prompt], num_beams=2)  # B=1 only
+
+
+def test_beam_search_sharded_mesh_parity(cpu_devices):
+    """Beam search composes with a tensor mesh: the per-step cache-row
+    gathers and the tile-from-B=1 prefill reshard under GSPMD, and the
+    sharded engine emits the single-device beams token for token."""
+    from jax.sharding import NamedSharding
+    from tensorlink_tpu.models.transformer import cache_specs, partition_specs
+    from tensorlink_tpu.parallel.mesh import build_mesh
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    kw = dict(seq_buckets=(16, 64), batch_buckets=(1, 2, 4), max_seq_len=64)
+    prompt = [5, 9, 2, 7]
+    ref = GenerationEngine(cfg, params, **kw).generate_beam(
+        [prompt], num_beams=4, max_new_tokens=8
+    )
+    mesh = build_mesh({"tensor": 2}, cpu_devices[:2])
+    specs = partition_specs(cfg, tensor_axis="tensor")
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+    eng = GenerationEngine(
+        cfg, sharded, mesh=mesh,
+        cache_specs=cache_specs(cfg, data_axis=None, tensor_axis="tensor"),
+        **kw,
+    )
+    got = eng.generate_beam([prompt], num_beams=4, max_new_tokens=8)
+    assert got.sequences == ref.sequences
