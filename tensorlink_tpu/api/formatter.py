@@ -262,13 +262,16 @@ class ResponseFormatter:
         completion_tokens: int = 0,
         reasoning: str = "",
         finish_reason: str = "stop",
+        extra: dict | None = None,
     ) -> dict:
-        """Non-stream final body (reference formatter.py:331-407)."""
+        """Non-stream final body (reference formatter.py:331-407).
+        ``extra`` merges server-side annotations (e.g. ``num_beams_used``
+        when the worker clamped a beam request) into the body top level."""
         if self.fmt == "openai":
             msg = {"role": "assistant", "content": text}
             if reasoning:
                 msg["reasoning_content"] = reasoning
-            return {
+            body = {
                 "id": self.id,
                 "object": "chat.completion",
                 "created": self.created,
@@ -278,15 +281,18 @@ class ResponseFormatter:
                 ],
                 "usage": self._usage(prompt_tokens, completion_tokens),
             }
-        if self.fmt == "raw":
-            return {"output": text, "reasoning": reasoning}
-        body = {
-            "response": text,
-            "model": self.model,
-            "usage": self._usage(prompt_tokens, completion_tokens),
-        }
-        if reasoning:
-            body["reasoning"] = reasoning
+        elif self.fmt == "raw":
+            body = {"output": text, "reasoning": reasoning}
+        else:
+            body = {
+                "response": text,
+                "model": self.model,
+                "usage": self._usage(prompt_tokens, completion_tokens),
+            }
+            if reasoning:
+                body["reasoning"] = reasoning
+        if extra:
+            body.update(extra)
         return body
 
     def complete_multi(self, results: list[dict]) -> dict:

@@ -390,6 +390,10 @@ class TensorlinkAPI:
                         completion_tokens=result["completion_tokens"],
                         reasoning=result["reasoning"],
                         finish_reason=result["finish_reason"],
+                        extra={
+                            k: result[k] for k in ("num_beams_used",)
+                            if k in result
+                        } or None,
                     ),
                 )
             await self._stream_generate(gen, fmt, writer)

@@ -408,7 +408,13 @@ class GenerationEngine:
         ``max_new_tokens``'s n_steps bucket. Hosting calls this when
         ``MLConfig.warmup_tokens`` is set. A request whose budget maps to a
         different pow2 n_steps bucket (or a longer prompt bucket) still
-        compiles on first use. Returns elapsed seconds."""
+        compiles on first use. Returns elapsed seconds.
+
+        Sampling leaves are warmed in the SERVING shape: the worker always
+        ships stacked ``[B, 1]`` knobs (ml/worker.py::_generate), and leaf
+        shapes are part of the jit cache key — warming with scalar leaves
+        would compile a program no API request ever hits and leave the
+        first real request paying the full decode-loop compile anyway."""
         import time as _t
 
         t0 = _t.perf_counter()
@@ -416,6 +422,9 @@ class GenerationEngine:
         for b in self.batch_buckets:
             self.generate_compiled(
                 [[1] * span] * b, max_new_tokens=max_new_tokens,
+                sampling=SamplingParams.stack(
+                    [SamplingParams.make()] * b, pad_to=b
+                ),
             )
         return _t.perf_counter() - t0
 

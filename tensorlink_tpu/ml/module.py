@@ -473,6 +473,7 @@ class DistributedModel:
         presence_penalty: float | Sequence[float] = 0.0,
         frequency_penalty: float | Sequence[float] = 0.0,
         num_beams: int = 1,
+        info_out: dict | None = None,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -494,7 +495,7 @@ class DistributedModel:
                 reuse_prefix=reuse_prefix, lookahead=lookahead,
                 presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty,
-                num_beams=num_beams,
+                num_beams=num_beams, info_out=info_out,
             )
         if int(num_beams) > 1:
             raise ValueError("beam search needs a single-stage job")
@@ -519,7 +520,7 @@ class DistributedModel:
         self, prompts, *, max_new_tokens, temperature, top_k, top_p,
         eos_ids, seed, stream_cb, budgets=None, reuse_prefix=False,
         lookahead=False, presence_penalty=0.0, frequency_penalty=0.0,
-        num_beams=1,
+        num_beams=1, info_out=None,
     ) -> list[list[int]]:
         """Whole model on one worker → its compiled engine does the loop."""
         stage = self.plan.stages[0]
@@ -551,6 +552,14 @@ class DistributedModel:
 
         if stream_id is None:
             resp = self._request(stage.worker_id, proto.GENERATE, body)
+            # response metadata (e.g. the worker's num_beams clamp) fills
+            # the CALLER's dict — an attribute on self would race the
+            # batcher thread, which drives concurrent generates on this
+            # same model without job.lock
+            if info_out is not None:
+                info_out.update(
+                    {k: resp[k] for k in ("num_beams_used",) if k in resp}
+                )
             return [list(map(int, s)) for s in resp["sequences"]]
 
         # streaming: issue the request in a thread so we can drain tokens

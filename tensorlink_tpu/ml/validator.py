@@ -471,18 +471,25 @@ class DistributedValidator:
         # speculative decode is greedy-only; the emitted tokens are identical
         # to vanilla greedy, so the flag is a pure speed hint
         spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0
+        beams_used = None
         if n_beams > 1:
             # deterministic beam decode: bypass the batcher (beams cannot
             # co-batch with other requests — they ARE the batch rows) and
             # serialize on the model lock like the non-batcher path; the
             # shared post-processing tail below handles eos/stop/finish
+            # the worker may clamp the width to its largest compiled batch
+            # bucket — info_out is per-call, so a concurrent batcher
+            # dispatch on this model cannot clobber it
+            info: dict = {}
             with job.lock:
                 seqs = job.model.generate(
                     [ids],
                     max_new_tokens=args["max_new_tokens"],
                     eos_ids=tok.eos_ids,
                     num_beams=n_beams,
+                    info_out=info,
                 )
+            beams_used = info.get("num_beams_used")
             out_ids = seqs[0]
         elif job.batcher is not None:
             # concurrent requests coalesce into one batched decode
@@ -536,13 +543,16 @@ class DistributedValidator:
         if hits:
             answer = answer[: min(hits)]
             finish = "stop"
-        return {
+        out = {
             "text": answer,
             "reasoning": reasoning,
             "prompt_tokens": len(ids),
             "completion_tokens": len(out_ids),
             "finish_reason": finish,
         }
+        if beams_used is not None and beams_used != n_beams:
+            out["num_beams_used"] = int(beams_used)  # worker clamped
+        return out
 
 
 class ModelNotReady(RuntimeError):

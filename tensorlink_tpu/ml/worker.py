@@ -962,30 +962,24 @@ class DistributedWorker:
             p.get("temperature", 0.0), p.get("top_k", 0), p.get("top_p", 1.0),
             p.get("presence_penalty", 0.0), p.get("frequency_penalty", 0.0),
         )
-        if any(isinstance(v, (list, tuple)) for v in knobs):
-            # batched request mix (ml/batching.py): per-row knobs. A scalar
-            # among sequences applies to every row.
-            n = len(prompts)
+        # per-row knobs (ml/batching.py mixes requests); a scalar among
+        # sequences applies to every row. Scalars are ALSO stacked to
+        # [B, 1] leaves so every serving request — solo or co-batched —
+        # shares the one warmed program (leaf shapes key the jit cache;
+        # engine.warmup() pre-compiles exactly this shape)
+        n = len(prompts)
 
-            def rows(v):
-                return list(v) if isinstance(v, (list, tuple)) else [v] * n
+        def rows(v):
+            return list(v) if isinstance(v, (list, tuple)) else [v] * n
 
-            per_row = [
-                SamplingParams.make(
-                    temperature=float(t), top_k=int(k), top_p=float(tp),
-                    presence_penalty=float(pp), frequency_penalty=float(fp),
-                )
-                for t, k, tp, pp, fp in zip(*(rows(v) for v in knobs))
-            ]
-            sampling = SamplingParams.stack(per_row, pad_to=n)
-        else:
-            sampling = SamplingParams.make(
-                temperature=float(knobs[0]),
-                top_k=int(knobs[1]),
-                top_p=float(knobs[2]),
-                presence_penalty=float(knobs[3]),
-                frequency_penalty=float(knobs[4]),
+        per_row = [
+            SamplingParams.make(
+                temperature=float(t), top_k=int(k), top_p=float(tp),
+                presence_penalty=float(pp), frequency_penalty=float(fp),
             )
+            for t, k, tp, pp, fp in zip(*(rows(v) for v in knobs))
+        ]
+        sampling = SamplingParams.stack(per_row, pad_to=n)
         budgets = p.get("budgets")
         reuse_prefix = bool(p.get("reuse_prefix", False)) and len(prompts) == 1
         # prompt-lookup speculation: greedy B=1 only (it IS vanilla greedy,
@@ -1018,8 +1012,16 @@ class DistributedWorker:
         if int(p.get("num_beams", 1)) > 1:
             # beams ride the engine's batch axis — clamp to the largest
             # compiled bucket (a deployment-config mismatch must degrade,
-            # not surface as an opaque 500)
+            # not surface as an opaque 500) — but never SILENTLY: the API
+            # schema promised [1, 8], so the clamp is logged and the
+            # effective width rides the response for clients to inspect
             k = min(int(p["num_beams"]), max(rt.engine.batch_buckets))
+            if k < int(p["num_beams"]):
+                self.log.warning(
+                    "num_beams=%d clamped to %d (largest compiled batch "
+                    "bucket; configure batch_buckets to serve wider beams)",
+                    int(p["num_beams"]), k,
+                )
             result = rt.engine.generate_beam(
                 prompts,
                 num_beams=k,
@@ -1037,7 +1039,8 @@ class DistributedWorker:
             self._respond(
                 peer, proto.GENERATE_RESP, p["rid"],
                 {"sequences": [list(map(int, s)) for s in result.sequences],
-                 "finished": list(map(bool, result.finished))},
+                 "finished": list(map(bool, result.finished)),
+                 "num_beams_used": k},
             )
             return
         if lookahead:

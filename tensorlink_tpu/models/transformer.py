@@ -548,15 +548,22 @@ def _stage_impl(
             # is independent per (batch, head), so no collectives
             try:
                 from jax import shard_map
-
-                # the pallas_call's out_shape carries no varying-axis
-                # metadata; the output sharding is fully described by
-                # out_specs
-                _sm_kw = {"check_vma": False}
             except ImportError:  # pre-0.8 jax
                 from jax.experimental.shard_map import shard_map
+            import inspect
 
+            # the pallas_call's out_shape carries no varying-axis metadata,
+            # so replication checking must be off — but the kwarg's NAME
+            # keys on the actual signature, not the import location: some
+            # jax versions export jax.shard_map while still taking
+            # check_rep
+            _sm_params = inspect.signature(shard_map).parameters
+            if "check_vma" in _sm_params:
+                _sm_kw = {"check_vma": False}
+            elif "check_rep" in _sm_params:
                 _sm_kw = {"check_rep": False}
+            else:
+                _sm_kw = {}
             from jax.sharding import PartitionSpec as _P
 
             sizes = dict(flash_mesh.shape)

@@ -174,6 +174,16 @@ def test_simple_complete_shape():
     assert body["response"] == "hi" and body["usage"]["total_tokens"] == 2
 
 
+def test_complete_extra_annotations():
+    # server-side annotations (e.g. the worker's num_beams clamp) merge into
+    # the body top level in every format
+    for fmt in ("openai", "simple", "raw"):
+        body = ResponseFormatter("m", fmt).complete(
+            "hi", extra={"num_beams_used": 4}
+        )
+        assert body["num_beams_used"] == 4
+
+
 def test_stream_chunk_shapes():
     oa = ResponseFormatter("m", "openai").stream_chunk("t")
     assert oa["object"] == "chat.completion.chunk"
