@@ -178,6 +178,50 @@ def _decode_loop(
     return tokens, cache, done, n_exec
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _beam_topk(logits, k: int):
+    """Per-row top-k of the log-softmax — the beam search's candidate
+    selection, on device. Ships [rows, k] (score, id) pairs to the host
+    instead of [rows, V] logits; ties resolve to the lowest index, matching
+    a stable argsort over the negated row."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jax.lax.top_k(logp, k)
+
+
+@dataclass
+class BeamState:
+    """Resumable beam-search session (engine.beam_start/advance/finish).
+
+    Host-side frontier bookkeeping (beams/scores/alive/done_pool) plus the
+    device-resident tiled KV cache. The serving worker keeps one of these
+    per in-flight beam request and advances it a bounded chunk of steps at
+    a time, so a long beam decode cannot head-of-line-block co-batched
+    traffic on the worker's serial loop."""
+
+    engine: "GenerationEngine"
+    K: int
+    B: int
+    room: int
+    prompt_len: int
+    eos_set: set
+    length_penalty: float
+    beams: list = None  # type: ignore[assignment]
+    scores: "np.ndarray" = None  # type: ignore[assignment]
+    alive: list = None  # type: ignore[assignment]
+    done_pool: list = None  # type: ignore[assignment]
+    cache: KVCache | None = None
+    tok: jax.Array | None = None
+    step: int = 0
+
+    def __post_init__(self):
+        if self.beams is None:
+            self.beams = []
+        if self.alive is None:
+            self.alive = []
+        if self.done_pool is None:
+            self.done_pool = []
+
+
 @dataclass
 class GenerationResult:
     sequences: list[list[int]]  # newly generated tokens per row (EOS included)
@@ -600,7 +644,7 @@ class GenerationEngine:
         )
 
     # -- beam search ------------------------------------------------------
-    def generate_beam(
+    def beam_start(
         self,
         prompts: Iterable[Sequence[int]],
         *,
@@ -608,14 +652,17 @@ class GenerationEngine:
         max_new_tokens: int = 128,
         eos_ids: Sequence[int] = (),
         length_penalty: float = 1.0,
-    ) -> GenerationResult:
-        """Beam-search decode (B=1): beams ride the engine's BATCH axis, so
-        each step is one batched decode (same parameter stream as B=1) plus
-        a per-step cache reorder — a [L, K, S, H, hd] gather that is noise
-        next to the parameter read. The reference exposes ``num_beams``
-        through HF ``generate`` (ml/formatter.py:88-92); here it is a
-        first-class engine path. Returns the best finished beam by
-        length-normalized log-probability (GNMT ``len**length_penalty``)."""
+    ) -> "BeamState":
+        """Prefill + first-token expansion of a RESUMABLE beam session.
+
+        Beams ride the engine's BATCH axis, so each step is one batched
+        decode (same parameter stream as B=1) plus a per-step cache
+        reorder. Per-step candidate selection runs ON DEVICE via
+        ``lax.top_k`` — K·(K+n_eos) ids+scores cross to the host, not
+        [K, V] logits (VERDICT r4 weak #4: np.argsort over a 151k vocab
+        per beam per token). The session shape lets the serving worker
+        advance a bounded chunk of steps at a time instead of occupying
+        its serial loop for the whole decode."""
         prompts = [list(p) for p in prompts]
         if len(prompts) != 1:
             raise ValueError("beam search is B=1")
@@ -631,8 +678,9 @@ class GenerationEngine:
         eos_set = set(int(e) for e in eos_ids)
         room = min(max_new_tokens, self.max_seq_len - len(prompt))
         if room <= 0:
-            return GenerationResult(
-                sequences=[[]], prompt_lens=[len(prompt)], finished=[True]
+            return BeamState(
+                engine=self, K=K, B=0, room=0, prompt_len=len(prompt),
+                eos_set=eos_set, length_penalty=float(length_penalty),
             )
         # prefill ONCE at B=1 and tile the cache rows to K — the same
         # [:, idx] gather the per-step reorder uses, instead of paying the
@@ -647,50 +695,67 @@ class GenerationEngine:
             v_scale=None if cache1.v_scale is None else cache1.v_scale[:, tile],
         )
         del cache1
-        logp = jax.nn.log_softmax(logits1.astype(jnp.float32), axis=-1)
-        row0 = np.asarray(logp[0])
-        first = np.argsort(-row0)[:K]
-        scores = row0[first]  # [K] cumulative log-probs
-        beams: list[list[int]] = [[int(t)] for t in first]
-        alive = [t not in eos_set for (t,) in (b[-1:] for b in beams)]
-        done_pool: list[tuple[float, list[int]]] = []
-        for k, b in enumerate(beams):
-            if not alive[k]:
-                done_pool.append((scores[k] / (1 ** length_penalty), b))
-        tok = jnp.asarray(
-            np.resize(np.asarray(first, np.int32), (B,)), jnp.int32
+        st = BeamState(
+            engine=self, K=K, B=B, room=room, prompt_len=len(prompt),
+            eos_set=eos_set, length_penalty=float(length_penalty),
         )
+        vals, idx = _beam_topk(logits1[:1], K)
+        row_v = np.asarray(vals)[0]
+        row_i = np.asarray(idx)[0]
+        st.scores = row_v.astype(np.float64)
+        st.beams = [[int(t)] for t in row_i]
+        st.alive = [int(t) not in eos_set for t in row_i]
+        for k, b in enumerate(st.beams):
+            if not st.alive[k]:
+                st.done_pool.append((st.scores[k] / 1.0, b))
+        st.cache = cache
+        st.tok = jnp.asarray(np.resize(row_i.astype(np.int32), (B,)))
+        st.step = 1
+        return st
 
-        for step in range(1, room):
-            if not any(alive):
-                break
-            logits, cache = _decode_step(self.params, tok, cache, self.cfg)
-            logp = np.asarray(
-                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            )[:K]
-            # candidates: every alive beam × vocab; dead rows excluded
+    def beam_advance(self, st: "BeamState", max_steps: int | None = None) -> bool:
+        """Run up to ``max_steps`` beam steps (all remaining when None).
+        Returns True when the session is finished."""
+        if st.room <= 0:
+            return True
+        n = 0
+        K = st.K
+        kk = K + len(st.eos_set)
+        while st.step < st.room and any(st.alive):
+            if max_steps is not None and n >= max_steps:
+                return False
+            n += 1
+            st.step += 1
+            logits, st.cache = _decode_step(
+                self.params, st.tok, st.cache, self.cfg
+            )
+            # [K, kk] scores+ids — the ONLY device->host transfer per step
+            vals, idx = _beam_topk(logits[:K], kk)
+            vals = np.asarray(vals)
+            idx = np.asarray(idx)
             cand: list[tuple[float, int, int]] = []  # (score, beam, token)
             for k in range(K):
-                if not alive[k]:
+                if not st.alive[k]:
                     continue
-                top = np.argsort(-logp[k])[: K + len(eos_set)]
-                for t in top:
-                    cand.append((scores[k] + float(logp[k][t]), k, int(t)))
+                for j in range(kk):
+                    cand.append(
+                        (st.scores[k] + float(vals[k, j]), k, int(idx[k, j]))
+                    )
             cand.sort(key=lambda c: -c[0])
             new_beams, new_scores, new_alive, src = [], [], [], []
             for sc, k, t in cand:
                 if len(new_beams) >= K:
                     break
-                seq = beams[k] + [t]
-                if t in eos_set or len(seq) >= room:
-                    done_pool.append(
-                        (sc / (len(seq) ** length_penalty), seq)
+                seq = st.beams[k] + [t]
+                if t in st.eos_set or len(seq) >= st.room:
+                    st.done_pool.append(
+                        (sc / (len(seq) ** st.length_penalty), seq)
                     )
-                    if t in eos_set:
+                    if t in st.eos_set:
                         continue  # finished beams leave the frontier
                 new_beams.append(seq)
                 new_scores.append(sc)
-                new_alive.append(t not in eos_set and len(seq) < room)
+                new_alive.append(t not in st.eos_set and len(seq) < st.room)
                 src.append(k)
             if not new_beams:
                 break
@@ -701,31 +766,67 @@ class GenerationEngine:
                 new_scores.append(-np.inf)
                 new_alive.append(False)
                 src.append(src[0])
-            beams, scores, alive = new_beams, np.asarray(new_scores), new_alive
+            st.beams, st.alive = new_beams, new_alive
+            st.scores = np.asarray(new_scores)
             # reorder every beam's cache row to follow its source beam
-            idx = np.resize(np.asarray(src, np.int32), (B,))
-            gidx = jnp.asarray(idx)
-            cache = KVCache(
-                k=cache.k[:, gidx], v=cache.v[:, gidx],
-                length=cache.length[gidx],
-                k_scale=None if cache.k_scale is None else cache.k_scale[:, gidx],
-                v_scale=None if cache.v_scale is None else cache.v_scale[:, gidx],
+            gidx = jnp.asarray(np.resize(np.asarray(src, np.int32), (st.B,)))
+            st.cache = KVCache(
+                k=st.cache.k[:, gidx], v=st.cache.v[:, gidx],
+                length=st.cache.length[gidx],
+                k_scale=None if st.cache.k_scale is None
+                else st.cache.k_scale[:, gidx],
+                v_scale=None if st.cache.v_scale is None
+                else st.cache.v_scale[:, gidx],
             )
-            tok = jnp.asarray(
-                np.resize(np.asarray([b[-1] for b in beams], np.int32), (B,)),
-                jnp.int32,
-            )
-        del cache
-        for k in range(K):
-            if alive[k]:
-                done_pool.append(
-                    (scores[k] / (len(beams[k]) ** length_penalty), beams[k])
+            st.tok = jnp.asarray(
+                np.resize(
+                    np.asarray([b[-1] for b in st.beams], np.int32), (st.B,)
                 )
-        best_score, best = max(done_pool, key=lambda d: d[0])
-        fin = bool(best and best[-1] in eos_set)
+            )
+        return True
+
+    def beam_finish(self, st: "BeamState") -> GenerationResult:
+        """Close the session: fold surviving beams into the pool and pick
+        the best by GNMT length-normalized log-probability."""
+        if st.room <= 0:
+            return GenerationResult(
+                sequences=[[]], prompt_lens=[st.prompt_len], finished=[True]
+            )
+        st.cache = None  # free the tiled KV
+        for k in range(st.K):
+            if st.alive[k]:
+                st.done_pool.append(
+                    (
+                        st.scores[k] / (len(st.beams[k]) ** st.length_penalty),
+                        st.beams[k],
+                    )
+                )
+        _best_score, best = max(st.done_pool, key=lambda d: d[0])
+        fin = bool(best and best[-1] in st.eos_set)
         return GenerationResult(
-            sequences=[best], prompt_lens=[len(prompt)], finished=[fin]
+            sequences=[best], prompt_lens=[st.prompt_len], finished=[fin]
         )
+
+    def generate_beam(
+        self,
+        prompts: Iterable[Sequence[int]],
+        *,
+        num_beams: int = 4,
+        max_new_tokens: int = 128,
+        eos_ids: Sequence[int] = (),
+        length_penalty: float = 1.0,
+    ) -> GenerationResult:
+        """One-shot beam-search decode (B=1): start + advance + finish.
+        The reference exposes ``num_beams`` through HF ``generate``
+        (ml/formatter.py:88-92); here it is a first-class engine path.
+        Returns the best finished beam by length-normalized
+        log-probability (GNMT ``len**length_penalty``)."""
+        st = self.beam_start(
+            prompts, num_beams=num_beams, max_new_tokens=max_new_tokens,
+            eos_ids=eos_ids, length_penalty=length_penalty,
+        )
+        self.beam_advance(st)
+        return self.beam_finish(st)
 
     # -- speculative decode (prompt-lookup) -------------------------------
     @staticmethod
@@ -824,6 +925,11 @@ class GenerationEngine:
         ema_acc: float | None = None
         seen_tv = seen_td = 0
         spec_on = True
+        # measured-loss disables are PERMANENT for the request: the
+        # pair-recurrence re-arm below only answers "is there anything to
+        # draft from", not "is drafting paying off" — re-arming after the
+        # break-even rule said no would reinstate the slowdown it stopped
+        spec_dead = False
         _EMA = 0.5
         # a long run of draft MISSES never produces a verify sample for the
         # timing rule, yet means the text isn't repetitive — stop looking
@@ -853,7 +959,7 @@ class GenerationEngine:
             nonlocal spec_on, miss_run
             pr = (history[-2], history[-1])
             if pr in pairs:
-                if not spec_on and stream_cb is not None:
+                if not spec_on and not spec_dead and stream_cb is not None:
                     spec_on = True  # generated text became repetitive
                     miss_run = 0
             else:
@@ -963,8 +1069,10 @@ class GenerationEngine:
                 ema_tv = dt if ema_tv is None else (
                     _EMA * dt + (1 - _EMA) * ema_tv
                 )
-                if ema_td is not None and seen_tv > 3:
+                if ema_td is not None and seen_tv > 3 and not spec_dead:
                     spec_on = self._spec_worthwhile(ema_acc, ema_tv, ema_td)
+                    if not spec_on:
+                        spec_dead = True
             # roll back rejected cache positions by resetting length only
             new_len = base_len + 1 + accepted
             cache = KVCache(

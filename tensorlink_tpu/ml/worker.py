@@ -65,6 +65,11 @@ class StageRuntime:
     # (platform/proofs.py; the monitor pulls it via PROOF_REQ)
     proof_log: list = field(default_factory=list)
     opt_steps: int = 0
+    # in-flight chunked beam-search sessions: rid -> (BeamState, payload,
+    # effective K). A long beam decode advances _BEAM_CHUNK_STEPS at a
+    # time and requeues itself, so queued co-batched generates interleave
+    # instead of head-of-line-blocking behind it
+    beam_sessions: dict[str, Any] = field(default_factory=dict)
 
     @property
     def n_layers(self) -> int:
@@ -77,6 +82,13 @@ class StageRuntime:
             and self.stage["last"]
             and self.stage["holds_head"]
         )
+
+
+# beam-search chunk size: steps a beam session may run per trip through the
+# worker's serial loop before requeueing itself behind waiting work. Small
+# enough that a queued co-batched generate waits one chunk, large enough to
+# amortize the session bookkeeping.
+_BEAM_CHUNK_STEPS = 32
 
 
 class DistributedWorker:
@@ -175,6 +187,7 @@ class DistributedWorker:
                         proto.CHECKPOINT: proto.CHECKPOINT_RESP,
                         proto.PROOF_REQ: proto.PROOF_RESP,
                         "load_stage": proto.MODULE_LOADED,
+                        "beam_continue": proto.GENERATE_RESP,
                     }.get(kind, proto.FORWARD_RESP)
                     # a chained hop's requester is the ORIGINATOR, not the
                     # previous worker — route the error to it (it holds the
@@ -193,6 +206,8 @@ class DistributedWorker:
             self._forward(p)
         elif kind == proto.GENERATE:
             self._generate(p)
+        elif kind == "beam_continue":
+            self._beam_step(p["job_id"], p["rid"])
         elif kind == proto.PARAMS_REQ:
             self._params_req(p)
         elif kind == proto.TRAIN_MODE:
@@ -1022,26 +1037,14 @@ class DistributedWorker:
                     "bucket; configure batch_buckets to serve wider beams)",
                     int(p["num_beams"]), k,
                 )
-            result = rt.engine.generate_beam(
+            st = rt.engine.beam_start(
                 prompts,
                 num_beams=k,
                 max_new_tokens=int(p.get("max_new_tokens", 128)),
                 eos_ids=p.get("eos_ids", ()),
             )
-            if stream_id:
-                # beams emit nothing until the search completes; close the
-                # relay so a streaming caller never stalls on the drain
-                self.bridge.request(
-                    "send_token",
-                    {"peer": peer, "stream": stream_id, "tokens": [],
-                     "done": True},
-                )
-            self._respond(
-                peer, proto.GENERATE_RESP, p["rid"],
-                {"sequences": [list(map(int, s)) for s in result.sequences],
-                 "finished": list(map(bool, result.finished)),
-                 "num_beams_used": k},
-            )
+            rt.beam_sessions[p["rid"]] = (st, p, k)
+            self._beam_step(p["job_id"], p["rid"])
             return
         if lookahead:
             result = rt.engine.generate_lookahead(
@@ -1091,6 +1094,48 @@ class DistributedWorker:
                 "sequences": [list(map(int, s)) for s in result.sequences],
                 "finished": list(map(bool, result.finished)),
             },
+        )
+
+    def _beam_step(self, job_id: str, rid: str) -> None:
+        """Advance an in-flight beam session one bounded chunk. Unfinished
+        sessions requeue a light marker on the worker's OWN work queue —
+        FIFO, so every generate that arrived meanwhile runs before the
+        next chunk (bounded occupancy instead of head-of-line blocking)."""
+        rt = self._runtime(job_id)
+        entry = rt.beam_sessions.get(rid)
+        if entry is None:
+            return  # job shut down / duplicate marker
+        st, p, k = entry
+        try:
+            # advance via the engine the session STARTED on (st.engine):
+            # a load_stage between chunks may swap rt.engine, and scoring
+            # this session's KV under different weights would corrupt it
+            done = st.engine.beam_advance(st, max_steps=_BEAM_CHUNK_STEPS)
+        except BaseException:
+            rt.beam_sessions.pop(rid, None)
+            raise  # the run-loop error path responds on this rid
+        if not done:
+            self.bridge.q.work.put(
+                ("beam_continue",
+                 {"job_id": job_id, "rid": rid, "peer": p["peer"]})
+            )
+            return
+        rt.beam_sessions.pop(rid, None)
+        result = st.engine.beam_finish(st)
+        stream_id = p.get("stream")
+        if stream_id:
+            # beams emit nothing until the search completes; close the
+            # relay so a streaming caller never stalls on the drain
+            self.bridge.request(
+                "send_token",
+                {"peer": p["peer"], "stream": stream_id, "tokens": [],
+                 "done": True},
+            )
+        self._respond(
+            p["peer"], proto.GENERATE_RESP, rid,
+            {"sequences": [list(map(int, s)) for s in result.sequences],
+             "finished": list(map(bool, result.finished)),
+             "num_beams_used": k},
         )
 
     # -- parameters -----------------------------------------------------

@@ -345,6 +345,42 @@ def test_beam_search_over_api(api_cluster):
     assert status == 400
 
 
+def test_beam_search_no_head_of_line_blocking(api_cluster):
+    """A long beam decode advances in bounded chunks on the worker
+    (ml/worker.py::_beam_step), so a small concurrent request completes
+    BEFORE the beam request instead of queueing behind its whole decode."""
+    import threading
+
+    api = api_cluster.api
+    done_at = {}
+
+    def beam():
+        st, b = _req(api, "POST", "/v1/generate",
+                     {"hf_name": MODEL, "message": "long beam",
+                      "max_new_tokens": 200, "do_sample": False,
+                      "num_beams": 4})
+        assert st == 200, b
+        done_at["beam"] = time.time()
+
+    t = threading.Thread(target=beam)
+    t.start()
+    time.sleep(0.3)  # let the beam request reach the worker
+    in_flight = t.is_alive()
+    st, b = _req(api, "POST", "/v1/generate",
+                 {"hf_name": MODEL, "message": "quick",
+                  "max_new_tokens": 4, "do_sample": False})
+    assert st == 200, b
+    done_at["quick"] = time.time()
+    t.join(timeout=120)
+    assert "beam" in done_at, "beam request never completed"
+    if not in_flight:
+        pytest.skip("beam finished before the probe dispatched — ordering "
+                    "not observable on this host")
+    assert done_at["quick"] < done_at["beam"], (
+        "small request was head-of-line-blocked behind the beam decode"
+    )
+
+
 def test_chat_completions_n_choices(api_cluster):
     """OpenAI ``n``: one request returns n choices (dispatched concurrently
     so the batcher coalesces them into one decode); sampled choices differ,
