@@ -499,21 +499,12 @@ class DistributedModel:
             )
         if int(num_beams) > 1:
             raise ValueError("beam search needs a single-stage job")
-        def nonzero(v):
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            return any(float(x or 0.0) != 0.0 for x in vals)
-
-        if nonzero(presence_penalty) or nonzero(frequency_penalty):
-            # the pipelined head-worker sampler is stateless per step (no
-            # context counts ride the session) — refuse rather than
-            # silently ignore a knob that changes output
-            raise ValueError(
-                "presence/frequency penalties need a single-stage job"
-            )
         return self._generate_pipelined(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
             stream_cb=stream_cb, budgets=budgets,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
         )
 
     def _generate_remote(
@@ -606,12 +597,16 @@ class DistributedModel:
     def _generate_pipelined(
         self, prompts, *, max_new_tokens, temperature, top_k=0, top_p=1.0,
         eos_ids=(), seed=0, stream_cb=None, budgets=None,
+        presence_penalty=0.0, frequency_penalty=0.0,
     ) -> list[list[int]]:
         """Host-driven decode across stages with per-stage session caches
         (net-new vs the reference, which cannot generate across shards
         without re-running the full forward per token). Sampling knobs may
         be per-row sequences and ``budgets`` caps rows individually — the
-        serving batcher co-batches mixed requests on pipelined jobs too."""
+        serving batcher co-batches mixed requests on pipelined jobs too.
+        Presence/frequency penalties ride the session: the head-holding
+        worker keeps the [B, V] context counts across steps
+        (ml/worker.py::_sample_from_logits)."""
         prompts = [list(map(int, p)) for p in prompts]
         B = len(prompts)
         T = max(len(p) for p in prompts)
@@ -625,12 +620,16 @@ class DistributedModel:
         cache_len = min(self.spec["seq_len"], T + max_new_tokens)
         eos = set(int(e) for e in eos_ids)
         per_row = any(
-            isinstance(v, (list, tuple)) for v in (temperature, top_k, top_p)
+            isinstance(v, (list, tuple))
+            for v in (temperature, top_k, top_p,
+                      presence_penalty, frequency_penalty)
         )
         # validate BEFORE anything indexes per-row lists (a short budgets
         # list must raise this message, not an IndexError below)
         for name, v in (("temperature", temperature), ("top_k", top_k),
-                        ("top_p", top_p), ("budgets", budgets)):
+                        ("top_p", top_p), ("budgets", budgets),
+                        ("presence_penalty", presence_penalty),
+                        ("frequency_penalty", frequency_penalty)):
             if isinstance(v, (list, tuple)) and len(v) != B:
                 raise ValueError(
                     f"per-row {name} has {len(v)} entries for {B} prompts"
@@ -660,12 +659,27 @@ class DistributedModel:
             "temperature": rows(temperature, float),
             "top_k": rows(top_k, int),
             "top_p": rows(top_p, float),
+            "presence_penalty": rows(presence_penalty, float),
+            "frequency_penalty": rows(frequency_penalty, float),
             "seed": int(seed),
         }
+
+        def nonzero(v):
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            return any(float(x or 0.0) != 0.0 for x in vals)
+
+        samp0 = dict(samp, step=0)
+        if nonzero(presence_penalty) or nonzero(frequency_penalty):
+            # the head-holding worker sees hidden states, not token ids —
+            # ship the prompt once so it can seed the session's [B, V]
+            # context counts (subsequent steps fold sampled tokens in
+            # worker-side; nothing else crosses per step)
+            samp0["prompt_tokens"] = toks
+            samp0["prompt_mask"] = mask
         last_idx = mask.sum(-1) - 1
         tok = self.forward(
             toks, mask, session=session, cache_len=cache_len,
-            sample=dict(samp, step=0), last_idx=last_idx,
+            sample=samp0, last_idx=last_idx,
         )
 
         seqs: list[list[int]] = [[] for _ in range(B)]

@@ -458,16 +458,10 @@ class DistributedValidator:
             from tensorlink_tpu.api.schemas import ValidationError
 
             raise ValidationError("beam search needs a single-stage model")
-        if (args["presence_penalty"] or args["frequency_penalty"]) and multi_stage:
-            # reject BEFORE enqueueing: a penalized request inside a
-            # co-batched pipelined dispatch would error every neighbor.
-            # ValidationError so the API maps it to a 400 with the message
-            # (a bare ValueError would surface as an opaque 500).
-            from tensorlink_tpu.api.schemas import ValidationError
-
-            raise ValidationError(
-                "presence/frequency penalties need a single-stage model"
-            )
+        # presence/frequency penalties work on BOTH distributions: the
+        # engine path carries counts in its compiled loop, the pipelined
+        # path keeps them session-resident on the head-holding worker
+        # (ml/worker.py::_sample_from_logits) — the r4 400 is gone.
         # speculative decode is greedy-only; the emitted tokens are identical
         # to vanilla greedy, so the flag is a pure speed hint
         spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0

@@ -70,6 +70,11 @@ class StageRuntime:
     # time and requeues itself, so queued co-batched generates interleave
     # instead of head-of-line-blocking behind it
     beam_sessions: dict[str, Any] = field(default_factory=dict)
+    # per-session [B, V] context token counts for OpenAI presence/frequency
+    # penalties on PIPELINED decode: the head-holding worker samples with
+    # them and folds each sampled token back in, so penalized requests work
+    # on multi-stage jobs too (the engine path carries its own counts)
+    penalty_counts: dict[str, Any] = field(default_factory=dict)
 
     @property
     def n_layers(self) -> int:
@@ -564,6 +569,7 @@ class DistributedWorker:
         op = p.get("op", "stage")
         if op == "end_session":
             rt.sessions.pop(p.get("session"), None)
+            rt.penalty_counts.pop(p.get("session"), None)
             self._respond(p["peer"], proto.FORWARD_RESP, p["rid"], {"ok": True})
             return
         train = bool(p.get("train", False))
@@ -713,7 +719,7 @@ class DistributedWorker:
             # final logits of a decode step: sample on-worker and ship one
             # token id per row — the per-token logits transfer (~600 KB at
             # a 151k vocab) never leaves the device host
-            tok = self._sample_from_logits(out, p.get("last_idx"), p["sample"])
+            tok = self._sample_from_logits(rt, out, p)
             self._respond(
                 reply_peer, proto.FORWARD_RESP, p["rid"], {"token": tok}
             )
@@ -723,16 +729,25 @@ class DistributedWorker:
             {"out": np.asarray(jax.device_get(out)), "is_logits": is_logits},
         )
 
-    def _sample_from_logits(self, logits, last_idx, samp: dict) -> np.ndarray:
+    def _sample_from_logits(self, rt: "StageRuntime", logits, p: dict) -> np.ndarray:
         """Worker-side sampling for pipelined decode (ml/module.py
         _generate_pipelined): gather each row's last real position (prefill)
         or the single decode position, then run the jitted sampler with a
-        deterministic (seed, step)-derived key."""
+        deterministic (seed, step)-derived key.
+
+        Presence/frequency penalties carry [B, V] context counts ACROSS the
+        session's decode steps on this worker (rt.penalty_counts): step 0
+        scatters the prompt ids shipped in the sample dict, and each sampled
+        token folds back in — so penalized requests work on pipelined
+        models instead of 400ing (the reference applies HF sampling
+        uniformly regardless of distribution, ml/worker.py:359-430)."""
         import jax
         import jax.numpy as jnp
 
         from tensorlink_tpu.engine.sampling import SamplingParams, sample
 
+        samp: dict = p["sample"]
+        last_idx = p.get("last_idx")
         if logits.ndim == 3:
             B = logits.shape[0]
             if last_idx is not None:
@@ -741,31 +756,67 @@ class DistributedWorker:
                 idx = jnp.full((B,), logits.shape[1] - 1, jnp.int32)
             step_logits = logits[jnp.arange(B), idx]
         else:
+            B = logits.shape[0]
             step_logits = logits
         t = samp.get("temperature", 0.0)
+        pen_p = samp.get("presence_penalty", 0.0)
+        pen_f = samp.get("frequency_penalty", 0.0)
+
+        def any_nonzero(v):
+            vals = v if isinstance(v, (list, tuple, np.ndarray)) else [v]
+            return any(float(x or 0.0) != 0.0 for x in vals)
+
+        penalized = any_nonzero(pen_p) or any_nonzero(pen_f)
         if isinstance(t, (list, tuple, np.ndarray)):
             # batched serving mixes requests with different knobs: [B, 1]
             # leaves ride ONE compiled sampler (engine/sampling.py contract)
+            def col(v, dtype):
+                # scalars replicate across rows (NOT pad-fill — every row
+                # shares the one requested value)
+                if not isinstance(v, (list, tuple, np.ndarray)):
+                    v = [v] * len(list(t))
+                return jnp.asarray(v, dtype).reshape(-1)[:, None]
+
             sp = SamplingParams(
-                temperature=jnp.asarray(t, jnp.float32).reshape(-1)[:, None],
-                top_k=jnp.asarray(
-                    samp.get("top_k", [0] * len(t)), jnp.int32
-                ).reshape(-1)[:, None],
-                top_p=jnp.asarray(
-                    samp.get("top_p", [1.0] * len(t)), jnp.float32
-                ).reshape(-1)[:, None],
+                temperature=col(t, jnp.float32),
+                top_k=col(samp.get("top_k", 0), jnp.int32),
+                top_p=col(samp.get("top_p", 1.0), jnp.float32),
+                presence_penalty=col(pen_p, jnp.float32),
+                frequency_penalty=col(pen_f, jnp.float32),
             )
         else:
             sp = SamplingParams.make(
                 temperature=float(t),
                 top_k=int(samp.get("top_k", 0)),
                 top_p=float(samp.get("top_p", 1.0)),
+                presence_penalty=float(pen_p or 0.0),
+                frequency_penalty=float(pen_f or 0.0),
             )
+        counts = None
+        session = p.get("session")
+        if penalized and session is not None:
+            counts = rt.penalty_counts.get(session)
+            if counts is None:
+                # session start: counts = the prompt's token histogram
+                pt = np.asarray(samp["prompt_tokens"], np.int64)
+                pm = np.asarray(samp["prompt_mask"], bool)
+                c = np.zeros((pt.shape[0], rt.cfg.vocab_size), np.int32)
+                for i in range(pt.shape[0]):
+                    np.add.at(c[i], pt[i][pm[i]], 1)
+                counts = jnp.asarray(c)
         key = jax.random.fold_in(
             jax.random.PRNGKey(int(samp.get("seed", 0))),
             int(samp.get("step", 0)),
         )
-        return np.asarray(jax.device_get(sample(step_logits, key, sp)))
+        tok = sample(step_logits, key, sp, counts)
+        if counts is not None:
+            # fold the sampled token into the context for the next step
+            # (rows the driver has finished keep sampling; their counts
+            # drift but their outputs are discarded host-side)
+            rt.penalty_counts[session] = counts.at[
+                jnp.arange(counts.shape[0]), tok
+            ].add(1)
+        return np.asarray(jax.device_get(tok))
 
     # -- backward (reference _handle_backward replays torch autograd,
     # ml/worker.py:233-291; here it applies the recorded vjp) -------------

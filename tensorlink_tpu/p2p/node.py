@@ -22,6 +22,7 @@ import asyncio
 import secrets
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Any, Awaitable, Callable
 
@@ -39,6 +40,11 @@ Handler = Callable[[Connection, int, str, Any], Awaitable[None]]
 # depends on job:{id} surviving the storing validator) and proposal bodies
 # (vote lookups). Everything else stays local-first.
 REPLICATED_PREFIXES = ("job:", "proposal:")
+
+# total bound on the handshake's on-chain credential check — the RPC
+# client's socket timeouts are per-op, so a slow-drip registry endpoint
+# needs an overall ceiling (fails CLOSED on expiry)
+CREDENTIAL_CHECK_TIMEOUT = 15.0
 
 
 class HandshakeError(Exception):
@@ -84,6 +90,12 @@ class P2PNode:
         # (node_id, role) -> bool, called off-loop (it may do blocking RPC).
         # None = local reputation only.
         self.credential_check: Callable[[str, str], bool] | None = None
+        # dedicated pool for credential checks: a timed-out check abandons
+        # its thread mid-RPC, and abandoning threads in the loop's DEFAULT
+        # executor would let repeated slow handshakes starve the bridge
+        # pumps and every other off-loop task node-wide. Lazily built;
+        # saturation here only rejects further handshakes (fail closed).
+        self._cred_pool: ThreadPoolExecutor | None = None
         self.handlers: dict[str, Handler] = {}
         self.started = threading.Event()
         self.terminate = threading.Event()
@@ -137,6 +149,10 @@ class P2PNode:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._cred_pool is not None:
+            # don't wait: an abandoned slow-drip check may never return
+            self._cred_pool.shutdown(wait=False, cancel_futures=True)
+            self._cred_pool = None
 
     async def _start_server(self) -> None:
         self._server = await asyncio.start_server(
@@ -195,9 +211,26 @@ class P2PNode:
         the refused peer sees a failed handshake on its own side."""
         if self.credential_check is None:
             return
-        ok = await asyncio.get_running_loop().run_in_executor(
-            None, self.credential_check, node_id, role
-        )
+        if self._cred_pool is None:
+            self._cred_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="cred-check"
+            )
+        try:
+            # total bound, not just the RPC's per-socket-op timeout: a
+            # slow-drip registry endpoint (1 byte per read) could otherwise
+            # hold this handshake open arbitrarily long. On timeout the
+            # pool thread is abandoned to finish; the handshake fails
+            # CLOSED now.
+            ok = await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    self._cred_pool, self.credential_check, node_id, role
+                ),
+                timeout=CREDENTIAL_CHECK_TIMEOUT,
+            )
+        except asyncio.TimeoutError:
+            raise HandshakeError(
+                f"credential check for {node_id[:12]} timed out"
+            ) from None
         if not ok:
             raise HandshakeError(
                 f"peer {node_id[:12]} role={role} not registered "

@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import hmac
 import hashlib
+import http.client
 import json
 import threading
 import urllib.request
@@ -367,6 +368,11 @@ def call_data(fn_sig: str, args: Sequence[Any]) -> bytes:
 
 
 class JsonRpc:
+    # an RPC response larger than this is hostile or broken — a registry
+    # view or tx hash is well under 1 KB, and an unbounded read() would let
+    # a malicious endpoint exhaust validator memory
+    MAX_RESPONSE_BYTES = 1 << 20
+
     def __init__(self, url: str, timeout: float = 10.0):
         self.url = url
         self.timeout = timeout
@@ -383,9 +389,23 @@ class JsonRpc:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                resp = json.loads(r.read())
-        except (OSError, ValueError) as e:
+                raw = r.read(self.MAX_RESPONSE_BYTES + 1)
+                if len(raw) > self.MAX_RESPONSE_BYTES:
+                    raise ChainError(
+                        f"rpc {method}: response exceeds "
+                        f"{self.MAX_RESPONSE_BYTES} bytes"
+                    )
+                resp = json.loads(raw)
+        except ChainError:
+            raise
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            # HTTPException covers hostile non-HTTP banners (BadStatusLine)
+            # and truncated chunked bodies (IncompleteRead) — neither is an
+            # OSError, and callers catch ChainError to degrade
             raise ChainError(f"rpc {method} failed: {e}") from e
+        if not isinstance(resp, dict):
+            # a JSON array/string/number here is not a JSON-RPC envelope
+            raise ChainError(f"rpc {method}: malformed response envelope")
         if "error" in resp:
             raise ChainError(f"rpc {method}: {resp['error']}")
         return resp.get("result")
@@ -448,7 +468,19 @@ class ChainClient:
             [{"to": self.contract, "data": "0x" + call_data(fn_sig, args).hex()},
              "latest"],
         )
-        return bytes.fromhex((result or "0x")[2:])
+        # normalize EVERY malformed-result shape to ChainError: callers
+        # (e.g. the handshake credential gate) catch ChainError to fail
+        # CLOSED — an odd-length hex string or a non-string result from a
+        # hostile RPC must not escape as ValueError/TypeError and crash
+        # the caller instead
+        try:
+            if result is None:
+                return b""
+            if not isinstance(result, str) or not result.startswith("0x"):
+                raise ValueError(f"non-hex eth_call result: {result!r:.80}")
+            return bytes.fromhex(result[2:])
+        except (ValueError, TypeError) as e:
+            raise ChainError(f"rpc eth_call: malformed result: {e}") from e
 
 
 class ChainSubmitter:
@@ -528,7 +560,9 @@ def make_credential_check(client: ChainClient):
             return True
         try:
             out = client.call_view(view, ["0x" + node_id])
-        except ChainError as e:
+        except Exception as e:  # noqa: BLE001 — ANY failure fails closed:
+            # a hostile RPC must not find an exception type that slips a
+            # peer past the gate (or crashes the handshake loop)
             log.warning("credential check for %s failed: %s", node_id[:12], e)
             return False
         return any(out)

@@ -51,13 +51,19 @@ def api_cluster(tmp_path_factory):
     worker = WorkerNode(
         WorkerConfig(seed_validators=[["127.0.0.1", validator.port]], **common)
     ).start()
+    worker2 = WorkerNode(
+        WorkerConfig(seed_validators=[["127.0.0.1", validator.port]],
+                     **{**common, "key_dir": str(tmp / "keys2")})
+    ).start()
     deadline = time.time() + 10
     while time.time() < deadline:
-        if validator.status()["peers"]:
+        if len(validator.status()["peers"]) >= 2:
             break
         time.sleep(0.2)
+    validator.test_workers = [worker, worker2]  # for capacity-shrink tests
     yield validator
     worker.stop()
+    worker2.stop()
     validator.stop()
 
 
@@ -255,6 +261,52 @@ def test_repetition_penalties_over_api(api_cluster):
         api, "POST", "/v1/generate", {**base, "frequency_penalty": 3.0},
     )
     assert status == 400  # out of [-2, 2]
+
+
+def test_repetition_penalties_pipelined_over_api(api_cluster):
+    """Penalties against a 2-STAGE hosted model (r4 weak #5 / directive 5:
+    these requests used to 400): shrink both workers' advertised capacity
+    so a 6-layer model must split, host it over REST, and check the knob
+    both works and bites."""
+    api = api_cluster.api
+    # the planner works from FREE bytes (capacity - reservations of models
+    # hosted by earlier tests) — shrink each worker so ~3.4 MB is free
+    stats = api_cluster.executor.bridge.request("stats_workers", timeout=15.0)
+    reserved = {
+        s["id"]: float(s["hbm_bytes"]) - float(s["free_bytes"]) for s in stats
+    }
+    for w in api_cluster.test_workers:
+        res = reserved.get(w.node_id, max(reserved.values(), default=0.0))
+        w.send_request(
+            "set_capacity",
+            {"hbm_bytes": res + 3_400_000.0, "n_devices": 1},
+        )
+    try:
+        cfg = ModelConfig(
+            family="llama", vocab_size=258, d_model=128, n_layers=6,
+            n_heads=4, n_kv_heads=2, head_dim=32, d_ff=256,
+            max_seq_len=256, dtype=jnp.float32,
+        ).to_json()
+        status, body = _req(
+            api, "POST", "/request-model",
+            {"hf_name": "tiny-2stage", "config": cfg, "seq_len": 64},
+        )
+        assert status == 200 and body["status"] == "ready", body
+        job = api_cluster.executor.hosted["tiny-2stage"]
+        assert job.model.plan.n_stages == 2, job.model.plan
+
+        base = {"hf_name": "tiny-2stage", "message": "aa bb aa bb",
+                "max_new_tokens": 16, "do_sample": False}
+        status, plain = _req(api, "POST", "/v1/generate", base)
+        assert status == 200, plain
+        status, pen = _req(
+            api, "POST", "/v1/generate", {**base, "presence_penalty": 2.0},
+        )
+        assert status == 200, pen  # used to be a 400 on multi-stage
+        assert pen["response"] != plain["response"]  # the knob bites
+    finally:
+        for w in api_cluster.test_workers:
+            w.send_request("set_capacity", w.executor.capacity())
 
 
 def test_generate_openai_format(api_cluster):

@@ -247,6 +247,130 @@ def test_contract_manager_submits_on_chain(eth, tmp_path):
     assert cm.submit_claim(h, "nobody") is None
 
 
+# ---------------------------------------------------------------------------
+# hostile RPC (VERDICT r4 weak #9 / directive 8): every malformed-response
+# shape must normalize to ChainError, the credential gate must fail CLOSED
+# on all of them, and a slow endpoint cannot stall the handshake path
+# ---------------------------------------------------------------------------
+class HostileEthNode:
+    """Serves a canned raw body (optionally after a delay) to every POST."""
+
+    def __init__(self, body: bytes, *, delay: float = 0.0, status: int = 200):
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                import time as _t
+
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if node.delay:
+                    _t.sleep(node.delay)
+                self.send_response(node.status)
+                self.send_header("Content-Length", str(len(node.body)))
+                self.end_headers()
+                self.wfile.write(node.body)
+
+        self.body, self.delay, self.status = body, delay, status
+        self.http = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.http.server_address[1]}"
+        threading.Thread(target=self.http.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.http.shutdown()
+
+
+def _rpc_body(result) -> bytes:
+    return json.dumps({"jsonrpc": "2.0", "id": 1, "result": result}).encode()
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        _rpc_body("0x123"),  # odd-length hex — bytes.fromhex would raise
+        _rpc_body("deadbeef"),  # missing 0x prefix
+        _rpc_body(12345),  # non-string result
+        _rpc_body({"nested": "garbage"}),  # object result
+        _rpc_body(None)[:-3],  # truncated JSON
+        b"<!DOCTYPE html><html>captive portal</html>",  # not JSON at all
+        json.dumps(["not", "an", "envelope"]).encode(),  # non-dict envelope
+        b"",  # empty body
+    ],
+    ids=["odd-hex", "no-prefix", "int-result", "object-result",
+         "truncated", "html", "array-envelope", "empty"],
+)
+def test_hostile_rpc_normalizes_to_chain_error(body):
+    n = HostileEthNode(body)
+    try:
+        client = C.ChainClient(n.url, CONTRACT, PRIV, chain_id=84532)
+        with pytest.raises(C.ChainError):
+            client.call_view("isActiveWorker(bytes32)", ["0x" + "ab" * 32])
+        # and the handshake gate fails CLOSED, never raises
+        check = C.make_credential_check(client)
+        assert check("ab" * 32, "worker") is False
+    finally:
+        n.close()
+
+
+def test_hostile_rpc_oversized_response_capped():
+    huge = _rpc_body("0x" + "00" * (C.JsonRpc.MAX_RESPONSE_BYTES // 2 + 64))
+    n = HostileEthNode(huge)
+    try:
+        client = C.ChainClient(n.url, CONTRACT, PRIV, chain_id=84532)
+        with pytest.raises(C.ChainError, match="exceeds"):
+            client.call_view("isActiveWorker(bytes32)", ["0x" + "ab" * 32])
+        assert C.make_credential_check(client)("ab" * 32, "worker") is False
+    finally:
+        n.close()
+
+
+def test_slow_rpc_fails_closed_within_timeout():
+    n = HostileEthNode(_rpc_body("0x" + "01".rjust(64, "0")), delay=5.0)
+    try:
+        client = C.ChainClient(n.url, CONTRACT, PRIV, chain_id=84532)
+        client.rpc.timeout = 0.5
+        check = C.make_credential_check(client)
+        import time as _t
+
+        t0 = _t.time()
+        assert check("ab" * 32, "worker") is False
+        assert _t.time() - t0 < 3.0  # bounded by the RPC timeout, not 5 s
+    finally:
+        n.close()
+
+
+def test_handshake_bounded_by_slow_credential_check(tmp_path):
+    """A credential check that never returns cannot hold the handshake
+    open past CREDENTIAL_CHECK_TIMEOUT — the accepting node stays live and
+    the slow peer is rejected (fail closed)."""
+    import time as _t
+
+    from tensorlink_tpu.p2p import node as p2p_node
+    from tensorlink_tpu.p2p.node import P2PNode
+
+    v = P2PNode("validator", local_test=True, key_dir=tmp_path / "kv",
+                spill_dir=tmp_path / "sv")
+    w = P2PNode("worker", local_test=True, key_dir=tmp_path / "kw",
+                spill_dir=tmp_path / "sw")
+    old_timeout = p2p_node.CREDENTIAL_CHECK_TIMEOUT
+    p2p_node.CREDENTIAL_CHECK_TIMEOUT = 1.0
+    try:
+        v.start()
+        w.start()
+        v.credential_check = lambda nid, role: _t.sleep(30) or True
+        t0 = _t.time()
+        with pytest.raises(Exception):
+            w.call(w.connect(v.host, v.port))
+        assert _t.time() - t0 < 10.0  # bounded, not 30 s
+        assert len(v.connections) == 0  # rejected, not half-open
+    finally:
+        p2p_node.CREDENTIAL_CHECK_TIMEOUT = old_timeout
+        w.stop()
+        v.stop()
+
+
 def test_from_env_degrades_without_credentials(tmp_path):
     from tensorlink_tpu.core.config import EnvFile
 

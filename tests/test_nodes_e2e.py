@@ -272,6 +272,31 @@ def test_pipelined_forward_and_generate_parity(cluster):
         s3 = model.generate([prompt], max_new_tokens=6, temperature=0.8,
                             seed=124)
         assert s1 != s3  # astronomically unlikely to collide over 6 tokens
+
+        # presence/frequency penalties ride the pipelined session (the
+        # head-holding worker carries the [B, V] context counts across
+        # steps — r4 weak #5: these requests used to 400 on multi-stage
+        # jobs): exact parity vs the local compiled penalized decode
+        from tensorlink_tpu.engine.sampling import SamplingParams
+
+        pen = model.generate([prompt], max_new_tokens=8,
+                             presence_penalty=1.5, frequency_penalty=0.5)
+        refpen = engine.generate_compiled(
+            [prompt], max_new_tokens=8,
+            sampling=SamplingParams.make(
+                presence_penalty=1.5, frequency_penalty=0.5
+            ),
+        )
+        assert pen[0] == refpen.sequences[0]
+        # and per-row in a batched mix: row 0 penalized, row 1 plain
+        mix = model.generate(
+            [prompt, p2], max_new_tokens=6,
+            temperature=[0.0, 0.0], top_k=[0, 0], top_p=[1.0, 1.0],
+            presence_penalty=[1.5, 0.0], frequency_penalty=[0.5, 0.0],
+        )
+        assert mix[0] == refpen.sequences[0][:6]
+        ref2b = engine.generate_compiled([p2], max_new_tokens=6)
+        assert mix[1] == ref2b.sequences[0]
     finally:
         try:
             model.shutdown()
