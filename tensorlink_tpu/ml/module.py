@@ -136,20 +136,30 @@ class DistributedModel:
         self.model_spec = reply.get("model", self.model_spec)
         self.cfg = ModelConfig.from_json(self.model_spec["config"])
 
-        # connect to each assigned worker and ship its stage
+        # connect to each assigned worker (co-slice coworkers included —
+        # they execute every mirrored work item) and ship its stage
         for stage in self.plan.stages:
-            wid = stage.worker_id
-            if wid in self.workers:
-                continue
-            host, port = reply["workers"][wid]
-            conn_id = self.node.connect_to(host, int(port))
-            self.workers[wid] = conn_id
-            # kept for chained forwards: each hop dials the NEXT stage's
-            # worker by address (worker-to-worker, no user transit)
-            self.worker_addrs[wid] = [host, int(port)]
+            for wid in [stage.worker_id] + list(stage.coworkers or []):
+                if wid in self.workers:
+                    continue
+                if wid not in reply["workers"]:
+                    # a merged stage missing ANY member's address cannot
+                    # run — its SPMD programs would block forever at the
+                    # first cross-process collective. Fail at setup.
+                    raise RuntimeError(
+                        f"job reply has no address for stage member "
+                        f"{wid[:8]} — cannot drive the merged mesh"
+                    )
+                host, port = reply["workers"][wid]
+                conn_id = self.node.connect_to(host, int(port))
+                self.workers[wid] = conn_id
+                # kept for chained forwards: each hop dials the NEXT
+                # stage's worker by address (worker-to-worker, no user
+                # transit)
+                self.worker_addrs[wid] = [host, int(port)]
         for stage in self.plan.stages:
-            resp = self._request(
-                stage.worker_id,
+            resp = self._request_mirrored(
+                stage,
                 proto.MODULE,
                 {
                     "job_id": self.job_id,
@@ -165,6 +175,69 @@ class DistributedModel:
             "job %s distributed over %d stage(s)",
             self.job_id[:8], self.plan.n_stages,
         )
+
+    def _stage_members(self, stage) -> list[str]:
+        """Primary first, then connected co-slice coworkers (merged-mesh
+        stages, parallel/planner.py::_merge_co_slice)."""
+        return [stage.worker_id] + [
+            c for c in (stage.coworkers or []) if c in self.workers
+        ]
+
+    def _request_mirrored(
+        self, stage, tag: str, body: dict, timeout=MAX_WAIT_TIME,
+    ):
+        """One work item to a stage — and, when the stage is a co-slice
+        MERGED mesh, the same item to every coworker process concurrently.
+        The members joined one jax.distributed runtime, so each compiled
+        call is one SPMD program that every process must launch; the
+        mirrored items ARE those launches, and XLA's collectives keep them
+        lockstep (a member that launches first simply blocks at its first
+        collective until the others arrive). Coworkers answer a slim ack
+        (``mirror`` flag, ml/worker.py); the primary's full response is
+        returned. No repair on merged stages — replacing one member of a
+        live jax.distributed job is not supported."""
+        members = self._stage_members(stage)
+        if len(members) == 1:
+            return self._request(stage.worker_id, tag, body, timeout)
+        import threading
+
+        results: dict[str, Any] = {}
+
+        def issue(m: str) -> None:
+            try:
+                results[m] = self._request(
+                    m, tag, dict(body, mirror=True), timeout, no_repair=True
+                )
+            except Exception as e:  # surfaced after the primary returns
+                results[m] = e
+
+        threads = [
+            threading.Thread(target=issue, args=(m,), daemon=True)
+            for m in members[1:]
+        ]
+        for t in threads:
+            t.start()
+        try:
+            out = self._request(
+                stage.worker_id, tag, body, timeout, no_repair=True
+            )
+        finally:
+            for t in threads:
+                t.join(timeout=timeout)
+        for m, t in zip(members[1:], threads):
+            if t.is_alive() or m not in results:
+                # an unfinished mirror is a desynced SPMD member — report
+                # it HERE, not as an unattributed hang on a later item
+                raise RuntimeError(
+                    f"co-slice member {m[:8]} did not complete the "
+                    f"mirrored {tag} within {timeout}s"
+                )
+        for m, r in results.items():
+            if isinstance(r, Exception):
+                raise RuntimeError(
+                    f"co-slice member {m[:8]} failed the mirrored {tag}: {r}"
+                )
+        return out
 
     def _request(
         self, worker_plan_id: str, tag: str, body: dict, timeout=MAX_WAIT_TIME,
@@ -362,7 +435,9 @@ class DistributedModel:
 
         if len(self.plan.stages) > 1 and all(
             s.worker_id in self.worker_addrs for s in self.plan.stages
-        ):
+        ) and not any(s.coworkers for s in self.plan.stages):
+            # (merged stages take the per-hop path below — chain entries
+            # address primaries only and would skip the coworker mirrors)
             # worker-to-worker chain: ONE request; activations hop straight
             # between stage workers and only the final result (token ids or
             # logits) returns here. Stateless calls fall back to the per-hop
@@ -401,15 +476,15 @@ class DistributedModel:
                 body["hidden"] = out
             if head_on_last and stage is last:
                 body = samp_body(body)
-            resp = self._request(stage.worker_id, proto.FORWARD, body)
+            resp = self._request_mirrored(stage, proto.FORWARD, body)
             if "token" in resp:
                 return np.asarray(resp["token"], np.int32)
             out = np.asarray(resp["out"])
 
         if not head_on_last:
             head_stage = next(s for s in self.plan.stages if s.holds_head)
-            resp = self._request(
-                head_stage.worker_id,
+            resp = self._request_mirrored(
+                head_stage,
                 proto.FORWARD,
                 samp_body({"job_id": self.job_id, "op": "head", "hidden": out}),
             )
@@ -487,6 +562,16 @@ class DistributedModel:
         the engine's bucketed batch, on pipelined jobs via the head
         worker's per-row sampler."""
         assert self.plan is not None
+        if any(s.coworkers for s in self.plan.stages):
+            # the engine's host-driven loops launch from ONE controller;
+            # on a merged (multi-process) mesh every member must launch
+            # every program — the training path mirrors work items, the
+            # serving loops do not (yet). Refuse instead of deadlocking at
+            # the first collective.
+            raise RuntimeError(
+                "generation on a co-slice merged mesh is not supported — "
+                "host the model without co_slice_planning for serving"
+            )
         if self.plan.n_stages == 1:
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -738,13 +823,13 @@ class DistributedModel:
                 body["tokens"] = x
             else:
                 body["hidden"] = out
-            resp = self._request(stage.worker_id, proto.FORWARD, body)
+            resp = self._request_mirrored(stage, proto.FORWARD, body)
             out = np.asarray(resp["out"])
         last = self.plan.stages[-1]
         if not (last.last and last.holds_head):
             head_stage = next(s for s in self.plan.stages if s.holds_head)
-            resp = self._request(
-                head_stage.worker_id, proto.FORWARD,
+            resp = self._request_mirrored(
+                head_stage, proto.FORWARD,
                 {"job_id": self.job_id, "op": "head", "hidden": out,
                  "train": True, "tag": tag},
             )
@@ -758,14 +843,14 @@ class DistributedModel:
         last = self.plan.stages[-1]
         if not (last.last and last.holds_head):
             head_stage = next(s for s in self.plan.stages if s.holds_head)
-            resp = self._request(
-                head_stage.worker_id, proto.BACKWARD,
+            resp = self._request_mirrored(
+                head_stage, proto.BACKWARD,
                 {"job_id": self.job_id, "op": "head", "tag": tag, "grad": g},
             )
             g = np.asarray(resp["grad"])
         for stage in reversed(self.plan.stages):
-            resp = self._request(
-                stage.worker_id, proto.BACKWARD,
+            resp = self._request_mirrored(
+                stage, proto.BACKWARD,
                 {"job_id": self.job_id, "op": "stage", "tag": tag, "grad": g},
             )
             if "grad" in resp:
@@ -782,8 +867,8 @@ class DistributedModel:
         self._grad_clip = spec.pop("grad_clip", 1.0)
         self._opt_name, self._opt_spec = name, dict(spec)
         for stage in self.plan.stages:
-            self._request(
-                stage.worker_id, proto.OPTIMIZER,
+            self._request_mirrored(
+                stage, proto.OPTIMIZER,
                 {"job_id": self.job_id, "op": "init",
                  "spec": {"name": name, "grad_clip": None, **spec}},
             )
@@ -792,8 +877,8 @@ class DistributedModel:
     def _global_grad_norm(self, scale: float = 1.0) -> float:
         sq = 0.0
         for stage in self.plan.stages:
-            resp = self._request(
-                stage.worker_id, proto.OPTIMIZER,
+            resp = self._request_mirrored(
+                stage, proto.OPTIMIZER,
                 {"job_id": self.job_id, "op": "grad_norm"},
             )
             sq += float(resp.get("grad_norm", 0.0)) ** 2
@@ -809,16 +894,16 @@ class DistributedModel:
         if clip and gnorm > clip:
             final_scale = scale * clip / gnorm
         for stage in self.plan.stages:
-            self._request(
-                stage.worker_id, proto.OPTIMIZER,
+            self._request_mirrored(
+                stage, proto.OPTIMIZER,
                 {"job_id": self.job_id, "op": "step", "scale": final_scale},
             )
         return {"grad_norm": gnorm}
 
     def zero_grad(self) -> None:
         for stage in self.plan.stages:
-            self._request(
-                stage.worker_id, proto.OPTIMIZER,
+            self._request_mirrored(
+                stage, proto.OPTIMIZER,
                 {"job_id": self.job_id, "op": "zero"},
             )
 
@@ -881,6 +966,11 @@ class DistributedModel:
             self._train_backward(np.asarray(dlogits), tag)
             return float(nll_sum)
 
+        # merged (co-slice) stages require every member process to see the
+        # SAME work-item order — concurrent micro threads would scramble
+        # per-member arrival order and deadlock the SPMD collectives
+        if any(s.coworkers for s in self.plan.stages):
+            overlap = False
         if overlap and n_micro > 1 and self.plan.n_stages > 1:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -906,12 +996,26 @@ class DistributedModel:
     # checkpointing (net-new: the reference has no mid-training
     # checkpoint/resume, SURVEY §5 — Orbax-style save/restore + HF export)
     # ------------------------------------------------------------------
+    def _refuse_on_merged_mesh(self, what: str) -> None:
+        """Param-materializing ops (checkpoint, download) reach only the
+        PRIMARY of a merged stage, whose device_get cannot see the
+        coworkers' shards — and a gather there would deadlock (the
+        coworkers never receive the work item). Refuse loudly until these
+        paths are mirrored too."""
+        if self.plan is not None and any(
+            s.coworkers for s in self.plan.stages
+        ):
+            raise RuntimeError(
+                f"{what} on a co-slice merged mesh is not supported yet"
+            )
+
     def save_checkpoint(self, ckpt_dir: str) -> dict:
         """Each stage writes params (+ optimizer state) to ``ckpt_dir``
         (shared filesystem), plus a manifest for resume."""
         import json
         from pathlib import Path
 
+        self._refuse_on_merged_mesh("save_checkpoint")
         paths = []
         for stage in self.plan.stages:
             resp = self._request(
@@ -930,6 +1034,7 @@ class DistributedModel:
         return {"paths": paths}
 
     def restore_checkpoint(self, ckpt_dir: str) -> None:
+        self._refuse_on_merged_mesh("restore_checkpoint")
         for stage in self.plan.stages:
             self._request(
                 stage.worker_id, proto.CHECKPOINT,
@@ -973,6 +1078,7 @@ class DistributedModel:
     # ------------------------------------------------------------------
     def parameters(self) -> list[dict]:
         """Pull each stage's parameter tree (numpy) from its worker."""
+        self._refuse_on_merged_mesh("parameter download")
         out = []
         for stage in self.plan.stages:
             resp = self._request(

@@ -161,6 +161,16 @@ class DistributedWorker:
             pod = os.environ.get("TPU_NAME")
             if sidx is not None and probe.platform == "tpu" and pod:
                 sid = f"{pod}:{sidx}"
+            elif sidx is not None and probe.platform == "tpu":
+                # slice topology IS visible but unnamed — without the gate
+                # co-slice merging silently never triggers; tell the
+                # operator what to set instead of leaving it a mystery
+                self.log.info(
+                    "TPU slice detected (slice_index=%s) but TPU_NAME is "
+                    "unset — not advertising a slice_id; set TPU_NAME (or "
+                    "MLConfig.slice_id) to enable co-slice merged planning",
+                    sidx,
+                )
         if sid:
             out["slice_id"] = sid
         if probe.degraded:
@@ -389,6 +399,18 @@ class DistributedWorker:
         if n <= 1:
             return None
         devs = acquire_devices().devices
+        from tensorlink_tpu.parallel.multihost import is_multihost
+
+        if stage.get("coworkers") and is_multihost():
+            # a MERGED co-slice stage spans the pooled devices of every
+            # process in the jax.distributed runtime — the GLOBAL list
+            # (identically ordered on every process, so all members build
+            # the same mesh). Gated on the stage actually being merged: a
+            # multihost-joined worker running an ordinary local stage must
+            # never mesh over other processes' (non-addressable) devices.
+            import jax
+
+            devs = jax.devices()
         if n > len(devs):
             self.log.warning(
                 "plan wants %d-device mesh, have %d — running unsharded",
@@ -431,6 +453,50 @@ class DistributedWorker:
         if rt is None:
             raise KeyError(f"job {job_id} not loaded")
         return rt
+
+    # -- multihost (co-slice merged mesh) transfers ----------------------
+    @staticmethod
+    def _spans_processes(mesh) -> bool:
+        """True when this stage's mesh includes devices of OTHER processes
+        (a co-slice merged plan under jax.distributed)."""
+        if mesh is None:
+            return False
+        import jax
+
+        pi = jax.process_index()
+        return any(d.process_index != pi for d in mesh.devices.flat)
+
+    def _to_host(self, rt: "StageRuntime", arr):
+        """Device → host. On a process-spanning mesh a plain device_get
+        would fail on non-addressable shards — gather the full value
+        instead (a collective: every member process executes this inside
+        the same mirrored work item, so launches stay lockstep)."""
+        import jax
+
+        if self._spans_processes(rt.mesh):
+            from jax.experimental import multihost_utils
+
+            # tiled=True: for a global jax.Array this returns the FULL
+            # global value (per-process host data would be stacked instead)
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True)
+            )
+        return np.asarray(jax.device_get(arr))
+
+    def _to_device(self, rt: "StageRuntime", arr):
+        """Host → device. On a process-spanning mesh, commit host data
+        replicated over the stage mesh (every member received the same
+        bytes in its mirrored work item); otherwise a plain local array."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._spans_processes(rt.mesh):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                np.asarray(arr), NamedSharding(rt.mesh, PartitionSpec())
+            )
+        return jnp.asarray(np.asarray(arr))
 
     def _stage_fwd_fn(
         self,
@@ -599,11 +665,13 @@ class DistributedWorker:
         apply_head = stage["last"] and stage["holds_head"]
         kw: dict[str, Any] = {}
         if first:
-            kw["tokens"] = jnp.asarray(np.asarray(p["tokens"], np.int32))
+            kw["tokens"] = self._to_device(rt, np.asarray(p["tokens"], np.int32))
         else:
-            kw["hidden"] = jnp.asarray(np.asarray(p["hidden"]))
+            kw["hidden"] = self._to_device(rt, np.asarray(p["hidden"]))
         if p.get("attn_mask") is not None:
-            kw["attn_mask"] = jnp.asarray(np.asarray(p["attn_mask"], bool))
+            kw["attn_mask"] = self._to_device(
+                rt, np.asarray(p["attn_mask"], bool)
+            )
 
         # product-path SP/PP (VERDICT r1 #3): a plan whose mesh carries a
         # seq axis runs ring attention inside stage_forward; a stage axis
@@ -724,9 +792,19 @@ class DistributedWorker:
                 reply_peer, proto.FORWARD_RESP, p["rid"], {"token": tok}
             )
             return
+        host_out = self._to_host(rt, out)  # collective on spanning meshes —
+        # must run on EVERY member, so it happens before the mirror check
+        if p.get("mirror"):
+            # co-slice member of a mirrored work item: the launches above
+            # were this process's half of the SPMD programs; only the
+            # primary's response carries the payload
+            self._respond(
+                reply_peer, proto.FORWARD_RESP, p["rid"], {"ok": True}
+            )
+            return
         self._respond(
             reply_peer, proto.FORWARD_RESP, p["rid"],
-            {"out": np.asarray(jax.device_get(out)), "is_logits": is_logits},
+            {"out": host_out, "is_logits": is_logits},
         )
 
     def _sample_from_logits(self, rt: "StageRuntime", logits, p: dict) -> np.ndarray:
@@ -816,7 +894,7 @@ class DistributedWorker:
             rt.penalty_counts[session] = counts.at[
                 jnp.arange(counts.shape[0]), tok
             ].add(1)
-        return np.asarray(jax.device_get(tok))
+        return self._to_host(rt, tok)
 
     # -- backward (reference _handle_backward replays torch autograd,
     # ml/worker.py:233-291; here it applies the recorded vjp) -------------
@@ -832,7 +910,9 @@ class DistributedWorker:
         if entry is None:
             raise KeyError(f"no saved activations for tag {key!r}")
         kind, flags, x_in, mask, wrt_input = entry
-        g = jnp.asarray(np.asarray(p["grad"]), rt.cfg.dtype)
+        g = self._to_device(
+            rt, np.asarray(p["grad"])
+        ).astype(rt.cfg.dtype)
         if kind == "head":
             grad_params, grad_input = self._head_bwd(rt)(rt.params, x_in, g)
         else:
@@ -845,7 +925,10 @@ class DistributedWorker:
         self._accumulate(rt, grad_params)
         body = {"ok": True}
         if grad_input is not None:
-            body["grad"] = np.asarray(jax.device_get(grad_input))
+            host_g = self._to_host(rt, grad_input)  # collective when
+            # spanning — run on every member before any mirror slimming
+            if not p.get("mirror"):
+                body["grad"] = host_g
         self._respond(p["peer"], proto.BACKWARD_RESP, p["rid"], body)
 
     def _head_bwd(self, rt: StageRuntime):
@@ -903,7 +986,7 @@ class DistributedWorker:
             # combines stages into the true global norm so clipping matches
             # the single-program optimizer chain (engine/training.py)
             gn = (
-                float(jax.device_get(optax.global_norm(rt.grad_accum)))
+                float(self._to_host(rt, optax.global_norm(rt.grad_accum)))
                 if rt.grad_accum is not None
                 else 0.0
             )
@@ -926,7 +1009,7 @@ class DistributedWorker:
             rt.params = optax.apply_updates(rt.params, updates)
             if rt.engine is not None:
                 rt.engine.params = rt.params
-            gnorm = float(jax.device_get(optax.global_norm(rt.grad_accum)))
+            gnorm = float(self._to_host(rt, optax.global_norm(rt.grad_accum)))
             self._record_proof(rt, gnorm)
             rt.grad_accum = None
             rt.n_accum = 0
@@ -948,7 +1031,11 @@ class DistributedWorker:
             sketch = proofs.gradient_sketch(
                 rt.grad_accum, seed=int(rt.job_id[:8], 16)
             )
-        except (ValueError, TypeError):
+        except Exception:  # noqa: BLE001 — the sketch is telemetry; on a
+            # process-spanning mesh its per-leaf gathers may produce
+            # non-addressable outputs, and the proof CHAIN (hash over
+            # grad_norm) must keep growing regardless
+            self.log.debug("gradient sketch unavailable", exc_info=True)
             sketch = np.zeros(0)
         prev = rt.proof_log[-1]["hash"] if rt.proof_log else ""
         rt.proof_log.append(

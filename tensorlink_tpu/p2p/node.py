@@ -22,7 +22,6 @@ import asyncio
 import secrets
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Any, Awaitable, Callable
 
@@ -90,12 +89,10 @@ class P2PNode:
         # (node_id, role) -> bool, called off-loop (it may do blocking RPC).
         # None = local reputation only.
         self.credential_check: Callable[[str, str], bool] | None = None
-        # dedicated pool for credential checks: a timed-out check abandons
-        # its thread mid-RPC, and abandoning threads in the loop's DEFAULT
-        # executor would let repeated slow handshakes starve the bridge
-        # pumps and every other off-loop task node-wide. Lazily built;
-        # saturation here only rejects further handshakes (fail closed).
-        self._cred_pool: ThreadPoolExecutor | None = None
+        # count of credential-check threads abandoned mid-RPC (slow-drip
+        # registry endpoints) — each holds one daemon thread + socket until
+        # the RPC's 1 MB read cap runs out; exposed for observability
+        self._cred_abandoned = 0
         self.handlers: dict[str, Handler] = {}
         self.started = threading.Event()
         self.terminate = threading.Event()
@@ -149,10 +146,6 @@ class P2PNode:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
-        if self._cred_pool is not None:
-            # don't wait: an abandoned slow-drip check may never return
-            self._cred_pool.shutdown(wait=False, cancel_futures=True)
-            self._cred_pool = None
 
     async def _start_server(self) -> None:
         self._server = await asyncio.start_server(
@@ -211,25 +204,50 @@ class P2PNode:
         the refused peer sees a failed handshake on its own side."""
         if self.credential_check is None:
             return
-        if self._cred_pool is None:
-            self._cred_pool = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="cred-check"
+        # one DEDICATED daemon thread per check — not the loop's default
+        # executor (abandoned threads there starve the bridge pumps
+        # node-wide) and not a small fixed pool (a slow-drip registry
+        # endpoint resets the per-socket-op timeout every byte, so a
+        # handful of dripping checks would wedge the pool and deny
+        # authentication forever). Inbound handshakes are rate-limited per
+        # IP, which bounds thread creation; each abandoned thread is
+        # bounded by the RPC's 1 MB response cap.
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def run_check() -> None:
+            try:
+                ok = self.credential_check(node_id, role)
+            except BaseException as e:  # noqa: BLE001 — deliver, don't die
+                loop.call_soon_threadsafe(
+                    lambda: fut.set_exception(e) if not fut.done() else None
+                )
+                return
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(ok) if not fut.done() else None
             )
+
+        threading.Thread(
+            target=run_check, name="cred-check", daemon=True
+        ).start()
         try:
-            # total bound, not just the RPC's per-socket-op timeout: a
-            # slow-drip registry endpoint (1 byte per read) could otherwise
-            # hold this handshake open arbitrarily long. On timeout the
-            # pool thread is abandoned to finish; the handshake fails
-            # CLOSED now.
-            ok = await asyncio.wait_for(
-                asyncio.get_running_loop().run_in_executor(
-                    self._cred_pool, self.credential_check, node_id, role
-                ),
-                timeout=CREDENTIAL_CHECK_TIMEOUT,
-            )
+            # total bound, not just the RPC's per-socket-op timeout. On
+            # expiry the thread is abandoned to finish; the handshake
+            # fails CLOSED now.
+            ok = await asyncio.wait_for(fut, timeout=CREDENTIAL_CHECK_TIMEOUT)
         except asyncio.TimeoutError:
+            self._cred_abandoned += 1
+            self.log.warning(
+                "credential check for %s exceeded %.0fs — thread abandoned "
+                "(%d total); registry endpoint may be hostile or down",
+                node_id[:12], CREDENTIAL_CHECK_TIMEOUT, self._cred_abandoned,
+            )
             raise HandshakeError(
                 f"credential check for {node_id[:12]} timed out"
+            ) from None
+        except Exception as e:
+            raise HandshakeError(
+                f"credential check for {node_id[:12]} errored: {e}"
             ) from None
         if not ok:
             raise HandshakeError(

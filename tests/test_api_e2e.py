@@ -309,6 +309,36 @@ def test_repetition_penalties_pipelined_over_api(api_cluster):
             w.send_request("set_capacity", w.executor.capacity())
 
 
+def test_moe_model_serves_over_api(api_cluster):
+    """A Mixtral-family (sparse-MoE) model hosts and generates through the
+    full REST -> validator -> worker -> engine path (r4 weak #6: MoE
+    serving was unproven end-to-end on any backend)."""
+    api = api_cluster.api
+    cfg = ModelConfig(
+        family="mixtral", vocab_size=258, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, d_ff=128,
+        max_seq_len=256, n_experts=4, n_experts_per_tok=2,
+        dtype=jnp.float32,
+    ).to_json()
+    status, body = _req(
+        api, "POST", "/request-model",
+        {"hf_name": "tiny-moe", "config": cfg, "seq_len": 128},
+    )
+    assert status == 200 and body["status"] == "ready", body
+    base = {"hf_name": "tiny-moe", "message": "route me",
+            "max_new_tokens": 8, "do_sample": False}
+    status, body = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, body
+    assert body["usage"]["completion_tokens"] == 8
+    # deterministic: greedy repeats exactly
+    status, again = _req(api, "POST", "/v1/generate", base)
+    assert again["response"] == body["response"]
+    # and sampled decode works on the MoE path too
+    status, s = _req(api, "POST", "/v1/generate",
+                     {**base, "do_sample": True, "temperature": 0.8})
+    assert status == 200, s
+
+
 def test_generate_openai_format(api_cluster):
     api = api_cluster.api
     status, body = _req(

@@ -61,6 +61,46 @@ def test_expert_sharded_forward_parity():
     )
 
 
+def test_moe_engine_under_expert_mesh_serves(tmp_path):
+    """The FULL GenerationEngine (prefill + compiled decode loop) under an
+    expert-axis mesh emits the single-device engine's greedy tokens — MoE
+    SERVING, not just a layer forward (r4 weak #6: this path was recorded
+    as a compile-time dead end and never exercised; the blowup is gone)."""
+    import time
+
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models.transformer import cache_specs
+
+    cfg = moe_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(seq_buckets=(16,), batch_buckets=(1, 2), max_seq_len=64)
+    ref = GenerationEngine(cfg, params, **kw)
+    r = ref.generate_compiled([[5, 9, 2, 7]], max_new_tokens=8)
+
+    mesh = build_mesh({"expert": 2}, jax.devices("cpu")[:2])
+    specs = partition_specs(cfg, tensor_axis=None, expert_axis="expert")
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, jax.sharding.NamedSharding(mesh, s)),
+        params, specs,
+    )
+    t0 = time.time()
+    eng = GenerationEngine(
+        cfg, sharded, mesh=mesh,
+        cache_specs=cache_specs(cfg, data_axis=None, tensor_axis=None),
+        **kw,
+    )
+    g = eng.generate_compiled([[5, 9, 2, 7]], max_new_tokens=8)
+    compile_s = time.time() - t0
+    assert g.sequences == r.sequences
+    # the r3 "dead end" was a pathological compile (>10 min); keep a loose
+    # regression bound so a recurrence fails loudly rather than hanging CI
+    assert compile_s < 120, f"expert-mesh engine compile took {compile_s:.0f}s"
+    # batched serving too (the batcher's co-batch shape)
+    g2 = eng.generate_compiled([[5, 9, 2, 7], [3, 3, 1]], max_new_tokens=6)
+    r2 = ref.generate_compiled([[5, 9, 2, 7], [3, 3, 1]], max_new_tokens=6)
+    assert g2.sequences == r2.sequences
+
+
 # -- sparse (capacity-factor all-to-all) dispatch: parallel/expert.py ----
 
 
