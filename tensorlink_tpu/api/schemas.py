@@ -55,10 +55,13 @@ class GenerationRequest:
     # OpenAI-style stop sequences (the reference declares this field,
     # api/models.py:70, but never applies it — here output is truncated at
     # the earliest occurrence, streaming included via api/formatter.py
-    # StopStream). Decoding itself still runs to its token budget (no
-    # mid-loop cancel), so completion_tokens counts decoded tokens, not
-    # the truncated text; with enable_thinking=true the live stream is
-    # unfiltered (raw think text) and only the final answer is truncated.
+    # StopStream). A confirmed match CANCELS the row mid-loop on
+    # host-driven decode paths (pipelined sessions, streamed engine
+    # decode) and stops stream forwarding on the fully-compiled loop
+    # (which runs out its budget on device); completion_tokens always
+    # counts tokens generated THROUGH the match, not the full decode.
+    # With enable_thinking=true the live stream is unfiltered (raw think
+    # text) and only the final answer is truncated.
     stop: list[str] = field(default_factory=list)
 
     @staticmethod

@@ -584,10 +584,13 @@ class GenerationEngine:
         """Host-driven loop (supports per-token streaming callbacks).
 
         ``stream_cb`` receives, per step, one new token id per live row
-        (None for rows already finished). ``budgets`` caps rows
-        individually (the serving batcher mixes requests with different
-        max_new_tokens); each row is limited by its OWN budget and cache
-        room, so a long-prompt neighbor never truncates a short one."""
+        (None for rows already finished); it MAY return a collection of
+        row indices to CANCEL (e.g. a confirmed stop-sequence match
+        downstream) — those rows freeze immediately instead of decoding
+        to their budget. ``budgets`` caps rows individually (the serving
+        batcher mixes requests with different max_new_tokens); each row
+        is limited by its OWN budget and cache room, so a long-prompt
+        neighbor never truncates a short one."""
         sampling = sampling or SamplingParams.make()
         prompts = [list(p) for p in prompts]  # materialize: iterated again
         # below for the penalty counts, and a generator would be spent
@@ -631,7 +634,10 @@ class GenerationEngine:
                 if len(seqs[i]) >= eff[i]:
                     done[i] = True
             if stream_cb is not None:
-                stream_cb(emitted)
+                cancel = stream_cb(emitted)
+                for i in cancel or ():
+                    if 0 <= int(i) < B:
+                        done[int(i)] = True
             if done[:n_rows].all() or step == steps - 1:
                 break
             key, sub = jax.random.split(key)

@@ -197,12 +197,19 @@ class GenBatcher:
         budgets = [r.max_new_tokens for r in batch]
         emitted_counts = [0] * len(batch)
 
-        def demux(emitted: list[int | None]) -> None:
+        def demux(emitted: list[int | None]) -> list[int]:
+            # returns rows to CANCEL: a request's stream_cb may return
+            # truthy (confirmed stop-sequence match) — the decode loop
+            # freezes that row (host-driven paths) or the drain stops
+            # forwarding it (compiled-loop paths)
+            cancel: list[int] = []
             for i, r in enumerate(batch):
                 if i < len(emitted) and emitted[i] is not None:
                     if emitted_counts[i] < budgets[i] and r.stream_cb:
-                        r.stream_cb([int(emitted[i])])
+                        if r.stream_cb([int(emitted[i])]):
+                            cancel.append(i)
                     emitted_counts[i] += 1
+            return cancel
 
         any_stream = any(r.stream_cb for r in batch)
         self._seq += 1

@@ -652,6 +652,12 @@ class DistributedModel:
         t = threading.Thread(target=issue, daemon=True)
         t.start()
         B = len(prompts)
+        cancelled: set[int] = set()
+
+        def feed(row_map: dict[int, int]) -> None:
+            cancel = stream_cb([row_map.get(i) for i in range(B)])
+            cancelled.update(int(i) for i in cancel or ())
+
         while True:
             tk = self.node.send_request(
                 "next_tokens",
@@ -665,16 +671,33 @@ class DistributedModel:
                 cur: dict[int, int] = {}
                 for r, tok in tk["tokens"]:
                     if r in cur:
-                        stream_cb([cur.get(i) for i in range(B)])
+                        feed(cur)
                         cur = {}
                     cur[int(r)] = int(tok)
                 if cur:
-                    stream_cb([cur.get(i) for i in range(B)])
+                    feed(cur)
             if tk.get("done"):
+                break
+            if len(cancelled) >= B:
+                # every row's downstream (stop filters) confirmed a cancel:
+                # stop forwarding so the client stream closes NOW. The
+                # worker's compiled loop still runs out its budget (no
+                # mid-loop backchannel into the device loop yet); the
+                # response's sequences are truncated by the API layer.
                 break
             if tk.get("timeout") and not t.is_alive():
                 break
         t.join(timeout=MAX_WAIT_TIME)
+        if len(cancelled) >= B:
+            # early break never observed the done marker, so the relay's
+            # drop-on-done cleanup didn't run — release the buffer (the
+            # worker has responded by now, so its trailing pushes landed)
+            try:
+                self.node.send_request(
+                    "drop_stream", {"stream": stream_id}, timeout=10.0
+                )
+            except Exception:
+                pass
         if "err" in result:
             raise result["err"]
         return [list(map(int, s)) for s in result["resp"]["sequences"]]
@@ -779,7 +802,15 @@ class DistributedModel:
                     emitted.append(None)
                 done[i] |= int(tok[i]) in eos or len(seqs[i]) >= eff[i]
             if stream_cb is not None and any(e is not None for e in emitted):
-                stream_cb(emitted)
+                # the callback may return row indices to CANCEL (confirmed
+                # stop-sequence matches): those rows stop decoding NOW —
+                # the pipelined loop is host-driven, so a stop saves the
+                # remaining per-token stage hops instead of burning the
+                # full budget
+                cancel = stream_cb(emitted)
+                for i in cancel or ():
+                    if 0 <= int(i) < B:
+                        done[int(i)] = True
             if done.all() or step == steps - 1:
                 break
             tok = self.forward(

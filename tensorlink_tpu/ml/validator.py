@@ -435,10 +435,10 @@ class DistributedValidator:
             if delta:
                 _deliver(delta)
 
-        def stream_cb(new_tokens: list[int | None]) -> None:
+        def stream_cb(new_tokens: list[int | None]):
             nonlocal prefix_offset, read_offset
             if on_delta is None:
-                return
+                return None
             emitted_ids.extend(t for t in new_tokens if t is not None)
             prefix_text = tok.decode(emitted_ids[prefix_offset:read_offset])
             new_text = tok.decode(emitted_ids[prefix_offset:])
@@ -447,6 +447,12 @@ class DistributedValidator:
                 prefix_offset = read_offset
                 read_offset = len(emitted_ids)
                 _emit(delta)
+            if stream_stops is not None and stream_stops.stopped:
+                # confirmed stop match: truthy return cancels this row —
+                # host-driven decode loops stop generating it, compiled
+                # loops stop forwarding its stream
+                return [0]
+            return None
 
         n_beams = int(getattr(req, "num_beams", 1) or 1)
         multi_stage = (
@@ -533,15 +539,36 @@ class DistributedValidator:
         reasoning, answer = extract_reasoning_and_answer(full_text)
         hit_eos = bool(out_ids) and out_ids[-1] in eos
         finish = "stop" if hit_eos else "length"
+        completion = len(out_ids)
         hits = [i for i in (answer.find(s) for s in stop_list) if i != -1]
         if hits:
             answer = answer[: min(hits)]
             finish = "stop"
+            # bill tokens generated THROUGH the stop match, not the whole
+            # decode (OpenAI semantics): the smallest prefix of out_ids
+            # whose decoded answer contains a stop. Monotone in k, so
+            # binary search; host-driven decode paths also CANCEL at the
+            # match, while the fully-compiled loop runs out its budget —
+            # either way the count is the truncated output's.
+            def _stopped_at(k: int) -> bool:
+                r_, a_ = extract_reasoning_and_answer(
+                    tok.decode([t for t in out_ids[:k] if t not in eos])
+                )
+                return any(a_.find(s) != -1 for s in stop_list)
+
+            lo_k, hi_k = 1, len(out_ids)
+            while lo_k < hi_k:
+                mid = (lo_k + hi_k) // 2
+                if _stopped_at(mid):
+                    hi_k = mid
+                else:
+                    lo_k = mid + 1
+            completion = lo_k
         out = {
             "text": answer,
             "reasoning": reasoning,
             "prompt_tokens": len(ids),
-            "completion_tokens": len(out_ids),
+            "completion_tokens": completion,
             "finish_reason": finish,
         }
         if beams_used is not None and beams_used != n_beams:

@@ -228,6 +228,13 @@ class RoleServer(TensorNode):
         except asyncio.TimeoutError:
             return {"tokens": [], "done": False, "timeout": True}
 
+    async def cmd_drop_stream(self, p) -> bool:
+        """Release a stream buffer without draining it to the done marker
+        (stop-sequence cancel stops forwarding early; the generation's
+        trailing tokens would otherwise sit in the buffer forever)."""
+        self.drop_stream(p["stream"])
+        return True
+
     # -- stats ----------------------------------------------------------
     async def _handle_stats_request(self, conn, kind, tag, body) -> None:
         free = self.capacity["hbm_bytes"] - sum(self.reserved.values())

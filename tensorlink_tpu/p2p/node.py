@@ -44,6 +44,10 @@ REPLICATED_PREFIXES = ("job:", "proposal:")
 # client's socket timeouts are per-op, so a slow-drip registry endpoint
 # needs an overall ceiling (fails CLOSED on expiry)
 CREDENTIAL_CHECK_TIMEOUT = 15.0
+# cap on concurrently-outstanding credential-check threads (abandoned
+# slow-drip checks keep their thread alive past the timeout); at the cap
+# further handshakes fail closed immediately
+CREDENTIAL_CHECK_MAX_LIVE = 32
 
 
 class HandshakeError(Exception):
@@ -93,6 +97,10 @@ class P2PNode:
         # registry endpoints) — each holds one daemon thread + socket until
         # the RPC's 1 MB read cap runs out; exposed for observability
         self._cred_abandoned = 0
+        # outstanding credential-check threads; bounded so hostile traffic
+        # from many IPs cannot accumulate dripping threads without limit
+        self._cred_live = 0
+        self._cred_lock = threading.Lock()
         self.handlers: dict[str, Handler] = {}
         self.started = threading.Event()
         self.terminate = threading.Event()
@@ -209,9 +217,24 @@ class P2PNode:
         # node-wide) and not a small fixed pool (a slow-drip registry
         # endpoint resets the per-socket-op timeout every byte, so a
         # handful of dripping checks would wedge the pool and deny
-        # authentication forever). Inbound handshakes are rate-limited per
-        # IP, which bounds thread creation; each abandoned thread is
-        # bounded by the RPC's 1 MB response cap.
+        # authentication forever). Outstanding threads are CAPPED: at the
+        # cap new handshakes fail closed immediately (a wedge now needs
+        # that many concurrently dripping checks, with loud warnings the
+        # whole way), and each abandoned thread's lifetime is bounded by
+        # the RPC's 1 MB response cap.
+        with self._cred_lock:
+            if self._cred_live >= CREDENTIAL_CHECK_MAX_LIVE:
+                self.log.warning(
+                    "credential-check concurrency cap (%d) reached — "
+                    "refusing handshake with %s (fail closed); registry "
+                    "endpoint is likely hostile or down",
+                    CREDENTIAL_CHECK_MAX_LIVE, node_id[:12],
+                )
+                raise HandshakeError(
+                    f"credential check for {node_id[:12]} refused: "
+                    "checker saturated"
+                )
+            self._cred_live += 1
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
@@ -223,6 +246,9 @@ class P2PNode:
                     lambda: fut.set_exception(e) if not fut.done() else None
                 )
                 return
+            finally:
+                with self._cred_lock:
+                    self._cred_live -= 1
             loop.call_soon_threadsafe(
                 lambda: fut.set_result(ok) if not fut.done() else None
             )

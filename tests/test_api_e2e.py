@@ -233,11 +233,43 @@ def test_stop_sequences_truncate_and_stream(api_cluster):
     pieces = [json.loads(e).get("token", "") for e in events if e != "[DONE]"]
     assert "".join(pieces) == expected
 
+    # billing: completion_tokens counts tokens THROUGH the stop match,
+    # not the full decode budget (OpenAI semantics; the r4 divergence)
+    status, body = _req(api, "POST", "/v1/generate", {**base, "stop": stop_s})
+    assert body["usage"]["completion_tokens"] < ref["usage"]["completion_tokens"], body
+
     # validation: >4 stops rejected
     status, body = _req(
         api, "POST", "/v1/generate", {**base, "stop": ["a"] * 5}
     )
     assert status == 400
+
+
+def test_stop_sequences_cancel_pipelined_decode(api_cluster):
+    """On a 2-stage (host-driven session) model a confirmed stop match
+    CANCELS the row mid-loop — the decode stops at the match instead of
+    burning the remaining budget (observable via completion_tokens and
+    the truncated stream)."""
+    api = api_cluster.api
+    _host_two_stage(api_cluster)
+    base = {"hf_name": "tiny-2stage", "message": "go", "max_new_tokens": 24,
+            "do_sample": False}
+    status, ref = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, ref
+    text = ref["response"]
+    if len(text) < 4:
+        pytest.skip("reference output too short to carve a stop from")
+    stop_s = text[2:4]
+    expected = text[: text.find(stop_s)]
+    status, events = _sse(
+        api, "/v1/generate", {**base, "stop": [stop_s], "stream": True}
+    )
+    assert status == 200
+    final = json.loads(events[-2]) if events[-1] == "[DONE]" else None
+    pieces = [json.loads(e).get("token", "") for e in events if e != "[DONE]"]
+    assert "".join(pieces) == expected
+    if final and "usage" in final:
+        assert final["usage"]["completion_tokens"] < 24
 
 
 def test_repetition_penalties_over_api(api_cluster):
@@ -263,14 +295,16 @@ def test_repetition_penalties_over_api(api_cluster):
     assert status == 400  # out of [-2, 2]
 
 
-def test_repetition_penalties_pipelined_over_api(api_cluster):
-    """Penalties against a 2-STAGE hosted model (r4 weak #5 / directive 5:
-    these requests used to 400): shrink both workers' advertised capacity
-    so a 6-layer model must split, host it over REST, and check the knob
-    both works and bites."""
+def _host_two_stage(api_cluster) -> None:
+    """Host (or reuse) 'tiny-2stage' as a genuinely 2-stage pipelined
+    model: shrink each worker's capacity so a 6-layer model must split
+    (the planner works from FREE bytes = capacity - reservations of models
+    hosted by earlier tests), host over REST, then restore capacities."""
+    job = api_cluster.executor.hosted.get("tiny-2stage")
+    if job is not None and job.status == "ready":
+        assert job.model.plan.n_stages == 2, job.model.plan
+        return
     api = api_cluster.api
-    # the planner works from FREE bytes (capacity - reservations of models
-    # hosted by earlier tests) — shrink each worker so ~3.4 MB is free
     stats = api_cluster.executor.bridge.request("stats_workers", timeout=15.0)
     reserved = {
         s["id"]: float(s["hbm_bytes"]) - float(s["free_bytes"]) for s in stats
@@ -294,19 +328,25 @@ def test_repetition_penalties_pipelined_over_api(api_cluster):
         assert status == 200 and body["status"] == "ready", body
         job = api_cluster.executor.hosted["tiny-2stage"]
         assert job.model.plan.n_stages == 2, job.model.plan
-
-        base = {"hf_name": "tiny-2stage", "message": "aa bb aa bb",
-                "max_new_tokens": 16, "do_sample": False}
-        status, plain = _req(api, "POST", "/v1/generate", base)
-        assert status == 200, plain
-        status, pen = _req(
-            api, "POST", "/v1/generate", {**base, "presence_penalty": 2.0},
-        )
-        assert status == 200, pen  # used to be a 400 on multi-stage
-        assert pen["response"] != plain["response"]  # the knob bites
     finally:
         for w in api_cluster.test_workers:
             w.send_request("set_capacity", w.executor.capacity())
+
+
+def test_repetition_penalties_pipelined_over_api(api_cluster):
+    """Penalties against a 2-STAGE hosted model (r4 weak #5 / directive 5:
+    these requests used to 400): the knob both works and bites."""
+    api = api_cluster.api
+    _host_two_stage(api_cluster)
+    base = {"hf_name": "tiny-2stage", "message": "aa bb aa bb",
+            "max_new_tokens": 16, "do_sample": False}
+    status, plain = _req(api, "POST", "/v1/generate", base)
+    assert status == 200, plain
+    status, pen = _req(
+        api, "POST", "/v1/generate", {**base, "presence_penalty": 2.0},
+    )
+    assert status == 200, pen  # used to be a 400 on multi-stage
+    assert pen["response"] != plain["response"]  # the knob bites
 
 
 def test_moe_model_serves_over_api(api_cluster):
