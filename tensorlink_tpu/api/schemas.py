@@ -48,9 +48,10 @@ class GenerationRequest:
     # greedy tokens, so honoring it is always safe; ignored when sampling.
     lookahead: bool = False
     # beam search width (the reference forwards num_beams to HF generate,
-    # ml/formatter.py:88-92; here engine/generate.py::generate_beam).
-    # >1: deterministic beam decode — sampling knobs are ignored, streaming
-    # is rejected, single-stage models only.
+    # ml/formatter.py:88-92; here engine/generate.py::generate_beam on
+    # whole-model jobs and ml/module.py::_generate_beam_pipelined on
+    # multi-stage jobs). >1: deterministic beam decode — sampling knobs
+    # are ignored, streaming is rejected.
     num_beams: int = 1
     # OpenAI-style stop sequences (the reference declares this field,
     # api/models.py:70, but never applies it — here output is truncated at

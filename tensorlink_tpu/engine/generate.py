@@ -188,6 +188,49 @@ def _beam_topk(logits, k: int):
     return jax.lax.top_k(logp, k)
 
 
+def beam_frontier_step(
+    beams: list, scores, alive: list, done_pool: list,
+    vals, idx, K: int, eos_set: set, room: int, length_penalty: float,
+):
+    """Pure host-side frontier advance shared by the engine's beam session
+    and the pipelined (multi-stage) beam driver (ml/module.py): fold the
+    per-beam device top-k candidates ``vals/idx [K, kk]`` into the next
+    frontier. Returns ``(beams, scores, alive, src)`` — ``src`` names each
+    surviving beam's source row for the KV-cache reorder — or ``None``
+    when no live candidates remain. ``done_pool`` is appended in place."""
+    kk = vals.shape[1]
+    cand: list[tuple[float, int, int]] = []  # (score, beam, token)
+    for k in range(K):
+        if not alive[k]:
+            continue
+        for j in range(kk):
+            cand.append((scores[k] + float(vals[k, j]), k, int(idx[k, j])))
+    cand.sort(key=lambda c: -c[0])
+    new_beams, new_scores, new_alive, src = [], [], [], []
+    for sc, k, t in cand:
+        if len(new_beams) >= K:
+            break
+        seq = beams[k] + [t]
+        if t in eos_set or len(seq) >= room:
+            done_pool.append((sc / (len(seq) ** length_penalty), seq))
+            if t in eos_set:
+                continue  # finished beams leave the frontier
+        new_beams.append(seq)
+        new_scores.append(sc)
+        new_alive.append(t not in eos_set and len(seq) < room)
+        src.append(k)
+    if not new_beams:
+        return None
+    # pad the frontier back to K rows (duplicates of row 0 — masked out by
+    # alive=False)
+    while len(new_beams) < K:
+        new_beams.append(new_beams[0])
+        new_scores.append(-np.inf)
+        new_alive.append(False)
+        src.append(src[0])
+    return new_beams, np.asarray(new_scores), new_alive, src
+
+
 @dataclass
 class BeamState:
     """Resumable beam-search session (engine.beam_start/advance/finish).
@@ -737,43 +780,14 @@ class GenerationEngine:
             )
             # [K, kk] scores+ids — the ONLY device->host transfer per step
             vals, idx = _beam_topk(logits[:K], kk)
-            vals = np.asarray(vals)
-            idx = np.asarray(idx)
-            cand: list[tuple[float, int, int]] = []  # (score, beam, token)
-            for k in range(K):
-                if not st.alive[k]:
-                    continue
-                for j in range(kk):
-                    cand.append(
-                        (st.scores[k] + float(vals[k, j]), k, int(idx[k, j]))
-                    )
-            cand.sort(key=lambda c: -c[0])
-            new_beams, new_scores, new_alive, src = [], [], [], []
-            for sc, k, t in cand:
-                if len(new_beams) >= K:
-                    break
-                seq = st.beams[k] + [t]
-                if t in st.eos_set or len(seq) >= st.room:
-                    st.done_pool.append(
-                        (sc / (len(seq) ** st.length_penalty), seq)
-                    )
-                    if t in st.eos_set:
-                        continue  # finished beams leave the frontier
-                new_beams.append(seq)
-                new_scores.append(sc)
-                new_alive.append(t not in st.eos_set and len(seq) < st.room)
-                src.append(k)
-            if not new_beams:
+            nxt = beam_frontier_step(
+                st.beams, st.scores, st.alive, st.done_pool,
+                np.asarray(vals), np.asarray(idx), K,
+                st.eos_set, st.room, st.length_penalty,
+            )
+            if nxt is None:
                 break
-            # pad the frontier back to K rows (duplicates of row 0 — they
-            # are masked out by alive=False)
-            while len(new_beams) < K:
-                new_beams.append(new_beams[0])
-                new_scores.append(-np.inf)
-                new_alive.append(False)
-                src.append(src[0])
-            st.beams, st.alive = new_beams, new_alive
-            st.scores = np.asarray(new_scores)
+            st.beams, st.scores, st.alive, src = nxt
             # reorder every beam's cache row to follow its source beam
             gidx = jnp.asarray(np.resize(np.asarray(src, np.int32), (st.B,)))
             st.cache = KVCache(

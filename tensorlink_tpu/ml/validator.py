@@ -417,16 +417,28 @@ class DistributedValidator:
         # and only the final answer field is truncated, since a stop match
         # inside the think block must not silence the whole stream.
         stop_list = list(getattr(req, "stop", []) or [])
+        multi_stage = (
+            job.model is not None
+            and getattr(job.model, "plan", None) is not None
+            and job.model.plan.n_stages > 1
+        )
+        # stop DETECTION also runs for NON-streamed requests on pipelined
+        # models: their decode is host-driven anyway, so a confirmed match
+        # cancels the loop and saves the remaining per-token stage hops.
+        # (Non-streamed single-stage requests stay on the fully-compiled
+        # loop — trading it for a host loop to enable cancel would cost
+        # far more than the cancel saves.)
         stream_stops = (
-            StopStream(stop_list, on_delta)
-            if stop_list and stripper is not None and on_delta is not None
+            StopStream(stop_list, on_delta or (lambda _s: None))
+            if stop_list and stripper is not None
+            and (on_delta is not None or multi_stage)
             else None
         )
 
         def _deliver(delta: str) -> None:
             if stream_stops is not None:
                 stream_stops.feed(delta)
-            else:
+            elif on_delta is not None:
                 on_delta(delta)
 
         def _emit(delta: str) -> None:
@@ -435,9 +447,11 @@ class DistributedValidator:
             if delta:
                 _deliver(delta)
 
+        use_cb = on_delta is not None or stream_stops is not None
+
         def stream_cb(new_tokens: list[int | None]):
             nonlocal prefix_offset, read_offset
-            if on_delta is None:
+            if not use_cb:
                 return None
             emitted_ids.extend(t for t in new_tokens if t is not None)
             prefix_text = tok.decode(emitted_ids[prefix_offset:read_offset])
@@ -455,15 +469,10 @@ class DistributedValidator:
             return None
 
         n_beams = int(getattr(req, "num_beams", 1) or 1)
-        multi_stage = (
-            job.model is not None
-            and getattr(job.model, "plan", None) is not None
-            and job.model.plan.n_stages > 1
-        )
-        if n_beams > 1 and multi_stage:
-            from tensorlink_tpu.api.schemas import ValidationError
-
-            raise ValidationError("beam search needs a single-stage model")
+        # beam search works on BOTH distributions: the engine session on
+        # whole-model jobs, the session-cached stage chain on pipelined
+        # jobs (ml/module.py::_generate_beam_pipelined) — the r4 400s for
+        # multi-stage beams and penalties are both gone.
         # presence/frequency penalties work on BOTH distributions: the
         # engine path carries counts in its compiled loop, the pipelined
         # path keeps them session-resident on the head-holding worker
@@ -502,7 +511,7 @@ class DistributedValidator:
                 top_p=args["top_p"],
                 presence_penalty=args["presence_penalty"],
                 frequency_penalty=args["frequency_penalty"],
-                stream_cb=stream_cb if on_delta is not None else None,
+                stream_cb=stream_cb if use_cb else None,
                 lookahead=spec,
             )
         else:
@@ -516,7 +525,7 @@ class DistributedValidator:
                     presence_penalty=args["presence_penalty"],
                     frequency_penalty=args["frequency_penalty"],
                     eos_ids=tok.eos_ids,
-                    stream_cb=stream_cb if on_delta is not None else None,
+                    stream_cb=stream_cb if use_cb else None,
                     lookahead=spec,
                 )
             out_ids = seqs[0]

@@ -724,6 +724,20 @@ class DistributedWorker:
         cache = None
         if session is not None:
             cache = rt.sessions.get(session)
+            if cache is not None and p.get("reorder_idx") is not None:
+                # pipelined beam search: this step's cache rows follow
+                # their beam's source row (the same [:, idx] gather the
+                # engine-side beam session does) — the permutation rides
+                # the forward body, so no extra per-stage round-trip
+                gidx = jnp.asarray(np.asarray(p["reorder_idx"], np.int32))
+                cache = KVCache(
+                    k=cache.k[:, gidx], v=cache.v[:, gidx],
+                    length=cache.length[gidx],
+                    k_scale=None if cache.k_scale is None
+                    else cache.k_scale[:, gidx],
+                    v_scale=None if cache.v_scale is None
+                    else cache.v_scale[:, gidx],
+                )
             if cache is None:
                 batch = (kw.get("tokens") if first else kw["hidden"]).shape[0]
                 scfg = rt.cfg.with_(n_layers=rt.n_layers)
@@ -746,7 +760,7 @@ class DistributedWorker:
     # chain fields every forwarded hop must carry onward
     _CHAIN_KEYS = (
         "job_id", "session", "cache_len", "attn_mask", "sample",
-        "last_idx", "reply_to",
+        "last_idx", "reply_to", "reorder_idx",
     )
 
     def _finish_fwd(self, rt: "StageRuntime", p: dict, out, is_logits: bool) -> None:
@@ -784,6 +798,17 @@ class DistributedWorker:
             return
         reply_peer = p.get("reply_to") or p["peer"]
         if p.get("sample") is not None and is_logits:
+            samp = p["sample"]
+            if samp.get("beam_k"):
+                # pipelined beam search: ship K x (K+n_eos) candidate
+                # (score, id) pairs from an on-device top-k — not [K, V]
+                # logits — to the frontier driver (ml/module.py)
+                vals, idx = self._beam_topk_from_logits(rt, out, p)
+                self._respond(
+                    reply_peer, proto.FORWARD_RESP, p["rid"],
+                    {"beam_vals": vals, "beam_idx": idx},
+                )
+                return
             # final logits of a decode step: sample on-worker and ship one
             # token id per row — the per-token logits transfer (~600 KB at
             # a 151k vocab) never leaves the device host
@@ -806,6 +831,31 @@ class DistributedWorker:
             reply_peer, proto.FORWARD_RESP, p["rid"],
             {"out": host_out, "is_logits": is_logits},
         )
+
+    def _beam_topk_from_logits(self, rt: "StageRuntime", logits, p: dict):
+        """Head-worker half of PIPELINED beam search: gather each row's
+        step logits, take the top-(K+n_eos) of the log-softmax on device
+        (engine/generate.py::_beam_topk — tie-break parity with stable
+        argsort is pinned there) and return host arrays."""
+        import jax.numpy as jnp
+
+        from tensorlink_tpu.engine.generate import _beam_topk
+
+        samp = p["sample"]
+        last_idx = p.get("last_idx")
+        if logits.ndim == 3:
+            B = logits.shape[0]
+            if last_idx is not None:
+                gidx = jnp.asarray(np.asarray(last_idx, np.int32))
+            else:
+                gidx = jnp.full((B,), logits.shape[1] - 1, jnp.int32)
+            step_logits = logits[jnp.arange(B), gidx]
+        else:
+            step_logits = logits
+        K = int(samp["beam_k"])
+        kk = K + int(samp.get("beam_n_eos", 0))
+        vals, idx = _beam_topk(step_logits[:K], max(kk, 1))
+        return self._to_host(rt, vals), self._to_host(rt, idx)
 
     def _sample_from_logits(self, rt: "StageRuntime", logits, p: dict) -> np.ndarray:
         """Worker-side sampling for pipelined decode (ml/module.py

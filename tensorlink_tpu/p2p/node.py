@@ -238,20 +238,24 @@ class P2PNode:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
+        def deliver(cb) -> None:
+            try:
+                loop.call_soon_threadsafe(cb)
+            except RuntimeError:
+                pass  # loop already closed (node stopping) — result moot
+
         def run_check() -> None:
             try:
                 ok = self.credential_check(node_id, role)
             except BaseException as e:  # noqa: BLE001 — deliver, don't die
-                loop.call_soon_threadsafe(
+                deliver(
                     lambda: fut.set_exception(e) if not fut.done() else None
                 )
                 return
             finally:
                 with self._cred_lock:
                     self._cred_live -= 1
-            loop.call_soon_threadsafe(
-                lambda: fut.set_result(ok) if not fut.done() else None
-            )
+            deliver(lambda: fut.set_result(ok) if not fut.done() else None)
 
         threading.Thread(
             target=run_check, name="cred-check", daemon=True

@@ -348,6 +348,14 @@ def test_repetition_penalties_pipelined_over_api(api_cluster):
     assert status == 200, pen  # used to be a 400 on multi-stage
     assert pen["response"] != plain["response"]  # the knob bites
 
+    # beam search works on the pipelined distribution too (r4: 400)
+    status, beam = _req(
+        api, "POST", "/v1/generate",
+        {**base, "num_beams": 3, "presence_penalty": 0.0},
+    )
+    assert status == 200, beam
+    assert beam["usage"]["completion_tokens"] > 0
+
 
 def test_moe_model_serves_over_api(api_cluster):
     """A Mixtral-family (sparse-MoE) model hosts and generates through the
