@@ -30,6 +30,27 @@ from tensorlink_tpu.p2p import protocol as proto
 MAX_WAIT_TIME = 150.0  # reference ml/module.py:58
 
 
+def _any_nonzero(v) -> bool:
+    """True when a scalar-or-per-row sampling knob has any nonzero entry
+    (None coerces to 0)."""
+    vals = v if isinstance(v, (list, tuple, np.ndarray)) else [v]
+    return any(float(x or 0.0) != 0.0 for x in vals)
+
+
+def _head_result(resp: dict):
+    """Decode a head-worker FORWARD response into its terminal result:
+    sampled token ids, speculative per-position argmax ids, or beam
+    candidate (vals, idx) — or None when the response carries a plain
+    activation/logits array (``resp["out"]``)."""
+    if "token" in resp:
+        return np.asarray(resp["token"], np.int32)
+    if "verify_ids" in resp:
+        return np.asarray(resp["verify_ids"], np.int32)
+    if "beam_vals" in resp:
+        return np.asarray(resp["beam_vals"]), np.asarray(resp["beam_idx"])
+    return None
+
+
 class JobDeclinedError(RuntimeError):
     pass
 
@@ -406,6 +427,7 @@ class DistributedModel:
         sample: dict | None = None,
         last_idx: np.ndarray | None = None,
         reorder_idx: np.ndarray | None = None,
+        reset_len: int | None = None,
     ) -> np.ndarray:
         """Chain the pipeline stages; returns logits ``[B, T, V]``.
 
@@ -430,6 +452,10 @@ class DistributedModel:
             # permutation rides the forward (and the worker chain), so no
             # extra per-stage round-trips
             body_common["reorder_idx"] = np.asarray(reorder_idx, np.int32)
+        if reset_len is not None:
+            # speculative decode: roll back the previous verify pass's
+            # rejected cache positions before this step (same piggyback)
+            body_common["reset_len"] = int(reset_len)
         if attn_mask is not None:
             body_common["attn_mask"] = np.asarray(attn_mask, bool)
 
@@ -484,13 +510,9 @@ class DistributedModel:
             if head_on_last and stage is last:
                 body = samp_body(body)
             resp = self._request_mirrored(stage, proto.FORWARD, body)
-            if "token" in resp:
-                return np.asarray(resp["token"], np.int32)
-            if "beam_vals" in resp:  # pipelined beam candidates [K, kk]
-                return (
-                    np.asarray(resp["beam_vals"]),
-                    np.asarray(resp["beam_idx"]),
-                )
+            res = _head_result(resp)
+            if res is not None:
+                return res
             out = np.asarray(resp["out"])
 
         if not head_on_last:
@@ -500,13 +522,9 @@ class DistributedModel:
                 proto.FORWARD,
                 samp_body({"job_id": self.job_id, "op": "head", "hidden": out}),
             )
-            if "token" in resp:
-                return np.asarray(resp["token"], np.int32)
-            if "beam_vals" in resp:
-                return (
-                    np.asarray(resp["beam_vals"]),
-                    np.asarray(resp["beam_idx"]),
-                )
+            res = _head_result(resp)
+            if res is not None:
+                return res
             out = np.asarray(resp["out"])
         return out
 
@@ -539,10 +557,9 @@ class DistributedModel:
             no_repair=body_common.get("session") is not None,
         )
         self.chain_forwards += 1
-        if "token" in resp:
-            return np.asarray(resp["token"], np.int32)
-        if "beam_vals" in resp:  # pipelined beam candidates [K, kk]
-            return np.asarray(resp["beam_vals"]), np.asarray(resp["beam_idx"])
+        res = _head_result(resp)
+        if res is not None:
+            return res
         return np.asarray(resp["out"])
 
     __call__ = forward
@@ -605,6 +622,22 @@ class DistributedModel:
             return self._generate_beam_pipelined(
                 prompts, num_beams=int(num_beams),
                 max_new_tokens=max_new_tokens, eos_ids=eos_ids,
+            )
+
+        if (
+            lookahead and len(list(prompts)) == 1
+            and not isinstance(temperature, (list, tuple))
+            and float(temperature) <= 0.0
+            and not _any_nonzero(presence_penalty)
+            and not _any_nonzero(frequency_penalty)
+        ):
+            # prompt-lookup speculation on the PIPELINED path: per-token
+            # cost here is dominated by the cross-stage hops, so accepted
+            # drafts divide the number of round trips. Greedy B=1 only —
+            # the emitted tokens are exactly the vanilla sequence.
+            return self._generate_lookahead_pipelined(
+                prompts, max_new_tokens=max_new_tokens, eos_ids=eos_ids,
+                stream_cb=stream_cb,
             )
         return self._generate_pipelined(
             prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -809,12 +842,8 @@ class DistributedModel:
             "seed": int(seed),
         }
 
-        def nonzero(v):
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            return any(float(x or 0.0) != 0.0 for x in vals)
-
         samp0 = dict(samp, step=0)
-        if nonzero(presence_penalty) or nonzero(frequency_penalty):
+        if _any_nonzero(presence_penalty) or _any_nonzero(frequency_penalty):
             # the head-holding worker sees hidden states, not token ids —
             # ship the prompt once so it can seed the session's [B, V]
             # context counts (subsequent steps fold sampled tokens in
@@ -950,6 +979,107 @@ class DistributedModel:
                     )
             _score, best = max(done_pool, key=lambda d: d[0])
             return [best]
+        finally:
+            for stage in self.plan.stages:
+                try:
+                    self._request(
+                        stage.worker_id, proto.FORWARD,
+                        {"job_id": self.job_id, "op": "end_session",
+                         "session": session},
+                        timeout=10.0,
+                    )
+                except Exception:
+                    pass
+
+    def _generate_lookahead_pipelined(
+        self, prompts, *, max_new_tokens: int, eos_ids=(),
+        n_draft: int = 8, stream_cb=None,
+    ) -> list[list[int]]:
+        """Greedy decode with prompt-lookup speculation across PIPELINED
+        stages (B=1): draft from the token history's own n-grams
+        (engine/generate.py::_lookup_draft — longest suffix first), verify
+        the whole draft in ONE multi-token session forward (the head
+        worker ships per-position argmax ids), keep the matched prefix +
+        correction, and roll back rejected cache positions via a
+        length-reset that rides the next forward. Emits EXACTLY the
+        vanilla greedy sequence; every accepted token is one fewer
+        full-pipeline round trip."""
+        from tensorlink_tpu.engine.generate import GenerationEngine
+
+        prompts = [list(map(int, p)) for p in prompts]
+        if len(prompts) != 1:
+            raise ValueError("lookahead decode is B=1")
+        prompt = prompts[0]
+        eos_set = set(int(e) for e in eos_ids)
+        cache_len = min(self.spec["seq_len"], len(prompt) + max_new_tokens)
+        limit = min(max_new_tokens, cache_len - len(prompt))
+        if limit <= 0:
+            return [[]]
+        session = secrets.token_hex(8)
+        lookup = GenerationEngine._lookup_draft
+        try:
+            toks = np.asarray([prompt], np.int32)
+            mask = np.ones((1, len(prompt)), bool)
+            # prefill: greedy sample of the last position (existing mode)
+            tok = int(self.forward(
+                toks, mask, session=session, cache_len=cache_len,
+                sample={"temperature": 0.0, "seed": 0, "step": 0},
+                last_idx=np.asarray([len(prompt) - 1], np.int32),
+            )[0])
+            history = list(prompt) + [tok]
+            seq = [tok]
+            if stream_cb is not None:
+                stream_cb([tok])
+            cur_len = len(prompt)  # cache rows written past the prompt
+            # pending rollback: set AFTER a verify pass, applied on the
+            # next forward (piggybacked reset_len)
+            pending_reset: int | None = None
+            while len(seq) < limit and tok not in eos_set:
+                remaining = limit - len(seq)
+                k = min(n_draft, remaining - 1, cache_len - cur_len - 1 - 1)
+                draft = lookup(history, k) if k > 0 else []
+                pad_to = len(draft)
+                if cur_len + 1 + n_draft + 1 <= cache_len:
+                    # FIXED [1, 1+n_draft] verify shape whenever the cache
+                    # has room — variable lengths would compile one stage
+                    # program per length on every worker
+                    pad_to = n_draft if draft else 0
+                step_toks = np.zeros((1, 1 + pad_to), np.int32)
+                step_toks[0, 0] = tok
+                step_toks[0, 1 : 1 + len(draft)] = draft
+                targets = self.forward(
+                    step_toks, session=session, cache_len=cache_len,
+                    sample={"verify": True},
+                    reset_len=pending_reset,
+                )[0]
+                base = cur_len if pending_reset is None else pending_reset
+                cur_len = base + step_toks.shape[1]
+                accepted = 0
+                while (
+                    accepted < len(draft)
+                    and draft[accepted] == int(targets[accepted])
+                ):
+                    if draft[accepted] in eos_set:
+                        break
+                    accepted += 1
+                emitted = list(draft[:accepted]) + [int(targets[accepted])]
+                pending_reset = base + 1 + accepted
+                taken: list[int] = []
+                for t in emitted:
+                    seq.append(t)
+                    history.append(t)
+                    taken.append(t)
+                    tok = t
+                    if t in eos_set or len(seq) >= limit:
+                        break
+                cancelled = False
+                if stream_cb is not None and taken:
+                    for t in taken:  # per-token callback contract
+                        if stream_cb([t]):
+                            cancelled = True  # confirmed stop match (B=1)
+                if cancelled or tok in eos_set:
+                    break
+            return [seq[:limit]]
         finally:
             for stage in self.plan.stages:
                 try:

@@ -724,6 +724,19 @@ class DistributedWorker:
         cache = None
         if session is not None:
             cache = rt.sessions.get(session)
+            if cache is not None and p.get("reset_len") is not None:
+                # pipelined speculative decode: roll back the REJECTED
+                # draft positions of the previous verify pass by resetting
+                # the write offset (stale KV beyond it is invisible —
+                # attention masks by length). Rides the forward body like
+                # reorder_idx: no extra per-stage round-trip.
+                cache = KVCache(
+                    k=cache.k, v=cache.v,
+                    length=jnp.full_like(
+                        cache.length, int(p["reset_len"])
+                    ),
+                    k_scale=cache.k_scale, v_scale=cache.v_scale,
+                )
             if cache is not None and p.get("reorder_idx") is not None:
                 # pipelined beam search: this step's cache rows follow
                 # their beam's source row (the same [:, idx] gather the
@@ -760,7 +773,7 @@ class DistributedWorker:
     # chain fields every forwarded hop must carry onward
     _CHAIN_KEYS = (
         "job_id", "session", "cache_len", "attn_mask", "sample",
-        "last_idx", "reply_to", "reorder_idx",
+        "last_idx", "reply_to", "reorder_idx", "reset_len",
     )
 
     def _finish_fwd(self, rt: "StageRuntime", p: dict, out, is_logits: bool) -> None:
@@ -799,6 +812,19 @@ class DistributedWorker:
         reply_peer = p.get("reply_to") or p["peer"]
         if p.get("sample") is not None and is_logits:
             samp = p["sample"]
+            if samp.get("verify"):
+                # pipelined speculative decode: ship the ARGMAX id at
+                # EVERY position of this step — the driver accepts the
+                # matched draft prefix plus the correction token
+                # (engine/generate.py::generate_lookahead semantics)
+                import jax.numpy as jnp_
+
+                ids = self._to_host(rt, jnp_.argmax(out, axis=-1))
+                self._respond(
+                    reply_peer, proto.FORWARD_RESP, p["rid"],
+                    {"verify_ids": np.asarray(ids, np.int32)},
+                )
+                return
             if samp.get("beam_k"):
                 # pipelined beam search: ship K x (K+n_eos) candidate
                 # (score, id) pairs from an on-device top-k — not [K, V]

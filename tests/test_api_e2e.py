@@ -356,6 +356,14 @@ def test_repetition_penalties_pipelined_over_api(api_cluster):
     assert status == 200, beam
     assert beam["usage"]["completion_tokens"] > 0
 
+    # speculative decode too: {"lookahead": true} on a pipelined model
+    # emits exactly the vanilla greedy text (fewer pipeline round trips)
+    status, spec = _req(
+        api, "POST", "/v1/generate", {**base, "lookahead": True},
+    )
+    assert status == 200, spec
+    assert spec["response"] == plain["response"]
+
 
 def test_moe_model_serves_over_api(api_cluster):
     """A Mixtral-family (sparse-MoE) model hosts and generates through the

@@ -273,6 +273,17 @@ def test_pipelined_forward_and_generate_parity(cluster):
                             seed=124)
         assert s1 != s3  # astronomically unlikely to collide over 6 tokens
 
+        # speculative decode rides the pipelined session too: drafts
+        # verify in ONE multi-token session forward (head ships argmax
+        # ids per position; rejected KV rolls back via a length reset on
+        # the next forward) and the emitted tokens are EXACTLY vanilla
+        # greedy — on a repetitive prompt (drafts accept) and a plain one
+        rep_p = ([7, 3, 200, 9] * 5)[:18]
+        for pr in (prompt, rep_p):
+            spec_g = model.generate([pr], max_new_tokens=8, lookahead=True)
+            ref_g = engine.generate_compiled([pr], max_new_tokens=8)
+            assert spec_g[0] == ref_g.sequences[0], pr
+
         # beam search rides the pipelined session too (r4 weak #5: beams
         # used to need a single-stage job): the 2-stage beam decode must
         # equal the local engine's beam session exactly — same on-device
