@@ -73,6 +73,14 @@ class MLConfig:
     # serving: how many concurrent API requests one batched decode may
     # coalesce (ml/batching.py); bounded by the largest batch bucket
     max_serve_batch: int = 8
+    # streamed requests: >0 runs the decode as fully-compiled on-device
+    # chunks of this many steps (one host round trip per chunk instead of
+    # per token — engine/generate.py::generate_chunked); 0 keeps the
+    # per-token host loop (lowest time-to-first-delta on local devices).
+    # Set 16-64 when the chip is reached over a high-latency tunnel; a
+    # stop-sequence cancel still cuts the stream at the exact token (only
+    # device compute, not emission, runs to the chunk end).
+    stream_chunk_steps: int = 0
     # pre-compile the serving engine at host time for this many decode
     # tokens (engine.warmup) — 0 skips; when set, "ready" means every batch
     # bucket's smallest-prompt prefill + this token budget's decode loop is

@@ -174,8 +174,11 @@ def _decode_loop(
     if penalize:
         init = init + (counts,)
     final = jax.lax.while_loop(cond, body, init)
-    n_exec, _, cache, done, _, tokens = final[:6]
-    return tokens, cache, done, n_exec
+    n_exec, _, cache, done, key, tokens = final[:6]
+    # the advanced key lets chunked callers continue the EXACT per-step
+    # split chain across chunk boundaries (sampled parity with a single
+    # long loop)
+    return tokens, cache, done, n_exec, key
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -692,6 +695,113 @@ class GenerationEngine:
             sequences=seqs, prompt_lens=lens, finished=list(done[:n_rows])
         )
 
+    def generate_chunked(
+        self,
+        prompts: Iterable[Sequence[int]],
+        *,
+        max_new_tokens: int = 128,
+        sampling: SamplingParams | None = None,
+        eos_ids: Sequence[int] = (),
+        seed: int = 0,
+        stream_cb: Callable[[list[int | None]], None] | None = None,
+        budgets: Sequence[int] | None = None,
+        reuse_prefix: bool = False,
+        chunk_steps: int = 32,
+    ) -> GenerationResult:
+        """Streaming at COMPILED-loop speed: the decode runs as a sequence
+        of fully-on-device while_loop chunks (one program — ``chunk_steps``
+        is its static n_steps), with the host touched once per chunk
+        instead of once per token. Over a tunneled chip the per-token host
+        loop pays a round trip per token (the round-2 decode disaster,
+        reintroduced for every streamed request); this bounds it to one
+        round trip per ``chunk_steps`` tokens while keeping the stream
+        callback's PER-STEP contract (tokens are just delivered in chunk
+        batches). A cancel return from the callback stops that row's
+        emission IMMEDIATELY (the already-decoded remainder of the chunk
+        is discarded; only device compute runs to the chunk end).
+        Penalized requests fall back to the per-token host loop — context
+        counts don't ride across chunk calls.
+
+        (Prologue is deliberately parallel to ``generate`` /
+        ``generate_compiled`` — a semantic change to row limits, EOS
+        handling, or first-token sampling must be applied to all three.)"""
+        sampling = sampling or SamplingParams.make()
+        if self._penalized(sampling):
+            return self.generate(
+                prompts, max_new_tokens=max_new_tokens, sampling=sampling,
+                eos_ids=eos_ids, seed=seed, stream_cb=stream_cb,
+                budgets=budgets, reuse_prefix=reuse_prefix,
+            )
+        prompts = [list(p) for p in prompts]
+        logits, cache, lens, B = self.prefill(prompts, reuse_prefix=reuse_prefix)
+        sampling = sampling.pad_rows(B)
+        n_rows = len(lens)
+        eff = self._row_limits(lens, B, max_new_tokens, budgets)
+        eos_set = set(int(e) for e in eos_ids)
+        eos = jnp.asarray(list(eos_ids) or [-1], np.int32)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub, sampling, None)
+        dummy = jnp.zeros((1, 1), jnp.int32)
+        chunk_steps = max(int(chunk_steps), 1)
+
+        seqs: list[list[int]] = [[] for _ in range(n_rows)]
+        done = np.zeros(B, bool)
+        remaining = np.asarray(eff, np.int64)
+        done |= remaining <= 0
+
+        def emit(step_tokens: np.ndarray) -> None:
+            """Deliver one decode step's tokens (engine stream contract:
+            one entry per row, None for finished rows) and fold them into
+            the per-row sequences / done flags."""
+            emitted: list[int | None] = []
+            for i in range(n_rows):
+                if not done[i]:
+                    t = int(step_tokens[i])
+                    seqs[i].append(t)
+                    emitted.append(t)
+                    remaining[i] -= 1
+                    if t in eos_set or remaining[i] <= 0:
+                        done[i] = True
+                else:
+                    emitted.append(None)
+            if stream_cb is not None:
+                cancel = stream_cb(emitted)
+                for i in cancel or ():
+                    if 0 <= int(i) < B:
+                        done[int(i)] = True
+
+        emit(np.asarray(tok))
+        while not done[:n_rows].all():
+            # freeze finished rows for the whole chunk (limits <= 0 →
+            # done0 inside the loop); live rows run up to their remaining
+            # budget, capped by the chunk. The loop returns its ADVANCED
+            # key, so the per-step split chain continues across chunks —
+            # a chunked sampled decode emits exactly what one long
+            # compiled loop (or the per-token host loop, which walks the
+            # same chain) would emit for the same seed.
+            lims = jnp.asarray(np.where(done, 0, remaining), jnp.int32)
+            tokens, cache, _dd, n_exec, key = _decode_loop(
+                self.params, tok, cache, key, sampling, eos, lims,
+                dummy, self.cfg, chunk_steps, penalize=False,
+            )
+            n_exec = int(n_exec)
+            if n_exec <= 0:
+                break
+            toks_host = np.asarray(tokens)[:, :n_exec]
+            for s in range(n_exec):
+                emit(toks_host[:, s])
+                if done[:n_rows].all():
+                    break
+            # next chunk resumes from each row's LAST token (frozen rows
+            # re-fed their own token inside the loop, so column n_exec-1
+            # is correct for them too)
+            tok = jnp.asarray(toks_host[:, n_exec - 1].astype(np.int32))
+        del cache
+        return GenerationResult(
+            sequences=seqs, prompt_lens=lens, finished=list(done[:n_rows])
+        )
+
     # -- beam search ------------------------------------------------------
     def beam_start(
         self,
@@ -1003,7 +1113,7 @@ class GenerationEngine:
                 lims = jnp.asarray(
                     [remaining] + [0] * (B - 1), jnp.int32
                 )
-                tokens, cache, _done, n_exec = _decode_loop(
+                tokens, cache, _done, n_exec, _key = _decode_loop(
                     self.params, jnp.full((B,), tok, jnp.int32), cache,
                     jax.random.PRNGKey(0), sp, eos_arr, lims,
                     jnp.zeros((1, 1), jnp.int32), self.cfg, n_steps,
@@ -1220,7 +1330,7 @@ class GenerationEngine:
         while n_steps < total - 1:
             n_steps <<= 1
         n_steps = max(min(n_steps, self.max_seq_len), 1)
-        tokens, cache, done, n_exec = _decode_loop(
+        tokens, cache, done, n_exec, _key = _decode_loop(
             self.params, first, cache, key, sampling, eos, limits, counts,
             self.cfg, n_steps, penalize=pen,
         )

@@ -1275,8 +1275,8 @@ class DistributedWorker:
                      "done": True},
                 )
         elif stream_id:
-            result = rt.engine.generate(
-                prompts,
+            chunk = int(self.node.config.ml.stream_chunk_steps or 0)
+            gen_kw = dict(
                 max_new_tokens=int(p.get("max_new_tokens", 128)),
                 sampling=sampling,
                 eos_ids=p.get("eos_ids", ()),
@@ -1285,6 +1285,15 @@ class DistributedWorker:
                 budgets=budgets,
                 reuse_prefix=reuse_prefix,
             )
+            if chunk > 0:
+                # compiled-chunk streaming: one host round trip per
+                # `chunk` tokens instead of per token — the difference
+                # between usable and crawling streams over a tunneled chip
+                result = rt.engine.generate_chunked(
+                    prompts, chunk_steps=chunk, **gen_kw
+                )
+            else:
+                result = rt.engine.generate(prompts, **gen_kw)
             self.bridge.request(
                 "send_token",
                 {"peer": peer, "stream": stream_id, "tokens": [], "done": True},

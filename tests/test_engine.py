@@ -385,6 +385,73 @@ def test_lookahead_compiled_tail_matches_greedy(tiny_model):
         GenerationEngine._spec_worthwhile = orig
 
 
+def test_chunked_stream_decode_matches_compiled(tiny_model):
+    """generate_chunked (compiled on-device chunks, one host trip per
+    chunk) emits exactly the compiled loop's greedy tokens, honors
+    per-row budgets/EOS in batched mixes, keeps the per-step stream
+    callback contract, and cancels at chunk boundaries."""
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16, 32), batch_buckets=(1, 2), max_seq_len=64
+    )
+    prompts = [[1, 2, 3, 4, 5], [7, 8]]
+    ref = eng.generate_compiled(prompts, max_new_tokens=24, budgets=[24, 5])
+    for chunk in (1, 3, 8, 64):
+        got = eng.generate_chunked(
+            prompts, max_new_tokens=24, budgets=[24, 5], chunk_steps=chunk
+        )
+        assert got.sequences == ref.sequences, chunk
+        assert got.finished == ref.finished, chunk
+
+    # stream contract: per-step row vectors identical to the host loop's
+    host_emits, chunk_emits = [], []
+    eng.generate(prompts, max_new_tokens=12,
+                 stream_cb=lambda e: host_emits.append(list(e)))
+    eng.generate_chunked(prompts, max_new_tokens=12, chunk_steps=5,
+                         stream_cb=lambda e: chunk_emits.append(list(e)))
+    assert chunk_emits == host_emits
+
+    # EOS semantics
+    eos = ref.sequences[0][3]
+    ref_e = eng.generate_compiled(prompts, max_new_tokens=24, eos_ids=[eos])
+    got_e = eng.generate_chunked(
+        prompts, max_new_tokens=24, eos_ids=[eos], chunk_steps=4
+    )
+    assert got_e.sequences == ref_e.sequences
+
+    # sampled: the chunked loop continues the SAME per-step key chain
+    # across chunk boundaries, so it matches the one-shot compiled loop
+    # (and the host loop, which walks the same chain) exactly per seed
+    sp = SamplingParams.make(temperature=0.9)
+    s_ref = eng.generate_compiled(
+        prompts, max_new_tokens=10, seed=5, sampling=sp
+    )
+    for chunk in (1, 3, 64):
+        s_c = eng.generate_chunked(
+            prompts, max_new_tokens=10, chunk_steps=chunk, seed=5, sampling=sp
+        )
+        assert s_c.sequences == s_ref.sequences, chunk
+    s_host = eng.generate(prompts, max_new_tokens=10, seed=5, sampling=sp)
+    assert s_host.sequences == s_ref.sequences
+
+    # cancel at a chunk boundary: stop row 0 after its 6th token
+    count = [0]
+
+    def cancel_cb(emitted):
+        if emitted[0] is not None:
+            count[0] += 1
+            if count[0] >= 6:
+                return [0]
+        return None
+
+    got_c = eng.generate_chunked(
+        [prompts[0]], max_new_tokens=24, chunk_steps=4, stream_cb=cancel_cb
+    )
+    # emission stops IMMEDIATELY at the cancel (the chunk's already-decoded
+    # remainder is discarded; only device compute runs to the chunk end)
+    assert got_c.sequences[0] == ref.sequences[0][:6]
+
+
 def test_beam_topk_matches_argsort_semantics():
     """Device-side lax.top_k candidate selection must rank exactly like the
     old host np.argsort over the full vocab — including tie-breaking to the

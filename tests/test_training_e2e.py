@@ -94,7 +94,10 @@ def test_single_stage_training_parity(cluster):
     from tensorlink_tpu.ml.module import DistributedModel
 
     cfg = tiny_cfg()
-    batches = _batches(cfg, 3)
+    # 5 steps -> 4 consecutive-sketch cosines: with only 2 (3 steps) the
+    # PoL continuity median is a coin-flip of per-batch gradient direction
+    # noise on this tiny model and the verdict flaked near the -0.2 bar
+    batches = _batches(cfg, 5)
     ref_params, ref_losses = _local_reference(cfg, seed=21, batches=batches)
 
     with DistributedModel(
