@@ -538,21 +538,14 @@ def run_bench() -> None:
             jax.block_until_ready(m["loss"])
             return (time.perf_counter() - t0) / n_steps
 
-        # remat trades an extra forward (~25-33% of step FLOPs) for
-        # activation memory — when this config fits HBM without it, the
-        # no-remat step is strictly faster. Try that first; ONLY a memory
-        # failure falls back (any other error must surface, not be masked
-        # by a valid-looking remat number).
-        try:
-            step_dt = run_train(remat=False)
-            remat_used = False
-        except Exception as e:
-            msg = str(e).upper()
-            if not any(s in msg for s in ("RESOURCE_EXHAUSTED", "OOM",
-                                          "OUT OF MEMORY", "ALLOCAT")):
-                raise
-            step_dt = run_train(remat=True)
-            remat_used = True
+        # remat ON, always: the sharding planner sizes training stages
+        # assuming rematerialized activations (parallel/planner.py), so a
+        # no-remat number describes a configuration the system never
+        # schedules — BENCH_r05's train_remat:false measured exactly that
+        # phantom. The ~25-33% extra forward FLOPs are the price of the
+        # configuration that actually runs.
+        step_dt = run_train(remat=True)
+        remat_used = True
         # standard 6·N·D convention (remat's extra forward eats into MFU)
         train_flops = 6.0 * tcfg.param_count() * tbatch * tseq
         mfu = train_flops / step_dt / peak_flops

@@ -58,9 +58,13 @@ class GenerationRequest:
     # the earliest occurrence, streaming included via api/formatter.py
     # StopStream). A confirmed match CANCELS the row mid-loop on
     # host-driven decode paths (pipelined sessions, streamed engine
-    # decode) and stops stream forwarding on the fully-compiled loop
-    # (which runs out its budget on device); completion_tokens always
-    # counts tokens generated THROUGH the match, not the full decode.
+    # decode), and on the fully-compiled streamed loop it rides the
+    # STREAM_CANCEL backchannel to the worker, which polls at
+    # ``stream_chunk_steps`` chunk boundaries — overrun past a stop is at
+    # most one chunk, not the full token budget. Non-streamed single-stage
+    # requests keep the pure compiled loop (no cancel); completion_tokens
+    # always counts tokens generated THROUGH the match, not the full
+    # decode.
     # With enable_thinking=true the live stream is unfiltered (raw think
     # text) and only the final answer is truncated.
     stop: list[str] = field(default_factory=list)

@@ -160,6 +160,15 @@ class NodeConfig:
     keeper_interval: float = 300.0
     monitor_interval: float = 30.0
     proposal_interval: float = 3600.0  # contract round cadence (0 = manual)
+    # seconds a job's worker may be unreachable before the monitor recruits
+    # a replacement (platform/job_monitor.py)
+    offline_grace: float = 5.0
+    # deterministic fault-injection plan (core/faults.py): {} disables the
+    # layer entirely — no fault-site code runs on the hot paths. A non-empty
+    # plan is installed in BOTH halves of the node: the spawned network
+    # process (p2p.send / connection.frame sites) and the ML executor
+    # (worker.session_step / worker.train_step sites).
+    faults: dict = field(default_factory=dict)
 
     def effective_host(self) -> str:
         return "127.0.0.1" if self.local_test else self.host

@@ -1,0 +1,189 @@
+"""Deterministic fault injection for the P2P/ML stack.
+
+A :class:`FaultPlan` is a *seeded* list of rules keyed on named sites.
+Each site is a point in the stack where a real deployment fails — a frame
+on the wire, a decode-session step on a worker, an optimizer step mid
+fine-tune — and each rule says *what* goes wrong there (drop / delay /
+duplicate / crash / error) and *when* (the nth matching call, or a seeded
+coin flip). Given the same seed and the same call sequence, a plan makes
+identical decisions every run, so a chaos test that kills a worker on the
+4th decode step kills it on the 4th decode step forever.
+
+Wired sites:
+
+- ``p2p.send``        — every outbound frame (p2p/connection.py::send_frame);
+  supports drop / delay / dup.
+- ``connection.frame`` — every inbound frame (p2p/connection.py::run);
+  supports drop / delay / dup.
+- ``worker.session_step`` — every session-carrying FORWARD a worker applies
+  (ml/worker.py::_forward); supports error / crash.
+- ``worker.train_step``   — every optimizer step (ml/worker.py::_optimizer);
+  supports error / crash.
+
+Zero overhead when disabled: the network process guards every site with
+``if faults.ENABLED:`` (a module bool that is False unless a plan was
+installed), and the ML worker holds ``self.faults = None`` unless its
+NodeConfig carries a plan — the default configuration executes no
+fault-site code on the hot decode path beyond one predicate.
+
+Plans are plain dicts so they ride ``NodeConfig.faults`` through the
+spawn-pickled network process and the ML executor alike::
+
+    WorkerConfig(faults={
+        "seed": 7,
+        "rules": [
+            {"site": "worker.session_step", "op": "crash", "nth": 4},
+            {"site": "p2p.send", "op": "dup", "prob": 1.0,
+             "key_substr": "fwd", "max_fires": None},
+        ],
+    })
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+OPS = ("drop", "delay", "dup", "crash", "error")
+
+
+class FaultInjected(RuntimeError):
+    """An injected *recoverable* failure (op="error")."""
+
+
+class FaultCrash(BaseException):
+    """An injected node death (op="crash"). Derives from BaseException so
+    generic ``except Exception`` error-reply paths cannot swallow it — the
+    run loop that catches it must take the node down, not answer the
+    request."""
+
+
+@dataclass
+class FaultRule:
+    site: str
+    op: str  # drop | delay | dup | crash | error
+    nth: int | None = None  # fire on exactly the nth MATCHING call (1-based)
+    prob: float = 0.0  # else: fire with this seeded probability
+    delay_s: float = 0.05
+    key_substr: str = ""  # only calls whose key contains this substring
+    max_fires: int | None = 1  # None = unlimited
+    # mutable per-run state
+    seen: int = 0
+    fires: int = 0
+
+    def __post_init__(self):
+        if self.op not in OPS:
+            raise ValueError(f"unknown fault op {self.op!r} (want one of {OPS})")
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    rules: list[FaultRule] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        rules = []
+        for r in d.get("rules", []):
+            r = dict(r)
+            rules.append(
+                FaultRule(
+                    site=r["site"],
+                    op=r["op"],
+                    nth=r.get("nth"),
+                    prob=float(r.get("prob", 0.0)),
+                    delay_s=float(r.get("delay_s", 0.05)),
+                    key_substr=str(r.get("key_substr", "")),
+                    max_fires=r.get("max_fires", 1),
+                )
+            )
+        return cls(seed=int(d.get("seed", 0)), rules=rules)
+
+    def _coin(self, site: str, n: int) -> float:
+        """Deterministic uniform in [0, 1) for the nth call at a site —
+        a hash, not an RNG stream, so interleaved sites never perturb each
+        other's draws."""
+        h = hashlib.sha256(f"{self.seed}:{site}:{n}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2**64
+
+    def inject(self, site: str, key: str = ""):
+        """Decide this call's fate. Returns ``None`` (proceed), ``"drop"``,
+        ``"dup"``, or ``("delay", seconds)``; raises :class:`FaultInjected`
+        (op="error") or :class:`FaultCrash` (op="crash").
+
+        Every matching rule counts every call (so interleaved rules keep
+        deterministic nth semantics); the FIRST rule that fires decides the
+        action."""
+        decided: FaultRule | None = None
+        for r in self.rules:
+            if r.site != site:
+                continue
+            if r.key_substr and r.key_substr not in key:
+                continue
+            r.seen += 1
+            if decided is not None:
+                continue  # shadowed by an earlier rule, but still counted
+            if r.max_fires is not None and r.fires >= r.max_fires:
+                continue
+            if r.nth is not None:
+                fire = r.seen == r.nth
+            else:
+                fire = self._coin(site, r.seen) < r.prob
+            if not fire:
+                continue
+            r.fires += 1
+            decided = r
+        if decided is None:
+            return None
+        if decided.op == "error":
+            raise FaultInjected(
+                f"injected fault at {site} (call {decided.seen}, key={key!r})"
+            )
+        if decided.op == "crash":
+            raise FaultCrash(
+                f"injected crash at {site} (call {decided.seen}, key={key!r})"
+            )
+        if decided.op == "delay":
+            return ("delay", decided.delay_s)
+        return decided.op  # drop | dup
+
+
+# ---------------------------------------------------------------------------
+# Process-global plan (network process sites). The ML executor holds its own
+# per-node instance instead (ml/worker.py) so several in-process worker nodes
+# in a test never share fault state.
+# ---------------------------------------------------------------------------
+
+ENABLED = False
+_PLAN: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> None:
+    global ENABLED, _PLAN
+    _PLAN = plan
+    ENABLED = True
+
+
+def uninstall() -> None:
+    global ENABLED, _PLAN
+    _PLAN = None
+    ENABLED = False
+
+
+def inject(site: str, key: str = ""):
+    """Module-level dispatch for sites guarded by ``if faults.ENABLED:``."""
+    if _PLAN is None:
+        return None
+    return _PLAN.inject(site, key)
+
+
+__all__ = [
+    "FaultPlan",
+    "FaultRule",
+    "FaultInjected",
+    "FaultCrash",
+    "install",
+    "uninstall",
+    "inject",
+    "ENABLED",
+]

@@ -19,7 +19,9 @@ All waits are bounded (reference MAX_WAIT_TIME=150 s, module.py:58).
 
 from __future__ import annotations
 
+import random
 import secrets
+import time
 from typing import Any, Callable, Sequence
 
 import numpy as np
@@ -28,6 +30,45 @@ from tensorlink_tpu.core.logging import get_logger
 from tensorlink_tpu.p2p import protocol as proto
 
 MAX_WAIT_TIME = 150.0  # reference ml/module.py:58
+
+# retry envelope for worker RPCs (exponential backoff with jitter — the
+# single bare retry this replaces would hammer a recovering worker and give
+# up exactly when a second replacement was one more attempt away)
+RETRY_ATTEMPTS = 4
+BACKOFF_BASE_S = 0.1
+BACKOFF_CAP_S = 5.0
+# transport-failure signatures: errors cross the IPC bridge as RemoteError
+# (stringified "TimeoutError: ..." / "ConnectionError: ...", nodes/ipc.py),
+# so match on text as well as type
+_TRANSPORT_SIGNS = (
+    "TimeoutError", "ConnectionError", "no connection", "IncompleteReadError",
+    "timed out",
+)
+
+
+def _transportish(e: BaseException) -> bool:
+    return isinstance(e, (TimeoutError, ConnectionError)) or any(
+        s in str(e) for s in _TRANSPORT_SIGNS
+    )
+
+
+class WorkerLost(RuntimeError):
+    """A stage worker's connection died mid-training-step: the step's
+    distributed state (micro-batch residuals, accumulated gradients) is
+    gone with it, so the step must be re-driven from the last checkpoint —
+    a transparent RPC retry would silently apply a partial gradient."""
+
+    def __init__(self, worker_id: str | None, cause: BaseException):
+        super().__init__(f"worker {str(worker_id)[:12]} lost: {cause}")
+        self.worker_id = worker_id
+        self.cause = cause
+
+
+class SessionLost(WorkerLost):
+    """A worker holding decode-session KV died mid-generate. A retry on a
+    replacement would decode against an EMPTY cache; the session must be
+    re-established by re-prefilling prompt + tokens-emitted-so-far
+    (_generate_pipelined recovery)."""
 
 
 def _any_nonzero(v) -> bool:
@@ -71,6 +112,10 @@ class DistributedModel:
         quant: str | None = None,  # "int8" | "int8+kv" quantized serving
         flash_attention: bool = False,  # Pallas flash prefill on workers
         start_session: bool = True,
+        ckpt_every_steps: int = 0,  # auto-checkpoint cadence (0 = off)
+        ckpt_dir: str | None = None,  # auto-checkpoint target directory
+        request_timeout: float = MAX_WAIT_TIME,
+        retry_attempts: int = RETRY_ATTEMPTS,
         **node_kw,
     ):
         from tensorlink_tpu.models.base import ModelConfig
@@ -119,6 +164,12 @@ class DistributedModel:
 
         self._repair_lock = threading.Lock()
         self._repaired: dict[str, str] = {}  # dead worker id -> replacement
+        self._request_timeout = float(request_timeout)
+        self._retry_attempts = max(int(retry_attempts), 1)
+        # jitter source for retry backoff — seeded so chaos runs replay
+        self._retry_rng = random.Random(seed)
+        self._ckpt_every_steps = int(ckpt_every_steps)
+        self._ckpt_dir = ckpt_dir
         if start_session:
             self._initialize_distribution()
 
@@ -205,7 +256,7 @@ class DistributedModel:
         ]
 
     def _request_mirrored(
-        self, stage, tag: str, body: dict, timeout=MAX_WAIT_TIME,
+        self, stage, tag: str, body: dict, timeout=None,
     ):
         """One work item to a stage — and, when the stage is a co-slice
         MERGED mesh, the same item to every coworker process concurrently.
@@ -217,6 +268,7 @@ class DistributedModel:
         (``mirror`` flag, ml/worker.py); the primary's full response is
         returned. No repair on merged stages — replacing one member of a
         live jax.distributed job is not supported."""
+        timeout = self._request_timeout if timeout is None else timeout
         members = self._stage_members(stage)
         if len(members) == 1:
             return self._request(stage.worker_id, tag, body, timeout)
@@ -260,32 +312,78 @@ class DistributedModel:
                 )
         return out
 
+    def _backoff_delay(self, attempt: int) -> float:
+        """Exponential backoff with jitter: base·2^(k-1), capped, scaled by
+        a seeded uniform in [0.5, 1.5) so synchronized retry storms from
+        concurrent driver threads decorrelate."""
+        base = min(BACKOFF_BASE_S * 2 ** (attempt - 1), BACKOFF_CAP_S)
+        return base * self._retry_rng.uniform(0.5, 1.5)
+
     def _request(
-        self, worker_plan_id: str, tag: str, body: dict, timeout=MAX_WAIT_TIME,
+        self, worker_plan_id: str, tag: str, body: dict, timeout=None,
         _repaired: bool = False, no_repair: bool = False,
     ):
-        try:
-            resp = self.node.send_request(
-                "tensor_request",
-                {
-                    "peer": self.workers[worker_plan_id],
-                    "tag": tag,
-                    "body": body,
-                    "timeout": timeout,
-                },
-                timeout=timeout + 10.0,
-            )
-        except Exception as e:
-            # connection to the worker died mid-request → pull a replacement
-            # from the validator and retry once (the reference's
-            # "request another worker" TODO, module.py:510-511, made real).
-            # ``no_repair``: a SESSION chain must never be silently re-sent —
-            # downstream stages may already have absorbed this call's KV
-            # writes, and a retry would append them twice.
-            if _repaired or no_repair or "no connection" not in str(e):
+        """One worker RPC with a bounded retry envelope.
+
+        - Transport timeouts retry the SAME worker with exponential backoff
+          — but only when the op is idempotent (it carries a session ``seq``,
+          which the worker dedups, ml/worker.py::_session_dup); anything
+          else could double-apply.
+        - A dead connection on a stateless op pulls a replacement from the
+          validator (the reference's "request another worker" TODO,
+          module.py:510-511, made real) and retries there.
+        - A dead connection on a SESSION op raises :class:`SessionLost`:
+          the replacement has no KV, so the generate loop must re-establish
+          the session (re-prefill), not retry the RPC.
+        - A dead connection mid-training-step (optimizer initialized)
+          raises :class:`WorkerLost`: the step's residuals/gradients died
+          with the worker, so train_step re-drives the whole step from the
+          last checkpoint instead of applying a partial gradient.
+        - ``no_repair``: mirrored SPMD work items are never retried at all —
+          a lone re-launch would desync the merged mesh.
+        """
+        timeout = self._request_timeout if timeout is None else timeout
+        session_op = tag == proto.FORWARD and body.get("session") is not None
+        idempotent = body.get("seq") is not None
+        attempts = 1 if (no_repair or _repaired) else self._retry_attempts
+        worker = worker_plan_id
+        resp = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(self._backoff_delay(attempt))
+            try:
+                resp = self.node.send_request(
+                    "tensor_request",
+                    {
+                        "peer": self.workers[worker],
+                        "tag": tag,
+                        "body": body,
+                        "timeout": timeout,
+                    },
+                    timeout=timeout + 10.0,
+                )
+            except Exception as e:
+                if no_repair or _repaired:
+                    raise
+                conn_lost = "no connection" in str(e)
+                if conn_lost and session_op:
+                    raise SessionLost(worker, e) from e
+                if conn_lost and getattr(self, "_opt_ready", False) \
+                        and getattr(self, "_step_active", False):
+                    raise WorkerLost(worker, e) from e
+                if conn_lost:
+                    if attempt == attempts - 1:
+                        raise
+                    worker = self._repair(worker)
+                    continue
+                if idempotent and _transportish(e) and attempt < attempts - 1:
+                    self.log.warning(
+                        "%s to %s timed out (attempt %d); retrying "
+                        "(seq-idempotent)", tag, worker[:8], attempt + 1,
+                    )
+                    continue
                 raise
-            new_id = self._repair(worker_plan_id)
-            return self._request(new_id, tag, body, timeout, _repaired=True)
+            break
         if isinstance(resp, dict) and resp.get("error"):
             # chained hops attribute the failing worker (ml/worker.py run
             # loop ships "worker" alongside the error)
@@ -387,11 +485,25 @@ class DistributedModel:
                          "dir": self._last_ckpt},
                         _repaired=True,
                     )
+                # roll the driver's step counter back to the snapshot so
+                # the "lost at most ckpt_every_steps steps" contract holds
+                # for the step accounting (and tags) too
+                try:
+                    import json
+                    from pathlib import Path
+
+                    manifest = json.loads(
+                        (Path(self._last_ckpt) / "manifest.json").read_text()
+                    )
+                    self._step = int(manifest.get("step", getattr(self, "_step", 0)))
+                except Exception:
+                    pass
             elif getattr(self, "_step", 0) > 0:
                 raise RuntimeError(
                     "worker replaced mid-training with no checkpoint to roll "
                     "back to: trained state on surviving stages is "
-                    "inconsistent with the fresh replacement stage — call "
+                    "inconsistent with the fresh replacement stage — set "
+                    "ckpt_every_steps (auto-checkpoint) or call "
                     "save_checkpoint() periodically to make repair lossless"
                 )
         self.log.info(
@@ -428,6 +540,7 @@ class DistributedModel:
         last_idx: np.ndarray | None = None,
         reorder_idx: np.ndarray | None = None,
         reset_len: int | None = None,
+        seq: int | None = None,
     ) -> np.ndarray:
         """Chain the pipeline stages; returns logits ``[B, T, V]``.
 
@@ -446,6 +559,10 @@ class DistributedModel:
         if session is not None:
             body_common["session"] = session
             body_common["cache_len"] = cache_len or self.spec["seq_len"]
+            if seq is not None:
+                # per-session op counter: workers dedup on it, which makes
+                # RPC retries and duplicated frames idempotent
+                body_common["seq"] = int(seq)
         if reorder_idx is not None:
             # beam search: each stage permutes its session cache rows to
             # follow their source beam BEFORE this step's attention — the
@@ -479,21 +596,20 @@ class DistributedModel:
             # not be silently re-driven (double KV writes).
             try:
                 return self._forward_chain(x, body_common, samp_body)
+            except SessionLost:
+                raise  # classified by _request — generate loops recover
             except Exception as e:
                 # transport failures cross the IPC bridge as RemoteError
                 # (stringified "TimeoutError: ..."/"ConnectionError: ...",
                 # nodes/ipc.py) — match on text as well as type. Compute
-                # errors and session calls re-raise: a partially-prefilled
-                # session must not be silently re-driven (double KV writes).
-                transport = isinstance(
-                    e, (TimeoutError, ConnectionError)
-                ) or any(
-                    s in str(e)
-                    for s in ("TimeoutError", "ConnectionError",
-                              "no connection", "IncompleteReadError")
-                )
-                if not transport or session is not None:
+                # errors re-raise. A session chain whose transport died
+                # raises SessionLost: the per-hop fallback cannot help (a
+                # mid-chain stage may already have absorbed this call's KV
+                # writes) — the generate loop re-establishes the session.
+                if not _transportish(e):
                     raise
+                if session is not None:
+                    raise SessionLost(None, e) from e
                 self.log.warning(
                     "chained forward failed (%s); per-hop fallback", e
                 )
@@ -552,10 +668,12 @@ class DistributedModel:
             body_common, op="chain", chain=entries,
             reply_to=self.node.node_id, tokens=x,
         ))
-        resp = self._request(
-            stages[0].worker_id, proto.FORWARD, body,
-            no_repair=body_common.get("session") is not None,
-        )
+        # session chains are safe to retry through _request: every hop
+        # dedups on the op's seq and re-drives its cached output downstream,
+        # so a retry after a lost reply reaches the final hop without any
+        # stage re-absorbing KV writes. A dead worker raises SessionLost
+        # (classified in _request) for the generate loop to recover.
+        resp = self._request(stages[0].worker_id, proto.FORWARD, body)
         self.chain_forwards += 1
         res = _head_result(resp)
         if res is not None:
@@ -708,6 +826,7 @@ class DistributedModel:
         t.start()
         B = len(prompts)
         cancelled: set[int] = set()
+        notified: set[int] = set()
         drained: list[list[int]] = [[] for _ in range(B)]
 
         def feed(row_map: dict[int, int]) -> None:
@@ -716,6 +835,27 @@ class DistributedModel:
                     drained[i].append(int(tk_))
             cancel = stream_cb([row_map.get(i) for i in range(B)])
             cancelled.update(int(i) for i in cancel or ())
+
+        def push_cancels() -> None:
+            # confirmed stop-sequence matches ride back to the worker as a
+            # STREAM_CANCEL control frame; its compiled chunked decode polls
+            # them at chunk boundaries and stops those rows early — overrun
+            # past a stop is ≤ one chunk instead of the full token budget
+            new = cancelled - notified
+            if not new:
+                return
+            notified.update(new)
+            try:
+                self.node.send_request(
+                    "send_control",
+                    {"peer": self.workers[stage.worker_id],
+                     "tag": proto.STREAM_CANCEL,
+                     "body": {"stream": stream_id,
+                              "rows": sorted(cancelled)}},
+                    timeout=10.0,
+                )
+            except Exception:
+                pass  # best-effort: the budget bound still applies
 
         while True:
             tk = self.node.send_request(
@@ -735,14 +875,15 @@ class DistributedModel:
                     cur[int(r)] = int(tok)
                 if cur:
                     feed(cur)
+                push_cancels()
             if tk.get("done"):
                 break
             if len(cancelled) >= B:
                 # every row's downstream (stop filters) confirmed a cancel:
                 # stop forwarding so the client stream closes NOW. The
-                # worker's compiled loop still runs out its budget (no
-                # mid-loop backchannel into the device loop yet); the
-                # response's sequences are truncated by the API layer.
+                # STREAM_CANCEL backchannel (push_cancels above) stops the
+                # worker's compiled loop at its next chunk boundary, so the
+                # response arrives within ~one chunk of decode.
                 break
             if tk.get("timeout") and not t.is_alive():
                 break
@@ -842,8 +983,11 @@ class DistributedModel:
             "seed": int(seed),
         }
 
+        penalized = (
+            _any_nonzero(presence_penalty) or _any_nonzero(frequency_penalty)
+        )
         samp0 = dict(samp, step=0)
-        if _any_nonzero(presence_penalty) or _any_nonzero(frequency_penalty):
+        if penalized:
             # the head-holding worker sees hidden states, not token ids —
             # ship the prompt once so it can seed the session's [B, V]
             # context counts (subsequent steps fold sampled tokens in
@@ -851,42 +995,123 @@ class DistributedModel:
             samp0["prompt_tokens"] = toks
             samp0["prompt_mask"] = mask
         last_idx = mask.sum(-1) - 1
-        tok = self.forward(
-            toks, mask, session=session, cache_len=cache_len,
-            sample=samp0, last_idx=last_idx,
-        )
 
         seqs: list[list[int]] = [[] for _ in range(B)]
-        done = np.asarray([e <= 0 for e in eff], bool)
-        for step in range(steps):
-            emitted: list[int | None] = []
-            for i in range(B):
-                if not done[i]:
-                    seqs[i].append(int(tok[i]))
-                    emitted.append(int(tok[i]))
-                else:
-                    emitted.append(None)
-                done[i] |= int(tok[i]) in eos or len(seqs[i]) >= eff[i]
-            if stream_cb is not None and any(e is not None for e in emitted):
-                # the callback may return row indices to CANCEL (confirmed
-                # stop-sequence matches): those rows stop decoding NOW —
-                # the pipelined loop is host-driven, so a stop saves the
-                # remaining per-token stage hops instead of burning the
-                # full budget
-                cancel = stream_cb(emitted)
-                for i in cancel or ():
-                    if 0 <= int(i) < B:
-                        done[int(i)] = True
-            if done.all() or step == steps - 1:
-                break
-            tok = self.forward(
-                tok[:, None].astype(np.int32),
-                session=session,
-                cache_len=cache_len,
-                sample=dict(samp, step=step + 1),
-            )
+        # session/seq state shared with the recovery closures; every session
+        # op carries a monotonically-increasing seq so RPC retries and
+        # duplicated frames are idempotent on the workers
+        state = {"session": session, "seq": 0, "recoveries": 0}
+        MAX_RECOVERIES = 3
 
-        # drop the session caches on the workers
+        def reestablish(step_idx: int):
+            """In-flight session recovery: a stage worker died mid-decode.
+            Repair every dead stage (validator recruits replacements and
+            re-ships their stage slices), drop session remnants on the
+            survivors, then re-prefill prompt + tokens-emitted-so-far under
+            a FRESH session id. The re-prefilled logits at each row's last
+            position equal the incremental decode logits, and the sampler
+            key depends only on (seed, step) — so the resumed stream is
+            bit-identical to the fault-free run: no duplicated, no missing
+            tokens."""
+            live = set(self.node.send_request("peers", timeout=10.0))
+            for st in self.plan.stages:
+                if self.workers.get(st.worker_id) not in live:
+                    self._repair(st.worker_id)
+            self._end_decode_session(state["session"])
+            state["session"] = secrets.token_hex(8)
+            rows = [prompts[i] + seqs[i] for i in range(B)]
+            lens = np.asarray([len(r) for r in rows], np.int64)
+            toks2 = np.zeros((B, int(lens.max())), np.int32)
+            mask2 = np.zeros_like(toks2, bool)
+            for i, r in enumerate(rows):
+                toks2[i, : len(r)] = r
+                mask2[i, : len(r)] = True
+            samp_r = dict(samp, step=step_idx)
+            if penalized:
+                # counts at step s = prompt + everything emitted before s —
+                # exactly these rows' histogram
+                samp_r["prompt_tokens"] = toks2
+                samp_r["prompt_mask"] = mask2
+            out = self.forward(
+                toks2, mask2, session=state["session"], cache_len=cache_len,
+                sample=samp_r, last_idx=(lens - 1).astype(np.int32), seq=0,
+            )
+            state["seq"] = 1
+            return out
+
+        def next_tok(step_idx: int, step_tok):
+            """The token of sampling step ``step_idx`` — via prefill
+            (step 0), an incremental decode step, or session
+            re-establishment after a lost worker."""
+            mode = "prefill" if step_tok is None else "decode"
+            while True:
+                try:
+                    if mode == "decode":
+                        out = self.forward(
+                            step_tok[:, None].astype(np.int32),
+                            session=state["session"], cache_len=cache_len,
+                            sample=dict(samp, step=step_idx),
+                            seq=state["seq"],
+                        )
+                        state["seq"] += 1
+                        return out
+                    if mode == "prefill":
+                        out = self.forward(
+                            toks, mask, session=state["session"],
+                            cache_len=cache_len, sample=samp0,
+                            last_idx=last_idx, seq=0,
+                        )
+                        state["seq"] = 1
+                        return out
+                    return reestablish(step_idx)
+                except Exception as e:
+                    recoverable = isinstance(e, SessionLost) or _transportish(e)
+                    if not recoverable or state["recoveries"] >= MAX_RECOVERIES:
+                        raise
+                    state["recoveries"] += 1
+                    self.log.warning(
+                        "decode session lost (%s); re-establishing on live "
+                        "workers (recovery %d/%d)",
+                        e, state["recoveries"], MAX_RECOVERIES,
+                    )
+                    mode = "reestablish"
+
+        try:
+            tok = next_tok(0, None)
+            done = np.asarray([e <= 0 for e in eff], bool)
+            for step in range(steps):
+                emitted: list[int | None] = []
+                for i in range(B):
+                    if not done[i]:
+                        seqs[i].append(int(tok[i]))
+                        emitted.append(int(tok[i]))
+                    else:
+                        emitted.append(None)
+                    done[i] |= int(tok[i]) in eos or len(seqs[i]) >= eff[i]
+                if stream_cb is not None and any(
+                    e is not None for e in emitted
+                ):
+                    # the callback may return row indices to CANCEL
+                    # (confirmed stop-sequence matches): those rows stop
+                    # decoding NOW — the pipelined loop is host-driven, so
+                    # a stop saves the remaining per-token stage hops
+                    # instead of burning the full budget
+                    cancel = stream_cb(emitted)
+                    for i in cancel or ():
+                        if 0 <= int(i) < B:
+                            done[int(i)] = True
+                if done.all() or step == steps - 1:
+                    break
+                tok = next_tok(step + 1, tok)
+            return seqs
+        finally:
+            # also on failure paths (exhausted recoveries, compute errors):
+            # surviving stages must not leak the session KV + dedup ledger
+            self._end_decode_session(state["session"])
+
+    def _end_decode_session(self, session: str) -> None:
+        """Drop a session's KV caches (and seq-dedup ledger) on every stage
+        worker; best-effort — a dead worker's cache died with it."""
         for stage in self.plan.stages:
             try:
                 self._request(
@@ -897,7 +1122,6 @@ class DistributedModel:
                 )
             except Exception:
                 pass
-        return seqs
 
     def _generate_beam_pipelined(
         self, prompts, *, num_beams: int, max_new_tokens: int,
@@ -980,16 +1204,7 @@ class DistributedModel:
             _score, best = max(done_pool, key=lambda d: d[0])
             return [best]
         finally:
-            for stage in self.plan.stages:
-                try:
-                    self._request(
-                        stage.worker_id, proto.FORWARD,
-                        {"job_id": self.job_id, "op": "end_session",
-                         "session": session},
-                        timeout=10.0,
-                    )
-                except Exception:
-                    pass
+            self._end_decode_session(session)
 
     def _generate_lookahead_pipelined(
         self, prompts, *, max_new_tokens: int, eos_ids=(),
@@ -1081,16 +1296,7 @@ class DistributedModel:
                     break
             return [seq[:limit]]
         finally:
-            for stage in self.plan.stages:
-                try:
-                    self._request(
-                        stage.worker_id, proto.FORWARD,
-                        {"job_id": self.job_id, "op": "end_session",
-                         "session": session},
-                        timeout=10.0,
-                    )
-                except Exception:
-                    pass
+            self._end_decode_session(session)
 
     # ------------------------------------------------------------------
     # training (reference module.py:348-524 micro-batch threads + autograd
@@ -1183,11 +1389,16 @@ class DistributedModel:
         clip = getattr(self, "_grad_clip", None)
         if clip and gnorm > clip:
             final_scale = scale * clip / gnorm
+        # once ANY stage has applied its update, a failure leaves the stages
+        # on mixed parameter versions — recovery must roll back to the last
+        # checkpoint, not merely re-drive (train_step/_recover_training)
+        self._opt_step_partial = True
         for stage in self.plan.stages:
             self._request_mirrored(
                 stage, proto.OPTIMIZER,
                 {"job_id": self.job_id, "op": "step", "scale": final_scale},
             )
+        self._opt_step_partial = False
         return {"grad_norm": gnorm}
 
     def zero_grad(self) -> None:
@@ -1198,6 +1409,103 @@ class DistributedModel:
             )
 
     def train_step(
+        self,
+        tokens: np.ndarray,  # int [B, T]
+        loss_mask: np.ndarray | None = None,  # bool [B, T]
+        attn_mask: np.ndarray | None = None,
+        *,
+        step_optimizer: bool = True,
+        overlap: bool = True,
+    ) -> dict:
+        """One durable training step: drives :meth:`_train_step_once` and,
+        when a stage worker dies mid-step (:class:`WorkerLost`), repairs the
+        dead stages — the replacement restores params AND optimizer state
+        from ``_last_ckpt`` (auto-written every ``ckpt_every_steps``) and
+        the driver's step counter rolls back to the snapshot — then
+        re-drives the whole step from clean gradients. A mid-fine-tune kill
+        therefore loses at most ``ckpt_every_steps`` steps, never a partial
+        gradient."""
+        self._step_active = True
+        try:
+            for attempt in range(2):
+                try:
+                    out = self._train_step_once(
+                        tokens, loss_mask, attn_mask,
+                        step_optimizer=step_optimizer, overlap=overlap,
+                    )
+                    break
+                except Exception as e:
+                    if attempt or not (
+                        isinstance(e, WorkerLost) or _transportish(e)
+                    ):
+                        raise
+                    self.log.warning(
+                        "training step lost a worker (%s); repairing and "
+                        "re-driving the step from the last checkpoint", e,
+                    )
+                    self._recover_training()
+        finally:
+            self._step_active = False
+        if (
+            step_optimizer and self._ckpt_every_steps > 0
+            and self._step % self._ckpt_every_steps == 0
+        ):
+            self.save_checkpoint(self._auto_ckpt_dir())
+        return out
+
+    def _auto_ckpt_dir(self) -> str:
+        if self._ckpt_dir is None:
+            import tempfile
+            from pathlib import Path
+
+            d = Path(tempfile.gettempdir()) / f"tltpu_ckpt_{self.job_id[:12]}"
+            self._ckpt_dir = str(d)
+        return self._ckpt_dir
+
+    def _recover_training(self) -> None:
+        """Repair every stage whose worker connection died (each repair
+        re-ships the stage and restores the last checkpoint on ALL stages,
+        _apply_update), then clear half-accumulated gradients everywhere so
+        the re-driven step starts clean.
+
+        If the failed step had already begun fanning out its OPTIMIZER
+        "step" ops (``_opt_step_partial``), some stages may hold the update
+        and others not — re-driving on top of that mixed state would apply
+        a second update on the fast stages. Roll EVERY stage back to the
+        last checkpoint first (and refuse when there is none)."""
+        live = set(self.node.send_request("peers", timeout=10.0))
+        for st in self.plan.stages:
+            if self.workers.get(st.worker_id) not in live:
+                self._repair(st.worker_id)
+        if getattr(self, "_opt_step_partial", False):
+            if not getattr(self, "_last_ckpt", None):
+                raise RuntimeError(
+                    "optimizer step failed after possibly applying updates "
+                    "on some stages, and no checkpoint exists to roll back "
+                    "to — set ckpt_every_steps (auto-checkpoint) to make "
+                    "this recoverable"
+                )
+            for s in self.plan.stages:
+                self._request(
+                    s.worker_id, proto.CHECKPOINT,
+                    {"job_id": self.job_id, "op": "restore",
+                     "dir": self._last_ckpt},
+                    _repaired=True,
+                )
+            try:
+                import json
+                from pathlib import Path
+
+                manifest = json.loads(
+                    (Path(self._last_ckpt) / "manifest.json").read_text()
+                )
+                self._step = int(manifest.get("step", self._step))
+            except Exception:
+                pass
+            self._opt_step_partial = False
+        self.zero_grad()
+
+    def _train_step_once(
         self,
         tokens: np.ndarray,  # int [B, T]
         loss_mask: np.ndarray | None = None,  # bool [B, T]
@@ -1286,30 +1594,19 @@ class DistributedModel:
     # checkpointing (net-new: the reference has no mid-training
     # checkpoint/resume, SURVEY §5 — Orbax-style save/restore + HF export)
     # ------------------------------------------------------------------
-    def _refuse_on_merged_mesh(self, what: str) -> None:
-        """Param-materializing ops (checkpoint, download) reach only the
-        PRIMARY of a merged stage, whose device_get cannot see the
-        coworkers' shards — and a gather there would deadlock (the
-        coworkers never receive the work item). Refuse loudly until these
-        paths are mirrored too."""
-        if self.plan is not None and any(
-            s.coworkers for s in self.plan.stages
-        ):
-            raise RuntimeError(
-                f"{what} on a co-slice merged mesh is not supported yet"
-            )
-
     def save_checkpoint(self, ckpt_dir: str) -> dict:
         """Each stage writes params (+ optimizer state) to ``ckpt_dir``
-        (shared filesystem), plus a manifest for resume."""
+        (shared filesystem), plus a manifest for resume. Merged (co-slice)
+        stages work too: the work item is MIRRORED to every member so the
+        per-leaf host gathers run as lockstep collectives; only the primary
+        writes the file (ml/worker.py::_checkpoint)."""
         import json
         from pathlib import Path
 
-        self._refuse_on_merged_mesh("save_checkpoint")
         paths = []
         for stage in self.plan.stages:
-            resp = self._request(
-                stage.worker_id, proto.CHECKPOINT,
+            resp = self._request_mirrored(
+                stage, proto.CHECKPOINT,
                 {"job_id": self.job_id, "op": "save", "dir": str(ckpt_dir)},
             )
             paths.append(resp["path"])
@@ -1324,10 +1621,9 @@ class DistributedModel:
         return {"paths": paths}
 
     def restore_checkpoint(self, ckpt_dir: str) -> None:
-        self._refuse_on_merged_mesh("restore_checkpoint")
         for stage in self.plan.stages:
-            self._request(
-                stage.worker_id, proto.CHECKPOINT,
+            self._request_mirrored(
+                stage, proto.CHECKPOINT,
                 {"job_id": self.job_id, "op": "restore", "dir": str(ckpt_dir)},
             )
 
@@ -1367,12 +1663,14 @@ class DistributedModel:
     # parameters (reference module.py:577-650 downloads state dicts)
     # ------------------------------------------------------------------
     def parameters(self) -> list[dict]:
-        """Pull each stage's parameter tree (numpy) from its worker."""
-        self._refuse_on_merged_mesh("parameter download")
+        """Pull each stage's parameter tree (numpy) from its worker.
+        Mirrored on merged co-slice stages (every member runs the gathers,
+        the primary ships the bytes) — so HF export and parameter download
+        work on merged meshes too."""
         out = []
         for stage in self.plan.stages:
-            resp = self._request(
-                stage.worker_id, proto.PARAMS_REQ, {"job_id": self.job_id}
+            resp = self._request_mirrored(
+                stage, proto.PARAMS_REQ, {"job_id": self.job_id}
             )
             out.append(resp["params"])
         return out

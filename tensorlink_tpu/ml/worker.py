@@ -31,6 +31,7 @@ from typing import Any
 
 import numpy as np
 
+from tensorlink_tpu.core.faults import FaultCrash, FaultPlan
 from tensorlink_tpu.core.logging import get_logger
 from tensorlink_tpu.p2p import protocol as proto
 
@@ -75,6 +76,13 @@ class StageRuntime:
     # them and folds each sampled token back in, so penalized requests work
     # on multi-stage jobs too (the engine path carries its own counts)
     penalty_counts: dict[str, Any] = field(default_factory=dict)
+    # idempotency ledger for sequence-numbered session ops: dedup key
+    # ("{session}:{phase}") -> last applied seq, and -> the op's cached
+    # outcome so a duplicate delivery (frame dup on the wire, RPC retry
+    # after a lost reply) re-sends the SAME result instead of re-applying
+    # the op's KV writes (ml/module.py drives retries on these seqs)
+    session_seq: dict[str, int] = field(default_factory=dict)
+    session_resp: dict[str, tuple] = field(default_factory=dict)
 
     @property
     def n_layers(self) -> int:
@@ -105,6 +113,14 @@ class DistributedWorker:
         self.log = get_logger(f"ml.worker{node.config.duplicate}")
         self.jobs: dict[str, StageRuntime] = {}
         self._lock = threading.Lock()
+        # per-node fault plan (core/faults.py) — an INSTANCE, not the module
+        # global, so several worker nodes living in one test process never
+        # share fault counters; None (the default) keeps the hot paths free
+        # of fault-site calls entirely
+        fspec = getattr(node.config, "faults", None)
+        self.faults: FaultPlan | None = (
+            FaultPlan.from_dict(fspec) if fspec else None
+        )
         # join the multi-controller runtime BEFORE first device use when the
         # deployment spans hosts of one slice (parallel/multihost.py) — then
         # jax.devices() is global and planned meshes may span the slice
@@ -189,6 +205,14 @@ class DistributedWorker:
                 return
             try:
                 self._handle(kind, payload)
+            except FaultCrash as e:
+                # injected node death: kill the network process abruptly so
+                # every peer sees a dropped connection (the repair paths'
+                # trigger), and exit this loop — no error reply, exactly
+                # like a real worker loss mid-request
+                self.log.warning("fault injection: %s — node going down", e)
+                self.node.crash()
+                return
             except Exception as e:
                 self.log.exception("work %s failed", kind)
                 rid, peer = payload.get("rid"), payload.get("peer")
@@ -634,10 +658,24 @@ class DistributedWorker:
         rt = self._runtime(p["job_id"])
         op = p.get("op", "stage")
         if op == "end_session":
-            rt.sessions.pop(p.get("session"), None)
-            rt.penalty_counts.pop(p.get("session"), None)
+            sid = p.get("session")
+            rt.sessions.pop(sid, None)
+            rt.penalty_counts.pop(sid, None)
+            for phase in ("s", "h"):
+                rt.session_seq.pop(f"{sid}:{phase}", None)
+                rt.session_resp.pop(f"{sid}:{phase}", None)
             self._respond(p["peer"], proto.FORWARD_RESP, p["rid"], {"ok": True})
             return
+        if p.get("session") is not None and p.get("seq") is not None:
+            # sequence-numbered session op: a duplicate delivery (frame dup
+            # on the wire, RPC retry after a lost reply) must never re-apply
+            # the KV writes — re-send the cached outcome instead
+            if self._session_dup(rt, p):
+                return
+        if self.faults is not None and p.get("session") is not None:
+            # fault site "worker.session_step" (core/faults.py): counted per
+            # APPLIED op so transport dups never perturb the plan's decisions
+            self.faults.inject("worker.session_step", op)
         train = bool(p.get("train", False))
         tag = p.get("tag", "")
         if op == "chain" and p.get("head_hop"):
@@ -773,8 +811,52 @@ class DistributedWorker:
     # chain fields every forwarded hop must carry onward
     _CHAIN_KEYS = (
         "job_id", "session", "cache_len", "attn_mask", "sample",
-        "last_idx", "reply_to", "reorder_idx", "reset_len",
+        "last_idx", "reply_to", "reorder_idx", "reset_len", "seq",
     )
+
+    # -- session-op idempotency (seq dedup) ------------------------------
+    @staticmethod
+    def _session_dedup_key(p: dict) -> str:
+        # a first+head-holding stage sees TWO ops per decode step (its
+        # stage slice, then the tied-embedding head hop) under the same
+        # seq — separate phases so the head hop is not mistaken for a dup
+        return f"{p['session']}:{'h' if p.get('head_hop') else 's'}"
+
+    def _session_dup(self, rt: "StageRuntime", p: dict) -> bool:
+        """True when this seq was already applied for its session/phase.
+        For the latest applied seq the cached outcome is re-delivered: a
+        direct response is re-sent under the retry's rid, and a mid-chain
+        hop re-drives the chain from its cached output (so a retry whose
+        original died downstream still reaches the final hop without any
+        stage recomputing or re-absorbing KV)."""
+        key = self._session_dedup_key(p)
+        seq = int(p["seq"])
+        if seq > rt.session_seq.get(key, -1):
+            return False
+        cached = rt.session_resp.get(key)
+        if cached is not None and cached[0] == seq:
+            _, kind, payload = cached
+            if kind == "resp" and p.get("rid"):
+                self._respond(
+                    p.get("reply_to") or p["peer"], proto.FORWARD_RESP,
+                    p["rid"], payload,
+                )
+            elif kind == "chain":
+                body = dict(payload["body"], _rid=p.get("rid"))
+                self.bridge.request(
+                    "chain_send", {**payload, "body": body}, timeout=150.0
+                )
+        return True
+
+    def _session_applied(self, rt: "StageRuntime", p: dict, kind: str, payload) -> None:
+        """Record a completed session op (seq watermark + cached outcome).
+        Recorded at COMPLETION, not at entry, so a failed op stays
+        retryable instead of its retry being swallowed as a dup."""
+        if p.get("session") is None or p.get("seq") is None:
+            return
+        key = self._session_dedup_key(p)
+        rt.session_seq[key] = int(p["seq"])
+        rt.session_resp[key] = (int(p["seq"]), kind, payload)
 
     def _finish_fwd(self, rt: "StageRuntime", p: dict, out, is_logits: bool) -> None:
         """Deliver a (non-training) forward result: forward to the next
@@ -799,10 +881,11 @@ class DistributedWorker:
                 hidden=np.asarray(jax.device_get(out)),
                 _rid=p["rid"],  # the originator's future resolves on this
             )
+            req = {"addr": list(nxt["addr"]), "tag": proto.FORWARD,
+                   "body": body}
+            self._session_applied(rt, p, "chain", req)
             self.bridge.request(
-                "chain_send",
-                {"addr": list(nxt["addr"]), "tag": proto.FORWARD,
-                 "body": body},
+                "chain_send", req,
                 # generous: a multi-GB activation over DCN outlives the
                 # 30 s IPC default, and a spurious timeout here would race
                 # an error reply against the still-progressing chain
@@ -810,6 +893,11 @@ class DistributedWorker:
             )
             return
         reply_peer = p.get("reply_to") or p["peer"]
+
+        def respond_final(body: dict) -> None:
+            self._session_applied(rt, p, "resp", body)
+            self._respond(reply_peer, proto.FORWARD_RESP, p["rid"], body)
+
         if p.get("sample") is not None and is_logits:
             samp = p["sample"]
             if samp.get("verify"):
@@ -820,28 +908,20 @@ class DistributedWorker:
                 import jax.numpy as jnp_
 
                 ids = self._to_host(rt, jnp_.argmax(out, axis=-1))
-                self._respond(
-                    reply_peer, proto.FORWARD_RESP, p["rid"],
-                    {"verify_ids": np.asarray(ids, np.int32)},
-                )
+                respond_final({"verify_ids": np.asarray(ids, np.int32)})
                 return
             if samp.get("beam_k"):
                 # pipelined beam search: ship K x (K+n_eos) candidate
                 # (score, id) pairs from an on-device top-k — not [K, V]
                 # logits — to the frontier driver (ml/module.py)
                 vals, idx = self._beam_topk_from_logits(rt, out, p)
-                self._respond(
-                    reply_peer, proto.FORWARD_RESP, p["rid"],
-                    {"beam_vals": vals, "beam_idx": idx},
-                )
+                respond_final({"beam_vals": vals, "beam_idx": idx})
                 return
             # final logits of a decode step: sample on-worker and ship one
             # token id per row — the per-token logits transfer (~600 KB at
             # a 151k vocab) never leaves the device host
             tok = self._sample_from_logits(rt, out, p)
-            self._respond(
-                reply_peer, proto.FORWARD_RESP, p["rid"], {"token": tok}
-            )
+            respond_final({"token": tok})
             return
         host_out = self._to_host(rt, out)  # collective on spanning meshes —
         # must run on EVERY member, so it happens before the mirror check
@@ -853,10 +933,7 @@ class DistributedWorker:
                 reply_peer, proto.FORWARD_RESP, p["rid"], {"ok": True}
             )
             return
-        self._respond(
-            reply_peer, proto.FORWARD_RESP, p["rid"],
-            {"out": host_out, "is_logits": is_logits},
-        )
+        respond_final({"out": host_out, "is_logits": is_logits})
 
     def _beam_topk_from_logits(self, rt: "StageRuntime", logits, p: dict):
         """Head-worker half of PIPELINED beam search: gather each row's
@@ -1068,6 +1145,11 @@ class DistributedWorker:
             )
             body = {"ok": True, "op": op, "grad_norm": gn}
         elif op == "step":
+            if self.faults is not None:
+                # fault site "worker.train_step": fires BEFORE the update is
+                # applied, so a crash here loses the in-flight step — the
+                # situation auto-checkpointing exists to bound
+                self.faults.inject("worker.train_step", op)
             if rt.opt is None:
                 raise ValueError("optimizer not initialized")
             if rt.grad_accum is None:
@@ -1135,43 +1217,86 @@ class DistributedWorker:
     # -- checkpoint (net-new vs reference: no mid-training checkpoint
     # exists there, SURVEY §5) -------------------------------------------
     def _checkpoint(self, p: dict) -> None:
+        """Save/restore this stage's params (+ optimizer state). Works on
+        merged (process-spanning) co-slice stages too: the work item is
+        MIRRORED to every member (ml/module.py::_request_mirrored), each
+        member executes the same per-leaf gathers/puts (collectives stay
+        lockstep), and only the primary touches the file / carries the
+        payload — the coworkers answer a slim ack."""
         import jax
 
         from tensorlink_tpu.core import serialization as ser
 
         rt = self._runtime(p["job_id"])
         op = p.get("op", "save")
+        mirror = bool(p.get("mirror"))
         path = Path(p["dir"]) / f"stage_{rt.stage['layer_lo']}_{rt.stage['layer_hi']}.tlts"
         if op == "save":
-            path.parent.mkdir(parents=True, exist_ok=True)
+            # _to_host gathers the full value on process-spanning meshes
+            # (plain device_get cannot see non-addressable shards); every
+            # member must run the gathers even though only the primary writes
             host = jax.tree.map(
-                lambda a: np.asarray(jax.device_get(a)), self._exact_params(rt)
+                lambda a: self._to_host(rt, a), self._exact_params(rt)
             )
-            state = {"params": host, "stage": rt.stage}
-            if rt.opt_state is not None:
-                state["opt_state"] = jax.tree.map(
-                    lambda a: np.asarray(jax.device_get(a)), rt.opt_state
+            opt_host = (
+                jax.tree.map(lambda a: self._to_host(rt, a), rt.opt_state)
+                if rt.opt_state is not None else None
+            )
+            if mirror:
+                self._respond(
+                    p["peer"], proto.CHECKPOINT_RESP, p["rid"],
+                    {"ok": True, "mirror": True},
                 )
+                return
+            path.parent.mkdir(parents=True, exist_ok=True)
+            state = {"params": host, "stage": rt.stage}
+            if opt_host is not None:
+                state["opt_state"] = opt_host
             ser.encode_to_file(state, path)
             body = {"ok": True, "path": str(path)}
         elif op == "restore":
             import jax.numpy as jnp
 
             state = ser.decode_from_file(path)
-            rt.params = jax.tree.map(jnp.asarray, state["params"])
+            host = jax.tree.map(np.asarray, state["params"])
+            if rt.mesh is not None:
+                # re-shard on the stage mesh (every member of a merged stage
+                # read the same bytes and builds the same global arrays);
+                # a bare jnp.asarray would silently replicate a sharded stage
+                rt.params = self._shard_params(host, rt.cfg, rt.stage, rt.mesh)
+            else:
+                rt.params = jax.tree.map(jnp.asarray, host)
             restored_opt = False
             if "opt_state" in state and rt.opt is not None:
+                from jax.sharding import NamedSharding
+
                 tmpl = rt.opt.init(rt.params)
-                flat, treedef = jax.tree.flatten(tmpl)
+                flat_t, treedef = jax.tree.flatten(tmpl)
                 restored = jax.tree.leaves(state["opt_state"])
-                rt.opt_state = jax.tree.unflatten(
-                    treedef, [jnp.asarray(r) for r in restored]
-                )
+                leaves = []
+                for t_leaf, r in zip(flat_t, restored):
+                    sh = getattr(t_leaf, "sharding", None)
+                    arr = np.asarray(r)
+                    # mesh-sharded template leaves (moments mirroring the
+                    # sharded params) get their sharding back — on a
+                    # spanning mesh a local jnp.asarray could not mix with
+                    # global params in the update. Everything else (step
+                    # counters etc.) stays an UNCOMMITTED array: committing
+                    # a scalar to one device would conflict with the
+                    # mesh-resident moments in the same eager update.
+                    leaves.append(
+                        jax.device_put(arr, sh)
+                        if isinstance(sh, NamedSharding)
+                        else jnp.asarray(arr)
+                    )
+                rt.opt_state = jax.tree.unflatten(treedef, leaves)
                 restored_opt = True
             if rt.engine is not None:
                 rt.engine.params = rt.params
             body = {"ok": True, "restored_opt": restored_opt,
                     "opt_in_checkpoint": "opt_state" in state}
+            if mirror:
+                body = {"ok": True, "mirror": True}
         else:
             raise ValueError(f"unknown checkpoint op {op!r}")
         self._respond(p["peer"], proto.CHECKPOINT_RESP, p["rid"], body)
@@ -1225,10 +1350,19 @@ class DistributedWorker:
         )
         stream_id = p.get("stream")
         peer = p["peer"]
+        chunk_cfg = int(self.node.config.ml.stream_chunk_steps or 0)
+        # confirmed stop-sequence cancels ride back from the driving user
+        # as STREAM_CANCEL frames parked on the network server; poll them
+        # every `poll_every` steps — one blocking IPC round trip per chunk,
+        # not per token — so the compiled chunked decode overruns a stop by
+        # at most one chunk instead of the full token budget
+        poll_every = chunk_cfg if chunk_cfg > 0 else 32
+        steps_seen = 0
 
         def stream_cb(emitted):
             # (row, token) pairs keep attribution for batched streams; the
             # driver reconstructs the per-row emission list
+            nonlocal steps_seen
             pairs = [[i, t] for i, t in enumerate(emitted) if t is not None]
             if pairs:
                 # fire-and-forget: a blocking round-trip here would add a
@@ -1237,6 +1371,16 @@ class DistributedWorker:
                     "send_token",
                     {"peer": peer, "stream": stream_id, "tokens": pairs},
                 )
+            steps_seen += 1
+            if stream_id and steps_seen % poll_every == 0:
+                try:
+                    rows = self.bridge.request(
+                        "poll_cancel", {"stream": stream_id}, timeout=5.0
+                    )
+                except Exception:
+                    rows = None  # relay hiccup must not kill the decode
+                return rows or None
+            return None
 
         if int(p.get("num_beams", 1)) > 1:
             # beams ride the engine's batch axis — clamp to the largest
@@ -1311,6 +1455,9 @@ class DistributedWorker:
                 budgets=budgets,
                 reuse_prefix=reuse_prefix,
             )
+        if stream_id:
+            # release any cancel rows parked for this stream server-side
+            self.bridge.notify("clear_cancels", {"stream": stream_id})
         self._respond(
             peer, proto.GENERATE_RESP, p["rid"],
             {
@@ -1379,13 +1526,20 @@ class DistributedWorker:
 
     def _params_req(self, p: dict) -> None:
         """Ship this stage's parameters back (reference parameter download,
-        ml/worker.py:1394-1413 writes a file; here it is one bulk frame)."""
+        ml/worker.py:1394-1413 writes a file; here it is one bulk frame).
+        Mirrored on merged co-slice stages: every member runs the gathers
+        (collectives on a spanning mesh), only the primary ships bytes."""
         import jax
 
         rt = self._runtime(p["job_id"])
         host_params = jax.tree.map(
-            lambda a: np.asarray(jax.device_get(a)), self._exact_params(rt)
+            lambda a: self._to_host(rt, a), self._exact_params(rt)
         )
+        if p.get("mirror"):
+            self._respond(
+                p["peer"], proto.PARAMETERS, p["rid"], {"ok": True, "mirror": True}
+            )
+            return
         self._respond(p["peer"], proto.PARAMETERS, p["rid"], {"params": host_params})
 
     def _train_mode(self, p: dict) -> None:

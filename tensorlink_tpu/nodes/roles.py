@@ -50,6 +50,13 @@ class RoleServer(TensorNode):
     """TensorNode + IPC command surface shared by all roles."""
 
     def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
+        if getattr(cfg, "faults", None):
+            # deterministic fault injection (core/faults.py): install the
+            # plan process-globally HERE — this network process is one OS
+            # process per node, so the global cannot leak across nodes
+            from tensorlink_tpu.core import faults
+
+            faults.install(faults.FaultPlan.from_dict(cfg.faults))
         super().__init__(
             cfg.role,
             host=cfg.effective_host(),
@@ -131,6 +138,12 @@ class RoleServer(TensorNode):
 
     async def cmd_validators(self, p) -> list[str]:
         return self.validator_ids()
+
+    async def cmd_peers(self, p) -> list[str]:
+        """Full node ids of live connections (``status`` truncates ids for
+        display; session recovery needs exact membership to tell which
+        stage workers died)."""
+        return list(self.connections)
 
     async def cmd_bootstrap(self, p) -> int:
         seeds = [tuple(s) for s in p.get("seeds", self.cfg.seed_validators)]
@@ -251,9 +264,15 @@ class WorkerServer(RoleServer):
     def __init__(self, cfg: NodeConfig, queues: BridgeQueues):
         super().__init__(cfg, queues)
         self.jobs: dict[str, dict] = {}
+        # stream id -> cancelled row indices (STREAM_CANCEL pushes from the
+        # driving user); the ML generate loop polls these at chunk
+        # boundaries via cmd_poll_cancel so a confirmed stop-sequence match
+        # ends the compiled decode within one chunk
+        self.stream_cancels: dict[str, set] = {}
         self.register(proto.JOB_REQ, self._handle_job_req)
         self.register(proto.JOB_SHUTDOWN, self._handle_job_shutdown)
         self.register(proto.MODULE, self._handle_module)
+        self.register(proto.STREAM_CANCEL, self._handle_stream_cancel)
         for tag in (
             proto.FORWARD, proto.BACKWARD, proto.GENERATE,
             proto.PARAMS_REQ, proto.OPTIMIZER, proto.TRAIN_MODE,
@@ -297,6 +316,22 @@ class WorkerServer(RoleServer):
         rid = body.pop("_rid", None)
         body.pop("_resp", None)
         self.post_work(tag, {**body, "peer": conn.node_id, "rid": rid})
+
+    async def _handle_stream_cancel(self, conn, kind, tag, body) -> None:
+        """Record confirmed stop-sequence cancels for a streamed generate.
+        Kept server-side (not relayed through the work queue): the ML run
+        loop is busy inside the generate and polls via cmd_poll_cancel."""
+        rows = self.stream_cancels.setdefault(str(body.get("stream", "")), set())
+        rows.update(int(r) for r in body.get("rows", []))
+        if len(self.stream_cancels) > 1024:  # stale-stream bound
+            self.stream_cancels.pop(next(iter(self.stream_cancels)))
+
+    async def cmd_poll_cancel(self, p) -> list[int]:
+        return sorted(self.stream_cancels.get(p.get("stream", ""), ()))
+
+    async def cmd_clear_cancels(self, p) -> bool:
+        self.stream_cancels.pop(p.get("stream", ""), None)
+        return True
 
 
 class ValidatorServer(RoleServer):

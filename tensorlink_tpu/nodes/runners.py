@@ -126,6 +126,21 @@ class BaseNode:
                 except Exception:
                     pass
 
+    def crash(self) -> None:
+        """Abrupt node death (fault injection, core/faults.py): kill the
+        network process with no shutdown courtesy so peers observe a dropped
+        connection — exactly what a real worker loss looks like. Unlike
+        :meth:`stop`, nothing is flushed and the ML loop is expected to be
+        the caller (it returns right after). ``stop()`` stays safe to call
+        afterwards."""
+        self._stop.set()
+        proc, self._proc = self._proc, None
+        if proc is not None:
+            proc.kill()
+            proc.join(timeout=5)
+        self._ml_thread = None  # the calling ML thread is exiting itself
+        self.bridge.close()
+
     def __enter__(self) -> "BaseNode":
         return self.start()
 

@@ -26,6 +26,7 @@ import uuid
 from pathlib import Path
 from typing import Awaitable, Callable
 
+from tensorlink_tpu.core import faults
 from tensorlink_tpu.core.logging import get_logger
 from tensorlink_tpu.p2p import protocol as proto
 
@@ -77,12 +78,21 @@ class Connection:
         await self.send_frame(kind, tag, payload)
 
     async def send_frame(self, kind: int, tag: str, payload: bytes) -> None:
+        dup = False
+        if faults.ENABLED:  # fault site "p2p.send": drop / delay / dup
+            act = faults.inject("p2p.send", tag)
+            if act == "drop":
+                return
+            if isinstance(act, tuple):
+                await asyncio.sleep(act[1])
+            dup = act == "dup"
         header = proto.pack_header(kind, tag, len(payload))
         async with self._wlock:
-            self.writer.write(header)
-            self.writer.write(payload)
-            await self.writer.drain()
-            self.bytes_sent += len(header) + len(payload)
+            for _ in range(2 if dup else 1):
+                self.writer.write(header)
+                self.writer.write(payload)
+                await self.writer.drain()
+                self.bytes_sent += len(header) + len(payload)
 
     async def send_file(self, kind: int, tag: str, path: str | Path, *, delete: bool = True) -> None:
         """Stream a file as one bulk frame without loading it (reference
@@ -121,6 +131,21 @@ class Connection:
                     break
                 self.bytes_received += proto.HEADER_SIZE + hdr.tag_len + hdr.payload_len
                 self.last_seen = time.monotonic()
+                deliveries = 1
+                if faults.ENABLED:  # fault site "connection.frame"
+                    act = faults.inject("connection.frame", tag)
+                    if act == "drop":
+                        if isinstance(payload, Path):
+                            # spilled frames are consumed on delivery — a
+                            # dropped one must still release its temp file
+                            payload.unlink(missing_ok=True)
+                        continue
+                    if isinstance(act, tuple):
+                        await asyncio.sleep(act[1])
+                    if act == "dup" and not isinstance(payload, Path):
+                        # spilled frames are consumed (unlinked) on first
+                        # delivery — only in-memory payloads can duplicate
+                        deliveries = 2
                 if tag == proto.PING:
                     await self.send_control(proto.PONG, {})
                     continue
@@ -129,7 +154,8 @@ class Connection:
                         self.latency_s = time.monotonic() - self._ping_sent_at
                         self._ping_sent_at = None
                     continue
-                await on_frame(self, hdr.kind, tag, payload)
+                for _ in range(deliveries):
+                    await on_frame(self, hdr.kind, tag, payload)
         except proto.ProtocolError as e:
             log.warning("protocol error from %s: %s", self.peername, e)
         finally:

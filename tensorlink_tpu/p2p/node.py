@@ -150,7 +150,8 @@ class P2PNode:
         if not self._loop:
             return
         self.terminate.set()
-        self._loop.call_soon_threadsafe(self._loop.stop)
+        if not self._loop.is_closed():  # idempotent: double-stop is a no-op
+            self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None

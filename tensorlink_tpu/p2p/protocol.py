@@ -81,6 +81,10 @@ GENERATE = "gen"
 GENERATE_RESP = "gen.resp"
 TOKEN = "token"
 STREAM_END = "stream.end"
+# user -> worker: confirmed stop-sequence matches for rows of a streamed
+# generate; the worker's compiled chunked decode checks these at chunk
+# boundaries and stops early instead of running out its token budget
+STREAM_CANCEL = "stream.cancel"
 PARAMS_REQ = "params.req"
 PARAMETERS = "params"
 OPTIMIZER = "opt"

@@ -22,8 +22,11 @@ import asyncio
 import time
 
 FREE_JOB_MAX_TIME = 3600.0  # reference validator_thread.py:19
-OFFLINE_GRACE = 5.0  # seconds a worker may be missing before replacement
+OFFLINE_GRACE = 5.0  # default; NodeConfig.offline_grace overrides
 PROOF_INTERVAL = 60.0  # seconds between PoL log pulls per job
+# a job that keeps losing workers is flapping — endless recruit loops burn
+# the spare pool for a job that cannot hold a placement; fail it instead
+MAX_REPAIRS_PER_JOB = 16
 
 
 class JobMonitor:
@@ -31,6 +34,10 @@ class JobMonitor:
 
     def __init__(self, server):
         self.server = server
+        self.grace = float(
+            getattr(getattr(server, "cfg", None), "offline_grace", OFFLINE_GRACE)
+            or OFFLINE_GRACE
+        )
 
     async def check_jobs(self) -> None:
         now = time.time()
@@ -69,16 +76,23 @@ class JobMonitor:
                 continue
             job.setdefault("offline_since", now)
             job["status"] = "pending_offline"
-            if now - job["offline_since"] < OFFLINE_GRACE:
+            if now - job["offline_since"] < self.grace:
+                continue
+            if job.get("repairs", 0) >= MAX_REPAIRS_PER_JOB:
+                # flapping: this job has churned through too many
+                # replacements — stop feeding it the worker pool
+                await self._finish(job_id, job, "failed")
                 continue
             ok = True
             for wid in missing:
                 update = await self.server.replace_worker(job_id, wid)
                 ok = ok and update is not None
+                if update is not None:
+                    job["repairs"] = job.get("repairs", 0) + 1
             if ok:
                 job["status"] = "active"
                 job.pop("offline_since", None)
-            elif now - job["offline_since"] > 6 * OFFLINE_GRACE:
+            elif now - job["offline_since"] > 6 * self.grace:
                 await self._finish(job_id, job, "failed")
 
     async def _finish(self, job_id: str, job: dict, status: str) -> None:

@@ -167,6 +167,35 @@ def test_coslice_merged_mesh_training(tmp_path):
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
 
+        # checkpoint + parameter download on the MERGED mesh: the work
+        # items are mirrored to every member, the per-leaf gathers run as
+        # lockstep collectives, only the primary touches the file
+        # (previously a RuntimeError refusal, VERDICT "What's missing" §3)
+        logits_before = np.asarray(model(toks))
+        ckpt = tmp_path / "coslice_ckpt"
+        paths = model.save_checkpoint(str(ckpt))["paths"]
+        assert paths and (tmp_path / "coslice_ckpt" / "manifest.json").exists()
+        model.restore_checkpoint(str(ckpt))
+        np.testing.assert_allclose(
+            np.asarray(model(toks)), logits_before, rtol=1e-5, atol=1e-6
+        )
+
+        # HF export round-trips: merged params -> safetensors -> load_params
+        from tensorlink_tpu.engine.loader import load_params
+
+        out_dir = tmp_path / "hf_export"
+        model.export_hf_checkpoint(str(out_dir))
+        _, reloaded = load_params(str(out_dir), cfg)
+        merged = model._merge_stage_params(model.parameters())
+        ref_leaves = jax.tree.leaves(merged["layers"])
+        new_leaves = jax.tree.leaves(reloaded["layers"])
+        assert len(ref_leaves) == len(new_leaves) > 0
+        for a, b in zip(new_leaves, ref_leaves):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-6, atol=1e-7,
+            )
+
         # serving is refused loudly on merged meshes (host-driven loops
         # are single-controller), not deadlocked
         with pytest.raises(RuntimeError, match="co-slice"):

@@ -1,0 +1,196 @@
+"""Unit tests for the deterministic fault-injection layer (core/faults.py)
+and the worker-side session-op idempotency it exists to exercise."""
+
+import numpy as np
+import pytest
+
+from tensorlink_tpu.core import faults
+from tensorlink_tpu.core.faults import FaultCrash, FaultInjected, FaultPlan
+
+
+def test_disabled_by_default_zero_overhead():
+    # the hot-path contract: without an installed plan the module flag is
+    # False, so guarded sites never even call inject()
+    assert faults.ENABLED is False
+    assert faults.inject("p2p.send", "fwd") is None  # and a stray call no-ops
+
+
+def test_install_uninstall_toggles_flag():
+    faults.install(FaultPlan.from_dict({"seed": 1, "rules": []}))
+    try:
+        assert faults.ENABLED is True
+    finally:
+        faults.uninstall()
+    assert faults.ENABLED is False
+
+
+def test_plan_deterministic_given_seed():
+    spec = {"seed": 42, "rules": [
+        {"site": "p2p.send", "op": "drop", "prob": 0.3, "max_fires": None},
+    ]}
+    runs = []
+    for _ in range(2):
+        p = FaultPlan.from_dict(spec)
+        runs.append([p.inject("p2p.send", "fwd") for _ in range(50)])
+    assert runs[0] == runs[1]
+    assert "drop" in runs[0] and None in runs[0]
+    # a different seed makes different decisions
+    p = FaultPlan.from_dict({**spec, "seed": 43})
+    assert [p.inject("p2p.send", "fwd") for _ in range(50)] != runs[0]
+
+
+def test_nth_counts_matching_calls_only():
+    p = FaultPlan.from_dict({"rules": [
+        {"site": "p2p.send", "op": "drop", "nth": 2, "key_substr": "fwd"},
+    ]})
+    assert p.inject("p2p.send", "ping") is None  # filtered, not counted
+    assert p.inject("p2p.send", "fwd") is None  # match #1
+    assert p.inject("p2p.send", "ping") is None
+    assert p.inject("p2p.send", "fwd") == "drop"  # match #2 fires
+    assert p.inject("p2p.send", "fwd") is None  # max_fires=1 default
+
+
+def test_ops_error_and_crash_raise():
+    p = FaultPlan.from_dict({"rules": [
+        {"site": "worker.session_step", "op": "error", "nth": 1},
+        {"site": "worker.train_step", "op": "crash", "nth": 1},
+    ]})
+    with pytest.raises(FaultInjected):
+        p.inject("worker.session_step")
+    with pytest.raises(FaultCrash):
+        p.inject("worker.train_step")
+    # FaultCrash must escape `except Exception` error-reply paths
+    assert not issubclass(FaultCrash, Exception)
+
+
+def test_delay_and_dup_actions():
+    p = FaultPlan.from_dict({"rules": [
+        {"site": "connection.frame", "op": "delay", "nth": 1, "delay_s": 0.2},
+        {"site": "connection.frame", "op": "dup", "nth": 2},
+    ]})
+    assert p.inject("connection.frame") == ("delay", 0.2)
+    assert p.inject("connection.frame") == "dup"
+
+
+def test_unknown_op_rejected():
+    with pytest.raises(ValueError):
+        FaultPlan.from_dict({"rules": [{"site": "x", "op": "explode"}]})
+
+
+# ---------------------------------------------------------------------------
+# worker-side seq dedup: duplicated / retried session ops never double-apply
+# ---------------------------------------------------------------------------
+
+
+class _FakeBridge:
+    """Captures worker responses and chain sends in-process."""
+
+    def __init__(self):
+        self.responses = []
+        self.chain_sends = []
+
+    def request(self, verb, payload, timeout=None):
+        if verb == "respond":
+            self.responses.append(payload)
+        elif verb == "chain_send":
+            self.chain_sends.append(payload)
+        return True
+
+    def notify(self, verb, payload):
+        pass
+
+
+class _FakeNode:
+    def __init__(self):
+        from tensorlink_tpu.core.config import WorkerConfig
+
+        self.config = WorkerConfig()
+        self.bridge = _FakeBridge()
+        self.node_id = "f" * 64
+
+
+@pytest.fixture()
+def worker():
+    from tensorlink_tpu.ml.worker import DistributedWorker
+    from tensorlink_tpu.models.base import ModelConfig
+
+    node = _FakeNode()
+    w = DistributedWorker(node)
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=8, d_ff=64, max_seq_len=32, dtype="float32",
+    )
+    w._handle("load_stage", {
+        "job_id": "j1",
+        "model": {"name": "t", "config": cfg.to_json(), "seed": 0},
+        "stage": {"layer_lo": 0, "layer_hi": 2, "first": True, "last": True,
+                  "holds_head": True, "worker_id": "w", "mesh_axes": {},
+                  "coworkers": []},
+        "peer": "user", "rid": "r0",
+    })
+    node.bridge.responses.clear()
+    return node, w
+
+
+def _decode_op(rid, seq, tok, step):
+    return {
+        "job_id": "j1", "op": "stage", "session": "s1", "cache_len": 32,
+        "seq": seq, "tokens": np.array([[tok]], np.int32),
+        "sample": {"temperature": 0.0, "seed": 0, "step": step},
+        "peer": "user", "rid": rid,
+    }
+
+
+def test_session_seq_dedup_never_double_applies(worker):
+    node, w = worker
+    prefill = {
+        "job_id": "j1", "op": "stage", "session": "s1", "cache_len": 32,
+        "seq": 0, "tokens": np.array([[3, 5, 7]], np.int32),
+        "sample": {"temperature": 0.0, "seed": 0, "step": 0},
+        "last_idx": np.array([2], np.int32),
+        "peer": "user", "rid": "r1",
+    }
+    w._handle("fwd", prefill)
+    rt = w.jobs["j1"]
+    len_after_prefill = int(np.asarray(rt.sessions["s1"].length)[0])
+    assert len_after_prefill == 3
+    tok1 = int(node.bridge.responses[-1]["body"]["token"][0])
+
+    # duplicate delivery of the SAME prefill (frame dup / RPC retry): the
+    # cache must not grow, and the cached token is re-sent under the new rid
+    w._handle("fwd", dict(prefill, rid="r1retry"))
+    assert int(np.asarray(rt.sessions["s1"].length)[0]) == 3
+    assert node.bridge.responses[-1]["rid"] == "r1retry"
+    assert int(node.bridge.responses[-1]["body"]["token"][0]) == tok1
+
+    # a decode step, then its duplicate
+    w._handle("fwd", _decode_op("r2", 1, tok1, 1))
+    assert int(np.asarray(rt.sessions["s1"].length)[0]) == 4
+    tok2 = int(node.bridge.responses[-1]["body"]["token"][0])
+    w._handle("fwd", _decode_op("r2retry", 1, tok1, 1))
+    assert int(np.asarray(rt.sessions["s1"].length)[0]) == 4  # not 5
+    assert int(node.bridge.responses[-1]["body"]["token"][0]) == tok2
+
+    # an OLDER seq than the watermark is dropped silently (original reply
+    # already delivered; nothing cached for it anymore)
+    n = len(node.bridge.responses)
+    w._handle("fwd", dict(prefill, rid="r1late"))
+    assert len(node.bridge.responses) == n
+
+    # end_session clears the ledger
+    w._handle("fwd", {"job_id": "j1", "op": "end_session", "session": "s1",
+                      "peer": "user", "rid": "r3"})
+    assert not rt.session_seq and not rt.session_resp
+
+
+def test_worker_fault_crash_site(worker):
+    node, w = worker
+    node.config.faults = {"rules": [
+        {"site": "worker.session_step", "op": "crash", "nth": 2},
+    ]}
+    from tensorlink_tpu.core.faults import FaultPlan
+
+    w.faults = FaultPlan.from_dict(node.config.faults)
+    w._handle("fwd", _decode_op("r1", 0, 3, 0))  # survives call 1
+    with pytest.raises(FaultCrash):
+        w._handle("fwd", _decode_op("r2", 1, 3, 1))  # dies on call 2
