@@ -73,6 +73,18 @@ class MLConfig:
     # serving: how many concurrent API requests one batched decode may
     # coalesce (ml/batching.py); bounded by the largest batch bucket
     max_serve_batch: int = 8
+    # continuous batching over the paged KV cache (engine/continuous.py,
+    # docs/SERVING.md): requests join the RUNNING slot batch at decode-chunk
+    # boundaries and finished rows free their KV pages immediately, instead
+    # of window-coalescing into run-to-completion static batches. Single-
+    # stage jobs decode on the worker's slot engine; pipelined jobs run
+    # slot admission through the session path (ml/batching.py). Models the
+    # paged engine can't serve (int8 KV cache, sliding-window attention)
+    # fall back to the windowed batcher automatically.
+    continuous_batching: bool = True
+    cont_max_slots: int = 8  # concurrent requests per model (B of the slot batch)
+    cont_page_size: int = 16  # KV positions per page
+    cont_chunk_steps: int = 8  # decode steps between admission boundaries
     # streamed requests: >0 runs the decode as fully-compiled on-device
     # chunks of this many steps (one host round trip per chunk instead of
     # per token — engine/generate.py::generate_chunked); 0 keeps the

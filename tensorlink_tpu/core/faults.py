@@ -19,6 +19,8 @@ Wired sites:
   (ml/worker.py::_forward); supports error / crash.
 - ``worker.train_step``   — every optimizer step (ml/worker.py::_optimizer);
   supports error / crash.
+- ``worker.cont_step``    — every continuous-batching decode chunk over the
+  worker's slot engine (ml/worker.py::_cont_step); supports error / crash.
 
 Zero overhead when disabled: the network process guards every site with
 ``if faults.ENABLED:`` (a module bool that is False unless a plan was

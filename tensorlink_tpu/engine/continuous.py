@@ -1,0 +1,449 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Replaces run-to-completion static batches (GenerationEngine.generate_* on
+a window-coalesced request group) with **step-granularity admission and
+eviction**: the engine decodes a fixed slot batch (B = max_slots) in
+chunks, and every chunk boundary can admit queued prefills into free
+slots and return finished slots' pages to the free-list. A request
+therefore joins the running batch within at most one decode chunk, and a
+finished row stops consuming decode steps immediately — the two failure
+modes of the static batcher (queue-until-drain, dead ``done``-masked
+rows) are structurally gone.
+
+Determinism contract (the parity tests' anchor): each slot samples with
+its OWN stateless key chain — token n of a request draws from
+``fold_in(PRNGKey(seed), n)`` — and a slot's logits depend only on its
+own pages (attention masks by slot length). So a request decodes
+token-for-token identically whether it runs alone, co-resident with any
+mix of neighbors, admitted mid-flight, or resumed on a replacement
+worker after a crash (the recovery path re-prefills prompt + emitted and
+continues the chain at n = len(emitted)).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import GenerationEngine
+from .paged import (
+    PageAllocator,
+    PagedKVCache,
+    bind_slot,
+    clear_slot,
+    paged_decode_chunk,
+    paged_decode_step,
+    pages_needed,
+    scatter_prefill,
+)
+from .sampling import SamplingParams, sample
+
+
+@jax.jit
+def _row_keys(seeds: jax.Array, steps: jax.Array) -> jax.Array:
+    """Per-slot sampling keys: ``fold_in(PRNGKey(seed_s), step_s)``.
+    Stateless in the step index — the property that makes crash recovery
+    and mid-flight admission bit-exact (no split chain to replay)."""
+    return jax.vmap(
+        lambda s, n: jax.random.fold_in(jax.random.PRNGKey(s), n)
+    )(seeds, steps)
+
+
+@jax.jit
+def _sample_rows(logits, keys, temp, top_k, top_p, pres, freq, counts):
+    """Row-independent sampling: each slot draws from its own key over its
+    own logits, so neighbors can never perturb a request's stream."""
+
+    def one(lg, key, t, k, p, pp, fp, cnt):
+        sp = SamplingParams(
+            temperature=t, top_k=k, top_p=p,
+            presence_penalty=pp, frequency_penalty=fp,
+        )
+        return sample(lg[None], key, sp, cnt[None])[0]
+
+    return jax.vmap(one)(logits, keys, temp, top_k, top_p, pres, freq, counts)
+
+
+@dataclass
+class ContinuousRequest:
+    """One in-flight (or queued) request's host-side state."""
+
+    rid: int
+    prompt: list[int]  # original prompt + any previously-emitted prefix
+    budget: int  # new tokens still wanted
+    sampling: SamplingParams  # scalar leaves
+    eos: frozenset
+    seed: int
+    start_step: int = 0  # tokens emitted before admission (recovery)
+    stream_cb: Callable[[int], bool | None] | None = None
+    on_finish: Callable[["ContinuousRequest"], None] | None = None
+    tokens: list[int] = field(default_factory=list)  # emitted THIS run
+    finished: bool = False
+    slot: int = -1
+    pages: list[int] = field(default_factory=list)
+    error: BaseException | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+class ContinuousEngine:
+    """Slot-batched continuous decode over one GenerationEngine's model.
+
+    Single-driver discipline: ``submit``/``cancel`` are thread-safe;
+    ``step_chunk`` must be called from one driver thread (the worker's
+    work loop or a ContinuousBatcher's dispatcher).
+    """
+
+    def __init__(
+        self,
+        engine: GenerationEngine,
+        *,
+        max_slots: int = 8,
+        page_size: int = 16,
+        chunk_steps: int = 8,
+    ):
+        if engine.cache_quant:
+            raise ValueError(
+                "continuous batching does not support the int8 KV cache — "
+                "serve quantized-cache models through the static batcher"
+            )
+        if engine.cfg.sliding_window is not None:
+            raise ValueError(
+                "continuous batching does not support sliding-window "
+                "attention yet — serve through the static batcher"
+            )
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.chunk_steps = max(int(chunk_steps), 1)
+        self.max_seq_len = engine.max_seq_len
+        # the Pallas kernel needs a real TPU; CPU (tests, fallback serving)
+        # runs the pure-jnp reference path — same math, one compiled program
+        self.use_kernel = jax.default_backend() == "tpu"
+        self.cache = PagedKVCache.init(
+            self.cfg, self.max_slots, page_size=self.page_size,
+            max_len=self.max_seq_len, dtype=engine.cache_dtype,
+        )
+        self.alloc = PageAllocator(self.cache.n_pages)
+        self._lock = threading.Lock()
+        self._queue: deque[ContinuousRequest] = deque()
+        self._rid = itertools.count(1)
+        self._slots: list[ContinuousRequest | None] = [None] * self.max_slots
+        # host mirrors of per-slot decode state (device arrays are rebuilt
+        # from these on admission/eviction — small, [S]-shaped)
+        self._tok = np.zeros(self.max_slots, np.int32)
+        self._seeds = np.zeros(self.max_slots, np.int32)
+        self._steps = np.zeros(self.max_slots, np.int32)
+        self._active = np.zeros(self.max_slots, bool)
+        self._temp = np.zeros(self.max_slots, np.float32)
+        self._topk = np.zeros(self.max_slots, np.int32)
+        self._topp = np.ones(self.max_slots, np.float32)
+        self._pres = np.zeros(self.max_slots, np.float32)
+        self._freq = np.zeros(self.max_slots, np.float32)
+        self._counts = jnp.zeros(
+            (self.max_slots, self.cfg.vocab_size), jnp.int32
+        )
+        # serving telemetry
+        self.stats = {
+            "admitted": 0, "evicted": 0, "decode_steps": 0,
+            "slot_steps_live": 0, "slot_steps_total": 0,
+        }
+
+    # -- client side -----------------------------------------------------
+    def submit(
+        self,
+        prompt: list[int],
+        *,
+        max_new_tokens: int,
+        sampling: SamplingParams | None = None,
+        eos_ids=(),
+        seed: int = 0,
+        start_step: int = 0,
+        stream_cb: Callable[[int], bool | None] | None = None,
+        on_finish: Callable[[ContinuousRequest], None] | None = None,
+    ) -> ContinuousRequest:
+        """Queue a request; it joins the slot batch at the next chunk
+        boundary with free capacity. ``start_step`` > 0 resumes a
+        recovered request's key chain (prompt then carries the original
+        prompt + tokens already delivered)."""
+        req = ContinuousRequest(
+            rid=next(self._rid),
+            prompt=[int(t) for t in prompt],
+            budget=int(max_new_tokens),
+            sampling=sampling or SamplingParams.make(),
+            eos=frozenset(int(e) for e in eos_ids),
+            seed=int(seed),
+            start_step=int(start_step),
+            stream_cb=stream_cb,
+            on_finish=on_finish,
+        )
+        with self._lock:
+            self._queue.append(req)
+        return req
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or bool(self._active.any())
+
+    @property
+    def live_slots(self) -> int:
+        return int(self._active.sum())
+
+    def jit_cache_sizes(self) -> dict:
+        """Compiled-program counts of the slot-batched hot loop — the
+        "no unbounded compile set" guarantee, asserted by the engine
+        tests: these stay fixed no matter the request mix."""
+        return {
+            "decode_chunk": paged_decode_chunk._cache_size(),
+            "decode_step": paged_decode_step._cache_size(),
+            "sample_rows": _sample_rows._cache_size(),
+            "row_keys": _row_keys._cache_size(),
+        }
+
+    # -- admission / eviction -------------------------------------------
+    def _finish(self, req: ContinuousRequest, *, finished: bool) -> None:
+        req.finished = finished
+        cb = req.on_finish
+        req.done.set()
+        if cb is not None:
+            cb(req)
+
+    def _emit(self, req: ContinuousRequest, tok: int) -> bool:
+        """Deliver one token; returns True when the request is done
+        (EOS / budget / downstream cancel)."""
+        req.tokens.append(tok)
+        cancel = False
+        if req.stream_cb is not None:
+            cancel = bool(req.stream_cb(tok))
+        return cancel or tok in req.eos or len(req.tokens) >= req.budget
+
+    def _admit_one(self, req: ContinuousRequest, slot: int) -> bool:
+        """Prefill ``req`` into ``slot``. Returns False when no pages are
+        free (request stays queued)."""
+        if len(req.prompt) > self.max_seq_len:
+            # surface the same diagnosable error the static path raises
+            # from prefill — never a mysterious empty completion
+            req.error = ValueError(
+                f"prompt length {len(req.prompt)} exceeds max_seq_len "
+                f"{self.max_seq_len}"
+            )
+            self._finish(req, finished=False)
+            return True
+        room = self.max_seq_len - len(req.prompt)
+        eff = min(req.budget, room)
+        if eff <= 0:
+            # zero room: report finished with an empty completion, matching
+            # the static paths' contract
+            self._finish(req, finished=True)
+            return True
+        req.budget = eff
+        total = min(len(req.prompt) + eff, self.max_seq_len)
+        pages = self.alloc.alloc(pages_needed(total, self.page_size))
+        if pages is None:
+            return False
+
+        # the prompt prefills through the engine's existing bucketed dense
+        # program (identical math to a solo decode), then its KV rows land
+        # on the allocated pages in one scatter
+        logits, dense, lens, _B = self.engine.prefill([req.prompt])
+        T = len(req.prompt)
+        T_pad = dense.k.shape[2]  # full dense cache span
+        # bucketed scatter span: smallest seq bucket covering the prompt
+        # (bounded program set); positions past the prompt land on scratch
+        spans = [b for b in self.engine.seq_buckets if b >= T]
+        T_sc = spans[0] if spans else T_pad
+        T_sc = min(T_sc, T_pad)
+        bt_row = np.zeros(self.cache.pages_per_slot, np.int32)
+        bt_row[: len(pages)] = pages
+        pos = np.arange(T_sc)
+        pg_idx = np.where(
+            pos < T, bt_row[pos // self.page_size], 0
+        ).astype(np.int32)
+        off_idx = np.where(pos < T, pos % self.page_size, 0).astype(np.int32)
+        self.cache = scatter_prefill(
+            self.cache,
+            dense.k[:, 0, :T_sc], dense.v[:, 0, :T_sc],
+            jnp.asarray(pg_idx), jnp.asarray(off_idx),
+        )
+        del dense
+        self.cache = bind_slot(
+            self.cache, jnp.int32(slot), jnp.asarray(bt_row), jnp.int32(T)
+        )
+
+        # first token: sampled from the prefill logits with the request's
+        # own key chain — exactly what a solo run draws
+        sp = req.sampling
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(req.seed), req.start_step
+        )
+        counts_row = self._prompt_counts(req)
+        tok = int(
+            np.asarray(sample(logits[:1], key, sp, counts_row[None]))[0]
+        )
+        self._counts = self._counts.at[slot].set(
+            counts_row.at[tok].add(1)
+        )
+        self.stats["admitted"] += 1
+        req.slot = slot
+        req.pages = pages
+        self._slots[slot] = req
+        self._seeds[slot] = req.seed
+        self._steps[slot] = req.start_step + 1  # next draw's index
+        self._tok[slot] = tok
+        self._active[slot] = True
+        t = np.asarray(sp.temperature)
+        self._temp[slot] = float(t.reshape(-1)[0])
+        self._topk[slot] = int(np.asarray(sp.top_k).reshape(-1)[0])
+        self._topp[slot] = float(np.asarray(sp.top_p).reshape(-1)[0])
+        self._pres[slot] = float(np.asarray(sp.presence_penalty).reshape(-1)[0])
+        self._freq[slot] = float(np.asarray(sp.frequency_penalty).reshape(-1)[0])
+        if self._emit(req, tok):
+            self._evict(slot)
+        return True
+
+    def _prompt_counts(self, req: ContinuousRequest) -> jax.Array:
+        """Context histogram for presence/frequency penalties (row-local,
+        like everything else about a slot)."""
+        if not (self._any(req.sampling.presence_penalty)
+                or self._any(req.sampling.frequency_penalty)):
+            return jnp.zeros((self.cfg.vocab_size,), jnp.int32)
+        c = np.zeros(self.cfg.vocab_size, np.int32)
+        np.add.at(c, np.asarray(req.prompt, np.int64), 1)
+        return jnp.asarray(c)
+
+    @staticmethod
+    def _any(v) -> bool:
+        return bool(np.any(np.asarray(v)))
+
+    def _evict(self, slot: int) -> None:
+        """Free a finished slot at a step boundary: pages → free-list,
+        table row → scratch, slot → admission pool."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._tok[slot] = 0
+        self._temp[slot] = 0.0
+        self.cache = clear_slot(self.cache, jnp.int32(slot))
+        self._counts = self._counts.at[slot].set(0)
+        if req is not None:
+            self.alloc.free(req.pages)
+            req.pages = []
+            self.stats["evicted"] += 1
+            self._finish(req, finished=True)
+
+    def _admit(self) -> None:
+        while True:
+            # the lock guards only the host-side deque — the device-heavy
+            # prefill in _admit_one runs OUTSIDE it so client submit()
+            # calls never stack behind admission compute (single-driver
+            # discipline means nobody else pops the head meanwhile)
+            with self._lock:
+                free = [
+                    s for s in range(self.max_slots) if not self._active[s]
+                ]
+                if not self._queue or not free:
+                    return
+                req = self._queue[0]
+            if not self._admit_one(req, free[0]):
+                return  # head-of-line waits for pages
+            with self._lock:
+                if self._queue and self._queue[0] is req:
+                    self._queue.popleft()
+
+    # -- the decode loop -------------------------------------------------
+    # per-slot EOS ids carried INTO the compiled chunk (freeze
+    # optimization); the host's delivery loop checks the full set, so an
+    # overflowing set only costs wasted in-chunk steps, never correctness
+    _EOS_WIDTH = 8
+
+    def step_chunk(self, *, admit_only: bool = False) -> bool:
+        """Admit queued requests, then run ONE compiled decode chunk
+        (``chunk_steps`` fixed-shape slot steps in a single on-device
+        while_loop — one host round trip per chunk, not per token),
+        delivering each slot's tokens up to its own done-point and
+        evicting finished slots at the boundary. Returns True while any
+        work (live slots or queued requests) remains — the driver's
+        requeue signal."""
+        self._admit()
+        if admit_only or not self._active.any():
+            return self.has_work()
+        S = self.max_slots
+        remaining = np.zeros(S, np.int32)
+        eos_arr = np.full((S, self._EOS_WIDTH), -1, np.int32)
+        for s in range(S):
+            req = self._slots[s]
+            if req is not None:
+                remaining[s] = req.budget - len(req.tokens)
+                ids = sorted(req.eos)[: self._EOS_WIDTH]
+                eos_arr[s, : len(ids)] = ids
+        tokens, n_exec, self.cache, _done, steps_dev, self._counts, _rem = (
+            paged_decode_chunk(
+                self.engine.params, jnp.asarray(self._tok), self.cache,
+                jnp.asarray(self._active),
+                jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._pres),
+                jnp.asarray(self._freq), self._counts,
+                jnp.asarray(remaining), jnp.asarray(eos_arr),
+                self.cfg, self.chunk_steps, self.use_kernel,
+            )
+        )
+        n_exec = int(n_exec)
+        if n_exec <= 0:
+            return self.has_work()
+        toks_host = np.asarray(tokens)[:, :n_exec]
+        self.stats["decode_steps"] += n_exec
+        self.stats["slot_steps_total"] += n_exec * S
+        for s in range(S):
+            if not self._active[s]:
+                continue
+            req = self._slots[s]
+            finished = False
+            emitted = 0
+            for i in range(n_exec):
+                tok = int(toks_host[s, i])
+                self._tok[s] = tok
+                emitted += 1
+                if self._emit(req, tok):
+                    finished = True
+                    break
+            # the chunk's frozen slots stopped their key chain exactly
+            # where the host delivery stops, so the emitted count IS the
+            # step advance (authoritative over the device mirror when an
+            # EOS id overflowed _EOS_WIDTH)
+            self._steps[s] += emitted
+            self.stats["slot_steps_live"] += emitted
+            if finished:
+                self._evict(s)
+        return self.has_work()
+
+    def run_until_idle(self) -> None:
+        """Drive the loop to quiescence (tests, bench, local serving)."""
+        while self.step_chunk():
+            pass
+
+    def close(self, error: BaseException | None = None) -> None:
+        """Fail everything still queued or in flight (model unhosting /
+        engine teardown)."""
+        err = error or RuntimeError("continuous engine closed")
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+        for s in range(self.max_slots):
+            req = self._slots[s]
+            if req is not None:
+                req.error = err
+                self._evict(s)
+        for req in pending:
+            req.error = err
+            self._finish(req, finished=False)
+
+
+__all__ = ["ContinuousEngine", "ContinuousRequest"]

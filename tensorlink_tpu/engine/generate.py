@@ -347,6 +347,16 @@ class GenerationEngine:
         # worth the device_get that storing it would cost
         self.prefix_lru_bytes = 512 << 20
 
+    # -- batch bucketing --------------------------------------------------
+    def batch_bucket(self, n_live: int) -> int:
+        """The batch shape ``n_live`` concurrent rows decode at: the
+        SMALLEST compiled bucket that fits them. This is the serving
+        batcher's sizing contract (regression-pinned in
+        tests/test_batching.py) — 2 live requests must run the B=2
+        program, never pad out to B=8 and pay 4× the decode FLOPs for
+        dead rows."""
+        return _bucket(max(int(n_live), 1), self.batch_buckets)
+
     # -- cache ------------------------------------------------------------
     def new_cache(self, batch: int) -> KVCache:
         cache = KVCache.init(
@@ -554,7 +564,7 @@ class GenerationEngine:
             out = self.prefill(prompts)
             self._prefix_store(prompt, out[1])
             return out
-        B = _bucket(len(prompts), self.batch_buckets)
+        B = self.batch_bucket(len(prompts))
         lens = [len(p) for p in prompts]
         T_max = max(lens)
         if T_max > self.max_seq_len:
@@ -707,6 +717,7 @@ class GenerationEngine:
         budgets: Sequence[int] | None = None,
         reuse_prefix: bool = False,
         chunk_steps: int = 32,
+        shrink_on_eviction: bool = True,
     ) -> GenerationResult:
         """Streaming at COMPILED-loop speed: the decode runs as a sequence
         of fully-on-device while_loop chunks (one program — ``chunk_steps``
@@ -721,6 +732,17 @@ class GenerationEngine:
         is discarded; only device compute runs to the chunk end).
         Penalized requests fall back to the per-token host loop — context
         counts don't ride across chunk calls.
+
+        ``shrink_on_eviction``: when rows finish (EOS / budget / cancel)
+        mid-batch, the next chunk re-buckets the SURVIVORS — live cache
+        rows gather into the smallest bucket ≥ live count instead of
+        dead-stepping the original batch shape to drain (the r5 co-batch
+        regression: 2 live rows decoding at B=8 pay 4× the FLOPs per
+        token). Greedy-only: argmax is shape-independent, but a sampled
+        row's draw depends on the batch's shared key walk, so sampled
+        mixes keep their shape to preserve seed parity with the one-shot
+        compiled loop. ``self.last_chunk_batches`` records each chunk's
+        batch shape for telemetry/tests.
 
         (Prologue is deliberately parallel to ``generate`` /
         ``generate_compiled`` — a semantic change to row limits, EOS
@@ -746,33 +768,65 @@ class GenerationEngine:
         chunk_steps = max(int(chunk_steps), 1)
 
         seqs: list[list[int]] = [[] for _ in range(n_rows)]
-        done = np.zeros(B, bool)
-        remaining = np.asarray(eff, np.int64)
+        done = np.zeros(n_rows, bool)
+        remaining = np.asarray(eff[:n_rows], np.int64)
         done |= remaining <= 0
+        # batch row -> request index (None for bucket padding); compaction
+        # rewrites this map when survivors re-bucket
+        rowmap: list[int | None] = list(range(n_rows)) + [None] * (B - n_rows)
+        # all-greedy mixes may re-bucket: argmax is batch-shape-independent,
+        # a sampled draw is not (the loop key is shared per step)
+        shrinkable = shrink_on_eviction and not bool(
+            np.any(np.asarray(sampling.temperature) > 0)
+        )
+        self.last_chunk_batches: list[int] = []
 
         def emit(step_tokens: np.ndarray) -> None:
             """Deliver one decode step's tokens (engine stream contract:
-            one entry per row, None for finished rows) and fold them into
-            the per-row sequences / done flags."""
-            emitted: list[int | None] = []
-            for i in range(n_rows):
-                if not done[i]:
-                    t = int(step_tokens[i])
-                    seqs[i].append(t)
-                    emitted.append(t)
-                    remaining[i] -= 1
-                    if t in eos_set or remaining[i] <= 0:
-                        done[i] = True
-                else:
-                    emitted.append(None)
+            one entry per REQUEST, None for finished rows) and fold them
+            into the per-request sequences / done flags."""
+            emitted: list[int | None] = [None] * n_rows
+            for r, i in enumerate(rowmap):
+                if i is None or done[i]:
+                    continue
+                t = int(step_tokens[r])
+                seqs[i].append(t)
+                emitted[i] = t
+                remaining[i] -= 1
+                if t in eos_set or remaining[i] <= 0:
+                    done[i] = True
             if stream_cb is not None:
                 cancel = stream_cb(emitted)
                 for i in cancel or ():
-                    if 0 <= int(i) < B:
+                    if 0 <= int(i) < n_rows:
                         done[int(i)] = True
 
         emit(np.asarray(tok))
-        while not done[:n_rows].all():
+        while not done.all():
+            if shrinkable:
+                live = [i for i in range(n_rows) if not done[i]]
+                newB = self.batch_bucket(len(live))
+                if newB < len(rowmap):
+                    # eviction: gather the survivors' cache rows into the
+                    # smallest bucket that holds them and decode on
+                    rows = [rowmap.index(i) for i in live]
+                    gidx = jnp.asarray(
+                        rows + [rows[0]] * (newB - len(rows)), jnp.int32
+                    )
+                    cache = KVCache(
+                        k=cache.k[:, gidx], v=cache.v[:, gidx],
+                        length=cache.length[gidx],
+                        k_scale=None if cache.k_scale is None
+                        else cache.k_scale[:, gidx],
+                        v_scale=None if cache.v_scale is None
+                        else cache.v_scale[:, gidx],
+                    )
+                    tok = tok[gidx]
+                    sampling = jax.tree.map(
+                        lambda l: l[gidx] if jnp.ndim(l) else l, sampling
+                    )
+                    rowmap = list(live) + [None] * (newB - len(live))
+            self.last_chunk_batches.append(len(rowmap))
             # freeze finished rows for the whole chunk (limits <= 0 →
             # done0 inside the loop); live rows run up to their remaining
             # budget, capped by the chunk. The loop returns its ADVANCED
@@ -780,7 +834,13 @@ class GenerationEngine:
             # a chunked sampled decode emits exactly what one long
             # compiled loop (or the per-token host loop, which walks the
             # same chain) would emit for the same seed.
-            lims = jnp.asarray(np.where(done, 0, remaining), jnp.int32)
+            lims = jnp.asarray(
+                [
+                    0 if (i is None or done[i]) else int(remaining[i])
+                    for i in rowmap
+                ],
+                jnp.int32,
+            )
             tokens, cache, _dd, n_exec, key = _decode_loop(
                 self.params, tok, cache, key, sampling, eos, lims,
                 dummy, self.cfg, chunk_steps, penalize=False,
@@ -791,7 +851,7 @@ class GenerationEngine:
             toks_host = np.asarray(tokens)[:, :n_exec]
             for s in range(n_exec):
                 emit(toks_host[:, s])
-                if done[:n_rows].all():
+                if done.all():
                     break
             # next chunk resumes from each row's LAST token (frozen rows
             # re-fed their own token inside the loop, so column n_exec-1

@@ -1,0 +1,397 @@
+"""Block-paged KV cache + slot-batched decode step (continuous batching).
+
+The dense :class:`~tensorlink_tpu.models.base.KVCache` is ``[L, B, S_max,
+n_kv, hd]`` — one contiguous span per batch row, so a batched decode is
+welded to one (B, S_max) shape and a finished row's span stays allocated
+until the whole batch drains. Here KV lives in fixed-size **pages**
+``[L, P, n_kv, page, hd]`` (kv-head-major, so the Pallas kernel's
+per-(page, head) blocks carry TPU-native ``(page, hd)`` trailing tiles)
+with a per-slot **block table**: sequences of
+ragged lengths share ONE compiled decode program (the block table and
+lengths are data, not shape), a finished slot's pages return to the
+free-list immediately, and a queued prompt is admitted by writing a new
+block-table row — no recompile, no cache realloc.
+
+Page 0 is a reserved scratch page: free slots ride the fixed slot-batch
+shape with an all-zero block-table row and length 0, so their (masked,
+invisible) per-step KV writes land on scratch instead of a page another
+slot owns — that invariant is what makes eviction safe with zero
+cross-slot contamination.
+
+Attention routes through ops/attention.py: the Pallas
+:func:`~tensorlink_tpu.ops.attention.paged_attention` kernel on TPU
+(gathers KV page-by-page via a scalar-prefetched block table), the
+pure-jnp :func:`~tensorlink_tpu.ops.attention.paged_attention_ref` on CPU
+and in parity tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.base import ModelConfig
+from ..models.transformer import (
+    _embed_tokens,
+    _logits,
+    _mlp,
+    _norm,
+    _rms_head_norm,
+    apply_rope,
+    _rope_dim,
+    rope_tables,
+)
+from ..models.quant import matmul as _mm
+from ..ops.attention import paged_attention, paged_attention_ref
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    """Paged decode cache: ``k``/``v`` are ``[L, P, n_kv, page, hd]``,
+    ``block_tables`` maps each serving slot to its pages ``[S, n_pp]``
+    (0 = the reserved scratch page), ``lengths`` counts valid positions
+    per slot ``[S]``. Stacked over layers like the dense cache so the
+    decode ``lax.scan`` indexes its layer slice; donated into the step so
+    XLA updates pages in place."""
+
+    k: jax.Array
+    v: jax.Array
+    block_tables: jax.Array  # int32 [S, pages_per_slot]
+    lengths: jax.Array  # int32 [S]
+
+    @classmethod
+    def init(
+        cls,
+        cfg: ModelConfig,
+        max_slots: int,
+        *,
+        page_size: int = 16,
+        max_len: int | None = None,
+        dtype=None,
+    ) -> "PagedKVCache":
+        S_max = max_len or cfg.max_seq_len
+        n_pp = -(-S_max // page_size)  # pages per slot (ceil)
+        P = 1 + max_slots * n_pp  # page 0 = scratch, never allocated
+        shape = (cfg.n_layers, P, cfg.n_kv_heads, page_size, cfg.head_dim)
+        dt = dtype or cfg.dtype
+        return cls(
+            k=jnp.zeros(shape, dt),
+            v=jnp.zeros(shape, dt),
+            block_tables=jnp.zeros((max_slots, n_pp), jnp.int32),
+            lengths=jnp.zeros((max_slots,), jnp.int32),
+        )
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def pages_per_slot(self) -> int:
+        return self.block_tables.shape[1]
+
+
+class PageAllocator:
+    """Host-side free-list over physical page ids 1..P-1 (0 is scratch).
+
+    Pure bookkeeping — allocation order is irrelevant to correctness (the
+    block table names pages explicitly), so a freed page is reused LIFO
+    for locality. ``alloc`` is all-or-nothing: admission either gets every
+    page a request could need or stays queued."""
+
+    def __init__(self, n_pages: int):
+        self._free = list(range(n_pages - 1, 0, -1))  # pop() yields 1 first
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p > 0:
+                self._free.append(p)
+
+
+def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
+                 write_off, att_len, block_tables, kernel: bool):
+    """One transformer block over a slot batch of single tokens (T=1),
+    reading/writing KV through pages. Mirrors transformer.py::_block's
+    projection/norm/residual structure exactly — the parity tests pin the
+    two paths token-for-token — but swaps the contiguous-cache
+    dynamic_update_slice for a flat page scatter and the masked einsum for
+    paged attention."""
+    S = x.shape[0]
+    post = cfg.norm_position == "post"
+    h = x if post else _norm(x, lp["ln1"], cfg)
+    ap = lp["attn"]
+    q = _mm(h, ap["wq"])
+    k = _mm(h, ap["wk"])
+    v = _mm(h, ap["wv"])
+    if "bq" in ap:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    if cfg.qk_norm_full:
+        q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
+        k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
+    q = q.reshape(S, 1, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
+        k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
+    if cos is not None:
+        rd = cos.shape[-1]
+        if rd == cfg.head_dim:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        else:
+            q = jnp.concatenate(
+                [apply_rope(q[..., :rd], cos, sin), q[..., rd:]], axis=-1
+            )
+            k = jnp.concatenate(
+                [apply_rope(k[..., :rd], cos, sin), k[..., rd:]], axis=-1
+            )
+
+    ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
+    # per-slot scatter of the new token's KV: (page, offset) index pairs
+    # (advanced-first indexing puts the slot axis in front, matching the
+    # [S, n_kv, hd] update)
+    ck = ck.at[write_pg, :, write_off].set(k[:, 0].astype(ck.dtype))
+    cv = cv.at[write_pg, :, write_off].set(v[:, 0].astype(cv.dtype))
+
+    scale = cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
+    attn = paged_attention if kernel else paged_attention_ref
+    attn_out = attn(
+        q[:, 0], ck.astype(q.dtype), cv.astype(q.dtype),
+        block_tables, att_len, scale=scale,
+    )[:, None]  # [S, 1, Hq, hd]
+    attn_out = _mm(attn_out.reshape(S, 1, cfg.q_dim), ap["wo"])
+    if "bo" in ap:
+        attn_out = attn_out + ap["bo"]
+    if post:
+        x = x + _norm(attn_out, lp["ln1"], cfg)
+        x = x + _norm(_mlp(x, lp["mlp"], cfg), lp["ln2"], cfg)
+    elif cfg.parallel_residual:
+        x = x + attn_out + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+    else:
+        x = x + attn_out
+        x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+    return x, (ck, cv)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
+)
+def paged_decode_step(
+    params,
+    tok: jax.Array,  # int32 [S] — each slot's last token
+    cache: PagedKVCache,
+    active: jax.Array,  # bool [S] — slots holding a live request
+    cfg: ModelConfig,
+    kernel: bool = False,
+):
+    """ONE fixed-shape decode step over every serving slot. Returns
+    ``(logits [S, V], cache)`` with each active slot's new KV written to
+    its pages and its length advanced by one.
+
+    This is the continuous-batching engine's only decode program: its
+    shape depends on (max_slots, model) alone — never on the request mix —
+    so the compiled set stays at exactly one entry per engine (asserted by
+    tests/test_continuous.py). Free slots write their masked token to the
+    scratch page and attend over nothing (length 0 → zero row)."""
+    S = tok.shape[0]
+    lengths = cache.lengths
+    page = cache.page_size
+    n_pp = cache.pages_per_slot
+    # physical write position for each slot's new token; free slots have a
+    # zeroed block-table row and length 0 → scratch page 0. The clamp is
+    # belt-and-braces: the host evicts a slot before it can reach capacity
+    pos = jnp.minimum(lengths, n_pp * page - 1)
+    pg = jnp.take_along_axis(
+        cache.block_tables, (pos // page)[:, None], axis=1
+    )[:, 0]
+    write_pg = jnp.where(active, pg, 0)
+    write_off = jnp.where(active, pos % page, 0)
+    att_len = jnp.where(active, lengths + 1, 0)
+
+    x = _embed_tokens(params, tok[:, None], cfg)  # [S, 1, d]
+    positions = lengths[:, None]
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
+
+    def scan_fn(carry, xs):
+        lp, ck, cv = xs
+        y, ckv = _paged_block(
+            carry, lp, cfg, cos, sin, (ck, cv), write_pg, write_off,
+            att_len, cache.block_tables, kernel,
+        )
+        return y, ckv
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_fn, x, (params["layers"], cache.k, cache.v)
+    )
+    x = _norm(x, params["final_norm"], cfg)
+    logits = _logits(params, x, cfg)[:, 0]
+    new_cache = replace(
+        cache, k=k_new, v=v_new,
+        lengths=jnp.where(active, lengths + 1, lengths),
+    )
+    return logits, new_cache
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "kernel"),
+    donate_argnames=("cache", "counts"),
+)
+def paged_decode_chunk(
+    params,
+    tok: jax.Array,  # int32 [S] — each slot's last token
+    cache: PagedKVCache,
+    active: jax.Array,  # bool [S]
+    seeds: jax.Array,  # int32 [S] — per-slot RNG seeds
+    steps: jax.Array,  # int32 [S] — per-slot next draw index
+    temp: jax.Array,  # f32 [S] sampling knobs …
+    top_k: jax.Array,  # int32 [S]
+    top_p: jax.Array,  # f32 [S]
+    pres: jax.Array,  # f32 [S]
+    freq: jax.Array,  # f32 [S]
+    counts: jax.Array,  # int32 [S, V] context histograms (penalties)
+    remaining: jax.Array,  # int32 [S] — tokens still wanted per slot
+    eos: jax.Array,  # int32 [S, E] per-slot EOS ids (pad with -1)
+    cfg: ModelConfig,
+    n_steps: int,
+    kernel: bool = False,
+):
+    """Up to ``n_steps`` fixed-shape slot decode steps in ONE on-device
+    while_loop — the host is touched once per CHUNK, not once per token
+    (the same lever as engine/generate.py::_decode_loop, now over paged
+    slots). A slot that finishes mid-chunk (EOS / budget) freezes: its
+    length stops advancing, it re-feeds its own token, and its per-slot
+    key index stops — so the emitted stream is BIT-IDENTICAL to stepping
+    one token at a time, which is what keeps the solo/co-batched/recovery
+    parity contract intact. Early-exits when every slot is done.
+
+    Returns ``(tokens [S, n_steps], n_exec, cache, done, steps, counts,
+    remaining)``; the host delivers each slot's tokens up to its own
+    done-point and evicts at the chunk boundary."""
+    from .continuous import _row_keys, _sample_rows
+
+    S = tok.shape[0]
+    tokens = jnp.zeros((S, n_steps), jnp.int32)
+    done0 = ~active | (remaining <= 0)
+
+    def cond(st):
+        return (st[0] < n_steps) & ~st[3].all()
+
+    def body(st):
+        i, tok, cache, done, steps, counts, remaining, tokens = st
+        logits, cache = paged_decode_step(
+            params, tok, cache, ~done, cfg, kernel
+        )
+        keys = _row_keys(seeds, steps)
+        nxt = _sample_rows(
+            logits, keys, temp, top_k, top_p, pres, freq, counts
+        )
+        nxt = jnp.where(done, tok, nxt)  # frozen slots re-feed their token
+        live = (~done).astype(jnp.int32)
+        counts = counts.at[jnp.arange(S), nxt].add(live)
+        steps = steps + live
+        remaining = remaining - live
+        done = done | (nxt[:, None] == eos).any(-1) | (remaining <= 0)
+        return (
+            i + 1, nxt, cache, done, steps, counts, remaining,
+            tokens.at[:, i].set(nxt),
+        )
+
+    init = (jnp.int32(0), tok, cache, done0, steps, counts, remaining, tokens)
+    n_exec, _tok, cache, done, steps, counts, remaining, tokens = (
+        jax.lax.while_loop(cond, body, init)
+    )
+    return tokens, n_exec, cache, done, steps, counts, remaining
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def scatter_prefill(
+    cache: PagedKVCache,
+    k_rows: jax.Array,  # [L, T, n_kv, hd] — one prompt's dense KV rows
+    v_rows: jax.Array,
+    page_idx: jax.Array,  # int32 [T] — destination page per position
+    off_idx: jax.Array,  # int32 [T] — offset within the page
+) -> PagedKVCache:
+    """Land a dense prefill's KV rows on a slot's pages. The prefill
+    itself runs the engine's existing bucketed program (same math as a
+    solo decode — the parity anchor); this scatter is one device-side
+    copy, so admission costs prefill + O(T) page writes and compiles one
+    program per seq bucket."""
+    # cache.k is [L, P, Hkv, page, hd]; advanced-first indexing puts the
+    # T axis in front, so the rows transpose to [T, L, Hkv, hd]
+    k = cache.k.at[:, page_idx, :, off_idx].set(
+        k_rows.transpose(1, 0, 2, 3).astype(cache.k.dtype)
+    )
+    v = cache.v.at[:, page_idx, :, off_idx].set(
+        v_rows.transpose(1, 0, 2, 3).astype(cache.v.dtype)
+    )
+    return replace(cache, k=k, v=v)
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def bind_slot(
+    cache: PagedKVCache, slot: jax.Array, bt_row: jax.Array, length: jax.Array
+) -> PagedKVCache:
+    """Point a slot at its allocated pages (admission)."""
+    return replace(
+        cache,
+        block_tables=cache.block_tables.at[slot].set(bt_row),
+        lengths=cache.lengths.at[slot].set(length),
+    )
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def clear_slot(cache: PagedKVCache, slot: jax.Array) -> PagedKVCache:
+    """Detach an evicted slot: zero its table row (→ scratch page) and its
+    length, so the fixed-shape step treats it as free. The pages
+    themselves go back to the host free-list — their stale contents are
+    unreachable once no table row names them."""
+    return replace(
+        cache,
+        block_tables=cache.block_tables.at[slot].set(
+            jnp.zeros((cache.pages_per_slot,), jnp.int32)
+        ),
+        lengths=cache.lengths.at[slot].set(0),
+    )
+
+
+def pages_needed(total_len: int, page_size: int) -> int:
+    """Pages a request of ``total_len`` positions (prompt + budget, capped
+    at the engine's max_seq_len) occupies."""
+    return -(-int(total_len) // int(page_size))
+
+
+__all__ = [
+    "PagedKVCache",
+    "PageAllocator",
+    "paged_decode_step",
+    "scatter_prefill",
+    "bind_slot",
+    "clear_slot",
+    "pages_needed",
+]

@@ -1,23 +1,35 @@
-"""Dynamic request batching for the serving path.
+"""Request scheduling for the serving path.
 
-The reference serializes generation per hosted model (one request at a
-time through HF ``generate()``); here concurrent API requests coalesce
-into ONE batched decode: the engine's batch buckets already compile
-programs for B ∈ {1, 2, 4, 8}, and a batched decode step costs the same
-HBM parameter stream as a B=1 step — so batching N requests multiplies
-serving throughput by ~N until the MXU, not bandwidth, binds.
+Two schedulers share the client API (``generate``/``close``/``stats``):
 
-Mechanics: requests enqueue; the dispatcher takes the head request, waits
-a short window for more, then issues one ``model.generate`` with per-row
-sampling knobs (SamplingParams.stack) and per-row budgets, demuxing the
-per-row stream callback back to each request. Pipelined (multi-stage)
-jobs co-batch too: their session decode samples per-row on the
-head-holding worker (ml/worker.py::_sample_from_logits).
+:class:`GenBatcher` — the STATIC batcher. Requests enqueue; the
+dispatcher takes the head request, waits a short window for more, then
+issues one ``model.generate`` with per-row sampling knobs and budgets,
+demuxing the per-row stream callback back to each request. The whole
+batch then runs to completion: finished rows dead-step until the batch
+drains, and new arrivals queue behind it.
+
+:class:`ContinuousBatcher` — continuous batching (the default,
+MLConfig.continuous_batching). There is no window and no drain barrier:
+each request joins the model's RUNNING slot batch within at most one
+decode chunk, and finished requests free their KV immediately.
+
+- single-stage jobs: the request passes straight through to the worker,
+  whose slot engine (engine/continuous.py) decodes all residents over the
+  paged KV cache and admits/evicts at chunk boundaries;
+- pipelined jobs: a :class:`PipelinedSlotSession` runs slot admission
+  through the PR-1 session path — one persistent seq-numbered decode
+  session of B rows whose finished rows are recycled (``reset_rows``)
+  for queued prompts, with the per-session recovery semantics intact.
+
+See docs/SERVING.md for the scheduler's admission/eviction rules.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
+import secrets
 import threading
 import time
 from dataclasses import dataclass, field
@@ -41,6 +53,10 @@ class _Pending:
     stream_cb: Callable[[list[int]], None] | None = None
     result: list[int] | None = None
     error: BaseException | None = None
+    # continuous scheduling (ContinuousBatcher): per-request RNG seed and
+    # the model's EOS set ride the record instead of the dispatch call
+    seed: int = 0
+    eos_ids: list[int] = field(default_factory=list)
 
 
 class GenBatcher:
@@ -257,4 +273,663 @@ class GenBatcher:
             r.done.set()
 
 
-__all__ = ["GenBatcher"]
+# ---------------------------------------------------------------------------
+# Continuous batching
+# ---------------------------------------------------------------------------
+
+
+class PipelinedSlotSession:
+    """Slot admission for MULTI-STAGE jobs through the distributed session
+    path: one persistent decode session of ``B = max_slots`` rows across
+    every stage worker. A queued request is admitted into a free row by a
+    masked prefill op (only its row's tokens carry attention mask, so
+    neighbors' caches don't move); a finished row is recycled by zeroing
+    its write offset on every stage (``reset_rows`` rides the next op) —
+    the dense-session analogue of returning KV pages to the free-list.
+
+    PR-1 semantics are preserved: every op carries the session's
+    monotonically-increasing ``seq`` (worker-side dedup makes retries and
+    frame dups idempotent), and a lost stage worker triggers repair +
+    re-prefill of each live row's prompt + emitted tokens under a fresh
+    session id. Sampling is per-row stateless —
+    ``fold_in(PRNGKey(seed_r), n)`` for row r's nth token
+    (ml/worker.py::_sample_from_logits "seeds" path) — so both co-residency
+    and recovery are bit-exact for every request.
+
+    Single-driver discipline like the engine-side slot loop: one
+    dispatcher thread calls ``admit``/``step``.
+    """
+
+    MAX_RECOVERIES = 3
+
+    def __init__(self, model: Any, *, max_slots: int = 4):
+        from collections import deque
+
+        self.model = model
+        self.B = int(max_slots)
+        self.cache_len = int(model.spec["seq_len"])
+        self.session = secrets.token_hex(8)
+        self.seq = 0
+        self.slots: list[dict | None] = [None] * self.B
+        self.queue: deque = deque()
+        self.reset_rows: set[int] = set()
+        self.recoveries = 0
+
+    # -- helpers ---------------------------------------------------------
+    def _live(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    @property
+    def free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def _samp(self) -> dict:
+        def rows(key, fill):
+            return [
+                (s[key] if s is not None else fill) for s in self.slots
+            ]
+
+        return {
+            "temperature": rows("temperature", 0.0),
+            "top_k": rows("top_k", 0),
+            "top_p": rows("top_p", 1.0),
+            "seeds": rows("seed", 0),
+            "steps": rows("step", 0),
+        }
+
+    def _emit(self, slot: dict, tok: int) -> bool:
+        """Deliver one token to a slot's request; True when it finished."""
+        req: _Pending = slot["req"]
+        slot["emitted"].append(tok)
+        slot["step"] += 1
+        cancel = False
+        if req.stream_cb is not None:
+            cancel = bool(req.stream_cb([tok]))
+        return (
+            cancel
+            or tok in slot["eos"]
+            or len(slot["emitted"]) >= slot["budget"]
+        )
+
+    def _finish_row(self, row: int) -> None:
+        slot = self.slots[row]
+        self.slots[row] = None
+        self.reset_rows.add(row)
+        req: _Pending = slot["req"]
+        req.result = [int(t) for t in slot["emitted"][: req.max_new_tokens]]
+        req.done.set()
+
+    def _apply_step_tokens(self, tok, rows: list[int]) -> None:
+        for r in rows:
+            slot = self.slots[r]
+            if slot is None:
+                continue
+            slot["last_tok"] = int(tok[r])
+            if self._emit(slot, int(tok[r])):
+                self._finish_row(r)
+
+    def _forward(self, **kw):
+        """One session op with in-flight recovery. On SessionLost (a stage
+        worker died) the whole slot set re-establishes — including any
+        rows this op was admitting, since their slot records are already
+        placed — and the re-prefill op itself advances every live row one
+        token, so the lost op is SUBSUMED: callers get ``None`` and must
+        not re-apply."""
+        from .module import SessionLost, _transportish
+
+        try:
+            out = self.model.forward(
+                session=self.session, cache_len=self.cache_len,
+                seq=self.seq, **kw,
+            )
+            self.seq += 1
+            self.reset_rows.clear()  # applied by this op
+            # a clean op closes any recovery episode: the budget bounds
+            # CONSECUTIVE failures, not lifetime ones — a session serving
+            # for days must not stop recovering after its 3rd distant blip
+            self.recoveries = 0
+            return out
+        except Exception as e:
+            recoverable = isinstance(e, SessionLost) or _transportish(e)
+            if not recoverable or self.recoveries >= self.MAX_RECOVERIES:
+                raise
+            # the re-establishment itself may hit a transient failure right
+            # when the mesh is churning — retry it within the same bounded
+            # recovery budget instead of failing every live request on the
+            # first double-fault
+            while True:
+                self.recoveries += 1
+                try:
+                    self._reestablish()
+                    return None
+                except Exception as e2:
+                    still_recoverable = (
+                        isinstance(e2, SessionLost) or _transportish(e2)
+                        or "no connection" in str(e2)
+                    )
+                    if not still_recoverable \
+                            or self.recoveries >= self.MAX_RECOVERIES:
+                        raise
+
+    def _reestablish(self) -> None:
+        """Repair dead stages and re-prefill every live row's prompt +
+        emitted tokens under a FRESH session id (PR 1 recovery). The
+        sampled token at each row's last position is exactly its next
+        pending draw (per-row keys are stateless in the step index), so
+        streams resume with no duplicated and no missing tokens."""
+        import numpy as np
+
+        live_peers = set(self.model.node.send_request("peers", timeout=10.0))
+        for st in self.model.plan.stages:
+            if self.model.workers.get(st.worker_id) not in live_peers:
+                self.model._repair(st.worker_id)
+        self.model._end_decode_session(self.session)
+        self.session = secrets.token_hex(8)
+        self.seq = 0
+        self.reset_rows.clear()
+        rows = self._live()
+        if not rows:
+            return
+        seqs = {
+            r: self.slots[r]["prompt"] + self.slots[r]["emitted"]
+            for r in rows
+        }
+        T = max(len(v) for v in seqs.values())
+        toks = np.zeros((self.B, T), np.int32)
+        mask = np.zeros((self.B, T), bool)
+        last_idx = np.zeros((self.B,), np.int32)
+        for r, ids in seqs.items():
+            toks[r, : len(ids)] = ids
+            mask[r, : len(ids)] = True
+            last_idx[r] = len(ids) - 1
+        tok = self.model.forward(
+            toks, mask, session=self.session, cache_len=self.cache_len,
+            sample=self._samp(), last_idx=last_idx, seq=0,
+        )
+        self.seq = 1
+        self._apply_step_tokens(tok, rows)
+
+    # -- driver API ------------------------------------------------------
+    def submit(self, req: "_Pending") -> None:
+        self.queue.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self._live())
+
+    def pump(self) -> None:
+        """Admit queued requests into free rows. Guard: the admission op's
+        masked [B, T] write lands at every LIVE row's current offset too
+        (invisible garbage at [len, len+T)) — a row within T of the cache
+        end would see that write CLAMP backward over real KV, so admission
+        defers until near-capacity rows finish (bounded: their budgets are
+        room-capped)."""
+        while self.queue:
+            free = self.free_slots
+            if not free:
+                return
+            group: list[_Pending] = []
+            for req in list(self.queue)[: len(free)]:
+                eff = min(req.max_new_tokens, self.cache_len - len(req.ids))
+                if eff <= 0:
+                    # zero room: finished with an empty completion, the
+                    # static paths' contract
+                    self.queue.remove(req)
+                    req.result = []
+                    req.done.set()
+                    continue
+                group.append(req)
+            if not group:
+                continue
+            live_max = max(
+                (
+                    len(s["prompt"]) + len(s["emitted"])
+                    for s in self.slots if s is not None
+                ),
+                default=0,
+            )
+            # drop the LONGEST-prompt members until the op's write span is
+            # safe — shorter requests behind an oversized head still admit
+            # now (the skipped one re-queues for the next pump, when
+            # evictions have freed room)
+            while group:
+                longest = max(group, key=lambda r: len(r.ids))
+                if live_max + len(longest.ids) <= self.cache_len:
+                    break
+                group.remove(longest)
+            if not group:
+                return  # wait for evictions to free cache room
+            for req in group:
+                self.queue.remove(req)
+            self._admit_group(group)
+
+    def _admit_group(self, group: list["_Pending"]) -> None:
+        """One masked prefill op admits the whole group and emits each
+        member's first token."""
+        import numpy as np
+
+        placed: list[tuple[int, _Pending]] = []
+        for req in group:
+            row = self.free_slots[0]
+            self.slots[row] = {
+                "req": req,
+                "prompt": [int(t) for t in req.ids],
+                "emitted": [],
+                "budget": min(
+                    req.max_new_tokens, self.cache_len - len(req.ids)
+                ),
+                "eos": set(req.eos_ids),
+                "seed": req.seed,
+                "step": 0,
+                "last_tok": 0,
+                "temperature": req.temperature,
+                "top_k": req.top_k,
+                "top_p": req.top_p,
+            }
+            placed.append((row, req))
+        # a recycled row being re-admitted stays in the reset list: the op
+        # zeroes its stale write offset BEFORE the prefill's KV writes land
+        recycled = sorted(self.reset_rows)
+        T = max(len(req.ids) for _, req in placed)
+        toks = np.zeros((self.B, T), np.int32)
+        mask = np.zeros((self.B, T), bool)
+        last_idx = np.zeros((self.B,), np.int32)
+        for row, req in placed:
+            toks[row, : len(req.ids)] = req.ids
+            mask[row, : len(req.ids)] = True
+            last_idx[row] = len(req.ids) - 1
+        tok = self._forward(
+            tokens=toks, attn_mask=mask, sample=self._samp(),
+            last_idx=last_idx, reset_rows=recycled,
+        )
+        if tok is not None:
+            self._apply_step_tokens(tok, [r for r, _ in placed])
+
+    def step(self) -> None:
+        """One decode step over the active rows (inactive rows ride the
+        fixed batch shape with a zero attention mask, so their caches
+        don't move)."""
+        import numpy as np
+
+        rows = self._live()
+        if not rows:
+            return
+        toks = np.zeros((self.B, 1), np.int32)
+        mask = np.zeros((self.B, 1), bool)
+        for r in rows:
+            toks[r, 0] = self.slots[r]["last_tok"]
+            mask[r, 0] = True
+        tok = self._forward(
+            tokens=toks, attn_mask=mask, sample=self._samp(),
+            reset_rows=sorted(self.reset_rows),
+        )
+        if tok is not None:
+            self._apply_step_tokens(tok, rows)
+
+    def fail(self, err: BaseException) -> None:
+        """Fan ``err`` out to every live and queued request (driver crash
+        path and close share this teardown)."""
+        for r in self._live():
+            slot = self.slots[r]
+            self.slots[r] = None
+            slot["req"].error = err
+            slot["req"].done.set()
+        while self.queue:
+            req = self.queue.popleft()
+            req.error = err
+            req.done.set()
+
+    def close(self) -> None:
+        try:
+            self.model._end_decode_session(self.session)
+        except Exception:
+            pass
+        self.fail(RuntimeError("model is being unhosted"))
+
+
+class ContinuousBatcher:
+    """Continuous serving scheduler — GenBatcher's client API (blocking
+    ``generate`` with stream demux, ``close``, ``stats``) without its
+    window/drain semantics: a request starts decoding within one decode
+    chunk of submission regardless of what else is in flight.
+
+    Modes (picked from what it wraps):
+
+    - ``engine=`` (a GenerationEngine or ContinuousEngine): drives a local
+      slot engine on a dispatcher thread — the in-process serving path,
+      used by the bench's serving leg and tests.
+    - ``model=`` single-stage DistributedModel: pure pass-through; each
+      request RPCs the worker with ``continuous=True`` and the worker's
+      slot engine co-batches concurrent requests (admission happens where
+      the accelerator is, so there is nothing to coalesce here).
+    - ``model=`` pipelined DistributedModel: a PipelinedSlotSession on a
+      dispatcher thread runs slot admission through the session path.
+
+    Requests the continuous paths can't serve (speculative-decode hints,
+    penalized requests on pipelined jobs) fall back to a direct
+    ``model.generate`` — never an error.
+    """
+
+    def __init__(
+        self,
+        model: Any = None,
+        eos_ids: list[int] | None = None,
+        *,
+        engine: Any = None,
+        max_slots: int = 8,
+        page_size: int = 16,
+        chunk_steps: int = 8,
+        seed: int = 0,
+    ):
+        from collections import deque
+
+        self.model = model
+        self.eos_ids = list(eos_ids or [])
+        self.seed = int(seed)
+        self._seq = itertools.count(1)
+        self._closed = False
+        self._submit_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._served = 0
+        self._inflight = 0
+        self._idle = threading.Condition()
+        self.live_samples: deque[int] = deque(maxlen=1000)
+        self._q: queue.Queue[_Pending | None] = queue.Queue()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._cont = None
+        self._sess = None
+        if engine is not None:
+            from tensorlink_tpu.engine.continuous import ContinuousEngine
+
+            self._cont = (
+                engine
+                if isinstance(engine, ContinuousEngine)
+                else ContinuousEngine(
+                    engine, max_slots=max_slots, page_size=page_size,
+                    chunk_steps=chunk_steps,
+                )
+            )
+            self.mode = "local"
+        elif model is not None and model.plan.n_stages == 1:
+            self.mode = "remote"
+        else:
+            self._sess = PipelinedSlotSession(model, max_slots=max_slots)
+            self.mode = "pipelined"
+        if self.mode in ("local", "pipelined"):
+            self._thread = threading.Thread(
+                target=self._drive, name="cont-batcher", daemon=True
+            )
+            self._thread.start()
+
+    # -- client side -----------------------------------------------------
+    def generate(
+        self,
+        ids: list[int],
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stream_cb: Callable[[list[int]], None] | None = None,
+        timeout: float = 600.0,
+        lookahead: bool = False,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
+    ) -> list[int]:
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("model is being unhosted")
+            req_seed = self.seed + next(self._seq)
+        penalized = bool(presence_penalty or frequency_penalty)
+        if self.mode == "remote":
+            # drain accounting for close(): unhost must not tear the job
+            # down under requests the worker is still decoding
+            with self._idle:
+                self._inflight += 1
+            try:
+                return self._generate_remote(
+                    ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    stream_cb=stream_cb, lookahead=lookahead,
+                    presence_penalty=presence_penalty,
+                    frequency_penalty=frequency_penalty, seed=req_seed,
+                )
+            finally:
+                with self._idle:
+                    self._inflight -= 1
+                    self._idle.notify_all()
+        if self.mode == "pipelined" and (penalized or lookahead):
+            # features the slot session doesn't carry (per-row context
+            # counts; speculation) run as a direct solo generate
+            seqs = self.model.generate(
+                [list(ids)], max_new_tokens=int(max_new_tokens),
+                temperature=float(temperature), top_k=int(top_k),
+                top_p=float(top_p), eos_ids=self.eos_ids, seed=req_seed,
+                stream_cb=(
+                    (lambda e: [0] if (
+                        e[0] is not None and stream_cb([int(e[0])])
+                    ) else None)
+                    if stream_cb else None
+                ),
+                lookahead=lookahead and float(temperature) == 0.0
+                and not penalized,
+                presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty,
+            )
+            self._note_served()
+            return [int(t) for t in seqs[0][: int(max_new_tokens)]]
+        req = _Pending(
+            ids=[int(t) for t in ids],
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), stream_cb=stream_cb,
+            presence_penalty=float(presence_penalty),
+            frequency_penalty=float(frequency_penalty),
+        )
+        req.seed = req_seed
+        req.eos_ids = self.eos_ids
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("model is being unhosted")
+            self._q.put(req)
+            self._wake.set()
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out in the batcher")
+        if req.error is not None:
+            raise req.error
+        self._note_served()
+        return req.result or []
+
+    def _generate_remote(
+        self, ids, *, max_new_tokens, temperature, top_k, top_p, stream_cb,
+        lookahead, presence_penalty, frequency_penalty, seed,
+    ) -> list[int]:
+        """Single-stage pass-through: the worker's slot engine is the
+        scheduler, so each request ships immediately — concurrency comes
+        from the API's request threads, admission from the worker."""
+        spec = bool(lookahead) and float(temperature) == 0.0 \
+            and not presence_penalty and not frequency_penalty
+        cb = None
+        if stream_cb is not None:
+            def cb(emitted):
+                if emitted and emitted[0] is not None:
+                    if stream_cb([int(emitted[0])]):
+                        return [0]
+                return None
+        seqs = self.model.generate(
+            [list(ids)], max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), eos_ids=self.eos_ids, seed=int(seed),
+            stream_cb=cb, lookahead=spec,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
+            # speculation runs the solo engine path; everything else joins
+            # the worker's slot batch
+            continuous=not spec,
+        )
+        self._note_served()
+        return [int(t) for t in seqs[0][: int(max_new_tokens)]]
+
+    def _note_served(self) -> None:
+        with self._stats_lock:
+            self._served += 1
+
+    # -- dispatcher ------------------------------------------------------
+    def _drain_queue(self, limit: int) -> list[_Pending]:
+        out: list[_Pending] = []
+        while len(out) < limit:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._closed = True
+                break
+            out.append(nxt)
+        return out
+
+    def _drive(self) -> None:
+        """Dispatcher loop: admit whatever is queued, decode one chunk,
+        repeat; park on the wake event when idle."""
+        sess = self._sess
+        cont = self._cont
+        while True:
+            try:
+                if cont is not None:
+                    for req in self._drain_queue(1 << 30):
+                        self._submit_local(req)
+                    busy = cont.has_work()
+                    if busy:
+                        with self._stats_lock:
+                            self.live_samples.append(cont.live_slots)
+                        cont.step_chunk()
+                else:
+                    for req in self._drain_queue(1 << 30):
+                        sess.submit(req)
+                    sess.pump()
+                    live = sess._live()
+                    if live:
+                        with self._stats_lock:
+                            self.live_samples.append(len(live))
+                        sess.step()
+                    busy = sess.has_work()
+            except BaseException as e:  # noqa: BLE001 — fan out and keep serving
+                if cont is not None:
+                    # the local engine is gone: refuse NEW work loudly (the
+                    # _closed check) and fail everything already queued —
+                    # otherwise callers block their full client timeout on
+                    # requests that can never run
+                    with self._submit_lock:
+                        self._closed = True
+                    cont.close(e)
+                    self._cont = cont = None
+                    while True:
+                        try:
+                            req = self._q.get_nowait()
+                        except queue.Empty:
+                            return
+                        if req is not None:
+                            req.error = e
+                            req.done.set()
+                sess.fail(e)
+                busy = False
+            if self._closed and not busy and self._q.empty():
+                return
+            if not busy:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _submit_local(self, req: "_Pending") -> None:
+        from tensorlink_tpu.engine.sampling import SamplingParams
+
+        def tok_cb(tok: int) -> bool:
+            if req.stream_cb is not None:
+                return bool(req.stream_cb([int(tok)]))
+            return False
+
+        def on_finish(creq) -> None:
+            if creq.error is not None:
+                req.error = creq.error
+            else:
+                req.result = [
+                    int(t) for t in creq.tokens[: req.max_new_tokens]
+                ]
+            req.done.set()
+
+        self._cont.submit(
+            req.ids, max_new_tokens=req.max_new_tokens,
+            sampling=SamplingParams.make(
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, presence_penalty=req.presence_penalty,
+                frequency_penalty=req.frequency_penalty,
+            ),
+            eos_ids=self.eos_ids, seed=req.seed,
+            stream_cb=tok_cb, on_finish=on_finish,
+        )
+
+    def stats(self) -> dict | None:
+        with self._stats_lock:
+            served = self._served
+            live = list(self.live_samples)
+        if not served and not live:
+            return None
+        out = {"requests": served, "continuous": True, "mode": self.mode}
+        if live:
+            out["mean_live_slots"] = round(sum(live) / len(live), 2)
+            out["max_live_slots"] = max(live)
+        if self._cont is not None:
+            st = self._cont.stats
+            if st["slot_steps_total"]:
+                out["slot_occupancy"] = round(
+                    st["slot_steps_live"] / st["slot_steps_total"], 3
+                )
+        return out
+
+    def close(self, timeout: float = 600.0) -> None:
+        """Serve everything already submitted, then stop."""
+        with self._submit_lock:
+            self._closed = True
+            if self._thread is not None:
+                self._q.put(None)
+                self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # the driver is wedged mid-decode: do NOT touch the engine
+                # from this thread (concurrent mutation of slots/cache
+                # could double-fire responses) — say so, like GenBatcher
+                from tensorlink_tpu.core.logging import get_logger
+
+                get_logger("ml.batching").warning(
+                    "ContinuousBatcher.close(): dispatcher did not drain "
+                    "within %.0fs; a slot decode may still be in flight",
+                    timeout,
+                )
+                return
+        if self.mode == "remote":
+            # in-flight pass-through requests are blocked inside worker
+            # RPCs — wait them out so unhost doesn't tear the job down
+            # under a live decode
+            deadline = time.monotonic() + timeout
+            with self._idle:
+                while self._inflight > 0:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._idle.wait(timeout=min(left, 5.0)):
+                        if time.monotonic() >= deadline:
+                            break
+        # local engines may still hold queued work if the driver died
+        if self._cont is not None:
+            self._cont.close()
+        if self._sess is not None:
+            self._sess.close()
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = RuntimeError("model is being unhosted")
+                req.done.set()
+
+
+__all__ = ["GenBatcher", "ContinuousBatcher", "PipelinedSlotSession"]

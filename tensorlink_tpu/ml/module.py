@@ -407,16 +407,23 @@ class DistributedModel:
         makes followers reuse the first thread's replacement instead of
         recruiting again."""
         with self._repair_lock:
-            fixed = self._repaired.get(dead_plan_wid)
+            fixed = self._chase_repaired(dead_plan_wid)
             if fixed:
-                # chase chained repairs (A→B then B→C): a straggler holding
-                # the oldest id must land on the live replacement
-                seen = {dead_plan_wid}
-                while fixed in self._repaired and fixed not in seen:
-                    seen.add(fixed)
-                    fixed = self._repaired[fixed]
                 return fixed
             return self._repair_locked(dead_plan_wid)
+
+    def _chase_repaired(self, dead_plan_wid: str) -> str | None:
+        """Resolve chained repairs (A→B then B→C): a straggler holding the
+        oldest id must land on the live replacement. None when this id was
+        never repaired. Caller holds _repair_lock."""
+        fixed = self._repaired.get(dead_plan_wid)
+        if not fixed:
+            return None
+        seen = {dead_plan_wid}
+        while fixed in self._repaired and fixed not in seen:
+            seen.add(fixed)
+            fixed = self._repaired[fixed]
+        return fixed
 
     def _repair_locked(self, dead_plan_wid: str) -> str:
         validators = self.node.send_request("validators", timeout=10.0)
@@ -430,6 +437,22 @@ class DistributedModel:
             timeout=25.0,
         )
         if not isinstance(update, dict) or "worker" not in update:
+            # the validator's MONITOR may have beaten this pull to the same
+            # dead worker (its replace already rewrote the plan, so the
+            # pull finds no stage to fix) — apply any pushed JOB_UPDATEs
+            # sitting in our buffer and reuse that replacement. (Inline
+            # rather than poll_job_updates(): we already hold _repair_lock.)
+            try:
+                for u in self.node.send_request("job_updates", timeout=10.0):
+                    if u.get("job_id") == self.job_id and "worker" in u:
+                        old = u.get("old_worker", "")
+                        if old in self.workers and old not in self._repaired:
+                            self._apply_update(u, old)
+            except Exception:
+                pass
+            fixed = self._chase_repaired(dead_plan_wid)
+            if fixed:
+                return fixed
             raise RuntimeError(
                 f"job repair failed: {update.get('error') if isinstance(update, dict) else update}"
             )
@@ -540,6 +563,7 @@ class DistributedModel:
         last_idx: np.ndarray | None = None,
         reorder_idx: np.ndarray | None = None,
         reset_len: int | None = None,
+        reset_rows: Sequence[int] | None = None,
         seq: int | None = None,
     ) -> np.ndarray:
         """Chain the pipeline stages; returns logits ``[B, T, V]``.
@@ -573,6 +597,11 @@ class DistributedModel:
             # speculative decode: roll back the previous verify pass's
             # rejected cache positions before this step (same piggyback)
             body_common["reset_len"] = int(reset_len)
+        if reset_rows:
+            # slot admission (continuous batching on pipelined jobs):
+            # recycle finished rows by zeroing their session-cache write
+            # offsets on EVERY stage before this op's KV writes land
+            body_common["reset_rows"] = [int(r) for r in reset_rows]
         if attn_mask is not None:
             body_common["attn_mask"] = np.asarray(attn_mask, bool)
 
@@ -703,6 +732,7 @@ class DistributedModel:
         frequency_penalty: float | Sequence[float] = 0.0,
         num_beams: int = 1,
         info_out: dict | None = None,
+        continuous: bool = False,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -727,6 +757,28 @@ class DistributedModel:
                 "host the model without co_slice_planning for serving"
             )
         if self.plan.n_stages == 1:
+            prompts = [list(p) for p in prompts]
+            if (
+                continuous
+                and len(prompts) == 1
+                and int(num_beams) <= 1
+                and not lookahead
+                and not any(
+                    isinstance(v, (list, tuple))
+                    for v in (temperature, top_k, top_p,
+                              presence_penalty, frequency_penalty)
+                )
+            ):
+                # continuous batching: this request joins the worker's
+                # RUNNING slot batch instead of dispatching a static batch
+                return self._generate_continuous_remote(
+                    prompts[0], max_new_tokens=int(max_new_tokens),
+                    temperature=float(temperature), top_k=int(top_k),
+                    top_p=float(top_p), eos_ids=eos_ids, seed=int(seed),
+                    stream_cb=stream_cb,
+                    presence_penalty=float(presence_penalty or 0.0),
+                    frequency_penalty=float(frequency_penalty or 0.0),
+                )
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_ids=eos_ids, seed=seed,
@@ -912,6 +964,171 @@ class DistributedModel:
                 f"{MAX_WAIT_TIME}s"
             )
         return [list(map(int, s)) for s in result["resp"]["sequences"]]
+
+    def _generate_continuous_remote(
+        self, prompt: list[int], *, max_new_tokens: int, temperature: float,
+        top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
+        presence_penalty: float, frequency_penalty: float,
+    ) -> list[list[int]]:
+        """One request through the worker's continuous slot engine
+        (B=1 per RPC; the worker co-batches concurrent requests into its
+        slot batch at chunk boundaries).
+
+        Recovery keeps PR 1's re-prefill semantics on paged slots: a lost
+        worker triggers repair, then the request re-submits with prompt =
+        original prompt + every token already DELIVERED and start_step =
+        len(delivered). The slot engine's per-token keys are
+        ``fold_in(PRNGKey(seed), n)`` — stateless in n — so the resumed
+        stream continues bit-identically: no duplicated, no missing
+        tokens, and the replacement worker's fresh page allocator can't
+        hand this session another session's KV blocks."""
+        delivered: list[int] = []
+        recoveries = 0
+        MAX_RECOVERIES = 3
+        while True:
+            # capture the id this attempt ISSUES to: a concurrent request's
+            # repair may rewrite the plan mid-flight, and recovery must
+            # repair the worker that actually failed us — _repair's chase
+            # map then reuses the concurrent thread's replacement instead
+            # of trying to "replace" the live one
+            wid = self.plan.stages[0].worker_id
+            budget = int(max_new_tokens) - len(delivered)
+            if budget <= 0:
+                return [delivered]
+            body = {
+                "job_id": self.job_id,
+                "prompts": [[int(t) for t in prompt] + delivered],
+                "max_new_tokens": budget,
+                "start_step": len(delivered),
+                "continuous": True,
+                "temperature": temperature, "top_k": top_k, "top_p": top_p,
+                "presence_penalty": presence_penalty,
+                "frequency_penalty": frequency_penalty,
+                "eos_ids": list(eos_ids), "seed": int(seed),
+            }
+            try:
+                if stream_cb is None:
+                    resp = self._request(
+                        wid, proto.GENERATE, body, _repaired=True
+                    )
+                    return [
+                        delivered
+                        + [int(t) for t in resp["sequences"][0]]
+                    ]
+                out, finished = self._drain_continuous_stream(
+                    wid, body, delivered, stream_cb
+                )
+                if finished:
+                    return [out]
+                delivered = out  # resume from what the relay delivered
+                raise WorkerLost(wid, RuntimeError("stream interrupted"))
+            except Exception as e:
+                # ONLY a dead connection means the worker (and its slots)
+                # are gone — a plain RPC timeout may just be a long decode
+                # queued behind a busy slot batch, and "repairing" the live
+                # worker for it would re-ship its stage and disturb every
+                # other session it serves (the static path draws the same
+                # line)
+                recoverable = isinstance(e, WorkerLost) \
+                    or "no connection" in str(e)
+                if not recoverable or recoveries >= MAX_RECOVERIES:
+                    raise
+                recoveries += 1
+                self.log.warning(
+                    "continuous generate lost its worker (%s); re-prefilling "
+                    "prompt + %d delivered tokens on a replacement "
+                    "(recovery %d/%d)",
+                    e, len(delivered), recoveries, MAX_RECOVERIES,
+                )
+                self._repair(wid)
+
+    def _drain_continuous_stream(
+        self, wid: str, body: dict, delivered: list[int], stream_cb
+    ) -> tuple[list[int], bool]:
+        """Issue a streamed continuous GENERATE and drain its relay.
+        Returns ``(tokens_so_far, finished)`` — ``finished=False`` means
+        the worker died mid-stream and the caller should resume from
+        ``tokens_so_far`` on a replacement."""
+        import threading
+
+        stream_id = secrets.token_hex(8)
+        body = dict(body, stream=stream_id)
+        result: dict = {}
+
+        def issue():
+            try:
+                result["resp"] = self._request(
+                    wid, proto.GENERATE, body, _repaired=True
+                )
+            except Exception as e:
+                result["err"] = e
+
+        t = threading.Thread(target=issue, daemon=True)
+        t.start()
+        toks = list(delivered)
+        notified = False
+        while True:
+            tk = self.node.send_request(
+                "next_tokens", {"stream": stream_id, "timeout": 5.0},
+                timeout=10.0,
+            )
+            for _row, tok in tk.get("tokens") or ():
+                toks.append(int(tok))
+                cancel = stream_cb([int(tok)])
+                if cancel and not notified:
+                    # confirmed stop match: the worker's slot engine stops
+                    # this request at its next emitted token (cancel polls
+                    # ride the chunk cadence)
+                    notified = True
+                    try:
+                        self.node.send_request(
+                            "send_control",
+                            {"peer": self.workers[wid],
+                             "tag": proto.STREAM_CANCEL,
+                             "body": {"stream": stream_id, "rows": [0]}},
+                            timeout=10.0,
+                        )
+                    except Exception:
+                        pass  # best-effort; the budget bound still applies
+            if tk.get("done"):
+                break
+            if tk.get("timeout") and not t.is_alive():
+                break  # issuer finished (response or death) with no marker
+        t.join(timeout=MAX_WAIT_TIME)
+        if "resp" not in result:
+            # worker died mid-stream: scoop any frames that beat the crash
+            # onto the relay AFTER our last drain, so the resumed request
+            # can't re-emit a token the caller already saw
+            try:
+                tk = self.node.send_request(
+                    "next_tokens", {"stream": stream_id, "timeout": 0.5},
+                    timeout=5.0,
+                )
+                for _row, tok in tk.get("tokens") or ():
+                    toks.append(int(tok))
+                    stream_cb([int(tok)])
+            except Exception:
+                pass
+        try:
+            self.node.send_request(
+                "drop_stream", {"stream": stream_id}, timeout=10.0
+            )
+        except Exception:
+            pass
+        if "resp" in result:
+            # the response is authoritative (fire-and-forget stream frames
+            # may drop); it holds THIS submission's tokens only
+            return (
+                delivered
+                + [int(x) for x in result["resp"]["sequences"][0]],
+                True,
+            )
+        err = result.get("err")
+        if err is not None and "no connection" not in str(err):
+            # compute errors and plain timeouts surface to the caller —
+            # only a dead connection licenses the resume-on-replacement
+            raise err
+        return toks, False
 
     def _generate_pipelined(
         self, prompts, *, max_new_tokens, temperature, top_k=0, top_p=1.0,

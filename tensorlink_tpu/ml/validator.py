@@ -304,14 +304,32 @@ class DistributedValidator:
                 self.log.warning("rollback of job %s failed", result["job_id"][:8])
             raise
         job.tokenizer = load_tokenizer(model_spec)
-        from tensorlink_tpu.ml.batching import GenBatcher
+        from tensorlink_tpu.ml.batching import ContinuousBatcher, GenBatcher
 
         ml_cfg = self.node.config.ml
-        job.batcher = GenBatcher(
-            job.model, job.tokenizer.eos_ids,
-            # a batch can never exceed what the engine's buckets compile for
-            max_batch=min(ml_cfg.max_serve_batch, ml_cfg.batch_buckets[-1]),
+        merged = any(s.coworkers for s in job.model.plan.stages)
+        # models the paged slot engine refuses must get the WINDOWED
+        # batcher here — routing them continuous would degrade each
+        # request to a serialized solo generate on the worker's fallback
+        unpageable = (
+            cfg.sliding_window is not None
+            or model_spec.get("quant") == "int8+kv"
         )
+        if ml_cfg.continuous_batching and not merged and not unpageable:
+            # continuous batching (docs/SERVING.md): no arrival window, no
+            # drain barrier — requests join the model's running slot batch
+            # at decode-chunk boundaries.
+            job.batcher = ContinuousBatcher(
+                job.model, job.tokenizer.eos_ids,
+                max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
+                chunk_steps=ml_cfg.cont_chunk_steps,
+            )
+        else:
+            job.batcher = GenBatcher(
+                job.model, job.tokenizer.eos_ids,
+                # a batch never exceeds what the engine's buckets compile for
+                max_batch=min(ml_cfg.max_serve_batch, ml_cfg.batch_buckets[-1]),
+            )
         job.status = "ready"
         self.log.info("hosting %s ready (%d stages)", name, len(result["plan"]["stages"]))
 

@@ -71,6 +71,13 @@ class StageRuntime:
     # time and requeues itself, so queued co-batched generates interleave
     # instead of head-of-line-blocking behind it
     beam_sessions: dict[str, Any] = field(default_factory=dict)
+    # continuous-batching slot engine (engine/continuous.py) for whole-model
+    # jobs: GENERATE requests flagged "continuous" submit into its slot
+    # batch and a cont_continue marker drives chunked decode through the
+    # work queue — new requests admit at chunk boundaries (FIFO interleave,
+    # same shape as the beam chunking above)
+    cont: Any = None
+    cont_scheduled: bool = False
     # per-session [B, V] context token counts for OpenAI presence/frequency
     # penalties on PIPELINED decode: the head-holding worker samples with
     # them and folds each sampled token back in, so penalized requests work
@@ -247,6 +254,8 @@ class DistributedWorker:
             self._generate(p)
         elif kind == "beam_continue":
             self._beam_step(p["job_id"], p["rid"])
+        elif kind == "cont_continue":
+            self._cont_step(p["job_id"])
         elif kind == proto.PARAMS_REQ:
             self._params_req(p)
         elif kind == proto.TRAIN_MODE:
@@ -261,7 +270,12 @@ class DistributedWorker:
             self._checkpoint(p)
         elif kind == "shutdown_job":
             with self._lock:
-                self.jobs.pop(p.get("job_id", ""), None)
+                rt = self.jobs.pop(p.get("job_id", ""), None)
+            if rt is not None and rt.cont is not None:
+                # fail queued/in-flight continuous requests fast rather
+                # than letting their clients wait out the RPC timeout
+                rt.cont.close(RuntimeError("job shut down"))
+                rt.cont = None
         elif kind == "token":
             pass  # token relays are user/validator side
         else:
@@ -385,7 +399,14 @@ class DistributedWorker:
                 quant=quant if cache_quant else None,
             )
         with self._lock:
+            old = self.jobs.get(job_id)
             self.jobs[job_id] = rt
+        if old is not None and old.cont is not None:
+            # a re-shipped stage replaces the runtime: fail the old slot
+            # engine's in-flight requests fast (their KV died with the old
+            # engine) instead of leaving clients to wait out the RPC timeout
+            old.cont.close(RuntimeError("stage reloaded"))
+            old.cont = None
         self.log.info(
             "loaded %s layers [%d,%d) first=%s head=%s in %.1fs",
             model.get("name", "?"), lo, hi, first, holds_head, time.time() - t0,
@@ -762,6 +783,18 @@ class DistributedWorker:
         cache = None
         if session is not None:
             cache = rt.sessions.get(session)
+            if cache is not None and p.get("reset_rows"):
+                # pipelined slot admission (ml/batching.py
+                # PipelinedSlotSession): rows whose previous request
+                # finished are recycled by zeroing their write offset —
+                # the stale KV beyond it is invisible (attention masks by
+                # length) and the admitted prompt overwrites it
+                rows = jnp.asarray(np.asarray(p["reset_rows"], np.int32))
+                cache = KVCache(
+                    k=cache.k, v=cache.v,
+                    length=cache.length.at[rows].set(0),
+                    k_scale=cache.k_scale, v_scale=cache.v_scale,
+                )
             if cache is not None and p.get("reset_len") is not None:
                 # pipelined speculative decode: roll back the REJECTED
                 # draft positions of the previous verify pass by resetting
@@ -811,7 +844,8 @@ class DistributedWorker:
     # chain fields every forwarded hop must carry onward
     _CHAIN_KEYS = (
         "job_id", "session", "cache_len", "attn_mask", "sample",
-        "last_idx", "reply_to", "reorder_idx", "reset_len", "seq",
+        "last_idx", "reply_to", "reorder_idx", "reset_len", "reset_rows",
+        "seq",
     )
 
     # -- session-op idempotency (seq dedup) ------------------------------
@@ -998,6 +1032,39 @@ class DistributedWorker:
             return any(float(x or 0.0) != 0.0 for x in vals)
 
         penalized = any_nonzero(pen_p) or any_nonzero(pen_f)
+        if samp.get("seeds") is not None:
+            # pipelined slot admission (continuous batching): each row
+            # samples with its OWN stateless key chain —
+            # fold_in(PRNGKey(seed_r), step_r) — so a slot's stream never
+            # depends on its neighbors, admission step offsets differ per
+            # row, and a recovered session resumes its draws exactly.
+            # (Non-penalized only; the slot scheduler routes penalized
+            # requests through the co-batch path.)
+            from tensorlink_tpu.engine.continuous import (
+                _row_keys, _sample_rows,
+            )
+
+            def row(v, dtype, fill):
+                vals = (
+                    list(v) if isinstance(v, (list, tuple, np.ndarray))
+                    else [v if v is not None else fill] * B
+                )
+                return jnp.asarray(np.asarray(vals, dtype))
+
+            keys = _row_keys(
+                row(samp["seeds"], np.int32, 0),
+                row(samp.get("steps", 0), np.int32, 0),
+            )
+            tok = _sample_rows(
+                step_logits, keys,
+                row(t, np.float32, 0.0),
+                row(samp.get("top_k", 0), np.int32, 0),
+                row(samp.get("top_p", 1.0), np.float32, 1.0),
+                row(pen_p, np.float32, 0.0),
+                row(pen_f, np.float32, 0.0),
+                jnp.zeros((B, rt.cfg.vocab_size), jnp.int32),
+            )
+            return self._to_host(rt, tok)
         if isinstance(t, (list, tuple, np.ndarray)):
             # batched serving mixes requests with different knobs: [B, 1]
             # leaves ride ONE compiled sampler (engine/sampling.py contract)
@@ -1312,6 +1379,8 @@ class DistributedWorker:
         if rt.engine is None:
             raise ValueError("generate requires a whole-model stage")
         prompts = [list(map(int, row)) for row in p["prompts"]]
+        if p.get("continuous") and self._generate_continuous(rt, p, prompts):
+            return  # admitted into the slot batch; responds via on_finish
         knobs = (
             p.get("temperature", 0.0), p.get("top_k", 0), p.get("top_p", 1.0),
             p.get("presence_penalty", 0.0), p.get("frequency_penalty", 0.0),
@@ -1465,6 +1534,144 @@ class DistributedWorker:
                 "finished": list(map(bool, result.finished)),
             },
         )
+
+    # -- continuous batching (engine/continuous.py) ----------------------
+    def _generate_continuous(self, rt: "StageRuntime", p: dict,
+                             prompts: list[list[int]]) -> bool:
+        """Admit a GENERATE flagged ``continuous`` into the job's slot
+        engine. Returns False when the request can't take the continuous
+        path (per-row knob lists, beams, lookahead, or a model the paged
+        engine refuses) — the caller then falls through to the static
+        engine paths, so the flag can never fail a request."""
+        from tensorlink_tpu.engine.sampling import SamplingParams
+
+        knobs = (
+            p.get("temperature", 0.0), p.get("top_k", 0),
+            p.get("top_p", 1.0), p.get("presence_penalty", 0.0),
+            p.get("frequency_penalty", 0.0),
+        )
+        if (
+            len(prompts) != 1
+            or any(isinstance(v, (list, tuple)) for v in knobs)
+            or int(p.get("num_beams", 1)) > 1
+            or p.get("lookahead")
+        ):
+            return False
+        cont = rt.cont
+        if cont is None or cont.engine is not rt.engine:
+            # (re)build after load_stage swapped the engine — old slots
+            # died with their engine's cache
+            from tensorlink_tpu.engine.continuous import ContinuousEngine
+
+            ml = self.node.config.ml
+            try:
+                rt.cont = cont = ContinuousEngine(
+                    rt.engine,
+                    max_slots=int(ml.cont_max_slots),
+                    page_size=int(ml.cont_page_size),
+                    chunk_steps=int(ml.cont_chunk_steps),
+                )
+            except ValueError as e:
+                # int8 KV cache / sliding window: static batcher territory
+                self.log.info("continuous batching unavailable: %s", e)
+                return False
+        t, k, tp, pp, fp = knobs
+        sampling = SamplingParams.make(
+            temperature=float(t), top_k=int(k), top_p=float(tp),
+            presence_penalty=float(pp or 0.0),
+            frequency_penalty=float(fp or 0.0),
+        )
+        stream_id = p.get("stream")
+        peer = p["peer"]
+        state = {"n": 0}
+
+        def stream_cb(tok: int):
+            # fire-and-forget per token; cancel frames (confirmed stop
+            # matches) poll once per chunk — overrun bounded like the
+            # compiled chunked stream
+            self.bridge.notify(
+                "send_token",
+                {"peer": peer, "stream": stream_id, "tokens": [[0, int(tok)]]},
+            )
+            state["n"] += 1
+            if state["n"] % cont.chunk_steps == 0:
+                try:
+                    rows = self.bridge.request(
+                        "poll_cancel", {"stream": stream_id}, timeout=5.0
+                    )
+                except Exception:
+                    rows = None  # relay hiccup must not kill the decode
+                return bool(rows)
+            return False
+
+        def on_finish(req):
+            if stream_id:
+                try:
+                    self.bridge.request(
+                        "send_token",
+                        {"peer": peer, "stream": stream_id, "tokens": [],
+                         "done": True},
+                    )
+                    self.bridge.notify("clear_cancels", {"stream": stream_id})
+                except Exception:
+                    pass
+            if req.error is not None:
+                self._respond(
+                    peer, proto.GENERATE_RESP, p["rid"],
+                    {"error": f"{type(req.error).__name__}: {req.error}",
+                     "worker": self.node.node_id},
+                )
+                return
+            self._respond(
+                peer, proto.GENERATE_RESP, p["rid"],
+                {"sequences": [list(map(int, req.tokens))],
+                 "finished": [bool(req.finished)],
+                 "continuous": True},
+            )
+
+        cont.submit(
+            prompts[0],
+            max_new_tokens=int(p.get("max_new_tokens", 128)),
+            sampling=sampling,
+            eos_ids=p.get("eos_ids", ()),
+            seed=int(p.get("seed", 0)),
+            start_step=int(p.get("start_step", 0)),
+            stream_cb=stream_cb if stream_id else None,
+            on_finish=on_finish,
+        )
+        self._schedule_cont(rt)
+        return True
+
+    def _schedule_cont(self, rt: "StageRuntime") -> None:
+        if not rt.cont_scheduled:
+            rt.cont_scheduled = True
+            self.bridge.q.work.put(("cont_continue", {"job_id": rt.job_id}))
+
+    def _cont_step(self, job_id: str) -> None:
+        """Drive the slot engine one decode chunk, then requeue — FIFO, so
+        every GENERATE that arrived meanwhile is admitted before the next
+        chunk (a new request starts decoding within ≤ one chunk of an
+        in-flight batch; same bounded-occupancy shape as _beam_step)."""
+        with self._lock:
+            rt = self.jobs.get(job_id)
+        if rt is None or rt.cont is None:
+            return
+        rt.cont_scheduled = False
+        if self.faults is not None:
+            # fault site "worker.cont_step" (core/faults.py): one count per
+            # decode chunk over a continuously-batched slot set
+            self.faults.inject("worker.cont_step", job_id)
+        try:
+            more = rt.cont.step_chunk()
+        except FaultCrash:
+            raise  # the run loop takes the node down
+        except BaseException as e:  # noqa: BLE001 — fan out per request
+            self.log.exception("continuous decode chunk failed")
+            rt.cont.close(e)  # responds the error on every live rid
+            rt.cont = None
+            return
+        if more:
+            self._schedule_cont(rt)
 
     def _beam_step(self, job_id: str, rid: str) -> None:
         """Advance an in-flight beam session one bounded chunk. Unfinished

@@ -1,12 +1,19 @@
-"""Flash-attention prefill kernel (Pallas/TPU).
+"""Attention kernels (Pallas/TPU): flash prefill + paged decode.
 
 The einsum attention in models/transformer.py materializes the full
 ``[B, H, T, S]`` score tensor in HBM — fine for decode (T=1) and short
-prefills, quadratic HBM traffic for long ones. This kernel computes
+prefills, quadratic HBM traffic for long ones. The flash kernel computes
 attention blockwise with an online softmax so scores never leave VMEM:
 grid ``(batch·kv_head·group, q_blocks, k_blocks)`` with the k loop
 innermost, carrying running max/denominator/accumulator in VMEM scratch
 (the standard FlashAttention recurrence).
+
+:func:`paged_attention` is the continuous-batching decode kernel
+(engine/paged.py): one query token per serving slot, KV gathered page by
+page through a scalar-prefetched block table — ragged sequence lengths
+share one fixed-shape program, and only each slot's LIVE pages stream
+from HBM. :func:`paged_attention_ref` is the pure-jax.numpy reference the
+CPU path and the parity tests run.
 
 Scope: **forward-only, causal, offset-0 prefill** — exactly the serving
 engine's fresh-cache prefill (engine/generate.py::_prefill). Training and
@@ -31,6 +38,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+
+def _compiler_params(**kw):
+    """jax-0.4.37 compat: ``pltpu.CompilerParams`` was still named
+    ``TPUCompilerParams`` there — resolve whichever this jax exports so the
+    kernels (and their CPU-interpret tests) run on both sides of the
+    rename."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kw)
 
 
 def _flash_kernel(
@@ -176,7 +194,7 @@ def flash_attention(
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -189,4 +207,182 @@ def flash_attention(
     )
 
 
-__all__ = ["flash_attention"]
+# ---------------------------------------------------------------------------
+# Paged decode attention (continuous batching, engine/paged.py)
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_ref(
+    q: jax.Array,  # [S, Hq, hd] — one query token per slot
+    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    v_pages: jax.Array,  # [P, Hkv, page, hd]
+    block_tables: jax.Array,  # int32 [S, pages_per_slot]
+    lengths: jax.Array,  # int32 [S] — valid positions per slot
+    *,
+    scale: float,
+) -> jax.Array:
+    """Pure-jnp paged attention — the CPU serving path and the ground truth
+    the Pallas kernel is pinned against.
+
+    Pages are ``[P, Hkv, page, hd]`` — kv-head-major, so the kernel's
+    per-(page, head) blocks have TPU-native ``(page, hd)`` trailing tiles.
+    This gathers each slot's pages into a contiguous ``[S, K, Hkv, hd]``
+    view (K = pages_per_slot·page) and runs the same masked-softmax GQA
+    math as models/transformer.py::attention. Positions at or beyond
+    ``lengths`` mask to NEG_INF (exp underflows to exactly 0, matching
+    the dense path's -inf bias); a slot with length 0 (free slot riding
+    the fixed batch shape) outputs zeros instead of a NaN row."""
+    S, Hq, hd = q.shape
+    P, Hkv, page, _ = k_pages.shape
+    n_pp = block_tables.shape[1]
+    K = n_pp * page
+    # whole-page gather: [S, n_pp, Hkv, page, hd] -> [S, K, Hkv, hd]
+    k = (
+        k_pages[block_tables]
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(S, K, Hkv, hd)
+    )
+    v = (
+        v_pages[block_tables]
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(S, K, Hkv, hd)
+    )
+    G = Hq // Hkv
+    qg = q.reshape(S, Hkv, G, hd).astype(jnp.float32)
+    scores = (
+        jnp.einsum(
+            "skgd,sxkd->skgx", qg, k.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    valid = jnp.arange(K)[None, :] < lengths[:, None]  # [S, K]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    w = jnp.where(lengths[:, None, None, None] > 0, w, 0.0)
+    out = jnp.einsum("skgx,sxkd->skgd", w, v.astype(jnp.float32))
+    return out.reshape(S, Hq, hd).astype(q.dtype)
+
+
+def _paged_kernel(
+    bt_ref,  # scalar-prefetch: block tables [S, n_pp]
+    len_ref,  # scalar-prefetch: lengths [S]
+    q_ref,  # [1, 1, G, hd]
+    k_ref,  # [1, 1, page, hd] — page bt[s, i] of kv head h
+    v_ref,  # [1, 1, page, hd]
+    o_ref,  # [1, 1, G, hd]
+    m_ref,  # [G, 1] running max (VMEM scratch)
+    l_ref,  # [G, 1] running denominator
+    acc_ref,  # [G, hd] f32 accumulator
+    *,
+    scale: float,
+    page: int,
+    n_pp: int,
+):
+    s = pl.program_id(0)
+    i = pl.program_id(2)
+    length = len_ref[s]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # pages wholly past the slot's length hold no live KV — skip their
+    # compute entirely (the ragged win: cost follows length, not capacity)
+    @pl.when(i * page < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, page]
+        pos = i * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        ok = pos < length  # [1, page]
+        sc = jnp.where(ok, sc, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)  # [G, page]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(i == n_pp - 1)
+    def _finalize():
+        # a free slot (length 0) never ran _compute: l == 0 and the floor
+        # yields a zero row, matching paged_attention_ref
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention(
+    q: jax.Array,  # [S, Hq, hd]
+    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    v_pages: jax.Array,  # [P, Hkv, page, hd]
+    block_tables: jax.Array,  # int32 [S, pages_per_slot]
+    lengths: jax.Array,  # int32 [S]
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged decode attention; returns ``[S, Hq, hd]``.
+
+    Grid ``(slot, kv_head, page_idx)``: the block table rides scalar
+    prefetch, so each grid step's k/v BlockSpec indexes the PHYSICAL page
+    ``block_tables[s, i]`` — the gather happens in the pipeline's HBM→VMEM
+    copies and repeated KV heads are never materialized (GQA queries group
+    on the kv-head axis like the flash kernel). The kv-head-major page
+    layout gives each block TPU-native ``(page, hd)`` trailing tiles. One
+    compiled program serves every (length mix, page assignment) — the
+    block table and lengths are data, not shape."""
+    S, Hq, hd = q.shape
+    P, Hkv, page, _ = k_pages.shape
+    n_pp = block_tables.shape[1]
+    G = Hq // Hkv
+    qg = q.reshape(S, Hkv, G, hd)
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, page=page, n_pp=n_pp
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(S, Hkv, n_pp),
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd), lambda s, h, i, bt, ln: (s, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, page, hd),
+                    lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page, hd),
+                    lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G, hd), lambda s, h, i, bt, ln: (s, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, G, hd), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables, lengths, qg, k_pages, v_pages)
+    return out.reshape(S, Hq, hd)
+
+
+__all__ = ["flash_attention", "paged_attention", "paged_attention_ref"]

@@ -1,0 +1,139 @@
+"""Host-side bookkeeping of the paged serving cache (engine/paged.py) and
+batch bucket sizing (engine/generate.py) — pure logic, no compiles.
+
+These invariants are what make continuous batching safe: the free-list
+can never hand out the scratch page or double-allocate, admission is
+all-or-nothing, and the serving batch shape is the smallest compiled
+bucket that fits the live rows."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.engine.paged import (
+    PageAllocator,
+    PagedKVCache,
+    pages_needed,
+)
+from tensorlink_tpu.models import ModelConfig
+
+TINY = ModelConfig(
+    family="llama", vocab_size=64, d_model=16, n_layers=2, n_heads=2,
+    n_kv_heads=2, head_dim=8, d_ff=32, max_seq_len=32,
+    dtype=jnp.float32, tie_embeddings=False,
+)
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator
+# ---------------------------------------------------------------------------
+def test_allocator_excludes_scratch_page():
+    a = PageAllocator(9)
+    assert a.n_free == 8  # ids 1..8; page 0 reserved
+    got = set()
+    while a.n_free:
+        got.update(a.alloc(1))
+    assert got == set(range(1, 9))  # never page 0
+
+
+def test_allocator_all_or_nothing():
+    a = PageAllocator(5)  # 4 usable
+    assert a.alloc(5) is None
+    assert a.n_free == 4  # a refused alloc takes nothing
+    pages = a.alloc(4)
+    assert len(pages) == 4 and a.n_free == 0
+    assert a.alloc(1) is None
+
+
+def test_allocator_free_and_lifo_reuse():
+    a = PageAllocator(6)
+    first = a.alloc(3)
+    a.free(first)
+    assert a.n_free == 5
+    # freed pages come back most-recent-first (locality)
+    assert a.alloc(1) == [first[-1]]
+
+
+def test_allocator_never_double_allocates():
+    a = PageAllocator(10)
+    one = a.alloc(4)
+    two = a.alloc(4)
+    assert not set(one) & set(two)
+    a.free(one)
+    three = a.alloc(5)
+    assert not set(three) & set(two)
+
+
+def test_allocator_free_ignores_scratch_id():
+    a = PageAllocator(4)
+    a.free([0, 0])  # page 0 must never enter the free list
+    assert a.n_free == 3
+    while a.n_free:
+        assert a.alloc(1) != [0]
+
+
+# ---------------------------------------------------------------------------
+# pages_needed
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "total,page,want",
+    [(1, 16, 1), (16, 16, 1), (17, 16, 2), (32, 16, 2), (33, 16, 3),
+     (7, 8, 1), (64, 8, 8)],
+)
+def test_pages_needed(total, page, want):
+    assert pages_needed(total, page) == want
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache layout
+# ---------------------------------------------------------------------------
+def test_paged_cache_shapes_and_properties():
+    c = PagedKVCache.init(TINY, max_slots=3, page_size=8, max_len=32)
+    n_pp = 32 // 8
+    P = 1 + 3 * n_pp  # + the scratch page
+    assert c.k.shape == (2, P, 2, 8, 8)  # [L, P, n_kv, page, hd]
+    assert c.v.shape == c.k.shape
+    assert c.block_tables.shape == (3, n_pp)
+    assert c.lengths.shape == (3,)
+    assert (c.page_size, c.max_slots, c.pages_per_slot, c.n_pages) == \
+        (8, 3, n_pp, P)
+
+
+def test_paged_cache_starts_free():
+    c = PagedKVCache.init(TINY, max_slots=2, page_size=8, max_len=32)
+    # every slot starts detached: zeroed table rows (→ scratch) + length 0
+    assert int(np.asarray(c.block_tables).sum()) == 0
+    assert int(np.asarray(c.lengths).sum()) == 0
+
+
+def test_paged_cache_ragged_max_len_rounds_up():
+    c = PagedKVCache.init(TINY, max_slots=1, page_size=8, max_len=20)
+    assert c.pages_per_slot == 3  # ceil(20 / 8)
+    assert c.pages_per_slot * c.page_size >= 20
+
+
+# ---------------------------------------------------------------------------
+# batch bucket sizing (the serving batch-shape contract)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def bucket_engine():
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import init_params
+
+    return GenerationEngine(
+        TINY, init_params(TINY, jax.random.PRNGKey(0)),
+        seq_buckets=(8,), batch_buckets=(1, 2, 4, 8), max_seq_len=32,
+    )
+
+
+@pytest.mark.parametrize(
+    "n,want", [(1, 1), (2, 2), (3, 4), (4, 4), (5, 8), (6, 8), (7, 8), (8, 8)]
+)
+def test_batch_bucket_smallest_fit(bucket_engine, n, want):
+    assert bucket_engine.batch_bucket(n) == want
+
+
+def test_batch_bucket_overflow_raises(bucket_engine):
+    with pytest.raises(ValueError):
+        bucket_engine.batch_bucket(9)

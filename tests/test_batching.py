@@ -10,6 +10,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tensorlink_tpu.engine.sampling import SamplingParams, sample
 from tensorlink_tpu.ml.batching import GenBatcher
@@ -119,6 +120,66 @@ def test_per_row_room_no_cross_truncation():
                    budgets=[50, 20])
         assert len(r.sequences[0]) == 4  # clamped by ITS room
         assert len(r.sequences[1]) == 20  # full budget, not truncated
+
+
+# ---------------------------------------------------------------------------
+# batch bucket selection (the r5 co-batch throughput regression)
+# ---------------------------------------------------------------------------
+def test_batch_bucket_smallest_fit_for_1_to_8_pending():
+    """The serving batch shape for n pending requests is the SMALLEST
+    compiled bucket ≥ n — 2 live requests must never pad to B=8 (4× the
+    decode FLOPs for dead rows, the BENCH_r05 0.56×-per-row regression)."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=2, head_dim=8, d_ff=32, max_seq_len=32,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    eng = GenerationEngine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0)),
+        seq_buckets=(8,), batch_buckets=(1, 2, 4, 8), max_seq_len=32,
+    )
+    want = {1: 1, 2: 2, 3: 4, 4: 4, 5: 8, 6: 8, 7: 8, 8: 8}
+    assert {n: eng.batch_bucket(n) for n in range(1, 9)} == want
+    # prefill agrees with the public rule
+    logits, cache, lens, B = eng.prefill([[1, 2], [3, 4]])
+    assert B == 2
+    del cache
+
+
+@pytest.mark.slow  # compiles decode-loop programs at three batch buckets;
+# CI runs it unfiltered — tier-1 keeps the (cheap) bucket-choice regression
+def test_chunked_decode_shrinks_bucket_on_eviction():
+    """When co-batched rows finish early, the next chunk re-buckets the
+    survivors: a greedy batch of 4 whose short rows drain must end its
+    decode at B=1, not dead-step B=4 to the long row's budget — with the
+    emitted sequences identical to the one-shot compiled loop."""
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    eng = GenerationEngine(
+        cfg, init_params(cfg, jax.random.PRNGKey(0)),
+        seq_buckets=(8,), batch_buckets=(1, 2, 4), max_seq_len=64,
+    )
+    prompts = [[1, 2], [3, 4], [5, 6], [7, 8]]
+    budgets = [24, 3, 3, 3]
+    ref = eng.generate_compiled(prompts, max_new_tokens=24, budgets=budgets)
+    got = eng.generate_chunked(
+        prompts, max_new_tokens=24, budgets=budgets, chunk_steps=4
+    )
+    assert got.sequences == ref.sequences
+    batches = eng.last_chunk_batches
+    assert batches[0] == 4  # started at the smallest bucket ≥ 4 live
+    assert batches[-1] == 1  # ended with only the long row decoding
+    # and the shrink is monotone — no bucket ever grows mid-decode
+    assert all(b2 <= b1 for b1, b2 in zip(batches, batches[1:]))
 
 
 # ---------------------------------------------------------------------------

@@ -277,6 +277,139 @@ def test_dropped_frame_retries_idempotently(tmp_path):
         _stop_all([user, *workers, validator])
 
 
+@pytest.mark.slow  # full multi-process cluster ×2 — runs in the CI chaos
+# job (unfiltered); excluded from the tier-1 'not slow' pass for wall-time
+def test_worker_crash_mid_continuous_batch_recovers_all_sessions(tmp_path):
+    """A worker killed mid-chunk with a CONTINUOUSLY-BATCHED slot set
+    (fault site worker.cont_step): every live session recovers via the
+    PR-1 re-prefill path — each request re-submits prompt + delivered
+    tokens on the repaired worker with start_step = len(delivered), whose
+    fresh page allocator hands it brand-new KV blocks (no cross-session
+    contamination). Both streams complete bit-identical to the fault-free
+    solo decode: no duplicated, no missing tokens."""
+    import threading
+
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=2,
+        worker_faults={0: {"seed": 5, "rules": [
+            {"site": "worker.cont_step", "op": "crash", "nth": 2},
+        ]}},
+    )
+    try:
+        # planner ranks by capacity: the single stage lands on workers[0]
+        # (the faulted one) and workers[1] stays free as the replacement
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.n_stages == 1
+        first_wid = model.plan.stages[0].worker_id
+        assert first_wid == workers[0].node_id
+
+        prompts = [[7, 3, 200], [9, 1, 2, 300]]
+        n_toks = 24
+        streams: list[list[int]] = [[], []]
+        results: list[list[int] | None] = [None, None]
+        errors: list[BaseException | None] = [None, None]
+
+        def go(i):
+            try:
+                seqs = model.generate(
+                    [prompts[i]], max_new_tokens=n_toks, continuous=True,
+                    stream_cb=lambda toks, i=i: streams[i].extend(
+                        t for t in toks if t is not None
+                    ),
+                )
+                results[i] = seqs[0]
+            except BaseException as e:  # surfaced by the assert below
+                errors[i] = e
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+            time.sleep(0.2)  # both live in the slot set before the crash
+        for t in threads:
+            t.join(120)
+        assert errors == [None, None], errors
+        # the faulted worker really died and was replaced
+        assert model.plan.stages[0].worker_id != first_wid
+        for i in (0, 1):
+            baseline = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == baseline, (i, results[i], baseline)
+            assert streams[i] == baseline, (i, streams[i], baseline)
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage, tier-1 wall-time
+def test_pipelined_slot_admission_with_crash_recovery(tmp_path):
+    """Continuous batching on a PIPELINED job: the slot session admits a
+    second request mid-flight through the seq-numbered session path, a
+    stage worker dies mid-step (worker.session_step crash), and the whole
+    slot set re-establishes on the replacement — both requests finish
+    bit-identical to fault-free solo decodes."""
+    import threading
+
+    from tensorlink_tpu.ml.batching import ContinuousBatcher
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=3,
+        worker_faults={0: {"seed": 7, "rules": [
+            {"site": "worker.session_step", "op": "crash", "nth": 6},
+        ]}},
+    )
+    try:
+        _pin_two_stages(workers)
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.n_stages == 2
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        workers[2].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+
+        b = ContinuousBatcher(model, eos_ids=[], max_slots=2)
+        assert b.mode == "pipelined"
+        prompts = [[7, 3, 200], [9, 1, 2]]
+        n_toks = [12, 8]
+        out: dict[int, list[int]] = {}
+        streams: dict[int, list[int]] = {0: [], 1: []}
+
+        def go(i):
+            out[i] = b.generate(
+                prompts[i], max_new_tokens=n_toks[i],
+                stream_cb=lambda ts, i=i: streams[i].extend(ts),
+            )
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in (0, 1)]
+        threads[0].start()
+        time.sleep(0.5)  # request 1 decodes; request 2 admits MID-FLIGHT
+        threads[1].start()
+        for t in threads:
+            t.join(120)
+        b.close()
+        # the faulted stage worker really died and was replaced
+        assert model.plan.stages[0].worker_id != workers[0].node_id
+        for i in (0, 1):
+            baseline = _engine_greedy(cfg, 11, prompts[i], n_toks[i])
+            assert out.get(i) == baseline, (i, out.get(i), baseline)
+            assert streams[i] == baseline, (i, streams[i], baseline)
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
 def test_stop_cancel_bounds_compiled_chunk_overrun(tmp_path):
     """Single-stage streamed decode on the fully-compiled chunked loop
     (stream_chunk_steps=4): when the stream callback confirms a stop after

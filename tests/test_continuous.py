@@ -1,0 +1,257 @@
+"""Continuous batching over the paged KV cache (engine/paged.py,
+engine/continuous.py, ml/batching.py::ContinuousBatcher).
+
+The determinism contract under test: a request decodes token-for-token
+identically whether it runs alone, co-resident with any neighbor mix,
+admitted mid-flight, or resumed after a crash — per-slot stateless RNG
+(fold_in(seed, n)) plus slot-local attention make this exact, not
+approximate. Plus the compile-set bound: the slot-batched decode is ONE
+program regardless of request mix."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.engine.continuous import ContinuousEngine
+from tensorlink_tpu.engine.generate import GenerationEngine
+from tensorlink_tpu.engine.sampling import SamplingParams
+from tensorlink_tpu.models import ModelConfig, init_params
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return GenerationEngine(
+        cfg, params, seq_buckets=(8, 32), batch_buckets=(1,), max_seq_len=64
+    )
+
+
+def _cont(eng, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("chunk_steps", 4)
+    return ContinuousEngine(eng, **kw)
+
+
+def _solo(eng, prompt, n, *, sampling=None, seed=0):
+    ce = _cont(eng)
+    req = ce.submit(prompt, max_new_tokens=n, sampling=sampling, seed=seed)
+    ce.run_until_idle()
+    return req.tokens
+
+
+# ---------------------------------------------------------------------------
+# parity: co-batched == solo, token for token
+# ---------------------------------------------------------------------------
+def test_continuous_parity_with_mid_flight_admission(tiny_engine):
+    """Each request's stream is bit-identical to its solo decode — greedy
+    and sampled rows mixed, one request admitted WHILE the others are
+    mid-flight (the acceptance criterion's exact shape)."""
+    eng = tiny_engine
+    mixes = [
+        ([1, 2, 3], 12, SamplingParams.make(temperature=0.9, top_k=5), 1),
+        ([4, 5], 6, SamplingParams.make(), 2),
+        ([9, 8, 7, 6], 10, SamplingParams.make(temperature=0.7, top_p=0.9), 3),
+    ]
+    ce = _cont(eng)
+    r0 = ce.submit(mixes[0][0], max_new_tokens=mixes[0][1],
+                   sampling=mixes[0][2], seed=mixes[0][3])
+    r1 = ce.submit(mixes[1][0], max_new_tokens=mixes[1][1],
+                   sampling=mixes[1][2], seed=mixes[1][3])
+    ce.step_chunk()  # r0/r1 are now mid-flight
+    assert ce.live_slots >= 1
+    r2 = ce.submit(mixes[2][0], max_new_tokens=mixes[2][1],
+                   sampling=mixes[2][2], seed=mixes[2][3])
+    ce.run_until_idle()
+    for req, (prompt, n, sp, seed) in zip((r0, r1, r2), mixes):
+        assert req.finished
+        assert req.tokens == _solo(eng, prompt, n, sampling=sp, seed=seed)
+
+
+def test_continuous_greedy_matches_dense_compiled(tiny_engine):
+    """Greedy through the paged slot path emits exactly the dense compiled
+    loop's tokens — the paged attention + scatter write is the same math
+    as the contiguous cache, not an approximation of it."""
+    eng = tiny_engine
+    prompt = [3, 1, 4, 1, 5]
+    ref = eng.generate_compiled([prompt], max_new_tokens=16).sequences[0]
+    assert _solo(eng, prompt, 16) == ref
+
+
+def test_continuous_recovery_resume_is_exact(tiny_engine):
+    """The PR-1 re-prefill recovery shape: resubmitting prompt + emitted
+    with start_step=len(emitted) continues the stream bit-identically
+    (per-token keys are stateless in the step index)."""
+    eng = tiny_engine
+    sp = SamplingParams.make(temperature=1.0, top_p=0.9)
+    full = _solo(eng, [5, 6, 7], 10, sampling=sp, seed=9)
+    cut = 4
+    ce = _cont(eng)
+    resumed = ce.submit(
+        [5, 6, 7] + full[:cut], max_new_tokens=10 - cut, sampling=sp,
+        seed=9, start_step=cut,
+    )
+    ce.run_until_idle()
+    assert full[:cut] + resumed.tokens == full
+
+
+# ---------------------------------------------------------------------------
+# bounded compile set
+# ---------------------------------------------------------------------------
+def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
+    """The compiled decode/sampling program count must not depend on the
+    request mix — ragged lengths, admissions, evictions and knob mixes are
+    all DATA to the one slot-batched program."""
+    eng = tiny_engine
+    ce = _cont(eng)
+    ce.submit([1], max_new_tokens=3)
+    ce.run_until_idle()
+    base = ce.jit_cache_sizes()
+    # churn: different lengths, budgets, knobs, staggered admission
+    reqs = [
+        ce.submit(list(range(1, 2 + i)), max_new_tokens=2 + 3 * i,
+                  sampling=SamplingParams.make(temperature=0.3 * i),
+                  seed=i)
+        for i in range(3)
+    ]
+    ce.step_chunk()
+    late = ce.submit([7] * 9, max_new_tokens=5, seed=99)
+    ce.run_until_idle()
+    assert all(r.finished for r in [*reqs, late])
+    after = ce.jit_cache_sizes()
+    assert after == base, (base, after)
+    assert after["decode_chunk"] == 1  # ONE slot-batched decode program
+
+
+# ---------------------------------------------------------------------------
+# pages: lifecycle + isolation
+# ---------------------------------------------------------------------------
+def test_eviction_returns_pages_and_isolates_slots(tiny_engine):
+    """Finished slots return their pages to the free-list at the step
+    boundary; live block tables never share a physical page (the
+    no-cross-session-contamination invariant), and the scratch page 0 is
+    never allocated."""
+    eng = tiny_engine
+    ce = _cont(eng)
+    free0 = ce.alloc.n_free
+    reqs = [
+        ce.submit([i + 1, i + 2], max_new_tokens=4 + i, seed=i)
+        for i in range(4)
+    ]
+    seen_tables = []
+    while ce.has_work():
+        ce.step_chunk()
+        bt = np.asarray(ce.cache.block_tables)
+        live = [s for s in range(ce.max_slots) if ce._active[s]]
+        pages = [p for s in live for p in bt[s] if p > 0]
+        assert len(pages) == len(set(pages)), "live slots share a page"
+        assert 0 not in [p for s in live for p in bt[s][: 1]], \
+            "live slot bound to the scratch page"
+        seen_tables.append(len(pages))
+    assert all(r.finished for r in reqs)
+    assert ce.alloc.n_free == free0  # every page came back
+    assert np.asarray(ce.cache.lengths).sum() == 0  # all slots cleared
+
+
+def test_admission_queues_when_slots_exhausted(tiny_engine):
+    """All-or-nothing admission: a request that can't get a slot (and all
+    the pages it could need) stays queued FIFO until evictions free
+    capacity — it is never admitted half-resident. (Slot shape matches the
+    other tests so the suite reuses the one compiled step program.)"""
+    eng = tiny_engine
+    ce = _cont(eng)  # max_slots=4
+    rs = [ce.submit([i + 1], max_new_tokens=3, seed=i) for i in range(6)]
+    ce.step_chunk(admit_only=True)
+    assert ce.live_slots == 4  # four admitted, two queued
+    ce.run_until_idle()
+    assert all(r.finished for r in rs)
+    assert ce.stats["admitted"] == 6
+
+
+# ---------------------------------------------------------------------------
+# scheduler: admission latency + batcher front-end
+# ---------------------------------------------------------------------------
+def test_new_request_joins_within_one_chunk(tiny_engine):
+    """A request submitted while a long decode is in flight starts
+    emitting within one decode chunk — not after the running batch
+    drains (the static batcher's convoy failure)."""
+    eng = tiny_engine
+    ce = _cont(eng, chunk_steps=4)
+    long_req = ce.submit([1, 2], max_new_tokens=40, seed=0)
+    ce.step_chunk()  # long request mid-flight
+    emitted_before_late = len(long_req.tokens)
+    late_first_at = {}
+
+    def late_cb(tok):
+        late_first_at.setdefault("long_progress", len(long_req.tokens))
+        return False
+
+    ce.submit([9, 9], max_new_tokens=4, seed=1, stream_cb=late_cb)
+    ce.step_chunk()
+    assert "long_progress" in late_first_at, "late request not admitted"
+    # the late request's first token arrived while the long one was still
+    # well short of done, within one chunk of its submission
+    assert late_first_at["long_progress"] <= emitted_before_late + ce.chunk_steps
+    assert not long_req.finished
+    ce.run_until_idle()
+    assert long_req.finished
+
+
+def test_continuous_batcher_local_engine(tiny_engine):
+    """ContinuousBatcher over a local engine: GenBatcher's client contract
+    (blocking generate, per-request stream demux, budget trim, close
+    drains) with continuous scheduling underneath."""
+    from tensorlink_tpu.ml.batching import ContinuousBatcher
+
+    b = ContinuousBatcher(
+        engine=tiny_engine, eos_ids=[], max_slots=4, page_size=8,
+        chunk_steps=4,
+    )
+    results: dict[int, list[int]] = {}
+    streams: dict[int, list[int]] = {i: [] for i in range(3)}
+
+    def req(i, n, temp):
+        results[i] = b.generate(
+            [i + 1, i + 2], max_new_tokens=n, temperature=temp,
+            stream_cb=lambda ts, i=i: streams[i].extend(ts),
+        )
+
+    threads = [
+        threading.Thread(target=req, args=(0, 4, 0.0)),
+        threading.Thread(target=req, args=(1, 2, 0.8)),
+        threading.Thread(target=req, args=(2, 6, 0.0)),
+    ]
+    for t in threads:
+        t.start()
+        time.sleep(0.01)
+    for t in threads:
+        t.join(30)
+    assert sorted(results) == [0, 1, 2]
+    assert [len(results[i]) for i in range(3)] == [4, 2, 6]
+    assert streams == {i: results[i] for i in range(3)}
+    st = b.stats()
+    assert st["requests"] == 3 and st["continuous"]
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.generate([1], max_new_tokens=1)
+
+
+def test_continuous_refuses_unsupported_cache_modes(tiny_engine):
+    """int8 KV and sliding windows stay on the static batcher: the engine
+    refuses loudly (the worker catches this and falls back)."""
+    cfg = tiny_engine.cfg.with_(sliding_window=8)
+    eng = GenerationEngine(
+        cfg, tiny_engine.params, seq_buckets=(8, 32), batch_buckets=(1,),
+        max_seq_len=64,
+    )
+    with pytest.raises(ValueError, match="sliding-window"):
+        ContinuousEngine(eng)

@@ -3,7 +3,9 @@
 The flash-attention prefill kernel (ops/attention.py) is pinned against
 the einsum reference (models/transformer.py::attention) across GQA/MHA
 shapes and block configurations, then end-to-end through the generation
-engine with cfg.flash_attention on."""
+engine with cfg.flash_attention on. The paged decode kernel
+(continuous batching) is pinned against its pure-jnp reference and the
+reference against the dense einsum path."""
 
 import jax
 import jax.numpy as jnp
@@ -11,7 +13,11 @@ import numpy as np
 import pytest
 
 from tensorlink_tpu.models.transformer import _mask_bias, attention
-from tensorlink_tpu.ops.attention import flash_attention
+from tensorlink_tpu.ops.attention import (
+    flash_attention,
+    paged_attention,
+    paged_attention_ref,
+)
 
 
 def _ref(q, k, v, scale):
@@ -75,6 +81,8 @@ def test_flash_rejects_indivisible_seq():
                         interpret=True)
 
 
+@pytest.mark.slow  # engine-level compile-heavy; CI engine job runs these
+# unfiltered — the tier-1 'not slow' pass keeps the kernel parity tests only
 def test_engine_flash_windowed_prefill_matches_dense():
     """A sliding-window (mistral-style) config takes the flash path too."""
     from tensorlink_tpu.engine.generate import GenerationEngine
@@ -97,6 +105,7 @@ def test_engine_flash_windowed_prefill_matches_dense():
     assert r_f.sequences == r_d.sequences
 
 
+@pytest.mark.slow  # see above
 def test_engine_flash_prefill_matches_dense():
     """cfg.flash_attention routes the engine's fresh-cache prefill through
     the kernel; generated tokens must match the einsum engine exactly
@@ -131,6 +140,83 @@ def test_engine_flash_prefill_matches_dense():
     )
 
 
+# ---------------------------------------------------------------------------
+# paged decode attention (continuous batching)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "S,Hq,Hkv,hd,page,n_pp",
+    [
+        (4, 8, 2, 32, 8, 4),  # GQA, ragged lengths
+        (2, 4, 4, 16, 16, 2),  # MHA
+        (3, 8, 1, 64, 4, 8),  # MQA, many small pages
+    ],
+)
+def test_paged_kernel_matches_ref(S, Hq, Hkv, hd, page, n_pp):
+    """The Pallas paged kernel (scalar-prefetched block tables, online
+    softmax per page) matches the pure-jnp reference across GQA shapes
+    and ragged lengths — including a free slot (length 0, zero output)
+    and a full slot."""
+    rng = np.random.default_rng(0)
+    P = 1 + S * n_pp
+    q = jnp.asarray(rng.normal(size=(S, Hq, hd)).astype(np.float32))
+    kp = jnp.asarray(rng.normal(size=(P, Hkv, page, hd)).astype(np.float32))
+    vp = jnp.asarray(rng.normal(size=(P, Hkv, page, hd)).astype(np.float32))
+    bt = jnp.asarray(rng.permutation(np.arange(1, P))[: S * n_pp]
+                     .reshape(S, n_pp).astype(np.int32))
+    lens = np.linspace(0, n_pp * page, S).astype(np.int32)  # 0 .. full
+    lens = jnp.asarray(lens)
+    scale = hd**-0.5
+    ref = paged_attention_ref(q, kp, vp, bt, lens, scale=scale)
+    got = paged_attention(q, kp, vp, bt, lens, scale=scale, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    assert np.abs(np.asarray(ref)[np.asarray(lens) == 0]).max() == 0
+
+
+def test_paged_ref_matches_dense_attention():
+    """A slot whose pages are filled contiguously computes EXACTLY what
+    the dense einsum path computes over a contiguous cache with the same
+    valid length — pages change layout, never math."""
+    rng = np.random.default_rng(1)
+    S, Hq, Hkv, hd, page, n_pp = 2, 4, 2, 16, 8, 3
+    L = n_pp * page
+    lens = [13, 24]
+    k_dense = rng.normal(size=(S, L, Hkv, hd)).astype(np.float32)
+    v_dense = rng.normal(size=(S, L, Hkv, hd)).astype(np.float32)
+    q = rng.normal(size=(S, 1, Hq, hd)).astype(np.float32)
+    # scatter the dense rows into pages (slot s gets pages 1+s*n_pp ...)
+    P = 1 + S * n_pp
+    kp = np.zeros((P, Hkv, page, hd), np.float32)
+    vp = np.zeros((P, Hkv, page, hd), np.float32)
+    bt = np.zeros((S, n_pp), np.int32)
+    for s in range(S):
+        pages = 1 + s * n_pp + np.arange(n_pp)
+        bt[s] = pages
+        kp[pages] = k_dense[s].reshape(n_pp, page, Hkv, hd).transpose(
+            0, 2, 1, 3
+        )
+        vp[pages] = v_dense[s].reshape(n_pp, page, Hkv, hd).transpose(
+            0, 2, 1, 3
+        )
+    got = paged_attention_ref(
+        jnp.asarray(q[:, 0]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(lens, jnp.int32), scale=hd**-0.5,
+    )
+    # dense einsum reference: query at position lens-1 over a [S, L] cache
+    pos = jnp.asarray(np.asarray(lens, np.int64)[:, None] - 1)
+    valid = jnp.arange(L)[None, :] < jnp.asarray(lens)[:, None]
+    bias = _mask_bias(pos, L, valid, None)
+    ref = attention(
+        jnp.asarray(q), jnp.asarray(k_dense), jnp.asarray(v_dense),
+        bias, hd**-0.5,
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.slow  # see above
 def test_engine_flash_sharded_mesh_matches_dense(cpu_devices):
     """Flash prefill composes with a tensor/data mesh (r3 weak: it was
     silently ignored on sharded stages): the kernel runs inside shard_map
