@@ -235,6 +235,11 @@ class TensorlinkAPI:
                 )
             if path == "/stats":
                 st = await self._ml(self.node.status)
+                # per-hosted-model serving telemetry (scheduler counters
+                # plus the slot engine's prefix-cache/occupancy snapshot
+                # when continuous batching is active) rides the same
+                # route operators already poll for node health
+                st["models"] = await self._ml(self.executor.hosted_snapshot)
                 return await self._send_json(writer, 200, st)
             if path == "/node-info":
                 return await self._send_json(writer, 200, self._node_info())

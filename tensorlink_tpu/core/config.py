@@ -85,6 +85,21 @@ class MLConfig:
     cont_max_slots: int = 8  # concurrent requests per model (B of the slot batch)
     cont_page_size: int = 16  # KV positions per page
     cont_chunk_steps: int = 8  # decode steps between admission boundaries
+    # chunked prefill (engine/continuous.py): an admitted prompt prefills
+    # in fixed-shape chunks of this many tokens interleaved with decode
+    # chunks, so a long admission never stalls co-resident decodes for
+    # more than one chunk (flat TTFT under mixed traffic). 0 = legacy
+    # monolithic admission (whole-prompt dense prefill; disables the
+    # prefix cache, which needs offset-carrying suffix prefill).
+    prefill_chunk: int = 128
+    # automatic prefix caching over the paged KV cache (docs/SERVING.md):
+    # full KV pages are kept resident keyed by their exact token chain
+    # from position 0; admission maps the longest cached prefix into the
+    # new slot's block table (zero prefill compute for the hit region),
+    # the first divergent page is copy-on-write, and unreferenced pages
+    # evict LRU when the allocator runs dry. Hits are bitwise the KV the
+    # slot would have computed — streams are identical cache on or off.
+    prefix_cache: bool = True
     # streamed requests: >0 runs the decode as fully-compiled on-device
     # chunks of this many steps (one host round trip per chunk instead of
     # per token — engine/generate.py::generate_chunked); 0 keeps the

@@ -10,14 +10,27 @@ finished row stops consuming decode steps immediately — the two failure
 modes of the static batcher (queue-until-drain, dead ``done``-masked
 rows) are structurally gone.
 
+Admission prefills through the **automatic prefix cache** + **chunked
+prefill** (docs/SERVING.md): the longest cached chain of full KV pages
+maps into the new slot's block table with zero prefill compute, the
+first divergent page is copy-on-write, and the remaining suffix runs as
+fixed-shape ``prefill_chunk`` chunks interleaved with decode chunks — a
+long admission never stalls co-resident decodes for more than one chunk.
+Finished slots promote their prompt-region pages back into the cache
+(ref-counted, LRU-leaf eviction under memory pressure), which also makes
+crash-recovery re-prefill near-free while the prefix stays resident.
+
 Determinism contract (the parity tests' anchor): each slot samples with
 its OWN stateless key chain — token n of a request draws from
 ``fold_in(PRNGKey(seed), n)`` — and a slot's logits depend only on its
-own pages (attention masks by slot length). So a request decodes
-token-for-token identically whether it runs alone, co-resident with any
-mix of neighbors, admitted mid-flight, or resumed on a replacement
-worker after a crash (the recovery path re-prefills prompt + emitted and
-continues the chain at n = len(emitted)).
+own pages (attention masks by slot length). Cached KV is bitwise the KV
+the slot would have computed (prefill chunk framing is invariant,
+test-pinned; decode-written pages are never promoted). So a request
+decodes token-for-token identically whether it runs alone, co-resident
+with any mix of neighbors, admitted mid-flight, or resumed on a
+replacement worker after a crash (the recovery path re-prefills prompt +
+emitted and continues the chain at n = len(emitted)) — with the prefix
+cache on or off.
 """
 
 from __future__ import annotations
@@ -32,14 +45,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import GenerationEngine
+from .generate import GenerationEngine, _head_from_hidden
 from .paged import (
     PageAllocator,
     PagedKVCache,
+    PrefixCache,
     bind_slot,
     clear_slot,
+    copy_page,
     paged_decode_chunk,
     paged_decode_step,
+    paged_prefill_chunk,
     pages_needed,
     scatter_prefill,
 )
@@ -87,7 +103,9 @@ class ContinuousRequest:
     tokens: list[int] = field(default_factory=list)  # emitted THIS run
     finished: bool = False
     slot: int = -1
-    pages: list[int] = field(default_factory=list)
+    pages: list[int] = field(default_factory=list)  # pages this slot OWNS
+    shared_nodes: list = field(default_factory=list)  # prefix-cache hits
+    prefill_pos: int = 0  # prompt tokens written so far (chunked prefill)
     error: BaseException | None = None
     done: threading.Event = field(default_factory=threading.Event)
 
@@ -107,6 +125,8 @@ class ContinuousEngine:
         max_slots: int = 8,
         page_size: int = 16,
         chunk_steps: int = 8,
+        prefill_chunk: int = 128,
+        prefix_cache: bool = True,
     ):
         if engine.cache_quant:
             raise ValueError(
@@ -132,6 +152,19 @@ class ContinuousEngine:
             max_len=self.max_seq_len, dtype=engine.cache_dtype,
         )
         self.alloc = PageAllocator(self.cache.n_pages)
+        # chunked prefill: the prompt suffix beyond any cache hit prefills
+        # in fixed-shape chunks interleaved with decode chunks, so a long
+        # admission never stalls running slots for more than one chunk.
+        # 0 = legacy monolithic admission (dense bucketed prefill +
+        # scatter) — the automatic prefix cache requires the chunked path
+        # (the suffix must be computable at an arbitrary page offset).
+        self.prefill_chunk = min(int(prefill_chunk), self.max_seq_len) \
+            if prefill_chunk and prefill_chunk > 0 else 0
+        self.prefix = (
+            PrefixCache(self.page_size)
+            if prefix_cache and self.prefill_chunk > 0 else None
+        )
+        self._prefilling: dict[int, ContinuousRequest] = {}
         self._lock = threading.Lock()
         self._queue: deque[ContinuousRequest] = deque()
         self._rid = itertools.count(1)
@@ -154,6 +187,8 @@ class ContinuousEngine:
         self.stats = {
             "admitted": 0, "evicted": 0, "decode_steps": 0,
             "slot_steps_live": 0, "slot_steps_total": 0,
+            "prefill_chunks": 0, "prefill_tokens": 0,
+            "prefill_tokens_skipped": 0,
         }
 
     # -- client side -----------------------------------------------------
@@ -190,21 +225,31 @@ class ContinuousEngine:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._queue) or bool(self._active.any())
+            return (
+                bool(self._queue)
+                or bool(self._active.any())
+                or bool(self._prefilling)
+            )
 
     @property
     def live_slots(self) -> int:
-        return int(self._active.sum())
+        """Slots holding a live request — decoding or mid-prefill."""
+        return int(self._active.sum()) + len(self._prefilling)
 
     def jit_cache_sizes(self) -> dict:
         """Compiled-program counts of the slot-batched hot loop — the
         "no unbounded compile set" guarantee, asserted by the engine
-        tests: these stay fixed no matter the request mix."""
+        tests: these stay fixed no matter the request mix. Chunked
+        prefill adds exactly two entries (the fixed-shape chunk program
+        and the COW page copy); prompt length, cache-hit offset and
+        chunk count are all DATA to them."""
         return {
             "decode_chunk": paged_decode_chunk._cache_size(),
             "decode_step": paged_decode_step._cache_size(),
             "sample_rows": _sample_rows._cache_size(),
             "row_keys": _row_keys._cache_size(),
+            "prefill_chunk": paged_prefill_chunk._cache_size(),
+            "copy_page": copy_page._cache_size(),
         }
 
     # -- admission / eviction -------------------------------------------
@@ -225,7 +270,7 @@ class ContinuousEngine:
         return cancel or tok in req.eos or len(req.tokens) >= req.budget
 
     def _admit_one(self, req: ContinuousRequest, slot: int) -> bool:
-        """Prefill ``req`` into ``slot``. Returns False when no pages are
+        """Place ``req`` into ``slot``. Returns False when no pages are
         free (request stays queued)."""
         if len(req.prompt) > self.max_seq_len:
             # surface the same diagnosable error the static path raises
@@ -245,40 +290,187 @@ class ContinuousEngine:
             return True
         req.budget = eff
         total = min(len(req.prompt) + eff, self.max_seq_len)
+        if self.prefill_chunk > 0:
+            return self._admit_paged(req, slot, total)
+        return self._admit_monolithic(req, slot, total)
+
+    def _alloc_pages(self, n: int) -> list[int] | None:
+        """All-or-nothing page grab with eviction-on-demand: when the
+        free-list is short, unreferenced cached prefixes are evicted
+        LRU-leaf-first — but ONLY when eviction can actually cover the
+        deficit. A request too big to fit even after a full cache wipe
+        stays queued WITHOUT destroying the resident prefixes the other
+        requests keep hitting."""
+        pages = self.alloc.alloc(n)
+        if pages is None and self.prefix is not None:
+            deficit = n - self.alloc.n_free
+            if self.prefix.n_evictable() >= deficit:
+                self.alloc.free(self.prefix.evict(deficit))
+                pages = self.alloc.alloc(n)
+        return pages
+
+    def _admit_paged(self, req: ContinuousRequest, slot: int,
+                     total: int) -> bool:
+        """Chunked-prefill admission: walk the prefix cache for the
+        longest resident chain of full pages (zero prefill compute for the
+        hit region), copy-on-write the first divergent page when its
+        cached sibling shares a partial token prefix, allocate private
+        pages for the rest, and queue the non-hit suffix for chunked
+        prefill at the coming step boundaries."""
+        T = len(req.prompt)
+        hit_nodes: list = []
+        cow = None
+        if self.prefix is not None:
+            # at least ONE real token must prefill so the final chunk
+            # yields the last prompt position's logits for the first draw
+            limit = T - 1
+            hit_nodes = self.prefix.match(req.prompt, limit)
+            cow = self.prefix.partial_match(hit_nodes, req.prompt, limit)
+            # pin the hit chain (and the COW source) through the
+            # allocation below — eviction-on-demand must not free them
+            self.prefix.acquire(hit_nodes)
+            if cow is not None:
+                self.prefix.acquire([cow[0]])
+        n_hit = len(hit_nodes)
+        pages = self._alloc_pages(pages_needed(total, self.page_size) - n_hit)
+        if pages is None:
+            if self.prefix is not None:
+                self.prefix.release(hit_nodes)
+                if cow is not None:
+                    self.prefix.release([cow[0]])
+            return False
+        hit_len = n_hit * self.page_size
+        cow_released = False
+        try:
+            bt_row = np.zeros(self.cache.pages_per_slot, np.int32)
+            bt_row[:n_hit] = [n.page for n in hit_nodes]
+            bt_row[n_hit : n_hit + len(pages)] = pages
+            if cow is not None:
+                # the divergent page: duplicate the cached page into the
+                # slot's first private page and credit the matched positions
+                src, n_match = cow
+                self.cache = copy_page(
+                    self.cache, jnp.int32(src.page), jnp.int32(pages[0])
+                )
+                hit_len += n_match
+                self.prefix.stats["cow_copies"] += 1
+                self.prefix.release([src])
+                cow_released = True
+            self.cache = bind_slot(
+                self.cache, jnp.int32(slot), jnp.asarray(bt_row),
+                jnp.int32(hit_len),
+            )
+        except BaseException:
+            # a failed admission must not leak: return the private pages
+            # and drop the pinned refs so close()'s conservation check
+            # still holds on the error-cleanup path
+            self.alloc.free(pages)
+            if self.prefix is not None:
+                self.prefix.release(hit_nodes)
+                if cow is not None and not cow_released:
+                    self.prefix.release([cow[0]])
+            raise
+        req.slot = slot
+        req.pages = pages
+        req.shared_nodes = hit_nodes
+        req.prefill_pos = hit_len
+        self._slots[slot] = req
+        self._prefilling[slot] = req
+        self.stats["admitted"] += 1
+        self.stats["prefill_tokens_skipped"] += hit_len
+        if self.prefix is not None:
+            # counted HERE, not in match(): one lookup per admission, so
+            # head-of-line page-wait retries don't skew the hit rate
+            self.prefix.stats["lookups"] += 1
+            if hit_len > 0:
+                self.prefix.stats["hits"] += 1
+            self.prefix.stats["hit_tokens"] += hit_len
+        return True
+
+    def _prefill_tick(self) -> None:
+        """One fixed-shape prefill chunk for EVERY mid-prefill slot, then
+        back to the decode chunk — the chunked-prefill TTFT guarantee:
+        co-resident decodes are never stalled by more than one chunk of
+        prefill compute per step, no matter how long an admitted prompt
+        is. A slot whose prompt completes activates immediately (its
+        first token samples from the final chunk's logits and it joins
+        this step's decode chunk)."""
+        C = self.prefill_chunk
+        for slot in sorted(self._prefilling):
+            req = self._prefilling[slot]
+            T = len(req.prompt)
+            n = min(C, T - req.prefill_pos)
+            toks = np.zeros(C, np.int32)
+            toks[:n] = req.prompt[req.prefill_pos : req.prefill_pos + n]
+            h_last, self.cache = paged_prefill_chunk(
+                self.engine.params, jnp.asarray(toks), self.cache,
+                jnp.int32(slot), jnp.int32(req.prefill_pos), jnp.int32(n),
+                self.cfg, self.use_kernel,
+            )
+            req.prefill_pos += n
+            self.stats["prefill_chunks"] += 1
+            self.stats["prefill_tokens"] += n
+            if req.prefill_pos >= T:
+                del self._prefilling[slot]
+                logits = _head_from_hidden(
+                    self.engine.params, h_last, self.cfg
+                )
+                self._activate(req, slot, logits)
+
+    def _admit_monolithic(self, req: ContinuousRequest, slot: int,
+                          total: int) -> bool:
+        """Legacy one-shot admission (``prefill_chunk=0``): the whole
+        prompt prefills through the engine's bucketed dense program, then
+        its KV rows land on the allocated pages in one scatter."""
         pages = self.alloc.alloc(pages_needed(total, self.page_size))
         if pages is None:
             return False
+        try:
+            logits, dense, lens, _B = self.engine.prefill([req.prompt])
+            T = len(req.prompt)
+            T_pad = dense.k.shape[2]  # full dense cache span
+            # bucketed scatter span: smallest seq bucket covering the
+            # prompt (bounded program set); positions past the prompt
+            # land on scratch
+            spans = [b for b in self.engine.seq_buckets if b >= T]
+            T_sc = spans[0] if spans else T_pad
+            T_sc = min(T_sc, T_pad)
+            bt_row = np.zeros(self.cache.pages_per_slot, np.int32)
+            bt_row[: len(pages)] = pages
+            pos = np.arange(T_sc)
+            pg_idx = np.where(
+                pos < T, bt_row[pos // self.page_size], 0
+            ).astype(np.int32)
+            off_idx = np.where(
+                pos < T, pos % self.page_size, 0
+            ).astype(np.int32)
+            self.cache = scatter_prefill(
+                self.cache,
+                dense.k[:, 0, :T_sc], dense.v[:, 0, :T_sc],
+                jnp.asarray(pg_idx), jnp.asarray(off_idx),
+            )
+            del dense
+            self.cache = bind_slot(
+                self.cache, jnp.int32(slot), jnp.asarray(bt_row),
+                jnp.int32(T)
+            )
+        except BaseException:
+            # failed admission must not leak pages past close()'s
+            # conservation check
+            self.alloc.free(pages)
+            raise
+        req.slot = slot
+        req.pages = pages
+        req.prefill_pos = T
+        self._slots[slot] = req
+        self.stats["admitted"] += 1
+        self._activate(req, slot, logits)
+        return True
 
-        # the prompt prefills through the engine's existing bucketed dense
-        # program (identical math to a solo decode), then its KV rows land
-        # on the allocated pages in one scatter
-        logits, dense, lens, _B = self.engine.prefill([req.prompt])
-        T = len(req.prompt)
-        T_pad = dense.k.shape[2]  # full dense cache span
-        # bucketed scatter span: smallest seq bucket covering the prompt
-        # (bounded program set); positions past the prompt land on scratch
-        spans = [b for b in self.engine.seq_buckets if b >= T]
-        T_sc = spans[0] if spans else T_pad
-        T_sc = min(T_sc, T_pad)
-        bt_row = np.zeros(self.cache.pages_per_slot, np.int32)
-        bt_row[: len(pages)] = pages
-        pos = np.arange(T_sc)
-        pg_idx = np.where(
-            pos < T, bt_row[pos // self.page_size], 0
-        ).astype(np.int32)
-        off_idx = np.where(pos < T, pos % self.page_size, 0).astype(np.int32)
-        self.cache = scatter_prefill(
-            self.cache,
-            dense.k[:, 0, :T_sc], dense.v[:, 0, :T_sc],
-            jnp.asarray(pg_idx), jnp.asarray(off_idx),
-        )
-        del dense
-        self.cache = bind_slot(
-            self.cache, jnp.int32(slot), jnp.asarray(bt_row), jnp.int32(T)
-        )
-
-        # first token: sampled from the prefill logits with the request's
-        # own key chain — exactly what a solo run draws
+    def _activate(self, req: ContinuousRequest, slot: int, logits) -> None:
+        """Prefill done: draw the first token from the last prompt
+        position's logits with the request's own key chain — exactly what
+        a solo run draws — and open the slot for decode chunks."""
         sp = req.sampling
         key = jax.random.fold_in(
             jax.random.PRNGKey(req.seed), req.start_step
@@ -290,10 +482,6 @@ class ContinuousEngine:
         self._counts = self._counts.at[slot].set(
             counts_row.at[tok].add(1)
         )
-        self.stats["admitted"] += 1
-        req.slot = slot
-        req.pages = pages
-        self._slots[slot] = req
         self._seeds[slot] = req.seed
         self._steps[slot] = req.start_step + 1  # next draw's index
         self._tok[slot] = tok
@@ -306,7 +494,6 @@ class ContinuousEngine:
         self._freq[slot] = float(np.asarray(sp.frequency_penalty).reshape(-1)[0])
         if self._emit(req, tok):
             self._evict(slot)
-        return True
 
     def _prompt_counts(self, req: ContinuousRequest) -> jax.Array:
         """Context histogram for presence/frequency penalties (row-local,
@@ -323,20 +510,121 @@ class ContinuousEngine:
         return bool(np.any(np.asarray(v)))
 
     def _evict(self, slot: int) -> None:
-        """Free a finished slot at a step boundary: pages → free-list,
-        table row → scratch, slot → admission pool."""
+        """Free a finished slot at a step boundary: shared prefix pages
+        drop their refcount, promotable private pages move INTO the
+        prefix cache, the rest return to the free-list; table row →
+        scratch, slot → admission pool."""
         req = self._slots[slot]
         self._slots[slot] = None
+        self._prefilling.pop(slot, None)
         self._active[slot] = False
         self._tok[slot] = 0
         self._temp[slot] = 0.0
         self.cache = clear_slot(self.cache, jnp.int32(slot))
         self._counts = self._counts.at[slot].set(0)
         if req is not None:
-            self.alloc.free(req.pages)
+            if self.prefix is not None:
+                self._release_pages(req)
+            else:
+                self.alloc.free(req.pages)
             req.pages = []
+            req.shared_nodes = []
             self.stats["evicted"] += 1
             self._finish(req, finished=True)
+
+    def _release_pages(self, req: ContinuousRequest) -> None:
+        """Return a finished slot's pages, promoting what the cache can
+        reuse. Promotable = full pages every position of which was
+        PREFILL-written from the prompt (``prefill_pos`` caps a
+        mid-prefill teardown). The decoded region is deliberately NOT
+        cached: a decode step's KV is the same math as a prefill
+        recompute but not bitwise identical to it (T=1 vs chunk-shaped
+        programs), and the cache's contract is that a hit is bitwise
+        the KV the slot would have computed — so only prefill-computed
+        pages (themselves chunk-framing-invariant, test-pinned) may
+        enter the trie."""
+        self.prefix.release(req.shared_nodes)
+        lim = min(len(req.prompt), req.prefill_pos)
+        page = self.page_size
+        n_hit = len(req.shared_nodes)
+        node = req.shared_nodes[-1] if req.shared_nodes else None
+        free_list: list[int] = []
+        promoting = req.error is None
+        for j, pid in enumerate(req.pages):
+            hi = (n_hit + j + 1) * page
+            if promoting and hi <= lim:
+                block = tuple(int(t) for t in req.prompt[hi - page : hi])
+                node, adopted = self.prefix.insert(node, block, pid)
+                if not adopted:
+                    # an identical chain landed first (e.g. a co-batched
+                    # twin finished earlier): keep theirs, free ours
+                    free_list.append(pid)
+            else:
+                # the chain must stay contiguous from position 0 — once a
+                # page can't be promoted, nothing after it can attach
+                promoting = False
+                free_list.append(pid)
+        self.alloc.free(free_list)
+
+    # -- page accounting -------------------------------------------------
+    def page_accounting(self) -> dict:
+        """Ownership snapshot over physical pages 1..P-1: the free-list,
+        the cache-resident set, and each live slot's private pages."""
+        slot_pages: list[int] = []
+        for s in range(self.max_slots):
+            req = self._slots[s]
+            if req is not None:
+                slot_pages.extend(req.pages)
+        return {
+            "free": set(self.alloc._free),
+            "cached": self.prefix.resident_pages if self.prefix else set(),
+            "slots": slot_pages,
+        }
+
+    def check_page_conservation(self) -> None:
+        """The hardened free-list invariant: free + slot-owned +
+        cache-resident == total usable pages, pairwise disjoint, scratch
+        page 0 in none of them. Raises AssertionError on violation —
+        asserted at engine teardown (close) and by the engine/chaos
+        tests after recovery."""
+        acc = self.page_accounting()
+        free, cached, slots = acc["free"], acc["cached"], acc["slots"]
+        total = self.cache.n_pages - 1
+        problems = []
+        if len(slots) != len(set(slots)):
+            problems.append("a page is owned by two slots")
+        if free & cached:
+            problems.append("free-list and cache overlap")
+        if set(slots) & (free | cached):
+            problems.append("slot-owned page also free or cached")
+        if 0 in (free | cached | set(slots)):
+            problems.append("scratch page 0 entered an ownership set")
+        if len(free) + len(cached) + len(slots) != total:
+            problems.append(
+                f"leak: free={len(free)} + cached={len(cached)} + "
+                f"slots={len(slots)} != total={total}"
+            )
+        if problems:
+            raise AssertionError(
+                "page conservation violated: " + "; ".join(problems)
+            )
+
+    def serving_snapshot(self) -> dict:
+        """Telemetry for the validator's /stats endpoint and the bench:
+        engine counters plus prefix-cache occupancy."""
+        out = dict(self.stats)
+        if self.prefix is not None:
+            ps = self.prefix.stats
+            out.update({
+                "prefix_lookups": ps["lookups"],
+                "prefix_hits": ps["hits"],
+                "prefix_hit_tokens": ps["hit_tokens"],
+                "prefix_cow_copies": ps["cow_copies"],
+                "prefix_evictions": ps["evictions"],
+                "prefix_inserts": ps["inserts"],
+                "prefix_resident_pages": self.prefix.n_resident,
+            })
+        return out
 
     def _admit(self) -> None:
         while True:
@@ -345,8 +633,11 @@ class ContinuousEngine:
             # calls never stack behind admission compute (single-driver
             # discipline means nobody else pops the head meanwhile)
             with self._lock:
+                # a slot is free only when NO request holds it — active
+                # decode or mid-prefill both count as occupied
                 free = [
-                    s for s in range(self.max_slots) if not self._active[s]
+                    s for s in range(self.max_slots)
+                    if self._slots[s] is None
                 ]
                 if not self._queue or not free:
                     return
@@ -372,7 +663,14 @@ class ContinuousEngine:
         work (live slots or queued requests) remains — the driver's
         requeue signal."""
         self._admit()
-        if admit_only or not self._active.any():
+        if admit_only:
+            return self.has_work()
+        if self._prefilling:
+            # one prefill chunk per mid-prefill slot, THEN the decode
+            # chunk: a long admission interleaves with running decodes
+            # instead of stalling them for its whole prompt
+            self._prefill_tick()
+        if not self._active.any():
             return self.has_work()
         S = self.max_slots
         remaining = np.zeros(S, np.int32)
@@ -444,6 +742,10 @@ class ContinuousEngine:
         for req in pending:
             req.error = err
             self._finish(req, finished=False)
+        # teardown invariant: with every slot evicted, the free-list plus
+        # the cache-resident set must account for every usable page —
+        # a violation here means a leak or a double-ownership upstream
+        self.check_page_conservation()
 
 
 __all__ = ["ContinuousEngine", "ContinuousRequest"]

@@ -1121,6 +1121,18 @@ class GenerationEngine:
         # break-even rule said no would reinstate the slowdown it stopped
         spec_dead = False
         _EMA = 0.5
+        # acceptance-rate kill switch (VERDICT r5: the bench measured a
+        # lookahead-enabled run at 0.56x plain throughput while the README
+        # claimed "never a slowdown"): the timing rule above needs several
+        # post-compile samples of BOTH program kinds before it can arm —
+        # on a request whose drafts keep hitting but not matching, that
+        # can take long enough to lose real wall clock. A verify pass that
+        # emits fewer than _MIN_TOKENS_PER_PASS tokens on average cannot
+        # beat plain decode even if the padded pass were free, so after
+        # _ACC_PROBE verify passes a measured acceptance that low disables
+        # speculation permanently — no timing signal required.
+        _ACC_PROBE = 4
+        _MIN_TOKENS_PER_PASS = 1.5
         # a long run of draft MISSES never produces a verify sample for the
         # timing rule, yet means the text isn't repetitive — stop looking
         # (and, non-stream, hand the remainder to the compiled loop)
@@ -1263,6 +1275,13 @@ class GenerationEngine:
                     spec_on = self._spec_worthwhile(ema_acc, ema_tv, ema_td)
                     if not spec_on:
                         spec_dead = True
+            if (
+                not spec_dead and seen_tv >= _ACC_PROBE
+                and ema_acc < _MIN_TOKENS_PER_PASS
+            ):
+                # measured acceptance alone says drafting is a loss
+                spec_on = False
+                spec_dead = True
             # roll back rejected cache positions by resetting length only
             new_len = base_len + 1 + accepted
             cache = KVCache(

@@ -27,6 +27,7 @@ and in parity tests.
 
 from __future__ import annotations
 
+import heapq
 from dataclasses import dataclass, replace
 from functools import partial
 
@@ -45,7 +46,12 @@ from ..models.transformer import (
     rope_tables,
 )
 from ..models.quant import matmul as _mm
-from ..ops.attention import paged_attention, paged_attention_ref
+from ..ops.attention import (
+    paged_attention,
+    paged_attention_ref,
+    paged_prefill_attention,
+    paged_prefill_attention_ref,
+)
 
 
 @jax.tree_util.register_dataclass
@@ -128,17 +134,218 @@ class PageAllocator:
                 self._free.append(p)
 
 
-def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
-                 write_off, att_len, block_tables, kernel: bool):
-    """One transformer block over a slot batch of single tokens (T=1),
-    reading/writing KV through pages. Mirrors transformer.py::_block's
-    projection/norm/residual structure exactly — the parity tests pin the
-    two paths token-for-token — but swaps the contiguous-cache
-    dynamic_update_slice for a flat page scatter and the masked einsum for
-    paged attention."""
-    S = x.shape[0]
-    post = cfg.norm_position == "post"
-    h = x if post else _norm(x, lp["ln1"], cfg)
+# ---------------------------------------------------------------------------
+# Automatic prefix cache (host-side index over physical pages)
+# ---------------------------------------------------------------------------
+
+
+class _TrieNode:
+    """One cached FULL page: the KV of ``block`` (page_size token ids) at
+    the absolute positions its chain depth implies."""
+
+    __slots__ = ("block", "page", "parent", "children", "refs", "tick")
+
+    def __init__(self, block: tuple, page: int, parent: "_TrieNode | None"):
+        self.block = block
+        self.page = page
+        self.parent = parent
+        self.children: dict[tuple, _TrieNode] = {}
+        self.refs = 0  # slots currently mapping this page
+        self.tick = 0  # LRU recency (monotonic engine counter)
+
+
+class PrefixCache:
+    """Host-side automatic-prefix-cache index over ``PagedKVCache`` pages.
+
+    A trie over page-size token blocks: a node's path from the root IS the
+    cache key — the exact token chain from position 0 — so two prompts
+    share a cached page only when every earlier token matches, which makes
+    the key rope-offset-invariant by construction (same tokens at the same
+    absolute positions ⇒ bitwise the same KV). The cache is per engine,
+    hence per (model, dtype): no model id needs to ride the key.
+
+    Only FULL pages are cached. ``refs`` counts slots whose block tables
+    currently name the page; refcount-0 pages stay resident and are
+    evicted leaf-first in LRU order when the allocator runs dry (evicting
+    an interior node would orphan descendants whose positions assume it).
+    Structural equality (no hashing) means no collision can ever map a
+    wrong page — the "hash map" is Python's dict over the block tuples.
+    """
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self.root = _TrieNode((), 0, None)
+        self._by_page: dict[int, _TrieNode] = {}
+        self._tick = 0
+        self.stats = {
+            "lookups": 0,
+            "hits": 0,
+            "hit_tokens": 0,
+            "cow_copies": 0,
+            "evictions": 0,
+            "inserts": 0,
+        }
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def resident_pages(self) -> set[int]:
+        return set(self._by_page)
+
+    @property
+    def n_resident(self) -> int:
+        return len(self._by_page)
+
+    def _touch(self, node: _TrieNode) -> None:
+        self._tick += 1
+        node.tick = self._tick
+
+    # -- lookup ----------------------------------------------------------
+    def _blocks(self, tokens, limit: int):
+        p = self.page_size
+        for i in range(0, (limit // p) * p, p):
+            yield tuple(int(t) for t in tokens[i : i + p])
+
+    def match(self, tokens, limit: int) -> list[_TrieNode]:
+        """Longest chain of cached full pages covering ``tokens[:limit]``.
+        Returns the matched nodes in position order (refs NOT yet taken —
+        callers acquire() before anything can evict, single-driver).
+        lookup/hit telemetry is counted at successful ADMISSION, not
+        here: a head-of-line request waiting for pages re-matches every
+        chunk and must not inflate the operator-facing hit rate."""
+        node = self.root
+        out: list[_TrieNode] = []
+        for block in self._blocks(tokens, limit):
+            child = node.children.get(block)
+            if child is None:
+                break
+            out.append(child)
+            self._touch(child)  # a hit IS a use: refresh LRU recency
+            node = child
+        return out
+
+    def partial_match(
+        self, nodes: list[_TrieNode], tokens, limit: int
+    ) -> tuple[_TrieNode, int] | None:
+        """Best divergent child for copy-on-write: among the children of
+        the last matched node, the page whose block shares the LONGEST
+        non-empty token prefix with what the request still needs (capped
+        at ``limit`` tokens past the full-page hit). The caller copies
+        that page and owns the copy — the cached original is never
+        written."""
+        parent = nodes[-1] if nodes else self.root
+        done = len(nodes) * self.page_size
+        want = [int(t) for t in tokens[done : done + min(self.page_size, limit - done)]]
+        if not want:
+            return None
+        best: tuple[_TrieNode, int] | None = None
+        for block, child in parent.children.items():
+            n = 0
+            for a, b in zip(want, block):
+                if a != b:
+                    break
+                n += 1
+            if n > 0 and (best is None or n > best[1]):
+                best = (child, n)
+        return best
+
+    # -- refcounts -------------------------------------------------------
+    def acquire(self, nodes: list[_TrieNode]) -> None:
+        for n in nodes:
+            n.refs += 1
+            self._touch(n)
+
+    def release(self, nodes: list[_TrieNode]) -> None:
+        for n in nodes:
+            assert n.refs > 0, "prefix-cache refcount underflow"
+            n.refs -= 1
+            self._touch(n)
+
+    # -- insert / evict --------------------------------------------------
+    def insert(
+        self, parent: "_TrieNode | None", block: tuple, page: int
+    ) -> tuple[_TrieNode, bool]:
+        """Adopt ``page`` as the cached KV of ``block`` under ``parent``
+        (None = root). Returns ``(node, adopted)`` — ``adopted=False``
+        means an identical chain is already resident: the caller keeps
+        ownership of ``page`` (frees it) and continues the walk from the
+        existing node."""
+        parent = parent or self.root
+        existing = parent.children.get(block)
+        if existing is not None:
+            self._touch(existing)
+            return existing, False
+        node = _TrieNode(block, int(page), parent)
+        parent.children[block] = node
+        self._by_page[int(page)] = node
+        self._touch(node)
+        self.stats["inserts"] += 1
+        return node, True
+
+    def n_evictable(self) -> int:
+        """Pages a (cascading) evict could free in the limit: nodes whose
+        WHOLE subtree is unreferenced — a referenced descendant pins its
+        ancestors because eviction is leaf-first. Lets the allocator skip
+        a destructive cache wipe when eviction can never satisfy the
+        allocation anyway."""
+        def walk(node: _TrieNode) -> tuple[int, bool]:
+            total, clear = 0, node.refs == 0
+            for child in node.children.values():
+                c_total, c_clear = walk(child)
+                total += c_total
+                clear = clear and c_clear
+            return total + (1 if clear else 0), clear
+        return sum(walk(c)[0] for c in self.root.children.values())
+
+    def evict(self, k: int) -> list[int]:
+        """Free up to ``k`` least-recently-used unreferenced LEAF pages
+        in one pass (a parent whose last child evicts becomes a leaf and
+        is eligible within the same call); returns the freed page ids.
+        One resident scan amortized over the whole batch — the allocator
+        asks for the full deficit at once instead of one page per retry."""
+        heap = [
+            (n.tick, n.page, n)
+            for n in self._by_page.values()
+            if n.refs == 0 and not n.children
+        ]
+        heapq.heapify(heap)
+        freed: list[int] = []
+        while heap and len(freed) < k:
+            _, _, victim = heapq.heappop(heap)
+            del victim.parent.children[victim.block]
+            del self._by_page[victim.page]
+            self.stats["evictions"] += 1
+            freed.append(victim.page)
+            parent = victim.parent
+            if (
+                parent is not self.root
+                and parent.refs == 0
+                and not parent.children
+            ):
+                heapq.heappush(heap, (parent.tick, parent.page, parent))
+        return freed
+
+    def evict_one(self) -> int | None:
+        """Free the least-recently-used unreferenced LEAF page; returns
+        its physical page id (for the allocator's free-list) or None when
+        nothing is evictable."""
+        freed = self.evict(1)
+        return freed[0] if freed else None
+
+    def drop_all(self) -> list[int]:
+        """Evict everything evictable (teardown): returns the freed page
+        ids. Referenced pages stay — their slots still map them."""
+        return self.evict(len(self._by_page))
+
+
+def _paged_qkv(h, lp, cfg: ModelConfig, cos, sin):
+    """Shared projection prologue of the paged blocks — q/k/v with
+    biases, both qk-norm variants, and (partial-dim) rope. IDENTICAL math
+    to transformer.py::_block's opening (the parity tests' anchor),
+    generic over the ``[B, T, d]`` input so the decode step (S slots × 1
+    token) and the prefill chunk (1 slot × C tokens) maintain ONE copy.
+    A new model-family flag added to the dense block must land here once,
+    not once per paged path."""
+    B, T = h.shape[:2]
     ap = lp["attn"]
     q = _mm(h, ap["wq"])
     k = _mm(h, ap["wk"])
@@ -148,9 +355,9 @@ def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
     if cfg.qk_norm_full:
         q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
         k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
-    q = q.reshape(S, 1, cfg.n_heads, cfg.head_dim)
-    k = k.reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
-    v = v.reshape(S, 1, cfg.n_kv_heads, cfg.head_dim)
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
         q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
         k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
@@ -166,6 +373,43 @@ def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
             k = jnp.concatenate(
                 [apply_rope(k[..., :rd], cos, sin), k[..., rd:]], axis=-1
             )
+    return q, k, v
+
+
+def _paged_residual(x, attn_raw, lp, cfg: ModelConfig):
+    """Shared epilogue: output projection (+bias) and the norm-position /
+    parallel-residual wiring, identical to transformer.py::_block's
+    closing. ``attn_raw`` is the attention output ``[B, T, Hq, hd]``."""
+    B, T = attn_raw.shape[:2]
+    ap = lp["attn"]
+    attn_out = _mm(attn_raw.reshape(B, T, cfg.q_dim), ap["wo"])
+    if "bo" in ap:
+        attn_out = attn_out + ap["bo"]
+    if cfg.norm_position == "post":
+        x = x + _norm(attn_out, lp["ln1"], cfg)
+        x = x + _norm(_mlp(x, lp["mlp"], cfg), lp["ln2"], cfg)
+    elif cfg.parallel_residual:
+        x = x + attn_out + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+    else:
+        x = x + attn_out
+        x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+    return x
+
+
+def _attn_scale(cfg: ModelConfig) -> float:
+    return cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
+
+
+def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
+                 write_off, att_len, block_tables, kernel: bool):
+    """One transformer block over a slot batch of single tokens (T=1),
+    reading/writing KV through pages. Mirrors transformer.py::_block's
+    projection/norm/residual structure exactly (via the shared
+    prologue/epilogue above) — the parity tests pin the two paths
+    token-for-token — but swaps the contiguous-cache dynamic_update_slice
+    for a flat page scatter and the masked einsum for paged attention."""
+    h = x if cfg.norm_position == "post" else _norm(x, lp["ln1"], cfg)
+    q, k, v = _paged_qkv(h, lp, cfg, cos, sin)  # [S, 1, H, hd]
 
     ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
     # per-slot scatter of the new token's KV: (page, offset) index pairs
@@ -174,24 +418,12 @@ def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
     ck = ck.at[write_pg, :, write_off].set(k[:, 0].astype(ck.dtype))
     cv = cv.at[write_pg, :, write_off].set(v[:, 0].astype(cv.dtype))
 
-    scale = cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
     attn = paged_attention if kernel else paged_attention_ref
-    attn_out = attn(
+    attn_raw = attn(
         q[:, 0], ck.astype(q.dtype), cv.astype(q.dtype),
-        block_tables, att_len, scale=scale,
+        block_tables, att_len, scale=_attn_scale(cfg),
     )[:, None]  # [S, 1, Hq, hd]
-    attn_out = _mm(attn_out.reshape(S, 1, cfg.q_dim), ap["wo"])
-    if "bo" in ap:
-        attn_out = attn_out + ap["bo"]
-    if post:
-        x = x + _norm(attn_out, lp["ln1"], cfg)
-        x = x + _norm(_mlp(x, lp["mlp"], cfg), lp["ln2"], cfg)
-    elif cfg.parallel_residual:
-        x = x + attn_out + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
-    else:
-        x = x + attn_out
-        x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
-    return x, (ck, cv)
+    return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
 
 
 @partial(
@@ -329,6 +561,109 @@ def paged_decode_chunk(
     return tokens, n_exec, cache, done, steps, counts, remaining
 
 
+def _paged_prefill_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv,
+                         write_pg, write_off, bt_row, start, kernel: bool):
+    """One transformer block over ONE slot's prefill chunk of C tokens,
+    reading/writing KV through the slot's pages. Shares ``_paged_block``'s
+    prologue/epilogue (scatter-then-attend order preserved) but carries a
+    whole chunk of queries at offset ``start`` — the offset-carrying
+    attention is what lets a prompt suffix prefill in pieces that each
+    attend everything before them."""
+    h = x if cfg.norm_position == "post" else _norm(x, lp["ln1"], cfg)
+    q, k, v = _paged_qkv(h, lp, cfg, cos, sin)  # [1, C, H, hd]
+
+    ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
+    # chunk scatter: position j of the chunk lands at (write_pg[j],
+    # write_off[j]); invalid tail positions land on scratch page 0, so
+    # their garbage KV is unreachable from any block table
+    ck = ck.at[write_pg, :, write_off].set(k[0].astype(ck.dtype))
+    cv = cv.at[write_pg, :, write_off].set(v[0].astype(cv.dtype))
+
+    attn = paged_prefill_attention if kernel else paged_prefill_attention_ref
+    attn_raw = attn(
+        q[0], ck.astype(q.dtype), cv.astype(q.dtype), bt_row, start,
+        scale=_attn_scale(cfg),
+    )[None]  # [1, C, Hq, hd]
+    return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
+)
+def paged_prefill_chunk(
+    params,
+    toks: jax.Array,  # int32 [C] — one slot's next prompt piece (0-padded)
+    cache: PagedKVCache,
+    slot: jax.Array,  # int32 scalar
+    start: jax.Array,  # int32 scalar — absolute position of toks[0]
+    n_valid: jax.Array,  # int32 scalar — real tokens in this chunk
+    cfg: ModelConfig,
+    kernel: bool = False,
+):
+    """One CHUNK of a slot's prompt prefill, straight onto its pages.
+
+    Fixed shape ``[C]`` (C = the engine's prefill_chunk) with slot, start
+    offset and valid count as DATA — the whole chunked-prefill feature
+    adds exactly ONE compiled program to the serving engine regardless of
+    prompt lengths or cache-hit mix (asserted next to the decode-chunk
+    bound in tests/test_continuous.py). Returns the final-norm hidden
+    state of the chunk's last valid token ``[1, d]`` (the engine applies
+    the vocab head only on the final chunk, via the same
+    ``_head_from_hidden`` program the dense chunked prefill uses) and the
+    cache with this slot's length advanced to ``start + n_valid``."""
+    C = toks.shape[0]
+    page = cache.page_size
+    n_pp = cache.pages_per_slot
+    bt_row = cache.block_tables[slot]  # [n_pp]
+    idx = jnp.arange(C)
+    pos = start + idx
+    valid = idx < n_valid
+    cpos = jnp.minimum(pos, n_pp * page - 1)
+    write_pg = jnp.where(valid, bt_row[cpos // page], 0)
+    write_off = jnp.where(valid, cpos % page, 0)
+
+    x = _embed_tokens(params, toks[None, :], cfg)  # [1, C, d]
+    positions = pos[None, :]
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
+
+    def scan_fn(carry, xs):
+        lp, ck, cv = xs
+        y, ckv = _paged_prefill_block(
+            carry, lp, cfg, cos, sin, (ck, cv), write_pg, write_off,
+            bt_row, start, kernel,
+        )
+        return y, ckv
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_fn, x, (params["layers"], cache.k, cache.v)
+    )
+    x = _norm(x, params["final_norm"], cfg)
+    h_last = x[0, jnp.maximum(n_valid - 1, 0)][None]  # [1, d]
+    new_cache = replace(
+        cache, k=k_new, v=v_new,
+        lengths=cache.lengths.at[slot].set(start + n_valid),
+    )
+    return h_last, new_cache
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def copy_page(
+    cache: PagedKVCache, src: jax.Array, dst: jax.Array
+) -> PagedKVCache:
+    """Copy-on-write: duplicate a cached page's KV (every layer) into a
+    page the admitting slot owns, so the slot can overwrite its tail
+    without touching the shared original."""
+    return replace(
+        cache,
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
+
+
 @partial(jax.jit, donate_argnames=("cache",))
 def scatter_prefill(
     cache: PagedKVCache,
@@ -389,7 +724,10 @@ def pages_needed(total_len: int, page_size: int) -> int:
 __all__ = [
     "PagedKVCache",
     "PageAllocator",
+    "PrefixCache",
     "paged_decode_step",
+    "paged_prefill_chunk",
+    "copy_page",
     "scatter_prefill",
     "bind_slot",
     "clear_slot",

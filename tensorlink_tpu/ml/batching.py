@@ -618,6 +618,8 @@ class ContinuousBatcher:
         max_slots: int = 8,
         page_size: int = 16,
         chunk_steps: int = 8,
+        prefill_chunk: int = 128,
+        prefix_cache: bool = True,
         seed: int = 0,
     ):
         from collections import deque
@@ -646,7 +648,8 @@ class ContinuousBatcher:
                 if isinstance(engine, ContinuousEngine)
                 else ContinuousEngine(
                     engine, max_slots=max_slots, page_size=page_size,
-                    chunk_steps=chunk_steps,
+                    chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
+                    prefix_cache=prefix_cache,
                 )
             )
             self.mode = "local"
@@ -877,12 +880,22 @@ class ContinuousBatcher:
         if live:
             out["mean_live_slots"] = round(sum(live) / len(live), 2)
             out["max_live_slots"] = max(live)
+        # ONE telemetry shape for both engine locations: the slot
+        # engine's full serving_snapshot() (scheduler counters +
+        # prefix-cache/occupancy) under "engine" — locally from the
+        # in-process engine, for single-stage remote jobs from the
+        # snapshot riding each GENERATE_RESP (ml/module.py::_note_serving)
         if self._cont is not None:
             st = self._cont.stats
             if st["slot_steps_total"]:
                 out["slot_occupancy"] = round(
                     st["slot_steps_live"] / st["slot_steps_total"], 3
                 )
+            out["engine"] = self._cont.serving_snapshot()
+        elif self.mode == "remote":
+            snap = getattr(self.model, "cont_serving_stats", None)
+            if isinstance(snap, dict) and snap:
+                out["engine"] = snap
         return out
 
     def close(self, timeout: float = 600.0) -> None:

@@ -965,6 +965,15 @@ class DistributedModel:
             )
         return [list(map(int, s)) for s in result["resp"]["sequences"]]
 
+    def _note_serving(self, resp: dict) -> None:
+        """Keep the worker's latest slot-engine snapshot (occupancy +
+        prefix-cache counters, riding each continuous GENERATE_RESP) so
+        the validator's /stats endpoint can surface it through
+        ContinuousBatcher.stats() without a polling RPC."""
+        snap = resp.get("serving")
+        if isinstance(snap, dict):
+            self.cont_serving_stats = snap
+
     def _generate_continuous_remote(
         self, prompt: list[int], *, max_new_tokens: int, temperature: float,
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
@@ -1011,6 +1020,7 @@ class DistributedModel:
                     resp = self._request(
                         wid, proto.GENERATE, body, _repaired=True
                     )
+                    self._note_serving(resp)
                     return [
                         delivered
                         + [int(t) for t in resp["sequences"][0]]
@@ -1118,6 +1128,7 @@ class DistributedModel:
         if "resp" in result:
             # the response is authoritative (fire-and-forget stream frames
             # may drop); it holds THIS submission's tokens only
+            self._note_serving(result["resp"])
             return (
                 delivered
                 + [int(x) for x in result["resp"]["sequences"][0]],

@@ -373,6 +373,12 @@ class DistributedValidator:
         out = {"model": name, "status": job.status}
         if job.error:
             out["error"] = job.error
+        # serving telemetry (scheduler + slot-engine/prefix-cache counters
+        # when the continuous path is active) — same dict /stats carries
+        # per hosted model via hosted_snapshot()
+        stats = job.batcher.stats() if job.batcher is not None else None
+        if stats:
+            out["serving"] = stats
         return out
 
     # ------------------------------------------------------------------

@@ -1570,6 +1570,8 @@ class DistributedWorker:
                     max_slots=int(ml.cont_max_slots),
                     page_size=int(ml.cont_page_size),
                     chunk_steps=int(ml.cont_chunk_steps),
+                    prefill_chunk=int(ml.prefill_chunk),
+                    prefix_cache=bool(ml.prefix_cache),
                 )
             except ValueError as e:
                 # int8 KV cache / sliding window: static batcher territory
@@ -1626,7 +1628,11 @@ class DistributedWorker:
                 peer, proto.GENERATE_RESP, p["rid"],
                 {"sequences": [list(map(int, req.tokens))],
                  "finished": [bool(req.finished)],
-                 "continuous": True},
+                 "continuous": True,
+                 # engine occupancy + prefix-cache counters ride every
+                 # response so the validator's /stats can surface them
+                 # without a dedicated polling RPC
+                 "serving": cont.serving_snapshot()},
             )
 
         cont.submit(

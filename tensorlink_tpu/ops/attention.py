@@ -264,6 +264,221 @@ def paged_attention_ref(
     return out.reshape(S, Hq, hd).astype(q.dtype)
 
 
+def paged_prefill_attention_ref(
+    q: jax.Array,  # [C, Hq, hd] — one slot's prefill-chunk queries
+    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    v_pages: jax.Array,  # [P, Hkv, page, hd]
+    bt_row: jax.Array,  # int32 [n_pp] — the slot's block-table row
+    start: jax.Array,  # int32 scalar — absolute position of q[0]
+    *,
+    scale: float,
+) -> jax.Array:
+    """Pure-jnp offset-carrying paged prefill attention — the CPU serving
+    path and the ground truth the Pallas kernel is pinned against.
+
+    This is what lifts the offset-0-only restriction of the monolithic
+    flash prefill: query ``j`` sits at absolute position ``start + j`` and
+    attends every key position ``<= start + j`` through the slot's pages
+    (the chunk's own keys included — the caller scatters the chunk's KV
+    into the pages BEFORE attention, exactly like the decode step). Same
+    masked-softmax GQA math as ``paged_attention_ref``, so a chunked
+    prefill is bit-identical to the monolithic one on positions the two
+    share. Positions past ``start + j`` (including any garbage beyond the
+    chunk's valid span) mask to NEG_INF; every query sees at least its own
+    key, so no zero-denominator guard is needed beyond the shared floor."""
+    C, Hq, hd = q.shape
+    P, Hkv, page, _ = k_pages.shape
+    n_pp = bt_row.shape[0]
+    K = n_pp * page
+    k = (
+        k_pages[bt_row]
+        .transpose(0, 2, 1, 3)
+        .reshape(K, Hkv, hd)
+    )
+    v = (
+        v_pages[bt_row]
+        .transpose(0, 2, 1, 3)
+        .reshape(K, Hkv, hd)
+    )
+    G = Hq // Hkv
+    qg = q.reshape(C, Hkv, G, hd).astype(jnp.float32)
+    scores = (
+        jnp.einsum(
+            "ckgd,xkd->ckgx", qg, k.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [C, Hkv, G, K]
+    q_pos = start + jnp.arange(C)[:, None]  # [C, 1]
+    k_pos = jnp.arange(K)[None, :]  # [1, K]
+    causal = k_pos <= q_pos  # [C, K]
+    scores = jnp.where(causal[:, None, None, :], scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("ckgx,xkd->ckgd", w, v.astype(jnp.float32))
+    return out.reshape(C, Hq, hd).astype(q.dtype)
+
+
+def _paged_prefill_kernel(
+    bt_ref,  # scalar-prefetch: block-table row [1, n_pp]
+    start_ref,  # scalar-prefetch: absolute position of q[0], [1]
+    q_ref,  # [1, C·G, hd]
+    k_ref,  # [1, 1, page, hd] — page bt[0, i] of kv head h
+    v_ref,  # [1, 1, page, hd]
+    o_ref,  # [1, C·G, hd]
+    m_ref,  # [C·G, 1] running max (VMEM scratch)
+    l_ref,  # [C·G, 1] running denominator
+    acc_ref,  # [C·G, hd] f32 accumulator
+    *,
+    scale: float,
+    page: int,
+    n_pp: int,
+    G: int,
+):
+    i = pl.program_id(1)
+    start = start_ref[0]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    CG = q_ref.shape[1]
+    C = CG // G
+    # pages wholly past the chunk's last visible position hold no
+    # attendable KV — skip their compute, and the BlockSpec index map
+    # clamps their fetch to the scratch page (a repeated block index is
+    # not re-copied by the pipeline), so both FLOPs and HBM traffic
+    # follow start + C, not the slot's page capacity
+    @pl.when(i * page <= start + C - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [C·G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [C·G, page]
+        # query row r is chunk position r // G at absolute start + r // G
+        q_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (CG, page), 0
+        ) // G
+        k_pos = i * page + jax.lax.broadcasted_iota(
+            jnp.int32, (CG, page), 1
+        )
+        ok = k_pos <= q_pos
+        sc = jnp.where(ok, sc, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(i == n_pp - 1)
+    def _finalize():
+        # every query attends at least its own (just-written) key, so
+        # l > 0; the floor only guards degenerate inputs
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_prefill_attention(
+    q: jax.Array,  # [C, Hq, hd]
+    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    v_pages: jax.Array,  # [P, Hkv, page, hd]
+    bt_row: jax.Array,  # int32 [n_pp]
+    start: jax.Array,  # int32 scalar
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Offset-carrying paged prefill attention (TPU); returns
+    ``[C, Hq, hd]``.
+
+    Grid ``(kv_head, page_idx)`` with the slot's block-table row and the
+    chunk's start offset riding scalar prefetch: each grid step's k/v
+    BlockSpec indexes the PHYSICAL page ``bt_row[i]`` (the gather is the
+    pipeline's HBM→VMEM copy), GQA queries group on the kv-head axis so
+    repeated KV heads are never materialized, and the online softmax
+    carries ``[C·G, 1]`` running max/denominator like the flash kernel.
+    One compiled program serves every (offset, page assignment) — the
+    block table and start are data, not shape."""
+    C, Hq, hd = q.shape
+    P, Hkv, page, _ = k_pages.shape
+    n_pp = bt_row.shape[0]
+    G = Hq // Hkv
+    # [C, Hq, hd] -> [Hkv, C·G, hd]: kv-head-major so one grid row's
+    # queries share the page block that prefetch pulled in
+    qg = (
+        q.reshape(C, Hkv, G, hd)
+        .transpose(1, 0, 2, 3)
+        .reshape(Hkv, C * G, hd)
+    )
+    kernel = functools.partial(
+        _paged_prefill_kernel, scale=scale, page=page, n_pp=n_pp, G=G
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(Hkv, n_pp),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, C * G, hd), lambda h, i, bt, st: (h, 0, 0)
+                ),
+                # pages wholly past the last visible position clamp their
+                # fetch to scratch page 0: the pipeline skips copies when
+                # the mapped block repeats, so HBM traffic follows the
+                # chunk's live span (start + C), not the slot's capacity
+                pl.BlockSpec(
+                    (1, 1, page, hd),
+                    lambda h, i, bt, st, p=page, c=C: (
+                        jnp.where(i * p <= st[0] + c - 1, bt[0, i], 0),
+                        h, 0, 0,
+                    ),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page, hd),
+                    lambda h, i, bt, st, p=page, c=C: (
+                        jnp.where(i * p <= st[0] + c - 1, bt[0, i], 0),
+                        h, 0, 0,
+                    ),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, C * G, hd), lambda h, i, bt, st: (h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((C * G, 1), jnp.float32),
+                pltpu.VMEM((C * G, 1), jnp.float32),
+                pltpu.VMEM((C * G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((Hkv, C * G, hd), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        bt_row.reshape(1, n_pp),
+        jnp.asarray(start, jnp.int32).reshape(1),
+        qg,
+        k_pages,
+        v_pages,
+    )
+    return (
+        out.reshape(Hkv, C, G, hd)
+        .transpose(1, 0, 2, 3)
+        .reshape(C, Hq, hd)
+    )
+
+
 def _paged_kernel(
     bt_ref,  # scalar-prefetch: block tables [S, n_pp]
     len_ref,  # scalar-prefetch: lengths [S]
@@ -385,4 +600,10 @@ def paged_attention(
     return out.reshape(S, Hq, hd)
 
 
-__all__ = ["flash_attention", "paged_attention", "paged_attention_ref"]
+__all__ = [
+    "flash_attention",
+    "paged_attention",
+    "paged_attention_ref",
+    "paged_prefill_attention",
+    "paged_prefill_attention_ref",
+]

@@ -14,6 +14,7 @@ import pytest
 from tensorlink_tpu.engine.paged import (
     PageAllocator,
     PagedKVCache,
+    PrefixCache,
     pages_needed,
 )
 from tensorlink_tpu.models import ModelConfig
@@ -111,6 +112,138 @@ def test_paged_cache_ragged_max_len_rounds_up():
     c = PagedKVCache.init(TINY, max_slots=1, page_size=8, max_len=20)
     assert c.pages_per_slot == 3  # ceil(20 / 8)
     assert c.pages_per_slot * c.page_size >= 20
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache (host-side trie over full KV pages: refcounts, COW, LRU)
+# ---------------------------------------------------------------------------
+def _insert_chain(pc: PrefixCache, tokens, pages):
+    """Insert consecutive full blocks of ``tokens`` mapped to ``pages``."""
+    node = None
+    p = pc.page_size
+    for i, pid in enumerate(pages):
+        node, adopted = pc.insert(node, tuple(tokens[i * p : (i + 1) * p]), pid)
+        assert adopted
+    return node
+
+
+def test_prefix_match_walks_longest_chain():
+    pc = PrefixCache(4)
+    toks = list(range(100, 112))  # 3 full blocks
+    _insert_chain(pc, toks, [5, 7, 9])
+    # full prompt (plus a divergent tail) matches the whole chain...
+    nodes = pc.match(toks + [1, 2], limit=14)
+    assert [n.page for n in nodes] == [5, 7, 9]
+    # ...a limit mid-chain caps the walk to FULL blocks below it
+    assert [n.page for n in pc.match(toks, limit=11)] == [5, 7]
+    # ...and divergence in an early block stops the walk there
+    div = toks[:4] + [0] + toks[5:]
+    assert [n.page for n in pc.match(div, limit=12)] == [5]
+    # chain keys are position-anchored: the same block at a different
+    # depth is NOT a hit (rope-offset invariance by construction)
+    assert pc.match(toks[4:], limit=8) == []
+
+
+def test_prefix_partial_match_picks_longest_cow_candidate():
+    pc = PrefixCache(4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    last = _insert_chain(pc, toks, [5, 7])
+    pc.insert(last, (9, 9, 2, 2), 11)
+    pc.insert(last, (9, 9, 9, 2), 12)
+    nodes = pc.match(toks + [9, 9, 9, 5], limit=12)
+    assert [n.page for n in nodes] == [5, 7]
+    got = pc.partial_match(nodes, toks + [9, 9, 9, 5], limit=12)
+    assert got is not None
+    node, n = got
+    assert node.page == 12 and n == 3  # the 3-token prefix beats 2
+    # no shared first token -> no COW candidate
+    assert pc.partial_match(nodes, toks + [4, 4, 4, 4], limit=12) is None
+
+
+def test_prefix_refcounts_block_eviction():
+    pc = PrefixCache(4)
+    toks = list(range(8))
+    _insert_chain(pc, toks, [3, 4])
+    nodes = pc.match(toks + [99], limit=9)
+    pc.acquire(nodes)
+    assert pc.evict_one() is None  # both referenced
+    pc.release(nodes)
+    # now evictable — leaf first (page 4 is the chain's leaf)
+    assert pc.evict_one() == 4
+    assert pc.evict_one() == 3  # parent became a leaf
+    assert pc.evict_one() is None
+    assert pc.n_resident == 0
+
+
+def test_prefix_eviction_is_lru_among_leaves():
+    pc = PrefixCache(2)
+    a = pc.insert(None, (1, 2), 3)[0]
+    pc.insert(None, (5, 6), 4)
+    pc.insert(None, (7, 8), 5)
+    # touching a's chain via a match refreshes its recency
+    pc.match([1, 2, 0], limit=3)
+    assert pc.evict_one() == 4  # oldest untouched leaf goes first
+    assert pc.evict_one() == 5
+    assert pc.evict_one() == a.page
+
+
+def test_prefix_insert_dedups_identical_chains():
+    pc = PrefixCache(4)
+    toks = [9, 8, 7, 6]
+    _insert_chain(pc, toks, [2])
+    node, adopted = pc.insert(None, tuple(toks), 6)
+    assert not adopted and node.page == 2  # caller keeps page 6
+    assert pc.n_resident == 1
+    assert pc.stats["inserts"] == 1
+
+
+def test_prefix_interior_nodes_never_evict():
+    pc = PrefixCache(2)
+    last = _insert_chain(pc, [1, 2, 3, 4, 5, 6], [7, 8, 9])
+    pc.acquire([last])  # pin only the LEAF
+    # 9 is referenced; 7 and 8 are interior — nothing may evict
+    assert pc.evict_one() is None
+    pc.release([last])
+    assert pc.drop_all() == [9, 8, 7]  # leaf-first cascade
+
+
+def test_prefix_n_evictable_excludes_pinned_subtrees():
+    """n_evictable counts exactly what a cascading evict can reach: a
+    referenced node blocks itself and every ancestor, but an unreferenced
+    leaf below a pinned interior node is still fair game."""
+    pc = PrefixCache(2)
+    last = _insert_chain(pc, [1, 2, 3, 4, 5, 6], [5, 6, 7])
+    pc.insert(None, (9, 9), 8)  # independent leaf
+    assert pc.n_evictable() == 4
+    pc.acquire([last])  # pin the leaf: the whole chain is stuck
+    assert pc.n_evictable() == 1
+    pc.release([last])
+    pc.acquire([last.parent])  # pin mid-chain: the leaf BELOW it still
+    assert pc.n_evictable() == 2  # evicts (7 + the independent 8)
+    pc.release([last.parent])
+    assert pc.n_evictable() == 4
+    assert len(pc.evict(4)) == 4  # and evict() reaches all of them
+
+
+def test_prefix_batch_evict_is_lru_with_cascade():
+    """evict(k) frees the k LRU unreferenced leaves in one pass, with a
+    parent becoming eligible the moment its last child goes — identical
+    order to k sequential evict_one calls, without k resident scans."""
+    pc = PrefixCache(2)
+    last = _insert_chain(pc, [1, 2, 3, 4], [5, 6])  # chain 5 -> 6
+    pc.insert(None, (9, 9), 7)  # independent leaf, most recent
+    pc.match([1, 2, 0], limit=3)  # refresh the chain root's recency
+    # oldest leaf 6 goes first; its parent 5 cascades into the pool but
+    # the match refreshed it, so leaf 7 (older tick) evicts before 5
+    assert pc.evict(3) == [6, 7, 5]
+    assert pc.n_resident == 0
+    # a pinned leaf caps the batch below k
+    last = _insert_chain(pc, [1, 2, 3, 4], [5, 6])
+    pc.acquire([last])
+    assert pc.evict(4) == []  # leaf pinned, parent interior
+    pc.release([last])
+    assert pc.evict(1) == [6]  # partial batch: only what's evictable
+    assert pc.evict(4) == [5]
 
 
 # ---------------------------------------------------------------------------

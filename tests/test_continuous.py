@@ -130,6 +130,23 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
     after = ce.jit_cache_sizes()
     assert after == base, (base, after)
     assert after["decode_chunk"] == 1  # ONE slot-batched decode program
+    # chunked prefill + prefix cache must not add per-mix compiles either:
+    # once every feature program has fired ONCE (prefill chunk at base,
+    # COW copy on the first divergent hit), multi-chunk prompts, cache
+    # hits (full-page and COW-partial), misses and evictions are all
+    # DATA — the compiled set stays frozen across any further mix
+    long = [5, 9] * 12
+    ce.submit(long, max_new_tokens=3, seed=7)  # miss -> promoted
+    ce.run_until_idle()
+    ce.submit(long[:20] + [2, 2, 2, 2], max_new_tokens=3, seed=8)  # COW
+    ce.run_until_idle()
+    warm = ce.jit_cache_sizes()
+    assert warm["prefill_chunk"] == after["prefill_chunk"]  # no growth yet
+    ce.submit(long + [3], max_new_tokens=3, seed=9)  # full-page + COW hit
+    ce.submit(long[:-1] + [2, 2], max_new_tokens=4, seed=10)
+    ce.submit([6] * 31, max_new_tokens=2, seed=11)  # different miss shape
+    ce.run_until_idle()
+    assert ce.jit_cache_sizes() == warm, (warm, ce.jit_cache_sizes())
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +260,288 @@ def test_continuous_batcher_local_engine(tiny_engine):
     b.close()
     with pytest.raises(RuntimeError):
         b.generate([1], max_new_tokens=1)
+
+
+# ---------------------------------------------------------------------------
+# automatic prefix caching + chunked prefill
+# ---------------------------------------------------------------------------
+SYS = [7, 3, 9, 11, 2, 5, 8, 1, 4, 6, 10, 12, 7, 9, 3, 5, 2, 8, 11, 1]
+
+
+def _run_set(eng, mixes, *, prefix_cache, prefill_chunk=128, stagger=False,
+             warm=None):
+    """Decode a request mix on a fresh engine; returns per-request token
+    streams (and the engine, for stats/conservation asserts). ``warm``
+    runs (and finishes) one request FIRST — on a cache-on engine its
+    promoted pages are what the mix can hit; run on the cache-off engine
+    too so the two sides stay symmetric."""
+    ce = _cont(
+        eng, prefix_cache=prefix_cache, prefill_chunk=prefill_chunk
+    )
+    if warm is not None:
+        w = ce.submit(warm, max_new_tokens=2, seed=1234)
+        ce.run_until_idle()
+        assert w.finished
+    reqs = []
+    for i, (prompt, n, sp, seed) in enumerate(mixes):
+        reqs.append(
+            ce.submit(prompt, max_new_tokens=n, sampling=sp, seed=seed)
+        )
+        if stagger:
+            ce.step_chunk()  # later requests join mid-flight
+    ce.run_until_idle()
+    assert all(r.finished for r in reqs)
+    return [r.tokens for r in reqs], ce
+
+
+def test_prefix_cache_streams_bit_identical_on_off(tiny_engine):
+    """THE acceptance pin: with a shared page-spanning system prompt, the
+    cache-on engine skips prefill compute for the hit region yet every
+    stream — greedy and sampled, co-batched and mid-flight admitted — is
+    BIT-identical to the cache-off engine's (cached KV is bitwise the KV
+    the slot would have computed)."""
+    eng = tiny_engine
+    mixes = [
+        (SYS + [21], 8, SamplingParams.make(), 1),
+        (SYS + [22, 23], 8, SamplingParams.make(temperature=0.9, top_k=5), 2),
+        (SYS + [24], 6, SamplingParams.make(temperature=0.7, top_p=0.9), 3),
+        (SYS + [21], 8, SamplingParams.make(), 4),  # same prompt, new seed
+    ]
+    off, _ = _run_set(
+        eng, mixes, prefix_cache=False, stagger=True, warm=SYS + [99]
+    )
+    on, ce = _run_set(
+        eng, mixes, prefix_cache=True, stagger=True, warm=SYS + [99]
+    )
+    assert on == off
+    snap = ce.serving_snapshot()
+    # the shared prefix really was reused, not recomputed: SYS spans two
+    # full 8-token pages resident from the warm request, and every mix
+    # member hits them
+    assert snap["prefix_hit_tokens"] >= 4 * 16
+    assert snap["prefill_tokens_skipped"] == snap["prefix_hit_tokens"]
+    ce.check_page_conservation()
+    # solo == co-batched with the cache on, too
+    for (prompt, n, sp, seed), toks in zip(mixes, on):
+        solo, ce2 = _run_set(
+            eng, [(prompt, n, sp, seed)], prefix_cache=True
+        )
+        assert solo[0] == toks
+        ce2.check_page_conservation()
+
+
+@pytest.mark.slow  # compiles three extra chunk shapes + the monolithic
+# path — tier-1 wall-time; the CI engine job runs this file unfiltered
+def test_chunked_prefill_matches_monolithic(tiny_engine):
+    """Greedy parity between the chunked-prefill admission (any chunk
+    size) and the legacy monolithic dense-prefill admission — chunking
+    changes scheduling, never the emitted stream."""
+    eng = tiny_engine
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3], SYS + [30], [8] * 17]
+    mixes = [(p, 10, SamplingParams.make(), i) for i, p in enumerate(prompts)]
+    mono, _ = _run_set(eng, mixes, prefix_cache=False, prefill_chunk=0)
+    for chunk in (4, 8, 64):
+        got, _ = _run_set(
+            eng, mixes, prefix_cache=False, prefill_chunk=chunk
+        )
+        assert got == mono, chunk
+
+
+def test_prefix_cache_cow_divergent_page(tiny_engine):
+    """A prompt diverging MID-page from a cached chain copy-on-writes the
+    divergent page: the matched positions skip prefill, the cached
+    original is never written (later hits of the original chain still
+    see its exact KV), and streams stay bit-identical to cache-off."""
+    eng = tiny_engine
+    base = SYS + [21, 22, 23, 24]  # 24 tokens = 3 full 8-token pages
+    fork = SYS + [21, 22, 99, 98]  # diverges at position 22, mid-page 3
+    mixes = [
+        (fork, 6, SamplingParams.make(temperature=0.8), 2),
+        (base, 6, SamplingParams.make(), 3),  # original chain re-hit
+    ]
+    off, _ = _run_set(eng, mixes, prefix_cache=False, warm=base)
+    on, ce = _run_set(eng, mixes, prefix_cache=True, warm=base)
+    assert on == off
+    snap = ce.serving_snapshot()
+    assert snap["prefix_cow_copies"] >= 1
+    # the fork's hit = 2 full pages + 2 COW-matched positions
+    ce.check_page_conservation()
+
+
+def test_prefix_cache_recovery_readmission_near_free(tiny_engine):
+    """Crash recovery re-admits through the cache: resubmitting prompt +
+    delivered with start_step resumes the stream bit-identically AND
+    skips the resident prefix's prefill (near-free re-prefill — the
+    tentpole's recovery dividend)."""
+    eng = tiny_engine
+    sp = SamplingParams.make(temperature=1.0, top_p=0.9)
+    ce = _cont(eng, prefix_cache=True)
+    full = ce.submit(SYS, max_new_tokens=10, sampling=sp, seed=9)
+    ce.run_until_idle()
+    cut = 4
+    # the dead worker's replacement: same engine state (the cache SURVIVES
+    # the session — pages were promoted at the original's eviction)
+    resumed = ce.submit(
+        SYS + full.tokens[:cut], max_new_tokens=10 - cut, sampling=sp,
+        seed=9, start_step=cut,
+    )
+    skipped0 = ce.stats["prefill_tokens_skipped"]
+    ce.run_until_idle()
+    assert full.tokens[:cut] + resumed.tokens == full.tokens
+    # the re-admission hit the resident prefix: SYS spans 2 full pages
+    assert ce.stats["prefill_tokens_skipped"] - skipped0 >= 16
+    ce.check_page_conservation()
+    # and the recovered stream equals the cache-OFF recovered stream
+    ce_off = _cont(eng, prefix_cache=False)
+    r_off = ce_off.submit(
+        SYS + full.tokens[:cut], max_new_tokens=10 - cut, sampling=sp,
+        seed=9, start_step=cut,
+    )
+    ce_off.run_until_idle()
+    assert r_off.tokens == resumed.tokens
+
+
+def test_shared_prefix_mid_flight_eviction(tiny_engine):
+    """A slot set sharing cached prefix pages: evicting one member
+    mid-flight (downstream cancel) releases only ITS references — the
+    co-resident followers keep decoding on the shared pages and emit
+    exactly their solo streams; page conservation holds throughout."""
+    eng = tiny_engine
+    ce = _cont(eng, prefix_cache=True)
+    seed_req = ce.submit(SYS + [40], max_new_tokens=2, seed=0)
+    ce.run_until_idle()  # leaves SYS's full pages resident
+    assert seed_req.finished
+
+    cancel_after = 2
+    seen: list[int] = []
+
+    def cancel_cb(tok: int) -> bool:
+        seen.append(tok)
+        return len(seen) >= cancel_after  # confirmed stop -> cancel row
+
+    victim = ce.submit(
+        SYS + [41], max_new_tokens=12, seed=1, stream_cb=cancel_cb
+    )
+    keep_a = ce.submit(SYS + [42], max_new_tokens=10, seed=2)
+    keep_b = ce.submit(
+        SYS + [43], max_new_tokens=10,
+        sampling=SamplingParams.make(temperature=0.8), seed=3,
+    )
+    while ce.has_work():
+        ce.step_chunk()
+        ce.check_page_conservation()  # invariant holds mid-flight too
+    assert victim.finished and len(victim.tokens) <= cancel_after + ce.chunk_steps
+    for req, (prompt, n, sp, seed) in (
+        (keep_a, (SYS + [42], 10, None, 2)),
+        (keep_b, (SYS + [43], 10, SamplingParams.make(temperature=0.8), 3)),
+    ):
+        assert req.tokens == _solo(eng, prompt, n, sampling=sp, seed=seed)
+    # eviction released the victim's refs: teardown finds no leak
+    ce.close()
+
+
+@pytest.mark.slow  # needs a small-chunk program shape (C=8) the rest of
+# the tier-1 file never compiles — the CI engine job runs it unfiltered
+def test_chunked_prefill_never_stalls_running_decodes(tiny_engine):
+    """The chunked-prefill TTFT guarantee: while a LONG prompt is being
+    admitted chunk by chunk, a co-resident request keeps emitting every
+    step — admission compute interleaves instead of convoying."""
+    eng = tiny_engine
+    ce = _cont(eng, prefix_cache=True, prefill_chunk=8)
+    bg = ce.submit([1, 2], max_new_tokens=30, seed=0)
+    ce.step_chunk()
+    assert len(bg.tokens) > 0
+    long_req = ce.submit(list(range(1, 49)), max_new_tokens=4, seed=1)
+    # 48 prompt tokens / 8-token chunks = 6 prefill ticks
+    stalls = 0
+    while long_req.slot < 0 or long_req.prefill_pos < 48:
+        before = len(bg.tokens)
+        ce.step_chunk()
+        if not bg.finished and len(bg.tokens) == before:
+            stalls += 1
+        if bg.finished:
+            break
+    assert stalls == 0, "a prefill tick starved the running decode"
+    ce.run_until_idle()
+    assert long_req.finished and bg.finished
+
+
+def test_alloc_pressure_skips_futile_cache_wipe(tiny_engine):
+    """Eviction-on-demand fires only when it can actually cover the
+    allocation's deficit: an oversized ask against a tight pool stays
+    queued WITHOUT destroying the resident prefixes every follower is
+    hitting (wipe-then-fail would turn them all into full misses)."""
+    ce = _cont(tiny_engine, prefix_cache=True)
+    held = ce.alloc.alloc(ce.alloc.n_free - 1)  # tighten the pool
+    page = ce.alloc.alloc(1)[0]  # -> 0 free
+    ce.prefix.insert(None, (1,) * ce.page_size, page)
+    # deficit 3, evictable 1: refuse, and leave the cache alone
+    assert ce._alloc_pages(3) is None
+    assert ce.prefix.n_resident == 1
+    assert ce.prefix.stats["evictions"] == 0
+    # deficit 1, evictable 1: evict exactly the deficit and fit
+    ce.alloc.free(held[:2])
+    got = ce._alloc_pages(3)
+    assert got is not None and len(got) == 3
+    assert ce.prefix.n_resident == 0
+
+
+def test_failed_admission_unwinds_pages_and_refs(tiny_engine, monkeypatch):
+    """A device failure mid-admission — after private pages are allocated
+    and prefix refs pinned — must unwind cleanly: pages back on the
+    free-list, refcounts dropped, so close()'s conservation check holds
+    on the error-cleanup path and the engine can keep serving."""
+    import tensorlink_tpu.engine.continuous as cont_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic device failure")
+
+    eng = tiny_engine
+    ce = _cont(eng, prefix_cache=True)
+    base = SYS + [21, 22, 23, 24]  # 3 full pages resident after this
+    ce.submit(base, max_new_tokens=2, seed=0)
+    ce.run_until_idle()
+    # fail at the COW copy: the deepest unwind point — hit-chain refs AND
+    # the COW source ref are pinned, private pages already off the list
+    monkeypatch.setattr(cont_mod, "copy_page", boom)
+    fork = ce.submit(SYS + [21, 22, 99, 98], max_new_tokens=4, seed=1)
+    with pytest.raises(RuntimeError, match="synthetic"):
+        ce.run_until_idle()
+    monkeypatch.undo()
+    ce.check_page_conservation()  # nothing leaked by the failed admission
+    ce.run_until_idle()  # the request stayed queued: re-admits cleanly
+    assert fork.finished
+    ce.check_page_conservation()
+    # at idle every slot has been evicted — a ref leaked by the failed
+    # admission would show as a permanently pinned resident node
+    assert all(n.refs == 0 for n in ce.prefix._by_page.values())
+
+    # the legacy monolithic path unwinds its pages too
+    ce0 = _cont(eng, prefix_cache=False, prefill_chunk=0)
+    monkeypatch.setattr(cont_mod, "scatter_prefill", boom)
+    ce0.submit(SYS + [33], max_new_tokens=2, seed=0)
+    with pytest.raises(RuntimeError, match="synthetic"):
+        ce0.run_until_idle()
+    monkeypatch.undo()
+    ce0.check_page_conservation()
+
+
+def test_page_conservation_asserted_at_teardown(tiny_engine):
+    """close() itself asserts free + slot-owned + cache-resident == total
+    (the hardened free-list invariant) — including when requests are
+    failed mid-flight by the teardown."""
+    eng = tiny_engine
+    ce = _cont(eng, prefix_cache=True)
+    ce.submit(SYS + [50], max_new_tokens=4, seed=1)
+    ce.run_until_idle()
+    r = ce.submit(SYS + [51], max_new_tokens=30, seed=2)
+    ce.step_chunk()  # leave it mid-flight
+    assert not r.finished
+    ce.close()  # evicts mid-flight slots, then checks conservation
+    assert r.error is not None
+    acc = ce.page_accounting()
+    assert not acc["slots"]  # nothing owned after teardown
+    assert len(acc["free"]) + len(acc["cached"]) == ce.cache.n_pages - 1
 
 
 def test_continuous_refuses_unsupported_cache_modes(tiny_engine):

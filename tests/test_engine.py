@@ -342,7 +342,11 @@ def test_lookahead_random_prompt_uses_decode_steps(tiny_model):
     assert spec_s.sequences == ref.sequences
     assert got == ref.sequences[0]
     st = eng.last_lookahead_stats
-    assert st["compiled_tail"] == 0 and st["verify_passes"] == 0
+    assert st["compiled_tail"] == 0  # never the compiled loop mid-stream
+    # host decode steps drive the stream; speculation may legally RE-ARM
+    # when the EMITTED text turns repetitive (that is the stream-path
+    # design), so a verify pass count here is platform-dependent — the
+    # exact-output assertions above are the correctness pin
     assert st["decode_steps"] > 0
 
 
@@ -383,6 +387,41 @@ def test_lookahead_compiled_tail_matches_greedy(tiny_model):
         assert eng.last_lookahead_stats["compiled_tail"] == 0
     finally:
         GenerationEngine._spec_worthwhile = orig
+
+
+def test_lookahead_acceptance_rate_auto_disable(tiny_model):
+    """VERDICT r5 regression: a request whose drafts keep FIRING but keep
+    being rejected must not decode its whole budget through padded verify
+    passes — the measured-acceptance rule alone (no timing signal: zero
+    plain decode steps happen when every step drafts) disables
+    speculation after a bounded probe, and the remainder rides the
+    compiled loop emitting exactly the vanilla greedy sequence."""
+    cfg, params = tiny_model
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(16, 32, 64), batch_buckets=(1,),
+        max_seq_len=128,
+    )
+    rep = ([5, 9, 2, 7] * 6)[:22]  # recurring pairs: the prescan arms
+    ref = eng.generate_compiled([rep], max_new_tokens=32)
+    # a draft token greedy never emits -> acceptance is exactly 0 per pass
+    bad = next(t for t in range(cfg.vocab_size - 1, 0, -1)
+               if t not in ref.sequences[0] and t not in rep)
+    orig = GenerationEngine._lookup_draft
+    try:
+        GenerationEngine._lookup_draft = staticmethod(
+            lambda history, n_draft, **_k: [bad] * n_draft
+        )
+        spec = eng.generate_lookahead([rep], max_new_tokens=32)
+        st = eng.last_lookahead_stats
+        assert spec.sequences == ref.sequences
+        assert st["spec_disabled"]
+        # the probe is bounded: exactly _ACC_PROBE verify passes, then the
+        # compiled tail finishes the request at full speed
+        assert st["verify_passes"] == 4, st
+        assert st["decode_steps"] == 0
+        assert st["compiled_tail"] > 0
+    finally:
+        GenerationEngine._lookup_draft = orig
 
 
 def test_chunked_stream_decode_matches_compiled(tiny_model):

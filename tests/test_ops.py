@@ -17,6 +17,8 @@ from tensorlink_tpu.ops.attention import (
     flash_attention,
     paged_attention,
     paged_attention_ref,
+    paged_prefill_attention,
+    paged_prefill_attention_ref,
 )
 
 
@@ -214,6 +216,136 @@ def test_paged_ref_matches_dense_attention():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# offset-carrying paged PREFILL attention (chunked prefill / prefix cache)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "C,Hq,Hkv,hd,page,n_pp,start",
+    [
+        (8, 8, 2, 32, 8, 4, 0),  # GQA, offset 0 (fresh admission)
+        (8, 8, 2, 32, 8, 4, 13),  # GQA, mid-page offset (COW landing)
+        # extra head layouts ride the CI engine job (tier-1 wall-time)
+        pytest.param(16, 4, 4, 16, 16, 3, 16, marks=pytest.mark.slow),
+        pytest.param(4, 8, 1, 64, 4, 8, 27, marks=pytest.mark.slow),
+    ],
+)
+def test_paged_prefill_kernel_matches_ref(C, Hq, Hkv, hd, page, n_pp, start):
+    """The offset-carrying Pallas prefill kernel (queries at absolute
+    positions start+j over scalar-prefetched pages) matches the pure-jnp
+    reference — the restriction the monolithic flash kernel had
+    (offset-0-only fresh caches) is what this lifts."""
+    rng = np.random.default_rng(4)
+    P = 1 + n_pp + 2
+    q = jnp.asarray(rng.normal(size=(C, Hq, hd)).astype(np.float32))
+    kp = jnp.asarray(rng.normal(size=(P, Hkv, page, hd)).astype(np.float32))
+    vp = jnp.asarray(rng.normal(size=(P, Hkv, page, hd)).astype(np.float32))
+    bt = jnp.asarray(rng.permutation(np.arange(1, P))[:n_pp].astype(np.int32))
+    scale = hd**-0.5
+    ref = paged_prefill_attention_ref(
+        q, kp, vp, bt, jnp.int32(start), scale=scale
+    )
+    got = paged_prefill_attention(
+        q, kp, vp, bt, jnp.int32(start), scale=scale, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_prefill_ref_matches_dense_causal():
+    """A chunk at offset ``start`` over contiguously-paged KV computes
+    exactly dense causal attention restricted to the chunk's rows: query
+    start+j sees keys 0..start+j. Pages change layout, never math."""
+    rng = np.random.default_rng(5)
+    C, Hq, Hkv, hd, page, n_pp = 8, 4, 2, 16, 8, 4
+    start = 11
+    L = n_pp * page
+    T = start + C  # keys live through the chunk's last position
+    k_dense = rng.normal(size=(T, Hkv, hd)).astype(np.float32)
+    v_dense = rng.normal(size=(T, Hkv, hd)).astype(np.float32)
+    q = rng.normal(size=(C, Hq, hd)).astype(np.float32)
+    kp = np.zeros((1 + n_pp, Hkv, page, hd), np.float32)
+    vp = np.zeros((1 + n_pp, Hkv, page, hd), np.float32)
+    bt = 1 + np.arange(n_pp, dtype=np.int32)
+    pad = np.zeros((L - T, Hkv, hd), np.float32)
+    kp[bt] = np.concatenate([k_dense, pad]).reshape(
+        n_pp, page, Hkv, hd
+    ).transpose(0, 2, 1, 3)
+    vp[bt] = np.concatenate([v_dense, pad]).reshape(
+        n_pp, page, Hkv, hd
+    ).transpose(0, 2, 1, 3)
+    got = paged_prefill_attention_ref(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.int32(start), scale=hd**-0.5,
+    )
+    # dense reference: a [1, T] causal attention, rows start..start+C-1
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (1, T))
+    bias = _mask_bias(pos, T, jnp.ones((1, T), bool), None)
+    full_q = np.zeros((1, T, Hq, hd), np.float32)
+    full_q[0, start:] = q
+    ref = attention(
+        jnp.asarray(full_q), jnp.asarray(k_dense)[None],
+        jnp.asarray(v_dense)[None], bias, hd**-0.5,
+    )[0, start:]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.slow  # compiles a dedicated small-chunk shape — CI engine
+# job runs it unfiltered on every push (tier-1 wall-time)
+def test_paged_prefill_chunk_framing_is_bitwise_invariant():
+    """THE property the prefix cache's bit-identity contract stands on:
+    prefilling a prompt through ``paged_prefill_chunk`` produces bitwise
+    identical KV pages and final logits no matter how the chunk
+    boundaries fall — so a cache-hit admission (suffix prefilled from an
+    arbitrary offset) computes exactly what a cold admission computes."""
+    from tensorlink_tpu.engine.generate import _head_from_hidden
+    from tensorlink_tpu.engine.paged import (
+        PagedKVCache, bind_slot, paged_prefill_chunk,
+    )
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = np.random.default_rng(6).integers(1, 128, 24).tolist()
+    page, C, T = 8, 8, 24
+    bt_row = np.zeros(8, np.int32)
+    bt_row[:8] = range(1, 9)
+
+    def run(bounds):
+        cache = PagedKVCache.init(cfg, 4, page_size=page, max_len=64)
+        cache = bind_slot(
+            cache, jnp.int32(0), jnp.asarray(bt_row), jnp.int32(0)
+        )
+        for a, b in bounds:
+            toks = np.zeros(C, np.int32)
+            toks[: b - a] = prompt[a:b]
+            h, cache = paged_prefill_chunk(
+                params, jnp.asarray(toks), cache, jnp.int32(0),
+                jnp.int32(a), jnp.int32(b - a), cfg, False,
+            )
+        k = np.asarray(cache.k)
+        real = np.stack(
+            [k[:, bt_row[p // page], :, p % page] for p in range(T)], 1
+        )
+        return real, np.asarray(_head_from_hidden(params, h, cfg))
+
+    k_ref, l_ref = run([(0, 8), (8, 16), (16, 24)])
+    for bounds in (
+        [(0, 8), (8, 16), (16, 21), (21, 24)],  # split tail (COW offsets)
+        [(0, 5), (5, 13), (13, 21), (21, 24)],  # misaligned from the start
+        [(0, 2), (2, 10), (10, 18), (18, 24)],  # another framing
+    ):
+        k_got, l_got = run(bounds)
+        assert np.array_equal(k_got, k_ref), bounds
+        assert np.array_equal(l_got, l_ref), bounds
 
 
 @pytest.mark.slow  # see above
