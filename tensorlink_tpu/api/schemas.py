@@ -68,6 +68,25 @@ class GenerationRequest:
     # With enable_thinking=true the live stream is unfiltered (raw think
     # text) and only the final answer is truncated.
     stop: list[str] = field(default_factory=list)
+    # SLO scheduling class (engine/scheduler.py, docs/SERVING.md
+    # "Scheduling"): "interactive" | "batch" | "best_effort". Empty →
+    # the validator's MLConfig.default_priority. Orders admission on the
+    # continuous serving path (aging keeps low classes starvation-free;
+    # an interactive request may preempt a lower-class slot) and selects
+    # the 429 backpressure queue the request is judged against.
+    priority: str = ""
+
+    _PRIORITIES = ("interactive", "batch", "best_effort")
+
+    @classmethod
+    def _parse_priority(cls, v) -> str:
+        if v is None or v == "":
+            return ""
+        _require(
+            isinstance(v, str) and v.lower() in cls._PRIORITIES,
+            "priority must be one of interactive|batch|best_effort",
+        )
+        return v.lower()
 
     @staticmethod
     def _parse_stop(v) -> list[str]:
@@ -103,6 +122,7 @@ class GenerationRequest:
                 lookahead=bool(d.get("lookahead", False)),
                 num_beams=int(d.get("num_beams", 1)),
                 stop=cls._parse_stop(d.get("stop")),
+                priority=cls._parse_priority(d.get("priority")),
             )
         except ValidationError:
             raise
@@ -160,6 +180,8 @@ class ChatCompletionRequest:
     # number of choices (OpenAI ``n``; non-streaming only — the n requests
     # dispatch concurrently and the batcher coalesces them into one decode)
     n: int = 1
+    # SLO scheduling class (see GenerationRequest.priority)
+    priority: str = ""
 
     @classmethod
     def parse(cls, d: dict) -> "ChatCompletionRequest":
@@ -184,6 +206,7 @@ class ChatCompletionRequest:
                 presence_penalty=float(d.get("presence_penalty", 0.0)),
                 frequency_penalty=float(d.get("frequency_penalty", 0.0)),
                 n=int(d.get("n", 1)),
+                priority=GenerationRequest._parse_priority(d.get("priority")),
             )
         except ValidationError:
             raise
@@ -220,6 +243,7 @@ class ChatCompletionRequest:
             stop=self.stop,
             presence_penalty=self.presence_penalty,
             frequency_penalty=self.frequency_penalty,
+            priority=self.priority,
         )
 
 

@@ -38,10 +38,17 @@ STREAM_TOKEN_TIMEOUT = 30.0  # reference api/node.py:410
 
 
 class HTTPError(Exception):
-    def __init__(self, status: int, message: str, extra: dict | None = None):
+    def __init__(
+        self,
+        status: int,
+        message: str,
+        extra: dict | None = None,
+        headers: dict | None = None,
+    ):
         super().__init__(message)
         self.status = status
         self.body = {"error": message, **(extra or {})}
+        self.headers = dict(headers or {})
 
 
 _STATUS = {
@@ -132,7 +139,7 @@ class TensorlinkAPI:
             method, path, headers, body = req
             await self._route(method, path, headers, body, writer)
         except HTTPError as e:
-            await self._send_json(writer, e.status, e.body)
+            await self._send_json(writer, e.status, e.body, headers=e.headers)
         except asyncio.TimeoutError:
             await self._send_json(writer, 408, {"error": "request timeout"})
         except (ConnectionError, OSError):
@@ -184,12 +191,19 @@ class TensorlinkAPI:
             raise HTTPError(400, "JSON body must be an object")
         return d
 
-    async def _send_json(self, writer, status: int, payload: dict) -> None:
+    async def _send_json(
+        self, writer, status: int, payload: dict,
+        headers: dict | None = None,
+    ) -> None:
         data = json.dumps(payload, default=str).encode()
+        extra = "".join(
+            f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+        )
         head = (
             f"HTTP/1.1 {status} {_STATUS.get(status, 'OK')}\r\n"
             "Content-Type: application/json\r\n"
             f"Content-Length: {len(data)}\r\n"
+            f"{extra}"
             "Connection: close\r\n\r\n"
         ).encode()
         writer.write(head + data)
@@ -335,13 +349,42 @@ class TensorlinkAPI:
             raise HTTPError(400, str(e))
         await self._generate_common(gen, writer)
 
+    def _reject_if_overloaded(self, job, gen, n: int) -> None:
+        """Scheduler-driven backpressure (replaces the old flat
+        concurrent-request counter): the hosted model's batcher judges the
+        request's priority class against its queue caps and estimated
+        wait, and a rejection becomes ``429`` with a ``Retry-After``
+        header plus the class/queue-depth detail in the JSON body. The
+        flat ``MAX_CONCURRENT`` bound survives only as the transport
+        backstop protecting the HTTP pool itself (models without a
+        class-aware batcher, requests racing a model reload)."""
+        priority = getattr(gen, "priority", "") or None
+        if self._inflight + n > MAX_CONCURRENT:
+            raise HTTPError(
+                429, "too many concurrent requests",
+                {"queue_depth": self._inflight, "cap": MAX_CONCURRENT,
+                 "priority": priority or "interactive", "retry_after": 1},
+                headers={"Retry-After": "1"},
+            )
+        check = getattr(getattr(job, "batcher", None), "admission_check", None)
+        rej = check(priority, n) if callable(check) else None
+        if rej:
+            retry = max(1, int(round(float(rej.get("retry_after", 1.0)))))
+            raise HTTPError(
+                429,
+                f"{rej['priority']} queue is full "
+                f"({rej['queue_depth']}/{rej['cap']} queued)",
+                {"priority": rej["priority"],
+                 "queue_depth": rej["queue_depth"],
+                 "cap": rej["cap"], "retry_after": retry},
+                headers={"Retry-After": str(retry)},
+            )
+
     async def _generate_common(
         self, gen: GenerationRequest, writer, n: int = 1
     ) -> None:
         from tensorlink_tpu.ml.validator import ModelNotReady
 
-        if self._inflight + n > MAX_CONCURRENT:
-            raise HTTPError(429, "too many concurrent requests")
         job = self.executor.hosted.get(gen.hf_name)
         if job is None or job.status != "ready":
             # 503 + auto-load trigger (reference api/node.py:143-155)
@@ -354,6 +397,9 @@ class TensorlinkAPI:
                 503, f"model {gen.hf_name} is {state}",
                 {"model": gen.hf_name, "status": state},
             )
+        self._reject_if_overloaded(job, gen, n)
+
+        from tensorlink_tpu.engine.scheduler import SchedulerOverloaded
 
         fmt = ResponseFormatter(gen.hf_name, gen.output_format)
         self._inflight += n
@@ -362,7 +408,8 @@ class TensorlinkAPI:
                 # return_exceptions: every sibling dispatch completes before
                 # an error propagates — otherwise one failed choice would
                 # orphan n-1 running generations while _inflight is already
-                # decremented for all n (silent 429-gate erosion)
+                # decremented for all n (silent 429-gate erosion; pinned by
+                # test_api_unit.py::test_n_gt_1_failure_does_not_erode_gate)
                 results = await asyncio.wait_for(
                     asyncio.gather(
                         *(self._ml(self.executor.generate_api, gen)
@@ -374,6 +421,18 @@ class TensorlinkAPI:
                 for r in results:
                     if isinstance(r, ModelNotReady):
                         raise HTTPError(503, str(r))
+                    if isinstance(r, SchedulerOverloaded):
+                        # the engine-side backstop fired (a race admitted
+                        # past the API gate): same 429 + Retry-After
+                        # contract as the front gate
+                        retry = max(1, int(round(r.retry_after)))
+                        raise HTTPError(
+                            429, str(r),
+                            {"priority": r.priority,
+                             "queue_depth": r.queue_depth,
+                             "cap": r.cap, "retry_after": retry},
+                            headers={"Retry-After": str(retry)},
+                        )
                     if isinstance(r, ValidationError):
                         # request-vs-model mismatch detected past parse time
                         # (e.g. penalties on a multi-stage model)

@@ -100,6 +100,33 @@ class MLConfig:
     # evict LRU when the allocator runs dry. Hits are bitwise the KV the
     # slot would have computed — streams are identical cache on or off.
     prefix_cache: bool = True
+    # -- SLO-aware request scheduling (engine/scheduler.py) --------------
+    # priority class a request gets when the API body carries none:
+    # "interactive" | "batch" | "best_effort". Classes order admission
+    # (aging keeps low classes starvation-free) and bound preemption —
+    # see docs/SERVING.md "Scheduling".
+    default_priority: str = "interactive"
+    # per-class queued-request cap: past it submissions fail fast (the
+    # API layer turns the rejection into 429 + Retry-After) instead of
+    # queueing until the client times out
+    sched_queue_cap: int = 64
+    # starvation-free aging: a queued request's effective class improves
+    # by one rank every this-many admission rounds (one round = one
+    # engine chunk), so sustained interactive load delays batch work but
+    # never parks it forever
+    sched_aging_ticks: int = 32
+    # cache-backed preemption: a higher-class request that would miss
+    # admission may evict the lowest-class / most-recently-admitted slot
+    # through the prefix-cache promotion path and re-queue it — the
+    # resumed stream is bit-identical to an uninterrupted run
+    sched_preemption: bool = True
+    # "slo" (priority + aging + preemption) or "fcfs" (PR-2 behavior:
+    # strict arrival order, no preemption) — the bench's baseline knob
+    sched_policy: str = "slo"
+    # backpressure: reject admission when the estimated queue wait for
+    # the request's class exceeds this many seconds (0 disables the
+    # wait check; the queue cap still applies)
+    sched_max_wait_s: float = 60.0
     # streamed requests: >0 runs the decode as fully-compiled on-device
     # chunks of this many steps (one host round trip per chunk instead of
     # per token — engine/generate.py::generate_chunked); 0 keeps the

@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from collections import deque
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -60,6 +60,12 @@ from .paged import (
     scatter_prefill,
 )
 from .sampling import SamplingParams, sample
+from .scheduler import (
+    DEFAULT_PRIORITY,
+    RequestScheduler,
+    SchedulerOverloaded,
+    normalize_priority,
+)
 
 
 @jax.jit
@@ -93,7 +99,7 @@ class ContinuousRequest:
 
     rid: int
     prompt: list[int]  # original prompt + any previously-emitted prefix
-    budget: int  # new tokens still wanted
+    budget: int  # total tokens wanted THIS submission (incl. pre-preempt)
     sampling: SamplingParams  # scalar leaves
     eos: frozenset
     seed: int
@@ -105,9 +111,24 @@ class ContinuousRequest:
     slot: int = -1
     pages: list[int] = field(default_factory=list)  # pages this slot OWNS
     shared_nodes: list = field(default_factory=list)  # prefix-cache hits
-    prefill_pos: int = 0  # prompt tokens written so far (chunked prefill)
+    prefill_pos: int = 0  # prefill tokens written so far (chunked prefill)
+    # the token sequence the CURRENT admission prefilled (prompt plus any
+    # tokens emitted before a preemption); prefill_target = its length —
+    # the promotion cap (positions past it are decode-written, never
+    # cached) and the key source for promoted pages
+    prefill_tokens: list[int] = field(default_factory=list)
+    prefill_target: int = 0
     error: BaseException | None = None
     done: threading.Event = field(default_factory=threading.Event)
+    # -- scheduling (engine/scheduler.py) -------------------------------
+    priority: str = DEFAULT_PRIORITY
+    sched_seq: int = 0  # arrival order; preserved across preemption
+    admit_seq: int = 0  # admission order; fresh on every (re)admission
+    enqueue_tick: int = 0  # aging clock origin; restarts on requeue
+    enqueue_t: float = 0.0
+    admit_rank: int = -1  # effective rank AT admission (preemption shield)
+    submit_t: float = 0.0
+    admit_t: float = 0.0
 
 
 class ContinuousEngine:
@@ -127,6 +148,12 @@ class ContinuousEngine:
         chunk_steps: int = 8,
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
+        sched_queue_cap: int = 64,
+        sched_aging_ticks: int = 32,
+        sched_preemption: bool = True,
+        sched_policy: str = "slo",
+        sched_max_wait_s: float = 60.0,
+        default_priority: str = DEFAULT_PRIORITY,
     ):
         if engine.cache_quant:
             raise ValueError(
@@ -166,7 +193,18 @@ class ContinuousEngine:
         )
         self._prefilling: dict[int, ContinuousRequest] = {}
         self._lock = threading.Lock()
-        self._queue: deque[ContinuousRequest] = deque()
+        # the policy layer owning the queued side of the lifecycle:
+        # priority classes, aging, preemption decisions, backpressure
+        # (engine/scheduler.py) — replaces the old FIFO deque
+        self.default_priority = normalize_priority(default_priority)
+        self.sched = RequestScheduler(
+            max_slots=self.max_slots,
+            queue_cap=sched_queue_cap,
+            aging_ticks=sched_aging_ticks,
+            preemption=sched_preemption,
+            policy=sched_policy,
+            max_wait_s=sched_max_wait_s,
+        )
         self._rid = itertools.count(1)
         self._slots: list[ContinuousRequest | None] = [None] * self.max_slots
         # host mirrors of per-slot decode state (device arrays are rebuilt
@@ -185,7 +223,8 @@ class ContinuousEngine:
         )
         # serving telemetry
         self.stats = {
-            "admitted": 0, "evicted": 0, "decode_steps": 0,
+            "admitted": 0, "evicted": 0, "preemptions": 0,
+            "decode_steps": 0,
             "slot_steps_live": 0, "slot_steps_total": 0,
             "prefill_chunks": 0, "prefill_tokens": 0,
             "prefill_tokens_skipped": 0,
@@ -201,13 +240,18 @@ class ContinuousEngine:
         eos_ids=(),
         seed: int = 0,
         start_step: int = 0,
+        priority: str | None = None,
         stream_cb: Callable[[int], bool | None] | None = None,
         on_finish: Callable[[ContinuousRequest], None] | None = None,
     ) -> ContinuousRequest:
-        """Queue a request; it joins the slot batch at the next chunk
-        boundary with free capacity. ``start_step`` > 0 resumes a
+        """Queue a request; the scheduler decides when (and at whose
+        expense) it joins the slot batch. ``start_step`` > 0 resumes a
         recovered request's key chain (prompt then carries the original
-        prompt + tokens already delivered)."""
+        prompt + tokens already delivered). ``priority`` is one of the
+        scheduler's classes (None → the engine default); past the class
+        queue cap the request fails immediately with
+        :class:`SchedulerOverloaded` on ``req.error`` instead of queueing
+        forever — the API layer's 429 backstop."""
         req = ContinuousRequest(
             rid=next(self._rid),
             prompt=[int(t) for t in prompt],
@@ -216,17 +260,36 @@ class ContinuousEngine:
             eos=frozenset(int(e) for e in eos_ids),
             seed=int(seed),
             start_step=int(start_step),
+            priority=normalize_priority(
+                priority if priority else self.default_priority
+            ),
             stream_cb=stream_cb,
             on_finish=on_finish,
         )
+        req.submit_t = time.monotonic()
+        overload: SchedulerOverloaded | None = None
         with self._lock:
-            self._queue.append(req)
+            try:
+                self.sched.push(req)
+            except SchedulerOverloaded as e:
+                overload = e
+        if overload is not None:
+            req.error = overload
+            self._finish(req, finished=False)
         return req
+
+    def admission_check(self, priority: str | None = None, n: int = 1):
+        """The batcher/API backpressure probe: None = would admit, else a
+        rejection record (queue depth, cap, retry-after estimate)."""
+        with self._lock:
+            return self.sched.admission_check(
+                priority if priority else self.default_priority, n
+            )
 
     def has_work(self) -> bool:
         with self._lock:
             return (
-                bool(self._queue)
+                len(self.sched) > 0
                 or bool(self._active.any())
                 or bool(self._prefilling)
             )
@@ -263,6 +326,16 @@ class ContinuousEngine:
     def _emit(self, req: ContinuousRequest, tok: int) -> bool:
         """Deliver one token; returns True when the request is done
         (EOS / budget / downstream cancel)."""
+        if not req.tokens:
+            # first token EVER for this request (a resumed-after-preempt
+            # request already has tokens, so TTFT is recorded once).
+            # Under the lock: serving_snapshot() iterates the TTFT
+            # sample deque from other threads (/stats), and a deque
+            # append racing that iteration raises.
+            with self._lock:
+                self.sched.note_first_token(
+                    req, time.monotonic() - req.submit_t
+                )
         req.tokens.append(tok)
         cancel = False
         if req.stream_cb is not None:
@@ -271,25 +344,32 @@ class ContinuousEngine:
 
     def _admit_one(self, req: ContinuousRequest, slot: int) -> bool:
         """Place ``req`` into ``slot``. Returns False when no pages are
-        free (request stays queued)."""
-        if len(req.prompt) > self.max_seq_len:
+        free (request stays queued). A preempted request re-admits here
+        with ``req.tokens`` non-empty: the prefill sequence is prompt +
+        emitted (the crash-recovery shape, so resumption is bit-exact)
+        and the budget/step accounting stays cumulative."""
+        seq = req.prompt + req.tokens
+        if len(seq) > self.max_seq_len:
             # surface the same diagnosable error the static path raises
             # from prefill — never a mysterious empty completion
             req.error = ValueError(
-                f"prompt length {len(req.prompt)} exceeds max_seq_len "
+                f"prompt length {len(seq)} exceeds max_seq_len "
                 f"{self.max_seq_len}"
             )
             self._finish(req, finished=False)
             return True
-        room = self.max_seq_len - len(req.prompt)
-        eff = min(req.budget, room)
+        room = self.max_seq_len - len(seq)
+        remaining = req.budget - len(req.tokens)
+        eff = min(remaining, room)
         if eff <= 0:
             # zero room: report finished with an empty completion, matching
             # the static paths' contract
             self._finish(req, finished=True)
             return True
-        req.budget = eff
-        total = min(len(req.prompt) + eff, self.max_seq_len)
+        req.budget = len(req.tokens) + eff
+        req.prefill_tokens = seq
+        req.prefill_target = len(seq)
+        total = min(len(seq) + eff, self.max_seq_len)
         if self.prefill_chunk > 0:
             return self._admit_paged(req, slot, total)
         return self._admit_monolithic(req, slot, total)
@@ -317,15 +397,16 @@ class ContinuousEngine:
         cached sibling shares a partial token prefix, allocate private
         pages for the rest, and queue the non-hit suffix for chunked
         prefill at the coming step boundaries."""
-        T = len(req.prompt)
+        seq = req.prefill_tokens
+        T = len(seq)
         hit_nodes: list = []
         cow = None
         if self.prefix is not None:
             # at least ONE real token must prefill so the final chunk
             # yields the last prompt position's logits for the first draw
             limit = T - 1
-            hit_nodes = self.prefix.match(req.prompt, limit)
-            cow = self.prefix.partial_match(hit_nodes, req.prompt, limit)
+            hit_nodes = self.prefix.match(seq, limit)
+            cow = self.prefix.partial_match(hit_nodes, seq, limit)
             # pin the hit chain (and the COW source) through the
             # allocation below — eviction-on-demand must not free them
             self.prefix.acquire(hit_nodes)
@@ -398,10 +479,12 @@ class ContinuousEngine:
         C = self.prefill_chunk
         for slot in sorted(self._prefilling):
             req = self._prefilling[slot]
-            T = len(req.prompt)
+            T = len(req.prefill_tokens)
             n = min(C, T - req.prefill_pos)
             toks = np.zeros(C, np.int32)
-            toks[:n] = req.prompt[req.prefill_pos : req.prefill_pos + n]
+            toks[:n] = req.prefill_tokens[
+                req.prefill_pos : req.prefill_pos + n
+            ]
             h_last, self.cache = paged_prefill_chunk(
                 self.engine.params, jnp.asarray(toks), self.cache,
                 jnp.int32(slot), jnp.int32(req.prefill_pos), jnp.int32(n),
@@ -426,8 +509,10 @@ class ContinuousEngine:
         if pages is None:
             return False
         try:
-            logits, dense, lens, _B = self.engine.prefill([req.prompt])
-            T = len(req.prompt)
+            logits, dense, lens, _B = self.engine.prefill(
+                [req.prefill_tokens]
+            )
+            T = len(req.prefill_tokens)
             T_pad = dense.k.shape[2]  # full dense cache span
             # bucketed scatter span: smallest seq bucket covering the
             # prompt (bounded program set); positions past the prompt
@@ -468,13 +553,14 @@ class ContinuousEngine:
         return True
 
     def _activate(self, req: ContinuousRequest, slot: int, logits) -> None:
-        """Prefill done: draw the first token from the last prompt
+        """Prefill done: draw the next token from the last prefilled
         position's logits with the request's own key chain — exactly what
-        a solo run draws — and open the slot for decode chunks."""
+        an uninterrupted run draws at this step (``base`` counts recovery
+        AND pre-preemption tokens, both already in the prefill sequence)
+        — and open the slot for decode chunks."""
         sp = req.sampling
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(req.seed), req.start_step
-        )
+        base = req.start_step + len(req.tokens)
+        key = jax.random.fold_in(jax.random.PRNGKey(req.seed), base)
         counts_row = self._prompt_counts(req)
         tok = int(
             np.asarray(sample(logits[:1], key, sp, counts_row[None]))[0]
@@ -483,7 +569,7 @@ class ContinuousEngine:
             counts_row.at[tok].add(1)
         )
         self._seeds[slot] = req.seed
-        self._steps[slot] = req.start_step + 1  # next draw's index
+        self._steps[slot] = base + 1  # next draw's index
         self._tok[slot] = tok
         self._active[slot] = True
         t = np.asarray(sp.temperature)
@@ -502,7 +588,11 @@ class ContinuousEngine:
                 or self._any(req.sampling.frequency_penalty)):
             return jnp.zeros((self.cfg.vocab_size,), jnp.int32)
         c = np.zeros(self.cfg.vocab_size, np.int32)
-        np.add.at(c, np.asarray(req.prompt, np.int64), 1)
+        # the prefill sequence (prompt + any pre-preemption tokens) IS
+        # the context at this step — an uninterrupted run's counts would
+        # be exactly this histogram here
+        ctx = req.prefill_tokens or req.prompt
+        np.add.at(c, np.asarray(ctx, np.int64), 1)
         return jnp.asarray(c)
 
     @staticmethod
@@ -514,6 +604,20 @@ class ContinuousEngine:
         drop their refcount, promotable private pages move INTO the
         prefix cache, the rest return to the free-list; table row →
         scratch, slot → admission pool."""
+        req = self._teardown_slot(slot)
+        if req is not None:
+            self.stats["evicted"] += 1
+            if req.admit_t:
+                self.sched.note_finished(
+                    req, time.monotonic() - req.admit_t
+                )
+            self._finish(req, finished=True)
+
+    def _teardown_slot(self, slot: int) -> ContinuousRequest | None:
+        """Shared slot teardown for eviction AND preemption: device row →
+        scratch, pages released (promotable prefill-written pages enter
+        the prefix cache), host mirrors cleared. Returns the request that
+        held the slot, its transient slot state reset."""
         req = self._slots[slot]
         self._slots[slot] = None
         self._prefilling.pop(slot, None)
@@ -529,22 +633,45 @@ class ContinuousEngine:
                 self.alloc.free(req.pages)
             req.pages = []
             req.shared_nodes = []
-            self.stats["evicted"] += 1
-            self._finish(req, finished=True)
+        return req
+
+    def _preempt(self, slot: int) -> None:
+        """Preempt a running (or mid-prefill) slot at an admission
+        boundary: tear the slot down through the normal release path —
+        prefill-written pages PROMOTE into the prefix cache, so the
+        resume's re-prefill walks them back with zero recompute while
+        they stay resident — and re-queue the request with its arrival
+        order intact (its aging clock restarts: ticks spent running are
+        not ticks spent waiting). Tokens already emitted were
+        already streamed; resumption re-prefills prompt + emitted and
+        continues the per-token key chain at ``start_step +
+        len(tokens)``, the exact crash-recovery contract, so the full
+        stream is bit-identical to an uninterrupted run."""
+        req = self._teardown_slot(slot)
+        if req is None:
+            return
+        req.slot = -1
+        req.prefill_pos = 0
+        req.prefill_tokens = []
+        req.prefill_target = 0
+        self.stats["preemptions"] += 1
+        with self._lock:
+            self.sched.requeue(req)
 
     def _release_pages(self, req: ContinuousRequest) -> None:
-        """Return a finished slot's pages, promoting what the cache can
+        """Return a released slot's pages, promoting what the cache can
         reuse. Promotable = full pages every position of which was
-        PREFILL-written from the prompt (``prefill_pos`` caps a
-        mid-prefill teardown). The decoded region is deliberately NOT
-        cached: a decode step's KV is the same math as a prefill
-        recompute but not bitwise identical to it (T=1 vs chunk-shaped
-        programs), and the cache's contract is that a hit is bitwise
-        the KV the slot would have computed — so only prefill-computed
-        pages (themselves chunk-framing-invariant, test-pinned) may
-        enter the trie."""
+        PREFILL-written from this admission's prefill sequence
+        (``prefill_pos`` caps a mid-prefill teardown, ``prefill_target``
+        caps off the decoded region on eviction AND preemption). The
+        decoded region is deliberately NOT cached: a decode step's KV is
+        the same math as a prefill recompute but not bitwise identical
+        to it (T=1 vs chunk-shaped programs), and the cache's contract
+        is that a hit is bitwise the KV the slot would have computed —
+        so only prefill-computed pages (themselves
+        chunk-framing-invariant, test-pinned) may enter the trie."""
         self.prefix.release(req.shared_nodes)
-        lim = min(len(req.prompt), req.prefill_pos)
+        lim = min(req.prefill_target, req.prefill_pos)
         page = self.page_size
         n_hit = len(req.shared_nodes)
         node = req.shared_nodes[-1] if req.shared_nodes else None
@@ -553,7 +680,9 @@ class ContinuousEngine:
         for j, pid in enumerate(req.pages):
             hi = (n_hit + j + 1) * page
             if promoting and hi <= lim:
-                block = tuple(int(t) for t in req.prompt[hi - page : hi])
+                block = tuple(
+                    int(t) for t in req.prefill_tokens[hi - page : hi]
+                )
                 node, adopted = self.prefix.insert(node, block, pid)
                 if not adopted:
                     # an identical chain landed first (e.g. a co-batched
@@ -611,8 +740,12 @@ class ContinuousEngine:
 
     def serving_snapshot(self) -> dict:
         """Telemetry for the validator's /stats endpoint and the bench:
-        engine counters plus prefix-cache occupancy."""
+        engine counters, scheduler per-class stats (queue depth,
+        queue-wait/TTFT percentiles, preemptions, rejections), plus
+        prefix-cache occupancy."""
         out = dict(self.stats)
+        with self._lock:
+            out.update(self.sched.snapshot())
         if self.prefix is not None:
             ps = self.prefix.stats
             out.update({
@@ -627,11 +760,18 @@ class ContinuousEngine:
         return out
 
     def _admit(self) -> None:
+        """One admission round (one scheduler tick): admit the scheduler's
+        best queued request into a free slot, preempting strictly-lower-
+        priority residents when the candidate would otherwise miss
+        admission — no free slot, or the allocator dry even after
+        prefix-cache eviction. The lock guards only the host-side queue
+        state — the device-heavy prefill in _admit_one runs OUTSIDE it so
+        client submit() calls never stack behind admission compute
+        (single-driver discipline means nobody else pops the selection
+        meanwhile)."""
+        with self._lock:
+            self.sched.tick()
         while True:
-            # the lock guards only the host-side deque — the device-heavy
-            # prefill in _admit_one runs OUTSIDE it so client submit()
-            # calls never stack behind admission compute (single-driver
-            # discipline means nobody else pops the head meanwhile)
             with self._lock:
                 # a slot is free only when NO request holds it — active
                 # decode or mid-prefill both count as occupied
@@ -639,14 +779,33 @@ class ContinuousEngine:
                     s for s in range(self.max_slots)
                     if self._slots[s] is None
                 ]
-                if not self._queue or not free:
-                    return
-                req = self._queue[0]
-            if not self._admit_one(req, free[0]):
-                return  # head-of-line waits for pages
+                req = self.sched.select()
+                victim = None
+                if req is not None and not free:
+                    victim = self.sched.victim(list(self._slots), req)
+            if req is None:
+                return
+            if not free:
+                if victim is None:
+                    return  # every resident outranks the best candidate
+                self._preempt(victim.slot)
+                continue  # the victim's slot is free now
+            while not self._admit_one(req, free[0]):
+                # allocator pressure the prefix cache couldn't cover:
+                # preempting a lower-priority resident frees its private
+                # pages (and promotes its prefill region, so ITS resume
+                # is near-free too); without a victim the candidate
+                # waits head-of-line like before
+                with self._lock:
+                    victim = self.sched.victim(list(self._slots), req)
+                if victim is None:
+                    return  # head-of-line waits for pages
+                self._preempt(victim.slot)
             with self._lock:
-                if self._queue and self._queue[0] is req:
-                    self._queue.popleft()
+                self.sched.remove(req)
+                if req.slot >= 0:
+                    self.sched.note_admitted(req)
+                    req.admit_t = time.monotonic()
 
     # -- the decode loop -------------------------------------------------
     # per-slot EOS ids carried INTO the compiled chunk (freeze
@@ -732,8 +891,9 @@ class ContinuousEngine:
         engine teardown)."""
         err = error or RuntimeError("continuous engine closed")
         with self._lock:
-            pending = list(self._queue)
-            self._queue.clear()
+            pending = self.sched.pending()
+            for req in pending:
+                self.sched.remove(req)
         for s in range(self.max_slots):
             req = self._slots[s]
             if req is not None:

@@ -35,6 +35,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from tensorlink_tpu.engine.scheduler import (
+    DEFAULT_PRIORITY,
+    PRIORITY_RANK,
+    normalize_priority,
+)
+
 
 @dataclass
 class _Pending:
@@ -57,6 +63,8 @@ class _Pending:
     # the model's EOS set ride the record instead of the dispatch call
     seed: int = 0
     eos_ids: list[int] = field(default_factory=list)
+    # SLO scheduling class (engine/scheduler.py); None → batcher default
+    priority: str | None = None
 
 
 class GenBatcher:
@@ -70,12 +78,14 @@ class GenBatcher:
         max_batch: int = 8,
         window_s: float = 0.01,
         seed: int = 0,
+        queue_cap: int = 256,
     ):
         self.model = model
         self.eos_ids = list(eos_ids)
         self.max_batch = max_batch
         self.window_s = window_s
         self.seed = seed
+        self.queue_cap = int(queue_cap)
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._seq = 0
         self._closed = False
@@ -103,9 +113,12 @@ class GenBatcher:
         lookahead: bool = False,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        priority: str | None = None,
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
-        ``stream_cb`` receives this request's new tokens as they decode."""
+        ``stream_cb`` receives this request's new tokens as they decode.
+        ``priority`` is accepted for API symmetry with the continuous
+        scheduler; the windowed batcher itself stays FCFS."""
         req = _Pending(
             ids=list(ids), max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
@@ -129,6 +142,21 @@ class GenBatcher:
         if req.error is not None:
             raise req.error
         return req.result or []
+
+    def admission_check(self, priority=None, n: int = 1) -> dict | None:
+        """Flat backpressure for the windowed batcher: reject when the
+        dispatch queue is deeper than ``queue_cap``. Classes don't
+        reorder anything here (FCFS), but the API layer's 429 +
+        Retry-After contract is shared with the continuous scheduler."""
+        depth = self._q.qsize()
+        if depth + n > self.queue_cap:
+            return {
+                "priority": str(priority or "interactive"),
+                "queue_depth": depth,
+                "cap": self.queue_cap,
+                "retry_after": max(1.0, min(depth * 0.5, 600.0)),
+            }
+        return None
 
     def close(self, timeout: float = 600.0) -> None:
         """Serve everything already queued, then stop. Blocks until the
@@ -468,7 +496,14 @@ class PipelinedSlotSession:
             if not free:
                 return
             group: list[_Pending] = []
-            for req in list(self.queue)[: len(free)]:
+            # class-ordered admission (stable: FIFO within a class) —
+            # the pipelined session has no preemption or aging, but an
+            # interactive turn never waits behind queued batch work
+            ordered = sorted(
+                self.queue,
+                key=lambda r: PRIORITY_RANK.get(r.priority or "", 0),
+            )
+            for req in ordered[: len(free)]:
                 eff = min(req.max_new_tokens, self.cache_len - len(req.ids))
                 if eff <= 0:
                     # zero room: finished with an empty completion, the
@@ -621,12 +656,25 @@ class ContinuousBatcher:
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
         seed: int = 0,
+        default_priority: str = DEFAULT_PRIORITY,
+        sched_queue_cap: int = 64,
+        sched_aging_ticks: int = 32,
+        sched_preemption: bool = True,
+        sched_policy: str = "slo",
+        sched_max_wait_s: float = 60.0,
     ):
         from collections import deque
 
         self.model = model
         self.eos_ids = list(eos_ids or [])
         self.seed = int(seed)
+        self.default_priority = normalize_priority(default_priority)
+        self.max_slots = int(max_slots)
+        self.sched_queue_cap = int(sched_queue_cap)
+        # per-class in-flight counters: the validator-side backpressure
+        # view for modes whose engine lives elsewhere (remote workers /
+        # pipelined sessions); local mode asks the engine scheduler
+        self._inflight_cls = {c: 0 for c in PRIORITY_RANK}
         self._seq = itertools.count(1)
         self._closed = False
         self._submit_lock = threading.Lock()
@@ -650,6 +698,12 @@ class ContinuousBatcher:
                     engine, max_slots=max_slots, page_size=page_size,
                     chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
                     prefix_cache=prefix_cache,
+                    default_priority=self.default_priority,
+                    sched_queue_cap=sched_queue_cap,
+                    sched_aging_ticks=sched_aging_ticks,
+                    sched_preemption=sched_preemption,
+                    sched_policy=sched_policy,
+                    sched_max_wait_s=sched_max_wait_s,
                 )
             )
             self.mode = "local"
@@ -678,17 +732,22 @@ class ContinuousBatcher:
         lookahead: bool = False,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        priority: str | None = None,
     ) -> list[int]:
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("model is being unhosted")
             req_seed = self.seed + next(self._seq)
+        priority = normalize_priority(priority or self.default_priority)
         penalized = bool(presence_penalty or frequency_penalty)
         if self.mode == "remote":
             # drain accounting for close(): unhost must not tear the job
-            # down under requests the worker is still decoding
+            # down under requests the worker is still decoding. Per-class
+            # counts feed admission_check — the validator-side view of a
+            # queue that actually lives on the worker's engine.
             with self._idle:
                 self._inflight += 1
+                self._inflight_cls[priority] += 1
             try:
                 return self._generate_remote(
                     ids, max_new_tokens=max_new_tokens,
@@ -696,10 +755,12 @@ class ContinuousBatcher:
                     stream_cb=stream_cb, lookahead=lookahead,
                     presence_penalty=presence_penalty,
                     frequency_penalty=frequency_penalty, seed=req_seed,
+                    priority=priority,
                 )
             finally:
                 with self._idle:
                     self._inflight -= 1
+                    self._inflight_cls[priority] -= 1
                     self._idle.notify_all()
         if self.mode == "pipelined" and (penalized or lookahead):
             # features the slot session doesn't carry (per-row context
@@ -728,6 +789,7 @@ class ContinuousBatcher:
             top_p=float(top_p), stream_cb=stream_cb,
             presence_penalty=float(presence_penalty),
             frequency_penalty=float(frequency_penalty),
+            priority=priority,
         )
         req.seed = req_seed
         req.eos_ids = self.eos_ids
@@ -746,10 +808,13 @@ class ContinuousBatcher:
     def _generate_remote(
         self, ids, *, max_new_tokens, temperature, top_k, top_p, stream_cb,
         lookahead, presence_penalty, frequency_penalty, seed,
+        priority=None,
     ) -> list[int]:
         """Single-stage pass-through: the worker's slot engine is the
         scheduler, so each request ships immediately — concurrency comes
-        from the API's request threads, admission from the worker."""
+        from the API's request threads, admission (and any preemption)
+        from the worker's scheduler, which reads ``priority`` off the
+        GENERATE body."""
         spec = bool(lookahead) and float(temperature) == 0.0 \
             and not presence_penalty and not frequency_penalty
         cb = None
@@ -766,6 +831,7 @@ class ContinuousBatcher:
             stream_cb=cb, lookahead=spec,
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
+            priority=priority,
             # speculation runs the solo engine path; everything else joins
             # the worker's slot batch
             continuous=not spec,
@@ -776,6 +842,38 @@ class ContinuousBatcher:
     def _note_served(self) -> None:
         with self._stats_lock:
             self._served += 1
+
+    def admission_check(self, priority=None, n: int = 1) -> dict | None:
+        """The API layer's backpressure gate (None = admit, else a
+        rejection record the server turns into 429 + Retry-After).
+
+        - local mode: the engine scheduler's real admission check (class
+          queue depth, estimated wait from observed service time);
+        - remote / pipelined: the engine queue lives elsewhere, so the
+          gate is the validator-side per-class in-flight count against
+          the same cap — coarser, but it bounds the queue the worker
+          would otherwise accumulate (its own scheduler still backstops
+          with SchedulerOverloaded).
+        """
+        cls = normalize_priority(priority or self.default_priority)
+        if self._cont is not None:
+            return self._cont.admission_check(cls, n)
+        with self._idle:
+            depth = self._inflight_cls.get(cls, 0)
+        if self.mode == "pipelined":
+            depth = max(depth, len(self._sess.queue) if self._sess else 0)
+        if depth + n > self.sched_queue_cap:
+            return {
+                "priority": cls,
+                "queue_depth": depth,
+                "cap": self.sched_queue_cap,
+                # no service-time estimator on this side: scale by how
+                # oversubscribed the class is, clamped like the engine's
+                "retry_after": max(
+                    1.0, min(depth / max(self.max_slots, 1) * 5.0, 600.0)
+                ),
+            }
+        return None
 
     # -- dispatcher ------------------------------------------------------
     def _drain_queue(self, limit: int) -> list[_Pending]:
@@ -867,6 +965,7 @@ class ContinuousBatcher:
                 frequency_penalty=req.frequency_penalty,
             ),
             eos_ids=self.eos_ids, seed=req.seed,
+            priority=req.priority,
             stream_cb=tok_cb, on_finish=on_finish,
         )
 

@@ -733,6 +733,7 @@ class DistributedModel:
         num_beams: int = 1,
         info_out: dict | None = None,
         continuous: bool = False,
+        priority: str | None = None,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -778,6 +779,7 @@ class DistributedModel:
                     stream_cb=stream_cb,
                     presence_penalty=float(presence_penalty or 0.0),
                     frequency_penalty=float(frequency_penalty or 0.0),
+                    priority=priority,
                 )
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -978,6 +980,7 @@ class DistributedModel:
         self, prompt: list[int], *, max_new_tokens: int, temperature: float,
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
         presence_penalty: float, frequency_penalty: float,
+        priority: str | None = None,
     ) -> list[list[int]]:
         """One request through the worker's continuous slot engine
         (B=1 per RPC; the worker co-batches concurrent requests into its
@@ -1015,6 +1018,10 @@ class DistributedModel:
                 "frequency_penalty": frequency_penalty,
                 "eos_ids": list(eos_ids), "seed": int(seed),
             }
+            if priority:
+                # the worker's scheduler reads the class off the wire; an
+                # old worker simply ignores the extra key (FCFS for it)
+                body["priority"] = str(priority)
             try:
                 if stream_cb is None:
                     resp = self._request(

@@ -323,6 +323,12 @@ class DistributedValidator:
                 job.model, job.tokenizer.eos_ids,
                 max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
                 chunk_steps=ml_cfg.cont_chunk_steps,
+                default_priority=ml_cfg.default_priority,
+                sched_queue_cap=ml_cfg.sched_queue_cap,
+                sched_aging_ticks=ml_cfg.sched_aging_ticks,
+                sched_preemption=ml_cfg.sched_preemption,
+                sched_policy=ml_cfg.sched_policy,
+                sched_max_wait_s=ml_cfg.sched_max_wait_s,
             )
         else:
             job.batcher = GenBatcher(
@@ -537,6 +543,7 @@ class DistributedValidator:
                 frequency_penalty=args["frequency_penalty"],
                 stream_cb=stream_cb if use_cb else None,
                 lookahead=spec,
+                priority=getattr(req, "priority", None) or None,
             )
         else:
             with job.lock:  # serialize per-model generation

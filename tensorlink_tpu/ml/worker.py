@@ -1572,6 +1572,12 @@ class DistributedWorker:
                     chunk_steps=int(ml.cont_chunk_steps),
                     prefill_chunk=int(ml.prefill_chunk),
                     prefix_cache=bool(ml.prefix_cache),
+                    default_priority=str(ml.default_priority),
+                    sched_queue_cap=int(ml.sched_queue_cap),
+                    sched_aging_ticks=int(ml.sched_aging_ticks),
+                    sched_preemption=bool(ml.sched_preemption),
+                    sched_policy=str(ml.sched_policy),
+                    sched_max_wait_s=float(ml.sched_max_wait_s),
                 )
             except ValueError as e:
                 # int8 KV cache / sliding window: static batcher territory
@@ -1642,6 +1648,7 @@ class DistributedWorker:
             eos_ids=p.get("eos_ids", ()),
             seed=int(p.get("seed", 0)),
             start_step=int(p.get("start_step", 0)),
+            priority=p.get("priority"),
             stream_cb=stream_cb if stream_id else None,
             on_finish=on_finish,
         )

@@ -228,3 +228,152 @@ def test_stop_stream_semantics():
     assert run(["h"], ["hello"]) == ("", True)
     # no stops configured behaves as passthrough
     assert run([], ["a", "b"]) == ("ab", False)
+
+
+# -- SLO scheduling at the API boundary -------------------------------------
+
+
+def test_priority_field_parses_and_maps():
+    r = GenerationRequest.parse({"hf_name": "m", "priority": "batch"})
+    assert r.priority == "batch"
+    # default: empty string → the validator's MLConfig default decides
+    assert GenerationRequest.parse({"hf_name": "m"}).priority == ""
+    c = ChatCompletionRequest.parse({
+        "model": "m",
+        "messages": [{"role": "user", "content": "hi"}],
+        "priority": "best_effort",
+    })
+    assert c.to_generation_request().priority == "best_effort"
+    with pytest.raises(ValidationError):
+        GenerationRequest.parse({"hf_name": "m", "priority": "urgent"})
+
+
+class _FakeJob:
+    """hosted-job stand-in carrying only what the gate reads."""
+
+    status = "ready"
+
+    def __init__(self, batcher):
+        self.batcher = batcher
+
+
+class _RejectingBatcher:
+    def __init__(self, rej):
+        self.rej = rej
+        self.calls = []
+
+    def admission_check(self, priority=None, n=1):
+        self.calls.append((priority, n))
+        return self.rej
+
+
+def _make_api(job):
+    """A TensorlinkAPI with no sockets: route handlers are exercised
+    directly on a private event loop."""
+    from tensorlink_tpu.api.server import TensorlinkAPI
+
+    class _Exec:
+        hosted = {"m": job}
+
+    api = TensorlinkAPI.__new__(TensorlinkAPI)
+    api.executor = _Exec()
+    api._inflight = 0
+    return api
+
+
+def test_scheduler_rejection_becomes_429_with_retry_after():
+    from tensorlink_tpu.api.server import HTTPError
+
+    rej = {
+        "priority": "batch", "queue_depth": 64, "cap": 64,
+        "retry_after": 17.4,
+    }
+    batcher = _RejectingBatcher(rej)
+    api = _make_api(_FakeJob(batcher))
+    gen = GenerationRequest.parse(
+        {"hf_name": "m", "priority": "batch"}
+    )
+    with pytest.raises(HTTPError) as ei:
+        api._reject_if_overloaded(_FakeJob(batcher), gen, 1)
+    e = ei.value
+    assert e.status == 429
+    # Retry-After rides a real header AND the JSON body, and the body
+    # names the class + queue depth the client was judged against
+    assert e.headers.get("Retry-After") == "17"
+    assert e.body["priority"] == "batch"
+    assert e.body["queue_depth"] == 64 and e.body["cap"] == 64
+    # the batcher saw the request's class and its dispatch width
+    assert batcher.calls == [("batch", 1)]
+
+
+def test_admission_pass_through_when_not_overloaded():
+    batcher = _RejectingBatcher(None)
+    api = _make_api(_FakeJob(batcher))
+    gen = GenerationRequest.parse({"hf_name": "m"})
+    api._reject_if_overloaded(_FakeJob(batcher), gen, 3)  # no raise
+    # empty priority is forwarded as None → the batcher's default class
+    assert batcher.calls == [(None, 3)]
+
+
+def test_n_gt_1_failure_does_not_erode_gate():
+    """The gate-erosion regression (the noted comment in
+    _generate_common): when one of n>1 coalesced dispatches fails, the
+    other n-1 must COMPLETE before the error propagates — _inflight is
+    restored to exactly 0, never decremented while siblings still run
+    (which would let new requests through a gate the pool can't honor)."""
+    import asyncio
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tensorlink_tpu.api.server import HTTPError, TensorlinkAPI
+
+    release = threading.Event()
+    peak = []
+
+    class _Exec:
+        def __init__(self):
+            self.hosted = {}
+
+        def generate_api(self, gen, on_delta=None):
+            if not release.wait(10):  # both siblings must be in flight
+                raise TimeoutError("sibling never dispatched")
+            if gen.temperature == 0.0:  # marker: this one fails
+                raise RuntimeError("boom")
+            return {
+                "text": "ok", "reasoning": None, "prompt_tokens": 1,
+                "completion_tokens": 1, "finish_reason": "stop",
+            }
+
+    api = TensorlinkAPI.__new__(TensorlinkAPI)
+    api.executor = _Exec()
+    api._inflight = 0
+    api._pool = ThreadPoolExecutor(max_workers=4)
+
+    class _Writer:
+        def write(self, data):
+            pass
+
+        async def drain(self):
+            pass
+
+    # n=2: one succeeds, one fails — drive _generate_common directly
+    gen = GenerationRequest.parse(
+        {"hf_name": "m", "temperature": 0.0, "do_sample": False}
+    )
+    job = _FakeJob(batcher=None)
+    api.executor.hosted["m"] = job
+
+    async def drive():
+        task = asyncio.ensure_future(
+            api._generate_common(gen, _Writer(), n=2)
+        )
+        await asyncio.sleep(0.05)
+        peak.append(api._inflight)  # both counted while in flight
+        release.set()
+        with pytest.raises(RuntimeError, match="boom"):
+            await task
+
+    asyncio.new_event_loop().run_until_complete(drive())
+    assert peak == [2]
+    assert api._inflight == 0  # fully restored, no erosion either way
+    api._pool.shutdown(wait=True)

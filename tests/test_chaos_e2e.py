@@ -323,6 +323,10 @@ def test_worker_crash_mid_continuous_batch_recovers_all_sessions(tmp_path):
             try:
                 seqs = model.generate(
                     [prompts[i]], max_new_tokens=n_toks, continuous=True,
+                    # distinct SLO classes ride the wire into the worker's
+                    # scheduler: recovery re-submission must preserve the
+                    # bit-exact stream regardless of class
+                    priority=("interactive", "batch")[i],
                     stream_cb=lambda toks, i=i: streams[i].extend(
                         t for t in toks if t is not None
                     ),

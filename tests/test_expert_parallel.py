@@ -188,7 +188,10 @@ def test_sparse_dispatch_flops_scale_with_k_not_E():
 
     def flops(c):
         fn = jax.jit(lambda x: _moe_mlp(x, p, c))
-        return fn.lower(h).compile().cost_analysis()["flops"]
+        ca = fn.lower(h).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):  # jax < 0.6: one dict per device
+            ca = ca[0]
+        return ca["flops"]
 
     dense = flops(cfg)
     sparse = flops(cfg.with_(moe_dispatch="sparse", moe_capacity_factor=1.0))

@@ -11,9 +11,31 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
 
 pytestmark = pytest.mark.e2e
+
+# jax < 0.5 CPU cannot run cross-process collectives at all — every
+# program touching a multi-process mesh dies with "Multiprocess
+# computations aren't implemented on the CPU backend" inside XLA. Not
+# shimmable (the backend genuinely lacks the feature); newer jaxlibs
+# run these tests unmodified.
+_CPU_MULTIPROC_UNSUPPORTED = tuple(
+    int(p) for p in jax.__version__.split(".")[:2]
+) < (0, 5) and (
+    # version first: jax >= 0.5 short-circuits before default_backend()
+    # would initialize the real accelerator at collection time
+    os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    or jax.default_backend() == "cpu"
+)
+if _CPU_MULTIPROC_UNSUPPORTED:
+    pytestmark = [
+        pytest.mark.e2e,
+        pytest.mark.skip(
+            reason="jax<0.5 CPU backend has no multiprocess collectives"
+        ),
+    ]
 
 _CHILD = r"""
 import os, sys
