@@ -21,6 +21,7 @@ Public API (mirrors the reference's ``tensorlink`` package surface):
 
 __version__ = "0.1.0"
 
+# tlint: disable=TL006(lazy-import name table — read-only after module definition)
 _LAZY = {
     "DistributedModel": "tensorlink_tpu.ml.module",
     "create_distributed_optimizer": "tensorlink_tpu.ml.optim",

@@ -51,6 +51,7 @@ class HTTPError(Exception):
         self.headers = dict(headers or {})
 
 
+# tlint: disable=TL006(read-only constant table — never mutated at runtime)
 _STATUS = {
     200: "OK", 400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed",
     408: "Request Timeout", 413: "Payload Too Large", 429: "Too Many Requests",
@@ -78,7 +79,9 @@ class TensorlinkAPI:
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._server: asyncio.AbstractServer | None = None
-        self._inflight = 0
+        # the transport-backstop gate: only ever touched on the server's
+        # event loop (handler coroutines + the on-loop reject helper)
+        self._inflight = 0  #: guarded by the event loop
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "TensorlinkAPI":
@@ -142,18 +145,21 @@ class TensorlinkAPI:
             await self._send_json(writer, e.status, e.body, headers=e.headers)
         except asyncio.TimeoutError:
             await self._send_json(writer, 408, {"error": "request timeout"})
+        # tlint: disable=TL005(client hung up mid-reply — no one left to answer)
         except (ConnectionError, OSError):
             pass
         except Exception:
             self.log.exception("request failed")
             try:
                 await self._send_json(writer, 500, {"error": "internal error"})
+            # tlint: disable=TL005(client hung up before the 500 could land — already logged above)
             except (ConnectionError, OSError):
                 pass
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
+            # tlint: disable=TL005(closing an already-dead transport)
             except (ConnectionError, OSError):
                 pass
 
@@ -349,6 +355,7 @@ class TensorlinkAPI:
             raise HTTPError(400, str(e))
         await self._generate_common(gen, writer)
 
+    # tlint: on-loop — only called from _generate_common (a coroutine)
     def _reject_if_overloaded(self, job, gen, n: int) -> None:
         """Scheduler-driven backpressure (replaces the old flat
         concurrent-request counter): the hosted model's batcher judges the

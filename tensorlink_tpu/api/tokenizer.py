@@ -12,6 +12,10 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Any
 
+from tensorlink_tpu.core.logging import get_logger
+
+log = get_logger("api.tokenizer")
+
 
 class ByteTokenizer:
     """UTF-8 byte fallback: id = byte value; 256=BOS, 257=EOS."""
@@ -85,6 +89,7 @@ def load_tokenizer(model_spec: dict) -> TokenizerAdapter:
             from transformers import AutoTokenizer
 
             return TokenizerAdapter(AutoTokenizer.from_pretrained(cand))
-        except Exception:
+        except Exception as e:
+            log.debug("tokenizer candidate %s unavailable: %s", cand, e)
             continue
     return TokenizerAdapter(ByteTokenizer())

@@ -99,13 +99,13 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGINT, handle)
     signal.signal(signal.SIGTERM, handle)
 
-    last_ui = time.time()
+    last_ui = time.monotonic()
     try:
         while not stop["flag"]:
             time.sleep(0.5)
-            if args.ui_interval and time.time() - last_ui >= args.ui_interval:
+            if args.ui_interval and time.monotonic() - last_ui >= args.ui_interval:
                 print(status_report(node), flush=True)
-                last_ui = time.time()
+                last_ui = time.monotonic()
     finally:
         node.stop()
     return 0

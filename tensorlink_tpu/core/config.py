@@ -28,6 +28,7 @@ from typing import Any
 DEFAULT_ENV_FILE = ".tensorlink_tpu.env"
 
 # Packaged defaults (reference: tensorlink/config/config.json + models.json).
+# tlint: disable=TL006(read-only defaults table — EnvFile overlays copy, never mutate)
 DEFAULT_CONFIG: dict[str, Any] = {
     "seed_validators": [],  # [(host, port), ...]
     "default_models": ["Qwen/Qwen3-8B"],
@@ -265,6 +266,7 @@ def _coerce(cls, data: dict[str, Any]):
     return cls(**kwargs)
 
 
+# tlint: disable=TL006(read-only constant table — never mutated at runtime)
 ROLE_CONFIGS = {
     "worker": WorkerConfig,
     "validator": ValidatorConfig,

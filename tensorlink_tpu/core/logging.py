@@ -16,6 +16,7 @@ from pathlib import Path
 VERBOSE = 5
 logging.addLevelName(VERBOSE, "VERBOSE")
 
+# tlint: disable=TL006(read-only constant table — never mutated at runtime)
 _COLORS = {
     "VERBOSE": "\033[90m",
     "DEBUG": "\033[36m",

@@ -40,7 +40,9 @@ _ALIGN = 64
 
 # name -> (to_tree, from_tree); to_tree returns a JSON-able tree possibly
 # containing arrays, from_tree reconstructs the object.
+# tlint: disable=TL006(codec registry — populated once at import by register_struct, read-only after)
 _STRUCTS: dict[str, tuple[Callable[[Any], Any], Callable[[Any], Any]]] = {}
+# tlint: disable=TL006(codec registry — populated once at import by register_struct, read-only after)
 _STRUCT_TYPES: dict[type, str] = {}
 
 

@@ -20,6 +20,7 @@ def _unregister(shm: shared_memory.SharedMemory) -> None:
     # resource tracker from double-unlinking at exit.
     try:  # pragma: no cover - depends on interpreter internals
         resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    # tlint: disable=TL005(resource_tracker is an interpreter internal; on mismatch the tracker just warns at exit)
     except Exception:
         pass
 
@@ -53,6 +54,7 @@ def load(size: int, name: str, *, unlink: bool = True) -> Any:
         if unlink:
             try:
                 shm.unlink()
+            # tlint: disable=TL005(consumer/producer race on unlink — either side may have won)
             except FileNotFoundError:
                 pass
     return obj
@@ -63,5 +65,6 @@ def unlink(name: str) -> None:
         shm = shared_memory.SharedMemory(name=name)
         shm.close()
         shm.unlink()
+    # tlint: disable=TL005(already gone is the desired end state of unlink)
     except FileNotFoundError:
         pass

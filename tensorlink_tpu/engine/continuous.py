@@ -68,6 +68,7 @@ from .scheduler import (
 )
 
 
+# tlint: hot-path
 @jax.jit
 def _row_keys(seeds: jax.Array, steps: jax.Array) -> jax.Array:
     """Per-slot sampling keys: ``fold_in(PRNGKey(seed_s), step_s)``.
@@ -78,6 +79,7 @@ def _row_keys(seeds: jax.Array, steps: jax.Array) -> jax.Array:
     )(seeds, steps)
 
 
+# tlint: hot-path
 @jax.jit
 def _sample_rows(logits, keys, temp, top_k, top_p, pres, freq, counts):
     """Row-independent sampling: each slot draws from its own key over its
@@ -195,9 +197,11 @@ class ContinuousEngine:
         self._lock = threading.Lock()
         # the policy layer owning the queued side of the lifecycle:
         # priority classes, aging, preemption decisions, backpressure
-        # (engine/scheduler.py) — replaces the old FIFO deque
+        # (engine/scheduler.py) — replaces the old FIFO deque. Client
+        # threads (submit/admission_check/serving_snapshot) race the
+        # driver on it; every touch goes through the engine lock.
         self.default_priority = normalize_priority(default_priority)
-        self.sched = RequestScheduler(
+        self.sched = RequestScheduler(  #: guarded by self._lock
             max_slots=self.max_slots,
             queue_cap=sched_queue_cap,
             aging_ticks=sched_aging_ticks,
@@ -468,6 +472,7 @@ class ContinuousEngine:
             self.prefix.stats["hit_tokens"] += hit_len
         return True
 
+    # tlint: hot-path
     def _prefill_tick(self) -> None:
         """One fixed-shape prefill chunk for EVERY mid-prefill slot, then
         back to the decode chunk — the chunked-prefill TTFT guarantee:
@@ -608,9 +613,15 @@ class ContinuousEngine:
         if req is not None:
             self.stats["evicted"] += 1
             if req.admit_t:
-                self.sched.note_finished(
-                    req, time.monotonic() - req.admit_t
-                )
+                # under the lock like every other scheduler touch: the
+                # service EWMA this updates is read concurrently by
+                # admission_check/serving_snapshot from client threads
+                # (found by tlint TL001 — the only sched access that ran
+                # outside the engine lock)
+                with self._lock:
+                    self.sched.note_finished(
+                        req, time.monotonic() - req.admit_t
+                    )
             self._finish(req, finished=True)
 
     def _teardown_slot(self, slot: int) -> ContinuousRequest | None:
@@ -813,6 +824,7 @@ class ContinuousEngine:
     # overflowing set only costs wasted in-chunk steps, never correctness
     _EOS_WIDTH = 8
 
+    # tlint: hot-path
     def step_chunk(self, *, admit_only: bool = False) -> bool:
         """Admit queued requests, then run ONE compiled decode chunk
         (``chunk_steps`` fixed-shape slot steps in a single on-device

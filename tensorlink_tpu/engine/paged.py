@@ -426,6 +426,7 @@ def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
     return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
 
 
+# tlint: hot-path
 @partial(
     jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
 )
@@ -489,6 +490,7 @@ def paged_decode_step(
     return logits, new_cache
 
 
+# tlint: hot-path
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "kernel"),
@@ -587,6 +589,7 @@ def _paged_prefill_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv,
     return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
 
 
+# tlint: hot-path
 @partial(
     jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
 )
@@ -650,6 +653,7 @@ def paged_prefill_chunk(
     return h_last, new_cache
 
 
+# tlint: hot-path
 @partial(jax.jit, donate_argnames=("cache",))
 def copy_page(
     cache: PagedKVCache, src: jax.Array, dst: jax.Array
@@ -664,6 +668,7 @@ def copy_page(
     )
 
 
+# tlint: hot-path
 @partial(jax.jit, donate_argnames=("cache",))
 def scatter_prefill(
     cache: PagedKVCache,
@@ -688,6 +693,7 @@ def scatter_prefill(
     return replace(cache, k=k, v=v)
 
 
+# tlint: hot-path
 @partial(jax.jit, donate_argnames=("cache",))
 def bind_slot(
     cache: PagedKVCache, slot: jax.Array, bt_row: jax.Array, length: jax.Array
@@ -700,6 +706,7 @@ def bind_slot(
     )
 
 
+# tlint: hot-path
 @partial(jax.jit, donate_argnames=("cache",))
 def clear_slot(cache: PagedKVCache, slot: jax.Array) -> PagedKVCache:
     """Detach an evicted slot: zero its table row (→ scratch page) and its

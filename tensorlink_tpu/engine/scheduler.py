@@ -59,6 +59,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+# tlint: disable=TL006(constant derived from PRIORITY_CLASSES — read-only)
 PRIORITY_RANK = {c: r for r, c in enumerate(PRIORITY_CLASSES)}
 DEFAULT_PRIORITY = "interactive"
 
@@ -153,22 +154,31 @@ class RequestScheduler:
         self.preemption = bool(preemption) and policy == "slo"
         self.policy = policy
         self.max_wait_s = float(max_wait_s)
-        self._queued: list = []
+        # the queue + its stats are raced by client threads (submit /
+        # admission_check / serving_snapshot) against the driver; every
+        # touch happens with the ENGINE's lock held by the caller, so
+        # touching methods carry `# tlint: holds-lock(the engine lock)`
+        self._queued: list = []  #: guarded by the engine lock
         self._seq = 0
         self._admit_seq = 0  # admission order — victim-recency tiebreak
         self._tick = 0
         # EWMA of per-request service time (admit→finish wall seconds):
         # the unit the wait estimator scales queue depth by
-        self._service_ewma = 0.0
-        self.by_class = {c: _ClassStats() for c in PRIORITY_CLASSES}
+        self._service_ewma = 0.0  #: guarded by the engine lock
+        self.by_class = {  #: guarded by the engine lock
+            c: _ClassStats() for c in PRIORITY_CLASSES
+        }
 
     # -- introspection ---------------------------------------------------
+    # tlint: holds-lock(the engine lock)
     def __len__(self) -> int:
         return len(self._queued)
 
+    # tlint: holds-lock(the engine lock)
     def pending(self) -> list:
         return list(self._queued)
 
+    # tlint: holds-lock(the engine lock)
     def depth(self, priority: str | None = None) -> int:
         if priority is None:
             return len(self._queued)
@@ -184,6 +194,7 @@ class RequestScheduler:
         return max(PRIORITY_RANK[req.priority] - waited // self.aging_ticks, 0)
 
     # -- queue side ------------------------------------------------------
+    # tlint: holds-lock(the engine lock)
     def push(self, req) -> None:
         """Enqueue; raises :class:`SchedulerOverloaded` past the class
         cap (the backstop — the API layer's admission_check normally
@@ -202,6 +213,7 @@ class RequestScheduler:
         req.enqueue_t = time.monotonic()
         self._queued.append(req)
 
+    # tlint: holds-lock(the engine lock)
     def requeue(self, req) -> None:
         """Re-queue a PREEMPTED request: keeps its original arrival seq
         (so it re-admits ahead of class peers that arrived later) but
@@ -222,6 +234,7 @@ class RequestScheduler:
         self._tick += 1
         return self._tick
 
+    # tlint: holds-lock(the engine lock)
     def select(self):
         """The queued request the next free slot should go to: best
         (effective rank, arrival seq). Returns None when idle. The caller
@@ -234,12 +247,15 @@ class RequestScheduler:
             key=lambda r: (self.effective_rank(r), r.sched_seq),
         )
 
+    # tlint: holds-lock(the engine lock)
     def remove(self, req) -> None:
         try:
             self._queued.remove(req)
+        # tlint: disable=TL005(remove() is idempotent by contract — the head-of-line retry path re-removes)
         except ValueError:
             pass
 
+    # tlint: holds-lock(the engine lock)
     def note_admitted(self, req) -> None:
         """Record admission: queue-wait sample, admission-time effective
         rank (the preemption shield — see :meth:`victim`), admission
@@ -253,9 +269,11 @@ class RequestScheduler:
         st.admitted += 1
         st.queue_waits.append(max(time.monotonic() - req.enqueue_t, 0.0))
 
+    # tlint: holds-lock(the engine lock)
     def note_first_token(self, req, ttft_s: float) -> None:
         self.by_class[req.priority].ttfts.append(max(float(ttft_s), 0.0))
 
+    # tlint: holds-lock(the engine lock)
     def note_finished(self, req, service_s: float) -> None:
         a = 0.2  # EWMA weight: a few requests settle the estimate
         s = max(float(service_s), 1e-3)
@@ -299,6 +317,7 @@ class RequestScheduler:
         )
 
     # -- backpressure ----------------------------------------------------
+    # tlint: holds-lock(the engine lock)
     def estimate_wait(self, priority: str) -> float:
         """Rough seconds until a NEW request of this class would reach a
         slot: requests queued at-or-above its rank, over the slot count,
@@ -313,6 +332,7 @@ class RequestScheduler:
         svc = self._service_ewma or 1.0
         return ahead / self.max_slots * svc
 
+    # tlint: holds-lock(the engine lock)
     def admission_check(self, priority, n: int = 1) -> dict | None:
         """The API layer's backpressure gate: None = admit, else a
         rejection record ``{priority, queue_depth, cap, retry_after}``
@@ -335,6 +355,7 @@ class RequestScheduler:
         return None
 
     # -- telemetry -------------------------------------------------------
+    # tlint: holds-lock(the engine lock)
     def snapshot(self) -> dict:
         """Flat-ish JSON-safe counters for ``serving_snapshot()``."""
         classes = {

@@ -88,12 +88,13 @@ class GenBatcher:
         self.queue_cap = int(queue_cap)
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._seq = 0
-        self._closed = False
+        self._closed = False  #: guarded by self._submit_lock
         self._submit_lock = threading.Lock()  # orders submits vs close()
         from collections import deque
 
         self._stats_lock = threading.Lock()
-        self.batch_sizes: deque[int] = deque(maxlen=1000)  # dispatch stats
+        # dispatch stats
+        self.batch_sizes: deque[int] = deque(maxlen=1000)  #: guarded by self._stats_lock
         self._thread = threading.Thread(
             target=self._loop, name="gen-batcher", daemon=True
         )
@@ -616,8 +617,12 @@ class PipelinedSlotSession:
     def close(self) -> None:
         try:
             self.model._end_decode_session(self.session)
-        except Exception:
-            pass
+        except Exception as e:
+            from tensorlink_tpu.core.logging import get_logger
+
+            get_logger("ml.batching").debug(
+                "end_decode_session at close failed: %s", e
+            )
         self.fail(RuntimeError("model is being unhosted"))
 
 
@@ -674,15 +679,15 @@ class ContinuousBatcher:
         # per-class in-flight counters: the validator-side backpressure
         # view for modes whose engine lives elsewhere (remote workers /
         # pipelined sessions); local mode asks the engine scheduler
-        self._inflight_cls = {c: 0 for c in PRIORITY_RANK}
+        self._inflight_cls = {c: 0 for c in PRIORITY_RANK}  #: guarded by self._idle
         self._seq = itertools.count(1)
-        self._closed = False
+        self._closed = False  #: guarded by self._submit_lock
         self._submit_lock = threading.Lock()
         self._stats_lock = threading.Lock()
-        self._served = 0
-        self._inflight = 0
+        self._served = 0  #: guarded by self._stats_lock
+        self._inflight = 0  #: guarded by self._idle
         self._idle = threading.Condition()
-        self.live_samples: deque[int] = deque(maxlen=1000)
+        self.live_samples: deque[int] = deque(maxlen=1000)  #: guarded by self._stats_lock
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -884,7 +889,12 @@ class ContinuousBatcher:
             except queue.Empty:
                 break
             if nxt is None:
-                self._closed = True
+                # under the submit lock like every other _closed write: a
+                # generate() racing the close sentinel must observe either
+                # open-and-enqueued or closed-and-refused, never a torn
+                # read (found by tlint TL001)
+                with self._submit_lock:
+                    self._closed = True
                 break
             out.append(nxt)
         return out
@@ -934,7 +944,9 @@ class ContinuousBatcher:
                             req.done.set()
                 sess.fail(e)
                 busy = False
-            if self._closed and not busy and self._q.empty():
+            with self._submit_lock:
+                closed = self._closed
+            if closed and not busy and self._q.empty():
                 return
             if not busy:
                 self._wake.wait(timeout=0.05)

@@ -412,6 +412,7 @@ class DistributedModel:
                 return fixed
             return self._repair_locked(dead_plan_wid)
 
+    # tlint: holds-lock(self._repair_lock)
     def _chase_repaired(self, dead_plan_wid: str) -> str | None:
         """Resolve chained repairs (A→B then B→C): a straggler holding the
         oldest id must land on the live replacement. None when this id was
@@ -425,6 +426,7 @@ class DistributedModel:
             fixed = self._repaired[fixed]
         return fixed
 
+    # tlint: holds-lock(self._repair_lock)
     def _repair_locked(self, dead_plan_wid: str) -> str:
         validators = self.node.send_request("validators", timeout=10.0)
         if not validators:
@@ -448,8 +450,8 @@ class DistributedModel:
                         old = u.get("old_worker", "")
                         if old in self.workers and old not in self._repaired:
                             self._apply_update(u, old)
-            except Exception:
-                pass
+            except Exception as e:
+                self.log.debug("job_updates scan during repair failed: %s", e)
             fixed = self._chase_repaired(dead_plan_wid)
             if fixed:
                 return fixed
@@ -519,8 +521,11 @@ class DistributedModel:
                         (Path(self._last_ckpt) / "manifest.json").read_text()
                     )
                     self._step = int(manifest.get("step", getattr(self, "_step", 0)))
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.log.warning(
+                        "checkpoint manifest %s unreadable: %s",
+                        self._last_ckpt, e,
+                    )
             elif getattr(self, "_step", 0) > 0:
                 raise RuntimeError(
                     "worker replaced mid-training with no checkpoint to roll "
@@ -908,6 +913,7 @@ class DistributedModel:
                               "rows": sorted(cancelled)}},
                     timeout=10.0,
                 )
+            # tlint: disable=TL005(best-effort cancel push — the chunk budget bound still applies)
             except Exception:
                 pass  # best-effort: the budget bound still applies
 
@@ -950,6 +956,7 @@ class DistributedModel:
                 self.node.send_request(
                     "drop_stream", {"stream": stream_id}, timeout=10.0
                 )
+            # tlint: disable=TL005(best-effort buffer release — the relay's stale-stream bound reclaims it)
             except Exception:
                 pass
         if "err" in result:
@@ -1105,6 +1112,7 @@ class DistributedModel:
                              "body": {"stream": stream_id, "rows": [0]}},
                             timeout=10.0,
                         )
+                    # tlint: disable=TL005(best-effort cancel push — the chunk budget bound still applies)
                     except Exception:
                         pass  # best-effort; the budget bound still applies
             if tk.get("done"):
@@ -1124,12 +1132,14 @@ class DistributedModel:
                 for _row, tok in tk.get("tokens") or ():
                     toks.append(int(tok))
                     stream_cb([int(tok)])
+            # tlint: disable=TL005(draining trailing tokens of a finished stream — the worker may be gone)
             except Exception:
                 pass
         try:
             self.node.send_request(
                 "drop_stream", {"stream": stream_id}, timeout=10.0
             )
+        # tlint: disable=TL005(best-effort buffer release — the relay's stale-stream bound reclaims it)
         except Exception:
             pass
         if "resp" in result:
@@ -1355,6 +1365,7 @@ class DistributedModel:
                      "session": session},
                     timeout=10.0,
                 )
+            # tlint: disable=TL005(session teardown fanout — a dead stage has no session left to end)
             except Exception:
                 pass
 
@@ -1735,8 +1746,11 @@ class DistributedModel:
                     (Path(self._last_ckpt) / "manifest.json").read_text()
                 )
                 self._step = int(manifest.get("step", self._step))
-            except Exception:
-                pass
+            except Exception as e:
+                self.log.warning(
+                    "checkpoint manifest %s unreadable: %s",
+                    self._last_ckpt, e,
+                )
             self._opt_step_partial = False
         self.zero_grad()
 
@@ -1921,8 +1935,8 @@ class DistributedModel:
         peers = set(self.workers.values())
         try:
             peers |= set(self.node.send_request("validators", timeout=10.0))
-        except Exception:
-            pass
+        except Exception as e:
+            self.log.debug("validator list for shutdown fanout failed: %s", e)
         for conn_id in peers:
             try:
                 self.node.send_request(
@@ -1931,6 +1945,7 @@ class DistributedModel:
                      "body": {"job_id": self.job_id}},
                     timeout=10.0,
                 )
+            # tlint: disable=TL005(best-effort release fanout — dead peers free the reservation by dying)
             except Exception:
                 pass
         self.job_id = None

@@ -74,7 +74,7 @@ class DistributedValidator:
     def _bump_demand(self, name: str) -> None:
         with self._demand_lock:
             self.demand[name] = self.demand.get(name, 0) + 1
-            now = time.time()
+            now = time.monotonic()
             if now - self._demand_written < self._demand_flush_s:
                 return  # debounce: no disk write per inference request
             self._demand_written = now
@@ -84,8 +84,9 @@ class DistributedValidator:
             tmp = self._demand_path.with_suffix(".tmp")
             tmp.write_text(json.dumps(snapshot))
             tmp.replace(self._demand_path)
-        except OSError:
-            pass  # stats persistence must never break planning
+        except OSError as e:
+            # stats persistence must never break planning — but say so
+            self.log.debug("demand persistence failed: %s", e)
 
     def _autoload_defaults(self) -> None:
         """Host each configured default model so the API serves it without a

@@ -157,6 +157,7 @@ class DistributedWorker:
             stats = {}
             try:
                 stats = d.memory_stats() or {}
+            # tlint: disable=TL005(memory_stats is backend-optional; no stats = advertise zero capacity)
             except Exception:
                 pass
             cap += float(stats.get("bytes_limit", 0.0))
@@ -296,7 +297,7 @@ class DistributedWorker:
             slice_stage_params,
         )
 
-        t0 = time.time()
+        t0 = time.monotonic()
         job_id = p["job_id"]
         model = p["model"]
         stage = p["stage"]
@@ -409,7 +410,7 @@ class DistributedWorker:
             old.cont = None
         self.log.info(
             "loaded %s layers [%d,%d) first=%s head=%s in %.1fs",
-            model.get("name", "?"), lo, hi, first, holds_head, time.time() - t0,
+            model.get("name", "?"), lo, hi, first, holds_head, time.monotonic() - t0,
         )
         self._respond(
             p["peer"], proto.MODULE_LOADED, p["rid"],
@@ -1621,8 +1622,11 @@ class DistributedWorker:
                          "done": True},
                     )
                     self.bridge.notify("clear_cancels", {"stream": stream_id})
-                except Exception:
-                    pass
+                except Exception as e:
+                    self.log.debug(
+                        "stream %s done-marker push failed: %s",
+                        stream_id, e,
+                    )
             if req.error is not None:
                 self._respond(
                     peer, proto.GENERATE_RESP, p["rid"],

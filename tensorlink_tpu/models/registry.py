@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from .base import ModelConfig
 
+# tlint: disable=TL006(family registry — populated at import, read-only after)
 _FAMILY_BUILDERS: dict[str, Callable[[dict], ModelConfig]] = {}
 
 

@@ -68,6 +68,7 @@ class MLBridge:
         while not self._closed.is_set():
             try:
                 rid, ok, result = self.q.resp.get(timeout=0.5)
+            # tlint: disable=TL005(the poll timeout IS the loop cadence — Empty means check the stop flag)
             except queue_mod.Empty:
                 continue
             except (EOFError, OSError):
@@ -134,6 +135,7 @@ class NetBridge:
     def _safe_put(q, item) -> None:
         try:
             q.put(item)
+        # tlint: disable=TL005(_safe_put's contract: consumer gone at shutdown means nothing to deliver to)
         except Exception:
             pass  # consumer gone (shutdown) — nothing to deliver to
 

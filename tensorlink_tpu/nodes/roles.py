@@ -400,6 +400,7 @@ class ValidatorServer(RoleServer):
         for k, ts in state.get("dht_tombstones", {}).items():
             try:
                 self.dht.delete(k, ts=float(ts))
+            # tlint: disable=TL005(malformed persisted tombstone — skip it, keep restoring the rest)
             except (TypeError, ValueError):
                 continue
         for k, v in state.get("dht", {}).items():
@@ -431,7 +432,7 @@ class ValidatorServer(RoleServer):
     async def _platform_loop(self) -> None:
         """Keeper writes, job monitoring, stats, contract rounds — the
         validator run loop's periodic duties (validator_thread.py:978-1011)."""
-        last_keeper = last_round = time.time()
+        last_keeper = last_round = time.monotonic()
         interval = max(min(self.cfg.monitor_interval, self.cfg.keeper_interval), 0.5)
         while not self.terminate.is_set():
             await asyncio.sleep(min(interval, self.cfg.monitor_interval))
@@ -439,7 +440,7 @@ class ValidatorServer(RoleServer):
                 await self.monitor.check_jobs()
                 self.keeper.update_statistics(self)
                 self.keeper.clean_node(self)
-                now = time.time()
+                now = time.monotonic()
                 if now - last_keeper >= self.cfg.keeper_interval:
                     self.keeper.write_state(self)
                     last_keeper = now
@@ -490,6 +491,7 @@ class ValidatorServer(RoleServer):
                     {"job_id": job_id, "stage": stages[0], "est_bytes": est},
                     timeout=RECRUIT_TIMEOUT,
                 )
+            # tlint: disable=TL005(recruit probe — a dead/slow candidate just means try the next one)
             except (TimeoutError, asyncio.TimeoutError, ConnectionError):
                 continue
             if "addr" not in reply:
@@ -512,8 +514,12 @@ class ValidatorServer(RoleServer):
             if user_conn is not None:
                 try:
                     await user_conn.send_control(proto.JOB_UPDATE, update)
-                except (ConnectionError, OSError):
-                    pass
+                except (ConnectionError, OSError) as e:
+                    # the user will pull the replacement via JOB_REPAIR
+                    self.log.warning(
+                        "job %s: JOB_UPDATE push to user failed (%s)",
+                        job_id[:8], e,
+                    )
             self.reputation.record(dead_wid, "worker_dropped")
             self.log.info(
                 "job %s: replaced worker %s -> %s", job_id[:8],
@@ -563,6 +569,7 @@ class ValidatorServer(RoleServer):
                     timeout=10.0,
                 )
                 self.contract.vote(h, vid, bool(reply.get("approve")))
+            # tlint: disable=TL005(a validator missing a vote round is normal liveness; quorum math tolerates it)
             except (TimeoutError, asyncio.TimeoutError, ConnectionError):
                 continue
         n_validators = len(self.validator_ids()) + 1
@@ -654,6 +661,7 @@ class ValidatorServer(RoleServer):
                     misses[wid] = 0
             else:
                 misses.pop(wid, None)
+                # tlint: disable=TL004(dinged stamps ride the persisted job record — epoch by design)
                 if now - dinged.get(wid, 0.0) > PENALTY_COOLDOWN_S:
                     self.reputation.record(wid, "proof_failed")
                     dinged[wid] = now
@@ -787,6 +795,7 @@ class ValidatorServer(RoleServer):
             stats = await self._own_worker_stats()
             try:
                 await self.respond(conn, proto.WORKERS, body, {"workers": stats})
+            # tlint: disable=TL005(the asking validator hung up while we gathered stats — nobody to answer)
             except (ConnectionError, OSError):
                 pass
 
@@ -865,6 +874,7 @@ class ValidatorServer(RoleServer):
                     await self._conn(wid).send_control(
                         proto.JOB_SHUTDOWN, {"job_id": job_id}
                     )
+                # tlint: disable=TL005(best-effort reservation release — a dead worker frees it by dying)
                 except (ConnectionError, OSError):
                     pass
         result = {
@@ -910,6 +920,7 @@ class ValidatorServer(RoleServer):
                     await self._conn(wid).send_control(
                         proto.JOB_SHUTDOWN, {"job_id": p["job_id"]}
                     )
+                # tlint: disable=TL005(best-effort release — a worker already gone freed its reservation by dying)
                 except (ConnectionError, OSError):
                     pass
             await self.dht_delete_global(f"job:{p['job_id']}")
@@ -956,6 +967,7 @@ def _json_safe(obj: Any) -> Any:
     return json.loads(json.dumps(obj, default=str))
 
 
+# tlint: disable=TL006(read-only constant table — never mutated at runtime)
 SERVERS = {
     "worker": WorkerServer,
     "validator": ValidatorServer,

@@ -104,6 +104,7 @@ class BaseNode:
         if self._ml_thread is not None:
             try:
                 self.queues.work.put(("_stop", None))
+            # tlint: disable=TL005(ring closed by a dead peer / full — the join below is the real stop)
             except (OSError, EOFError, queue_mod.Full):
                 pass  # ring closed by a dead peer / full — join regardless
             self._ml_thread.join(timeout=10)
@@ -111,6 +112,7 @@ class BaseNode:
         if self._proc is not None:
             try:
                 self.queues.cmd.put((0, "_stop", None))
+            # tlint: disable=TL005(network process already gone — the join below is the real stop)
             except (OSError, EOFError, queue_mod.Full):
                 pass
             self._proc.join(timeout=10)
@@ -123,6 +125,7 @@ class BaseNode:
             if release is not None:
                 try:
                     release()
+                # tlint: disable=TL005(teardown of shm rings whose peer may have released first)
                 except Exception:
                     pass
 

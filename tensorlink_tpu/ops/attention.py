@@ -126,6 +126,7 @@ def _flash_kernel(
         )
 
 
+# tlint: hot-path
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_q", "block_k", "interpret", "window"),
@@ -212,6 +213,7 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
+# tlint: hot-path
 def paged_attention_ref(
     q: jax.Array,  # [S, Hq, hd] — one query token per slot
     k_pages: jax.Array,  # [P, Hkv, page, hd]
@@ -264,6 +266,7 @@ def paged_attention_ref(
     return out.reshape(S, Hq, hd).astype(q.dtype)
 
 
+# tlint: hot-path
 def paged_prefill_attention_ref(
     q: jax.Array,  # [C, Hq, hd] — one slot's prefill-chunk queries
     k_pages: jax.Array,  # [P, Hkv, page, hd]
@@ -387,6 +390,7 @@ def _paged_prefill_kernel(
         )
 
 
+# tlint: hot-path
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def paged_prefill_attention(
     q: jax.Array,  # [C, Hq, hd]

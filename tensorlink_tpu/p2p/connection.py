@@ -54,7 +54,9 @@ class Connection:
         self.last_seen = time.monotonic()
         self.latency_s: float | None = None
         self.ghosts = 0  # unexpected-message counter (reference connection.py:60)
-        self.bytes_sent = 0
+        # the write lock serializes frame writes AND the sent counter —
+        # concurrent senders would interleave header/payload on the wire
+        self.bytes_sent = 0  #: guarded by self._wlock
         self.bytes_received = 0
         self.closed = asyncio.Event()
         self._wlock = asyncio.Lock()
@@ -191,6 +193,7 @@ class Connection:
                         await self.send_control(proto.PING, {})
                     except (ConnectionError, OSError):
                         break
+        # tlint: disable=TL005(task cancellation is the ping loop's normal shutdown signal)
         except asyncio.CancelledError:
             pass
 
@@ -213,6 +216,7 @@ class Connection:
         try:
             self.writer.close()
             await self.writer.wait_closed()
+        # tlint: disable=TL005(closing an already-dead transport)
         except (ConnectionError, OSError):
             pass
 
@@ -226,9 +230,11 @@ def cleanup_spill(spill_dir: str | Path, max_age_s: float = 3600) -> int:
     n = 0
     for p in d.glob("rx_*.tlts"):
         try:
+            # tlint: disable=TL004(st_mtime is epoch — wall clock is the only comparable base)
             if now - p.stat().st_mtime > max_age_s:
                 p.unlink()
                 n += 1
+        # tlint: disable=TL005(spill sweep races the consumer unlinking its own file)
         except OSError:
             pass
     return n

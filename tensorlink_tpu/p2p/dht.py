@@ -129,9 +129,11 @@ class DHT:
         t = time.time() if ts is None else ts
         dead = self.tombstones.get(key)
         if dead is not None:
+            # tlint: disable=TL004(LWW origin timestamps are cross-node epoch stamps)
             if ts is not None and t <= dead:
                 return  # the record was deleted after this write happened
             del self.tombstones[key]  # genuinely re-created
+        # tlint: disable=TL004(LWW origin timestamps are cross-node epoch stamps)
         if ts is not None and self.updated_at.get(key, -1.0) > t:
             return  # a newer live record wins
         self.store_map[key] = value
@@ -142,14 +144,16 @@ class DHT:
         it back. Returns True if local state changed (used by the relay to
         terminate the delete flood)."""
         t = time.time() if ts is None else ts
+        # tlint: disable=TL004(LWW origin timestamps are cross-node epoch stamps)
         if ts is not None and self.updated_at.get(key, -1.0) > t:
             return False  # a newer write beats this replicated delete
         existed = self.store_map.pop(key, None) is not None
         self.updated_at.pop(key, None)
         prev = self.tombstones.get(key, -1.0)
+        # tlint: disable=TL004(LWW origin timestamps are cross-node epoch stamps)
         if t > prev:
             self.tombstones[key] = t
-        return existed or t > prev
+        return existed or t > prev  # tlint: disable=TL004(LWW epoch stamps)
 
     def get_local(self, key: str) -> Any:
         return self.store_map.get(key)
@@ -165,6 +169,7 @@ class DHT:
         under ``prefixes``."""
         now = time.time()
         for k in [
+            # tlint: disable=TL004(tombstone TTL compares cross-node epoch stamps)
             k for k, t in self.tombstones.items() if now - t > TOMBSTONE_TTL_S
         ]:
             del self.tombstones[k]
@@ -239,6 +244,7 @@ class DHT:
                 result = await asyncio.wait_for(
                     self.forward(peer, key, hops), timeout
                 )
+            # tlint: disable=TL005(the continue IS the reroute — the next nearest peer is tried)
             except (asyncio.TimeoutError, ConnectionError, OSError):
                 continue
             if result is None:

@@ -96,10 +96,11 @@ class P2PNode:
         # count of credential-check threads abandoned mid-RPC (slow-drip
         # registry endpoints) — each holds one daemon thread + socket until
         # the RPC's 1 MB read cap runs out; exposed for observability
-        self._cred_abandoned = 0
+        self._cred_abandoned = 0  #: guarded by the node event loop
         # outstanding credential-check threads; bounded so hostile traffic
-        # from many IPs cannot accumulate dripping threads without limit
-        self._cred_live = 0
+        # from many IPs cannot accumulate dripping threads without limit —
+        # incremented on the loop, decremented from the check threads
+        self._cred_live = 0  #: guarded by self._cred_lock
         self._cred_lock = threading.Lock()
         self.handlers: dict[str, Handler] = {}
         self.started = threading.Event()
@@ -242,6 +243,7 @@ class P2PNode:
         def deliver(cb) -> None:
             try:
                 loop.call_soon_threadsafe(cb)
+            # tlint: disable=TL005(loop already closed while the node stops — the result is moot)
             except RuntimeError:
                 pass  # loop already closed (node stopping) — result moot
 
@@ -528,6 +530,7 @@ class P2PNode:
             if peer is not None:
                 try:
                     await peer.send_control(tag, body)
+                # tlint: disable=TL005(best-effort fanout — a dead validator peer re-syncs via anti-entropy)
                 except (ConnectionError, OSError):
                     pass
 
@@ -643,6 +646,7 @@ class P2PNode:
                         if pid and addr and pid != self.node_id and pid not in self.connections:
                             try:
                                 await self.connect(addr[0], addr[1])
+                            # tlint: disable=TL005(bootstrap keeps trying other advertised peers; the outer seed loop logs)
                             except (OSError, HandshakeError, asyncio.TimeoutError):
                                 pass
                 except (OSError, HandshakeError, asyncio.TimeoutError, ConnectionError) as e:

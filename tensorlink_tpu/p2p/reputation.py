@@ -18,6 +18,7 @@ import time
 # event -> score delta. Magnitudes are relative to BAN_THRESHOLD: one failed
 # job is forgivable, three in a half-life window are not; ghost frames only
 # ban at sustained-flood volume.
+# tlint: disable=TL006(read-only constant table — never mutated at runtime)
 EVENT_WEIGHTS = {
     "handshake_ok": 0.5,
     "ghost": -1.0,  # unparseable/unexpected frame
@@ -85,6 +86,7 @@ class ReputationTracker:
             try:
                 self._scores[nid] = float(e["score"])
                 self._at[nid] = float(e["ts"])
+            # tlint: disable=TL005(malformed persisted entry — skip it, keep the rest of the snapshot)
             except (KeyError, TypeError, ValueError):
                 continue
 

@@ -197,6 +197,7 @@ class PortMapper:
         for port, protocol in self.mapped:
             try:
                 delete_port_mapping(self.gateway, port, protocol)
+            # tlint: disable=TL005(unmapping at close — the gateway may already be gone; mappings expire anyway)
             except (UPnPError, OSError):
                 pass
         self.mapped.clear()

@@ -32,6 +32,7 @@ from ..models.base import ModelConfig
 from ..models.transformer import cache_specs, partition_specs
 
 MAX_STAGES = 6  # reference ml/validator.py:427-430
+# tlint: disable=TL006(read-only constant table — never mutated at runtime)
 _DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2, "float8_e4m3fn": 1}
 
 

@@ -54,6 +54,7 @@ class ChainError(Exception):
 # ---------------------------------------------------------------------------
 
 _M64 = (1 << 64) - 1
+# tlint: disable=TL006(Keccak round constants — read-only)
 _RC = [
     0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
     0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
@@ -65,6 +66,7 @@ _RC = [
     0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
 ]
 # rotation offsets r[x][y]
+# tlint: disable=TL006(Keccak rotation offsets — read-only)
 _ROT = [
     [0, 36, 3, 41, 18],
     [1, 44, 10, 45, 2],

@@ -132,6 +132,7 @@ class ContractManager:
         Jobs restored after a validator restart carry ``t0_restored`` so
         downtime is never credited as served capacity."""
         t0 = float(job.get("t0_restored") or job.get("t0", time.time()))
+        # tlint: disable=TL004(job t0 is persisted/replicated — epoch is the record's clock)
         dt = max((ended or time.time()) - t0, 0.0)
         stage_bytes = job.get("stage_bytes", {})
         for s in job.get("plan", {}).get("stages", []):

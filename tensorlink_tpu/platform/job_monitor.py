@@ -45,6 +45,7 @@ class JobMonitor:
             status = job.get("status", "active")
             if status in ("failed", "completed"):
                 continue
+            # tlint: disable=TL004(job t0 is persisted/replicated — epoch is the record's clock)
             if now - job.get("t0", now) > FREE_JOB_MAX_TIME:
                 await self._finish(job_id, job, "completed")
                 continue
@@ -59,6 +60,7 @@ class JobMonitor:
                 # healthy job: periodically verify proof-of-learning logs
                 # (reference PoL hooks exist but are commented out,
                 # job_monitor.py:193-207 — here a bad log costs reputation)
+                # tlint: disable=TL004(pol.ts rides the persisted job record — epoch)
                 if now - job.get("pol", {}).get("ts", 0.0) > PROOF_INTERVAL:
                     # fire-and-forget: the pull awaits per-worker replies
                     # (10 s timeouts) and must never stall this tick's
@@ -76,6 +78,7 @@ class JobMonitor:
                 continue
             job.setdefault("offline_since", now)
             job["status"] = "pending_offline"
+            # tlint: disable=TL004(offline_since rides the persisted job record — epoch)
             if now - job["offline_since"] < self.grace:
                 continue
             if job.get("repairs", 0) >= MAX_REPAIRS_PER_JOB:
@@ -92,6 +95,7 @@ class JobMonitor:
             if ok:
                 job["status"] = "active"
                 job.pop("offline_since", None)
+            # tlint: disable=TL004(offline_since rides the persisted job record — epoch)
             elif now - job["offline_since"] > 6 * self.grace:
                 await self._finish(job_id, job, "failed")
 

@@ -86,10 +86,12 @@ class Keeper:
         now = time.time()
         state["peers"] = {
             k: v for k, v in state.get("peers", {}).items()
+            # tlint: disable=TL004(restored-state freshness vs persisted epoch stamps)
             if now - float(v.get("ts", 0)) < NODE_MAX_AGE
         }
         state["jobs"] = {
             k: v for k, v in state.get("jobs", {}).items()
+            # tlint: disable=TL004(restored-state freshness vs persisted epoch stamps)
             if now - float(v.get("ts", 0)) < JOB_MAX_AGE
         }
         self.daily = state.get("daily", {})

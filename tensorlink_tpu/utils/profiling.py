@@ -48,6 +48,7 @@ def device_memory() -> list[dict[str, Any]]:
         stats: dict[str, Any] = {}
         try:
             stats = d.memory_stats() or {}
+        # tlint: disable=TL005(memory_stats is backend-optional; CPU backends report nothing)
         except Exception:
             pass
         out.append(

@@ -55,8 +55,8 @@ def api_cluster(tmp_path_factory):
         WorkerConfig(seed_validators=[["127.0.0.1", validator.port]],
                      **{**common, "key_dir": str(tmp / "keys2")})
     ).start()
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
         if len(validator.status()["peers"]) >= 2:
             break
         time.sleep(0.2)
@@ -498,7 +498,7 @@ def test_beam_search_no_head_of_line_blocking(api_cluster):
                       "max_new_tokens": 200, "do_sample": False,
                       "num_beams": 4})
         assert st == 200, b
-        done_at["beam"] = time.time()
+        done_at["beam"] = time.monotonic()
 
     t = threading.Thread(target=beam)
     t.start()
@@ -508,7 +508,7 @@ def test_beam_search_no_head_of_line_blocking(api_cluster):
                  {"hf_name": MODEL, "message": "quick",
                   "max_new_tokens": 4, "do_sample": False})
     assert st == 200, b
-    done_at["quick"] = time.time()
+    done_at["quick"] = time.monotonic()
     t.join(timeout=120)
     assert "beam" in done_at, "beam request never completed"
     if not in_flight:

@@ -75,8 +75,8 @@ def _cluster(tmp_path, n_workers=3, worker_faults=None, user_faults=None):
     user = UserNode(UserConfig(
         seed_validators=seeds, faults=user_faults or {}, **common
     )).start()
-    deadline = time.time() + 15
-    while time.time() < deadline:
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
         if len(validator.status()["peers"]) >= n_workers + 1:
             break
         time.sleep(0.2)
@@ -438,8 +438,8 @@ def test_stop_cancel_bounds_compiled_chunk_overrun(tmp_path):
     )).start()
     user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
     try:
-        deadline = time.time() + 15
-        while time.time() < deadline:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
             if len(validator.status()["peers"]) >= 2:
                 break
             time.sleep(0.2)

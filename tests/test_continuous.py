@@ -112,7 +112,14 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
     request mix — ragged lengths, admissions, evictions and knob mixes are
     all DATA to the one slot-batched program."""
     eng = tiny_engine
+    # jit caches are PROCESS-global (module-level jitted functions in
+    # engine/paged.py) — any earlier test module that served a different
+    # model config leaves its programs in the same cache, so an absolute
+    # `decode_chunk == 1` was order-dependent (failed at tier-1 position,
+    # passed solo; tlint TL006's leak class). Count THIS engine's
+    # contribution as a delta from the process state at test start.
     ce = _cont(eng)
+    pre = ce.jit_cache_sizes()  # before this engine compiled anything
     ce.submit([1], max_new_tokens=3)
     ce.run_until_idle()
     base = ce.jit_cache_sizes()
@@ -129,7 +136,13 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
     assert all(r.finished for r in [*reqs, late])
     after = ce.jit_cache_sizes()
     assert after == base, (base, after)
-    assert after["decode_chunk"] == 1  # ONE slot-batched decode program
+    # at most ONE slot-batched decode compile across this whole test —
+    # zero when an earlier test already compiled the same-shaped program
+    # (same process-global cache, same tiny config: even this module's
+    # own earlier tests do), one when this test ran first. The teeth are
+    # the delta bound + `after == base` above: request-mix churn never
+    # adds a program (delta, not absolute — the order-dependence note)
+    assert 0 <= after["decode_chunk"] - pre["decode_chunk"] <= 1
     # chunked prefill + prefix cache must not add per-mix compiles either:
     # once every feature program has fired ONCE (prefill chunk at base,
     # COW copy on the first divergent hit), multi-chunk prompts, cache
@@ -265,6 +278,7 @@ def test_continuous_batcher_local_engine(tiny_engine):
 # ---------------------------------------------------------------------------
 # automatic prefix caching + chunked prefill
 # ---------------------------------------------------------------------------
+# tlint: disable=TL006(read-only shared-prompt fixture data)
 SYS = [7, 3, 9, 11, 2, 5, 8, 1, 4, 6, 10, 12, 7, 9, 3, 5, 2, 8, 11, 1]
 
 

@@ -128,8 +128,8 @@ def test_coslice_merged_mesh_training(tmp_path):
     model = None
     try:
         # both children must be up (jax.distributed blocks until both join)
-        deadline = time.time() + 120
-        while time.time() < deadline:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
             stats = validator.send_request("stats_workers", timeout=15.0)
             if len(stats) == 2 and all(
                 s.get("slice_id") == "testpod:0" for s in stats

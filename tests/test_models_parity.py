@@ -12,6 +12,7 @@ import pytest
 import jax.numpy as jnp
 
 
+# tlint: disable=TL006(read-only parametrize table)
 FAMILIES = {
     "gpt2": dict(
         cls="GPT2LMHeadModel",

@@ -42,10 +42,10 @@ def test_roundtrip_objects():
 def test_get_timeout_raises_empty():
     ch = RingChannel(1 << 16)
     try:
-        t0 = time.time()
+        t0 = time.monotonic()
         with pytest.raises(queue.Empty):
             ch.get(timeout=0.2)
-        assert 0.1 < time.time() - t0 < 2.0
+        assert 0.1 < time.monotonic() - t0 < 2.0
     finally:
         ch.release()
 

@@ -59,8 +59,8 @@ def cluster(tmp_path_factory):
     ).start()
     user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
     # let the mesh settle
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
         peers = validator.status()["peers"]
         if len(peers) >= 3:
             break
@@ -378,8 +378,8 @@ def test_job_placed_via_second_validator(tmp_path):
         UserConfig(seed_validators=[["127.0.0.1", v1.port]], **common("u"))
     ).start()
     try:
-        deadline = time.time() + 10
-        while time.time() < deadline:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
             peers = v2.status()["peers"]
             if len(peers) >= 2:  # v1 + worker
                 break
@@ -389,8 +389,8 @@ def test_job_placed_via_second_validator(tmp_path):
         for pid, p in v1.status()["peers"].items():
             if p["role"] == "worker":
                 assert v1.send_request("disconnect", {"peer": pid})
-        deadline = time.time() + 5
-        while time.time() < deadline and any(
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
             p["role"] == "worker" for p in v1.status()["peers"].values()
         ):
             time.sleep(0.1)

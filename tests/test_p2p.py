@@ -108,7 +108,7 @@ def test_dht_xor_routing_metric():
 def test_dht_tombstones_block_resurrection():
     """A deleted replicated record must not come back via anti-entropy: the
     tombstone outlives the record, beats older writes, and ships to peers."""
-    t0 = time.time()
+    t0 = time.monotonic()
     d = DHT("00" * 32)
     d.store("job:x", {"v": 1}, ts=t0 - 30)
     assert d.delete("job:x", ts=t0 - 20)
@@ -140,7 +140,7 @@ def test_dht_query_cache_respects_tombstones():
     """A stale copy fetched from a lagging peer must not resurrect a
     tombstoned record: the remote answer caches with its ORIGIN ts, which
     loses to the newer local tombstone."""
-    t0 = time.time()
+    t0 = time.monotonic()
 
     async def forward(peer, key, hops=0):
         return {"v": "stale"}, t0 - 30  # (value, origin_ts)
@@ -221,8 +221,8 @@ def trio(tmp_path):
 
 
 def _wait(pred, timeout=5.0):
-    t0 = time.time()
-    while time.time() - t0 < timeout:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
         if pred():
             return True
         time.sleep(0.05)

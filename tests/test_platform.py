@@ -57,6 +57,7 @@ def test_keeper_write_and_restore(tmp_path):
 
 def test_keeper_age_filters(tmp_path):
     k = Keeper(tmp_path / "state.json")
+    # tlint: disable=TL004(fabricating a stale epoch stamp for the keeper age filter)
     old = time.time() - 10 * 86400
     node = _fake_node(jobs={"old": {"t0": old, "ts": old}})
     state = k.write_state(node)
@@ -107,6 +108,7 @@ def test_merkle_proof_roundtrip():
 def test_proposal_lifecycle_and_claims():
     cm = ContractManager("val0", quorum=0.5)
     job = {
+        # tlint: disable=TL004(fabricating an epoch job t0 for contract accounting)
         "t0": time.time() - 100.0,
         "plan": {"stages": [{"worker_id": "wA"}, {"worker_id": "wB"}]},
         "stage_bytes": {"wA": 1000.0, "wB": 500.0},

@@ -173,8 +173,8 @@ def cluster(tmp_path_factory):
     seeds = [["127.0.0.1", validator.port]]
     worker = WorkerNode(WorkerConfig(seed_validators=seeds, **common)).start()
     user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
         if len(validator.status()["peers"]) >= 2:
             break
         time.sleep(0.2)

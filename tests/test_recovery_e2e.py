@@ -53,8 +53,8 @@ def cluster(tmp_path):
         WorkerConfig(seed_validators=seeds, duplicate="1", **common)
     ).start()
     user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
         if len(validator.status()["peers"]) >= 3:
             break
         time.sleep(0.2)
@@ -109,9 +109,9 @@ def test_monitor_pushes_replacement(cluster):
     assert model.plan.stages[0].worker_id == w1.node_id
     w1.stop()
 
-    deadline = time.time() + 30
+    deadline = time.monotonic() + 30
     applied = 0
-    while time.time() < deadline and not applied:
+    while time.monotonic() < deadline and not applied:
         applied = model.poll_job_updates()
         time.sleep(0.5)
     assert applied == 1
@@ -154,8 +154,8 @@ def test_validator_failover_repair(tmp_path):
     user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
     try:
         # wait until everyone discovered the second validator via PEERS
-        deadline = time.time() + 15
-        while time.time() < deadline:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
             vs = user.send_request("validators")
             ws = v2.status()["peers"]
             if len(vs) >= 2 and sum(
@@ -174,8 +174,8 @@ def test_validator_failover_repair(tmp_path):
         out_before = model(toks)
 
         # the job record must have replicated to v2 before the failover
-        deadline = time.time() + 10
-        while time.time() < deadline:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
             if v2.send_request("dht_get", {"key": f"job:{model.job_id}"}):
                 break
             time.sleep(0.2)
@@ -228,9 +228,9 @@ def test_contract_round_and_claim(cluster):
 def test_keeper_persistence_across_restart(cluster, tmp_path):
     """The validator snapshots state; /network-history reflects stats."""
     validator = cluster["validator"]
-    deadline = time.time() + 15
+    deadline = time.monotonic() + 15
     hist = {}
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         hist = validator.send_request("network_history")
         if hist.get("daily", {}).get("labels"):
             break

@@ -39,7 +39,9 @@ def test_roundtrip_nested():
 def test_roundtrip_bfloat16():
     import jax.numpy as jnp
 
-    x = jnp.asarray(np.random.randn(16, 8), dtype=jnp.bfloat16)
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((16, 8)), dtype=jnp.bfloat16
+    )
     out = ser.decode(ser.encode({"w": x}))
     np.testing.assert_array_equal(np.asarray(x), out["w"])
     assert str(out["w"].dtype) == "bfloat16"
@@ -91,7 +93,12 @@ def test_struct_registry():
 
 
 def test_shared_memory_roundtrip():
-    obj = {"t": np.random.randn(32, 32).astype(np.float32), "tag": "fwd"}
+    obj = {
+        "t": np.random.default_rng(1)
+        .standard_normal((32, 32))
+        .astype(np.float32),
+        "tag": "fwd",
+    }
     size, name = shm.store(obj)
     out = shm.load(size, name)
     np.testing.assert_array_equal(obj["t"], out["t"])

@@ -38,6 +38,7 @@ def tiny_cfg(**kw):
     return ModelConfig(**base)
 
 
+# tlint: disable=TL006(read-only parametrize table)
 BOUNDARIES = [(0, 2, 4)]  # two stages: layers [0,2) and [2,4)
 
 

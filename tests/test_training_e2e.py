@@ -52,8 +52,8 @@ def cluster(tmp_path_factory):
     user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
     import time
 
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
         if len(validator.status()["peers"]) >= 3:
             break
         time.sleep(0.2)

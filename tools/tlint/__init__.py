@@ -1,0 +1,42 @@
+"""tlint — project-native static analysis for tensorlink-tpu.
+
+Seven AST rules enforcing the coding disciplines the runtime contracts
+depend on (docs/STATIC_ANALYSIS.md):
+
+- TL001 guarded-by: ``#: guarded by self._lock`` attributes only under
+  the lock (or in ``# tlint: holds-lock`` methods).
+- TL002 no-blocking-under-lock: no socket I/O, un-timed queue ops,
+  sleeps, RPCs, or device syncs while holding a thread lock.
+- TL003 hot-path-sync: ``# tlint: hot-path`` functions never host-sync.
+- TL004 monotonic-durations: elapsed time uses ``time.monotonic()``.
+- TL005 no-swallowed-exceptions: no ``except: pass``-only handlers.
+- TL006 mutable-module-global: no leakable module-level mutable state.
+- TL007 unseeded-rng: no process-global RNG in ``engine/`` or ``tests/``.
+
+Run: ``python -m tools.tlint tensorlink_tpu tests`` (blocking in CI).
+"""
+
+from .context import FileContext
+from .engine import (
+    DEFAULT_BASELINE,
+    Report,
+    check_source,
+    format_report,
+    load_baseline,
+    main,
+    run,
+)
+from .rules import RULES, Violation
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "FileContext",
+    "RULES",
+    "Report",
+    "Violation",
+    "check_source",
+    "format_report",
+    "load_baseline",
+    "main",
+    "run",
+]

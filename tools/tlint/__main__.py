@@ -1,0 +1,7 @@
+"""``python -m tools.tlint [paths...]`` — the CI entry point."""
+
+import sys
+
+from .engine import main
+
+sys.exit(main())

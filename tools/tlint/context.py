@@ -1,0 +1,224 @@
+"""Per-file analysis context: source, AST, comments, annotations.
+
+Everything the rules need that plain ``ast`` does not give them lives
+here — comments (via ``tokenize``, so strings containing ``# tlint:``
+never fool the parser), the ``# tlint:`` marker/suppression grammar, and
+the ``#: guarded by`` attribute annotations (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# -- the comment grammar ----------------------------------------------------
+# Suppressions: "# tlint: disable=TL004(reason), TL005(other reason)".
+# The reason is REQUIRED — a bare "disable=TL004" is itself reported
+# (TL000) so silencing the analyzer always leaves a paper trail.
+_SUPPRESS_RE = re.compile(r"#\s*tlint:\s*disable=(?P<items>.+)$")
+_SUPPRESS_ITEM_RE = re.compile(r"(?P<rule>TL\d{3})(?:\((?P<reason>[^)]*)\))?")
+
+# Function markers (on the ``def`` line or the line directly above):
+#   # tlint: hot-path                 -> TL003 applies to this function
+#   # tlint: holds-lock(self._lock)   -> caller holds the lock (TL001 ok,
+#                                        TL002 treats the body as locked)
+#   # tlint: on-loop                  -> runs on the owning event loop
+_MARKER_RE = re.compile(
+    r"#\s*tlint:\s*(?P<kind>hot-path|on-loop|holds-lock)"
+    r"(?:\((?P<arg>[^)]*)\))?"
+)
+
+# Guarded-attribute annotation, on an attribute assignment line (or the
+# standalone comment line above it):
+#   self.sched = ...  #: guarded by self._lock
+#   self._inflight = 0  #: guarded by the event loop
+_GUARD_RE = re.compile(r"#:\s*guarded by\s+(?P<guard>.+?)\s*$")
+_GUARD_SELF_RE = re.compile(r"^self\.(?P<attr>\w+)$")
+
+
+@dataclass
+class Suppression:
+    rule: str
+    reason: str
+    line: int
+    used: bool = False
+
+
+@dataclass
+class Marker:
+    kind: str  # hot-path | on-loop | holds-lock
+    arg: str  # holds-lock's lock expression, e.g. "self._lock"
+    line: int
+
+
+@dataclass
+class Guard:
+    """What protects a ``#: guarded by`` attribute.
+
+    - ``lock``: an attribute of the same object (``self._lock``) — access
+      requires a lexically-enclosing ``with self._lock:`` (or holds-lock).
+    - ``loop``: event-loop confinement — access only from coroutines of
+      the class (or ``# tlint: on-loop`` methods).
+    - ``external``: a lock the CALLER holds (e.g. the engine lock around
+      RequestScheduler) — every touching method must declare the contract
+      with ``# tlint: holds-lock(...)``.
+    """
+
+    kind: str  # "lock" | "loop" | "external"
+    lock_attr: str | None  # X for kind == "lock"
+    raw: str
+    line: int
+
+
+@dataclass
+class FileContext:
+    rel: str  # repo-relative posix path (reporting + baseline identity)
+    source: str
+    tree: ast.Module = None
+    lines: list[str] = field(default_factory=list)
+    comments: dict[int, str] = field(default_factory=dict)  # line -> text
+    suppressions: dict[int, list[Suppression]] = field(default_factory=dict)
+    bad_suppressions: list[Suppression] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, rel: str, source: str) -> "FileContext":
+        ctx = cls(rel=rel, source=source)
+        ctx.tree = ast.parse(source)
+        ctx.lines = source.splitlines()
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in toks:
+                if tok.type == tokenize.COMMENT:
+                    ctx.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # unterminated constructs: best effort
+            pass
+        for line, text in ctx.comments.items():
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            for item in _SUPPRESS_ITEM_RE.finditer(m.group("items")):
+                sup = Suppression(
+                    rule=item.group("rule"),
+                    reason=(item.group("reason") or "").strip(),
+                    line=line,
+                )
+                if sup.reason:
+                    ctx.suppressions.setdefault(line, []).append(sup)
+                else:
+                    ctx.bad_suppressions.append(sup)
+        return ctx
+
+    # -- suppression lookup -------------------------------------------------
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A violation at ``line`` is suppressed by a reasoned disable
+        comment on the same line, or on a standalone comment line directly
+        above it."""
+        for cand in (line, line - 1):
+            for sup in self.suppressions.get(cand, ()):
+                if sup.rule != rule:
+                    continue
+                if cand == line - 1 and not self._standalone_comment(cand):
+                    continue
+                sup.used = True
+                return True
+        return False
+
+    def _standalone_comment(self, line: int) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        return self.lines[line - 1].lstrip().startswith("#")
+
+    # -- markers ------------------------------------------------------------
+    def markers_for_def(self, node: ast.AST) -> list[Marker]:
+        """``# tlint:`` markers attached to a function: on any decorator
+        line, the ``def`` line, or the standalone comment line above."""
+        first = min(
+            [node.lineno] + [d.lineno for d in getattr(node, "decorator_list", [])]
+        )
+        out: list[Marker] = []
+        lines = {node.lineno, first, first - 1}
+        for ln in sorted(lines):
+            text = self.comments.get(ln)
+            if not text:
+                continue
+            if ln == first - 1 and not self._standalone_comment(ln):
+                continue
+            for m in _MARKER_RE.finditer(text):
+                out.append(
+                    Marker(
+                        kind=m.group("kind"),
+                        arg=(m.group("arg") or "").strip(),
+                        line=ln,
+                    )
+                )
+        return out
+
+    # -- guarded-by annotations ----------------------------------------------
+    def class_guards(self, cls: ast.ClassDef) -> dict[str, Guard]:
+        """``attr name -> Guard`` for every ``#: guarded by`` annotation in
+        the class body: attribute assignments (``self.x = ...``) in any
+        method, or class-level ``x: T`` declarations."""
+        guards: dict[str, Guard] = {}
+
+        def note(attr: str, line: int) -> None:
+            for ln in (line, line - 1):
+                text = self.comments.get(ln)
+                if not text:
+                    continue
+                if ln == line - 1 and not self._standalone_comment(ln):
+                    continue
+                g = _GUARD_RE.search(text)
+                if not g:
+                    continue
+                guards[attr] = _parse_guard(g.group("guard"), ln)
+                return
+
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                note(stmt.target.id, stmt.lineno)
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        note(t.attr, node.lineno)
+        return guards
+
+
+def _parse_guard(raw: str, line: int) -> Guard:
+    raw = raw.strip()
+    m = _GUARD_SELF_RE.match(raw)
+    if m:
+        return Guard(kind="lock", lock_attr=m.group("attr"), raw=raw, line=line)
+    if "loop" in raw.lower():
+        # loop confinement ("the event loop", "node loop"): only
+        # coroutines (or # tlint: on-loop methods) of the class may touch
+        # the attribute
+        return Guard(kind="loop", lock_attr=None, raw=raw, line=line)
+    # anything else ("the engine lock", "caller's lock") is a lock held by
+    # the CALLER — touching methods must carry # tlint: holds-lock(...)
+    return Guard(kind="external", lock_attr=None, raw=raw, line=line)
+
+
+def scope_name(stack: list[ast.AST]) -> str:
+    """Dotted scope for reporting/baseline identity: ``Class.method`` /
+    ``outer.inner`` / ``<module>``."""
+    parts = [
+        n.name
+        for n in stack
+        if isinstance(n, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    return ".".join(parts) if parts else "<module>"

@@ -1,0 +1,265 @@
+"""tlint driver: walk files, run rules, apply suppressions + baseline.
+
+Exit contract (the CI gate): 0 iff every violation is either inline-
+suppressed with a reason or matched by a baseline entry, and no
+suppression is missing its reason. Stale baseline entries (matching
+nothing anymore) are warnings — they mean a deferred violation got
+fixed and the entry should be deleted.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .context import FileContext
+from .rules import RULES, Violation
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv"}
+
+
+@dataclass
+class Report:
+    violations: list[Violation] = field(default_factory=list)  # actionable
+    baselined: list[Violation] = field(default_factory=list)
+    suppressed_count: int = 0
+    bad_suppressions: list[tuple[str, int, str]] = field(default_factory=list)
+    stale_baseline: list[dict] = field(default_factory=list)
+    parse_errors: list[tuple[str, str]] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.violations or self.bad_suppressions)
+
+
+def iter_py_files(paths: list[Path]):
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    yield f
+
+
+def _relpath(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def check_source(
+    source: str, rel: str, rules: dict | None = None
+) -> tuple[list[Violation], FileContext]:
+    """Run the rules over one in-memory file. Returns violations that are
+    NOT inline-suppressed (baseline is the caller's business) plus the
+    context (for suppression bookkeeping). The unit the fixture tests
+    drive."""
+    ctx = FileContext.parse(rel, source)
+    out: list[Violation] = []
+    for rule_fn in (rules or RULES).values():
+        for v in rule_fn(ctx):
+            if not ctx.suppressed(v.rule, v.line):
+                out.append(v)
+    out.sort(key=lambda v: (v.rel, v.line, v.col, v.rule))
+    return out, ctx
+
+
+def load_baseline(path: Path) -> list[dict]:
+    """Baseline entries: ``{rule, file, scope, symbol, reason}``. Every
+    entry must carry a non-empty reason — the baseline is a record of
+    DELIBERATELY deferred violations, not a mute button."""
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    entries = data.get("violations", data if isinstance(data, list) else [])
+    for e in entries:
+        missing = [
+            k for k in ("rule", "file", "scope", "symbol", "reason") if k not in e
+        ]
+        if missing:
+            raise ValueError(
+                f"baseline entry {e!r} is missing {', '.join(missing)}"
+            )
+        if not str(e["reason"]).strip():
+            raise ValueError(f"baseline entry {e!r} has an empty reason")
+    return entries
+
+
+def _baseline_match(v: Violation, entries: list[dict]) -> dict | None:
+    for e in entries:
+        if (
+            e["rule"] == v.rule
+            and e["file"] == v.rel
+            and e["scope"] == v.scope
+            and e["symbol"] == v.symbol
+        ):
+            return e
+    return None
+
+
+def run(
+    paths: list[Path],
+    *,
+    baseline_path: Path | None = DEFAULT_BASELINE,
+    rules: dict | None = None,
+) -> Report:
+    rep = Report()
+    entries = load_baseline(baseline_path) if baseline_path else []
+    matched_entries: set[int] = set()
+    for f in iter_py_files(paths):
+        rel = _relpath(f)
+        try:
+            source = f.read_text()
+            ctx = FileContext.parse(rel, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            rep.parse_errors.append((rel, str(e)))
+            continue
+        rep.files_checked += 1
+        for rule_fn in (rules or RULES).values():
+            for v in rule_fn(ctx):
+                if ctx.suppressed(v.rule, v.line):
+                    rep.suppressed_count += 1
+                    continue
+                entry = _baseline_match(v, entries)
+                if entry is not None:
+                    matched_entries.add(id(entry))
+                    rep.baselined.append(v)
+                    continue
+                rep.violations.append(v)
+        for sup in ctx.bad_suppressions:
+            rep.bad_suppressions.append(
+                (
+                    rel,
+                    sup.line,
+                    f"suppression of {sup.rule} without a reason — write "
+                    f"`# tlint: disable={sup.rule}(why this is safe)`",
+                )
+            )
+    rep.stale_baseline = [e for e in entries if id(e) not in matched_entries]
+    rep.violations.sort(key=lambda v: (v.rel, v.line, v.col, v.rule))
+    return rep
+
+
+def format_report(rep: Report, *, verbose: bool = False) -> str:
+    lines: list[str] = []
+    for rel, err in rep.parse_errors:
+        lines.append(f"{rel}: parse error: {err}")
+    for v in rep.violations:
+        lines.append(f"{v.rel}:{v.line}:{v.col + 1}: {v.rule} {v.message}")
+    for rel, line, msg in rep.bad_suppressions:
+        lines.append(f"{rel}:{line}:1: TL000 {msg}")
+    if verbose:
+        for v in rep.baselined:
+            lines.append(
+                f"{v.rel}:{v.line}:{v.col + 1}: {v.rule} [baselined] "
+                f"{v.message}"
+            )
+    for e in rep.stale_baseline:
+        lines.append(
+            f"warning: stale baseline entry {e['rule']} {e['file']} "
+            f"{e['scope']} {e['symbol']} — the violation is gone; delete "
+            "the entry"
+        )
+    n_bad = len(rep.violations) + len(rep.bad_suppressions)
+    lines.append(
+        f"tlint: {rep.files_checked} files, {n_bad} violation(s), "
+        f"{len(rep.baselined)} baselined, {rep.suppressed_count} suppressed"
+        + (f", {len(rep.stale_baseline)} stale baseline entr(ies)"
+           if rep.stale_baseline else "")
+    )
+    return "\n".join(lines)
+
+
+def write_baseline(rep: Report, path: Path) -> int:
+    """Record every current actionable violation as a deferred baseline
+    entry (reason = TODO placeholder the author must fill in — the
+    loader rejects empty reasons, so a freshly written baseline fails
+    until each entry is justified)."""
+    seen = set()
+    entries = []
+    for v in rep.violations:
+        k = v.key()
+        if k in seen:
+            continue
+        seen.add(k)
+        entries.append(
+            {
+                "rule": v.rule,
+                "file": v.rel,
+                "scope": v.scope,
+                "symbol": v.symbol,
+                "reason": "",
+            }
+        )
+    path.write_text(json.dumps({"violations": entries}, indent=2) + "\n")
+    return len(entries)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tlint",
+        description="project-native static analysis (TL001-TL007)",
+    )
+    ap.add_argument("paths", nargs="*", default=["tensorlink_tpu", "tests"])
+    ap.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help="baseline JSON of deferred violations",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true", help="ignore the baseline"
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current violations as baseline entries (reasons left "
+        "empty for the author to fill in) and exit",
+    )
+    ap.add_argument(
+        "--verbose", action="store_true", help="also print baselined hits"
+    )
+    ap.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    args = ap.parse_args(argv)
+
+    rules = RULES
+    if args.select:
+        want = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        unknown = want - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}")
+            return 2
+        rules = {k: v for k, v in RULES.items() if k in want}
+
+    baseline = None if args.no_baseline else Path(args.baseline)
+    if args.write_baseline:
+        rep = run([Path(p) for p in args.paths], baseline_path=None, rules=rules)
+        n = write_baseline(rep, Path(args.baseline))
+        print(f"tlint: wrote {n} baseline entr(ies) to {args.baseline}")
+        return 0
+    try:
+        rep = run(
+            [Path(p) for p in args.paths], baseline_path=baseline, rules=rules
+        )
+    except ValueError as e:  # malformed baseline
+        print(f"tlint: {e}")
+        return 2
+    print(format_report(rep, verbose=args.verbose))
+    return 1 if rep.failed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,785 @@
+"""The TL rule set (docs/STATIC_ANALYSIS.md has the catalogue).
+
+Each rule is a function ``(ctx: FileContext) -> Iterator[Violation]``.
+Rules are deliberately project-shaped: they know this tree's locking
+conventions, its RPC surface (``send_request``), and its JAX hot-path
+hygiene (fixed-shape programs, no host↔device sync mid-chunk) — the
+runtime contracts in docs/SERVING.md and docs/FAILURE_MODEL.md depend on
+these coding disciplines, and generic linters cannot see them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Iterator
+
+from .context import FileContext, Guard, scope_name
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    rel: str
+    line: int
+    col: int
+    scope: str
+    symbol: str  # stable anchor used for baseline identity
+    message: str
+
+    def key(self) -> tuple[str, str, str, str]:
+        return (self.rule, self.rel, self.scope, self.symbol)
+
+
+def _unparse(node: ast.AST, limit: int = 60) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        text = type(node).__name__
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
+def _contains_call(node: ast.AST, mod: str, fn: str) -> bool:
+    """Does ``node`` contain a ``mod.fn()`` call anywhere?"""
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == fn
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == mod
+        ):
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _func_defs(tree: ast.AST):
+    """Yield (func_node, stack_of_enclosing_nodes) for every def."""
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, stack + [child]
+                yield from walk(child, stack + [child])
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + [child])
+            else:
+                yield from walk(child, stack)
+
+    yield from walk(tree, [])
+
+
+def _own_nodes(root: ast.AST) -> list[ast.AST]:
+    """Every node belonging to ``root``'s own scope, document order,
+    parents before children — nested function/lambda subtrees excluded
+    (they are their own scopes), class bodies included."""
+    out: list[ast.AST] = []
+
+    def walk(n):
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            out.append(c)
+            walk(c)
+
+    walk(root)
+    return out
+
+
+def _scopes(tree: ast.Module):
+    """``(scope name, own nodes)`` for the module scope and every def."""
+    yield "<module>", _own_nodes(tree)
+    for func, stack in _func_defs(tree):
+        yield scope_name(stack), _own_nodes(func)
+
+
+# ---------------------------------------------------------------------------
+# TL001 — guarded-by
+# ---------------------------------------------------------------------------
+
+
+def tl001_guarded_by(ctx: FileContext) -> Iterator[Violation]:
+    """Attributes annotated ``#: guarded by self._lock`` may only be
+    touched inside ``with self._lock:`` (or ``async with``) in methods of
+    the class; ``#: guarded by the event loop`` attributes only from
+    coroutines of the class. ``__init__`` (no concurrency yet) and
+    ``# tlint: holds-lock(self._lock)`` / ``# tlint: on-loop`` methods
+    (the caller provides the guard) are exempt — the markers make the
+    caller-holds contract visible and greppable."""
+    for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+        guards = ctx.class_guards(cls)
+        if not guards:
+            continue
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name in ("__init__", "__new__", "__post_init__"):
+                continue
+            markers = ctx.markers_for_def(method)
+            held_marks = {
+                m.arg.removeprefix("self.")
+                for m in markers
+                if m.kind == "holds-lock" and m.arg.startswith("self.")
+            }
+            on_loop = any(m.kind == "on-loop" for m in markers)
+            caller_holds = any(m.kind == "holds-lock" for m in markers)
+            is_async = isinstance(method, ast.AsyncFunctionDef)
+            yield from _walk_guarded(
+                ctx, cls, method, method, guards, frozenset(held_marks),
+                async_ok=is_async or on_loop, caller_holds=caller_holds,
+            )
+
+
+def _walk_guarded(
+    ctx: FileContext,
+    cls: ast.ClassDef,
+    method: ast.AST,
+    node: ast.AST,
+    guards: dict[str, Guard],
+    held: frozenset[str],
+    *,
+    async_ok: bool,
+    caller_holds: bool = False,
+) -> Iterator[Violation]:
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in child.items:
+                attr = _self_attr(item.context_expr)
+                if attr is None and isinstance(item.context_expr, ast.Call):
+                    attr = _self_attr(item.context_expr.func)
+                if attr:
+                    acquired.add(attr)
+            # report guarded attrs used in the with-items themselves
+            for item in child.items:
+                yield from _check_guarded_exprs(
+                    ctx, cls, method, item.context_expr, guards, held,
+                    async_ok=async_ok, caller_holds=caller_holds,
+                    skip=acquired,
+                )
+            for stmt in child.body:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    # a def INSIDE the with-block still escapes the lock
+                    yield from _walk_nested(ctx, cls, method, stmt, guards)
+                else:
+                    yield from _walk_guarded(
+                        ctx, cls, method, stmt, guards, held | acquired,
+                        async_ok=async_ok, caller_holds=caller_holds,
+                    )
+            continue
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield from _walk_nested(ctx, cls, method, child, guards)
+            continue
+        yield from _check_guarded_exprs(
+            ctx, cls, method, child, guards, held, async_ok=async_ok,
+            caller_holds=caller_holds, recurse=False,
+        )
+        yield from _walk_guarded(
+            ctx, cls, method, child, guards, held, async_ok=async_ok,
+            caller_holds=caller_holds,
+        )
+
+
+def _walk_nested(
+    ctx: FileContext,
+    cls: ast.ClassDef,
+    method: ast.AST,
+    node: ast.AST,
+    guards: dict[str, Guard],
+) -> Iterator[Violation]:
+    """A nested def/lambda may run later, on another thread, outside the
+    lock/loop — it inherits NO guard context (only its own ``holds-lock``
+    markers)."""
+    nested_marks = (
+        {
+            m.arg.removeprefix("self.")
+            for m in ctx.markers_for_def(node)
+            if m.kind == "holds-lock" and m.arg.startswith("self.")
+        }
+        if not isinstance(node, ast.Lambda)
+        else set()
+    )
+    body = node.body if isinstance(node.body, list) else [node.body]
+    for stmt in body:
+        yield from _walk_guarded(
+            ctx, cls, method, stmt, guards, frozenset(nested_marks),
+            async_ok=False, caller_holds=bool(nested_marks),
+        )
+
+
+def _check_guarded_exprs(
+    ctx: FileContext,
+    cls: ast.ClassDef,
+    method: ast.AST,
+    node: ast.AST,
+    guards: dict[str, Guard],
+    held: frozenset[str],
+    *,
+    async_ok: bool,
+    caller_holds: bool = False,
+    skip: set[str] | None = None,
+    recurse: bool = True,
+) -> Iterator[Violation]:
+    nodes = ast.walk(node) if recurse else [node]
+    for n in nodes:
+        attr = _self_attr(n)
+        if attr is None or attr not in guards or (skip and attr in skip):
+            continue
+        g = guards[attr]
+        if g.kind == "lock":
+            if g.lock_attr in held:
+                continue
+            msg = (
+                f"self.{attr} is guarded by self.{g.lock_attr} "
+                f"(annotated at line {g.line}) but accessed without "
+                f"holding it — wrap in `with self.{g.lock_attr}:` or mark "
+                f"the method `# tlint: holds-lock(self.{g.lock_attr})`"
+            )
+        elif g.kind == "external":
+            if caller_holds:
+                continue
+            msg = (
+                f"self.{attr} is guarded by {g.raw} (annotated at line "
+                f"{g.line}), held by CALLERS — methods touching it must "
+                f"declare `# tlint: holds-lock({g.raw})`"
+            )
+        else:
+            if async_ok:
+                continue
+            msg = (
+                f"self.{attr} is confined to the event loop (annotated at "
+                f"line {g.line}) but accessed from a sync/nested function "
+                "that may run on any thread — mark the method "
+                "`# tlint: on-loop` only if every caller is a coroutine"
+            )
+        yield Violation(
+            rule="TL001",
+            rel=ctx.rel,
+            line=n.lineno,
+            col=n.col_offset,
+            scope=f"{cls.name}.{method.name}",
+            symbol=f"self.{attr}",
+            message=msg,
+        )
+
+
+# ---------------------------------------------------------------------------
+# TL002 — no blocking calls under a held lock
+# ---------------------------------------------------------------------------
+
+_LOCKISH = re.compile(r"lock|mutex|cond|idle|gate", re.IGNORECASE)
+_QUEUEISH = re.compile(r"(^|_)(q|queue|work|inbox|outbox)s?$")
+_THREADISH = re.compile(r"thread", re.IGNORECASE)
+_BLOCKING_SOCKET = {"recv", "recv_into", "recvfrom", "sendall", "accept"}
+_DEVICE_SYNC = {"block_until_ready", "device_get"}
+
+
+def _has_kw(call: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in call.keywords)
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    """Why this call can block the lock holder (None = not blocking)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        recv = f.value
+        if f.attr == "sleep" and isinstance(recv, ast.Name) and recv.id == "time":
+            return "time.sleep under a held lock stalls every waiter"
+        if f.attr in _BLOCKING_SOCKET:
+            return f"socket .{f.attr}() can block indefinitely"
+        if f.attr in _DEVICE_SYNC:
+            return f".{f.attr}() synchronizes host and device"
+        if f.attr == "send_request":
+            return "send_request is a blocking RPC round-trip"
+        if f.attr == "get":
+            leaf = _unparse(recv, 80).rsplit(".", 1)[-1]
+            # dict.get(key) takes a positional key; blocking queue .get()
+            # takes none — only the latter shape is flagged. .put() is not:
+            # it only blocks on BOUNDED queues, which this tree avoids.
+            if (
+                _QUEUEISH.search(leaf)
+                and not call.args
+                and not _has_kw(call, "timeout", "block")
+            ):
+                return "queue .get() without a timeout can block forever"
+        if f.attr == "join" and not call.args and not _has_kw(call, "timeout"):
+            leaf = _unparse(recv, 80).rsplit(".", 1)[-1]
+            if _THREADISH.search(leaf):
+                return "thread .join() without a timeout can block forever"
+    elif isinstance(f, ast.Name) and f.id == "device_get":
+        return "device_get() synchronizes host and device"
+    return None
+
+
+def tl002_no_blocking_under_lock(ctx: FileContext) -> Iterator[Violation]:
+    """No blocking call (socket I/O, un-timed queue ops, ``time.sleep``,
+    blocking RPC, host↔device sync) inside a held THREAD lock — every
+    other thread contending on the lock stalls behind it. ``async with``
+    is exempt (awaiting inside an asyncio lock yields the loop); methods
+    marked ``# tlint: holds-lock(...)`` are checked as if locked, since
+    their callers hold the lock across the whole body."""
+    for func, stack in _func_defs(ctx.tree):
+        marks = ctx.markers_for_def(func)
+        base_locks = [
+            m.arg for m in marks if m.kind == "holds-lock" and m.arg
+        ]
+        yield from _walk_lock_regions(
+            ctx, func, func, list(base_locks), scope_name(stack)
+        )
+
+
+def _walk_lock_regions(
+    ctx: FileContext,
+    func: ast.AST,
+    node: ast.AST,
+    held: list[str],
+    scope: str,
+) -> Iterator[Violation]:
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # visited on their own by _func_defs
+        if isinstance(child, ast.With):
+            acquired = []
+            for item in child.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                attr = _self_attr(expr)
+                if attr and _LOCKISH.search(attr):
+                    acquired.append(f"self.{attr}")
+                elif isinstance(expr, ast.Name) and _LOCKISH.search(expr.id):
+                    acquired.append(expr.id)
+            for stmt in child.body:
+                yield from _walk_lock_regions(
+                    ctx, func, stmt, held + acquired, scope
+                )
+            continue
+        if held and isinstance(child, ast.Call):
+            reason = _blocking_reason(child)
+            if reason is not None and not _is_lock_method(child, held):
+                yield Violation(
+                    rule="TL002",
+                    rel=ctx.rel,
+                    line=child.lineno,
+                    col=child.col_offset,
+                    scope=scope,
+                    symbol=_unparse(child.func),
+                    message=(
+                        f"blocking call {_unparse(child)} while holding "
+                        f"{', '.join(sorted(set(held)))}: {reason}"
+                    ),
+                )
+        yield from _walk_lock_regions(ctx, func, child, held, scope)
+
+
+def _is_lock_method(call: ast.Call, held: list[str]) -> bool:
+    """Condition-variable methods on the held lock itself (``wait`` with a
+    timeout, ``notify``...) are how conditions are used, not a hazard."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    return _unparse(call.func.value, 200) in held
+
+
+# ---------------------------------------------------------------------------
+# TL003 — hot-path host-sync hygiene
+# ---------------------------------------------------------------------------
+
+_HOT_SYNC_ATTRS = {
+    "item": ".item() forces a device->host transfer",
+    "tolist": ".tolist() forces a device->host transfer",
+    "block_until_ready": "block_until_ready() stalls the dispatch pipeline",
+    "device_get": "device_get() forces a device->host transfer",
+}
+
+
+def tl003_hot_path_sync(ctx: FileContext) -> Iterator[Violation]:
+    """Functions marked ``# tlint: hot-path`` (the decode/prefill/
+    admission paths) must not host-sync: no ``np.asarray``/``np.array``
+    on device values, no ``.item()``/``.tolist()``, no
+    ``block_until_ready``/``device_get``. A host round-trip mid-chunk
+    serializes the dispatch pipeline — the hazard the fixed-shape chunk
+    programs exist to avoid (docs/SERVING.md)."""
+    for func, stack in _func_defs(ctx.tree):
+        if not any(
+            m.kind == "hot-path" for m in ctx.markers_for_def(func)
+        ):
+            continue
+        scope = scope_name(stack)
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            sym = None
+            msg = None
+            if isinstance(f, ast.Attribute):
+                if (
+                    f.attr in ("asarray", "array")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")
+                ):
+                    sym = f"np.{f.attr}"
+                    msg = (
+                        f"np.{f.attr}() on a hot path copies device data "
+                        "to host (use jnp inside the program; sync once "
+                        "at the chunk boundary)"
+                    )
+                elif f.attr in _HOT_SYNC_ATTRS:
+                    sym = f".{f.attr}"
+                    msg = _HOT_SYNC_ATTRS[f.attr]
+            if sym is None:
+                continue
+            yield Violation(
+                rule="TL003",
+                rel=ctx.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                scope=scope,
+                symbol=sym,
+                message=f"host sync in hot-path function: {msg}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# TL004 — monotonic durations
+# ---------------------------------------------------------------------------
+
+
+def tl004_monotonic_durations(ctx: FileContext) -> Iterator[Violation]:
+    """``time.time()`` is wall clock: NTP steps it backwards and forwards,
+    so subtracting or comparing it for elapsed time yields negative or
+    wildly wrong durations. Durations and deadlines use
+    ``time.monotonic()``. Genuine epoch timestamps (persisted records,
+    cross-node LWW ordering, file mtimes) keep ``time.time()`` with a
+    reasoned suppression."""
+    for scope, nodes in _scopes(ctx.tree):
+        yield from _tl004_scan(ctx, scope, nodes)
+
+
+def _tl004_scan(
+    ctx: FileContext, scope: str, nodes: list[ast.AST]
+) -> Iterator[Violation]:
+    # names assigned (in this scope) from expressions containing a
+    # time.time() call are wall-tainted: `t0 = time.time()`,
+    # `deadline = time.time() + 10`
+    tainted: set[str] = set()
+    for node in nodes:
+        if isinstance(node, ast.Assign) and _contains_call(
+            node.value, "time", "time"
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if _contains_call(node.value, "time", "time") and isinstance(
+                node.target, ast.Name
+            ):
+                tainted.add(node.target.id)
+
+    def wallish(node: ast.AST) -> bool:
+        if _contains_call(node, "time", "time"):
+            return True
+        return any(
+            isinstance(n, ast.Name) and n.id in tainted
+            for n in ast.walk(node)
+        )
+
+    inside_reported: set[int] = set()  # ids of descendants of a reported node
+    for node in nodes:
+        if id(node) in inside_reported:
+            continue
+        hit = None
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            if wallish(node.left) or wallish(node.right):
+                hit = "subtracting"
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            walls = [s for s in sides if wallish(s)]
+            others = [
+                s
+                for s in sides
+                if s not in walls and not isinstance(s, ast.Constant)
+            ]
+            if walls and (len(walls) > 1 or others):
+                hit = "comparing"
+        if hit is None:
+            continue
+        inside_reported.update(id(n) for n in ast.walk(node))
+        yield Violation(
+            rule="TL004",
+            rel=ctx.rel,
+            line=node.lineno,
+            col=node.col_offset,
+            scope=scope,
+            symbol=_unparse(node),
+            message=(
+                f"{hit} wall-clock time for elapsed time: "
+                f"`{_unparse(node)}` — use time.monotonic() for "
+                "durations/deadlines (wall clock steps under NTP); if "
+                "this genuinely needs epoch time, suppress with a reason"
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# TL005 — no swallowed exceptions
+# ---------------------------------------------------------------------------
+
+
+def tl005_no_swallowed_exceptions(ctx: FileContext) -> Iterator[Violation]:
+    """An ``except`` body that is only ``pass``/``continue`` erases the
+    failure: in thread targets and node loops the thread keeps running
+    with corrupt state and nobody ever learns why (the bug class behind
+    silent chaos-test hangs). Log at warning with context, re-raise, or
+    — when the exception is genuinely ignorable — narrow the type and
+    suppress with a reason."""
+    if ctx.rel.startswith("tests/"):
+        return  # test code swallows intentionally (polling loops, teardown)
+    for scope, nodes in _scopes(ctx.tree):
+        for node in nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not all(
+                isinstance(s, (ast.Pass, ast.Continue)) for s in node.body
+            ):
+                continue
+            types = _unparse(node.type) if node.type else "<bare>"
+            yield Violation(
+                rule="TL005",
+                rel=ctx.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                scope=scope,
+                symbol=f"except {types}",
+                message=(
+                    f"`except {types}` swallows the exception with only "
+                    "pass/continue — log at warning with context, "
+                    "re-raise, or narrow the type and suppress with the "
+                    "reason it is ignorable"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# TL006 — mutable module-global state
+# ---------------------------------------------------------------------------
+
+_CLASSISH = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+_MUTABLE_CTORS = {
+    "list",
+    "dict",
+    "set",
+    "deque",
+    "defaultdict",
+    "Counter",
+    "OrderedDict",
+    "bytearray",
+}
+
+
+def tl006_mutable_module_global(ctx: FileContext) -> Iterator[Violation]:
+    """Module-level mutable state leaks between tests (and between jobs
+    in one process): importing the module once, any mutation survives
+    into every later user — the order-dependence bug class. Flags (a)
+    module-level names bound to mutable containers, (b) functions that
+    rebind module globals via ``global``. Read-only constant tables and
+    deliberate process-global registries get a reasoned suppression or a
+    baseline entry."""
+    for node in ctx.tree.body:
+        targets: list[ast.Name] = []
+        value: ast.AST | None = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            targets = [node.target]
+            value = node.value
+        if not targets or value is None:
+            continue
+        if not _is_mutable_value(value):
+            continue
+        for t in targets:
+            if t.id == "__all__":
+                continue
+            yield Violation(
+                rule="TL006",
+                rel=ctx.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                scope="<module>",
+                symbol=t.id,
+                message=(
+                    f"module-level mutable global `{t.id}` — state leaks "
+                    "across tests/jobs sharing the process; move it into "
+                    "an object, or suppress with the reason it is safe "
+                    "(read-only table / reset-guarded registry)"
+                ),
+            )
+    # class-attribute patching in tests: `SomeClass.attr = ...` mutates
+    # state every other test (and the ML threads the e2e suites run
+    # in-process) sees — and a save/restore pair does NOT undo it for
+    # descriptors: `orig = Cls.meth` resolves a staticmethod to its bare
+    # function, so the restore installs a plain function that binds self
+    # (the exact leak behind the order-dependent lookahead failure).
+    # Restore from `Cls.__dict__[name]`, or better, don't patch classes.
+    if ctx.rel.startswith("tests/"):
+        for func, stack in _func_defs(ctx.tree):
+            for node in _own_nodes(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and _CLASSISH.match(t.value.id)
+                    ):
+                        yield Violation(
+                            rule="TL006",
+                            rel=ctx.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            scope=scope_name(stack),
+                            symbol=f"{t.value.id}.{t.attr}",
+                            message=(
+                                f"test patches class attribute "
+                                f"`{t.value.id}.{t.attr}` — leaks into "
+                                "every later test in the process, and a "
+                                "getattr-based save/restore corrupts "
+                                "descriptors (staticmethod -> bound "
+                                "method); restore from "
+                                f"`{t.value.id}.__dict__` and suppress "
+                                "with that reason, or avoid class "
+                                "patching"
+                            ),
+                        )
+    for func, stack in _func_defs(ctx.tree):
+        assigned = {
+            t.id
+            for n in ast.walk(func)
+            if isinstance(n, ast.Assign)
+            for t in n.targets
+            if isinstance(t, ast.Name)
+        } | {
+            n.target.id
+            for n in ast.walk(func)
+            if isinstance(n, (ast.AnnAssign, ast.AugAssign))
+            and isinstance(n.target, ast.Name)
+        }
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Global):
+                continue
+            rebound = [n for n in node.names if n in assigned]
+            if not rebound:
+                continue
+            yield Violation(
+                rule="TL006",
+                rel=ctx.rel,
+                line=node.lineno,
+                col=node.col_offset,
+                scope=scope_name(stack),
+                symbol=",".join(rebound),
+                message=(
+                    f"function rebinds module global(s) "
+                    f"{', '.join(rebound)} — runtime-mutated module state "
+                    "leaks across tests/jobs; prefer instance state, or "
+                    "suppress with the reset discipline that makes it safe"
+                ),
+            )
+
+
+def _is_mutable_value(value: ast.AST) -> bool:
+    if isinstance(
+        value,
+        (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp),
+    ):
+        return True
+    if isinstance(value, ast.Call):
+        f = value.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        return name in _MUTABLE_CTORS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# TL007 — unseeded RNG
+# ---------------------------------------------------------------------------
+
+_NP_SEEDED_OK = {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox"}
+_PY_SEEDED_OK = {"Random", "SystemRandom"}
+
+
+def tl007_unseeded_rng(ctx: FileContext) -> Iterator[Violation]:
+    """Global-state RNG (``np.random.rand...``, ``random.random...``)
+    breaks the determinism contract: draws depend on whatever ran before
+    in the process, so streams (and tests) stop being reproducible. Use
+    ``np.random.default_rng(seed)`` / ``random.Random(seed)`` /
+    ``jax.random`` keys. Scope: ``engine/`` (the contract) and ``tests/``
+    (suite reproducibility)."""
+    if not ("/engine/" in f"/{ctx.rel}" or ctx.rel.startswith("tests/")):
+        return
+    for scope, nodes in _scopes(ctx.tree):
+        for call in nodes:
+            if not isinstance(call, ast.Call) or not isinstance(
+                call.func, ast.Attribute
+            ):
+                continue
+            f = call.func
+            sym = None
+            if (
+                isinstance(f.value, ast.Attribute)
+                and f.value.attr == "random"
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id in ("np", "numpy")
+                and f.attr not in _NP_SEEDED_OK
+            ):
+                if f.attr == "RandomState" and call.args:
+                    continue
+                sym = f"np.random.{f.attr}"
+            elif (
+                isinstance(f.value, ast.Name)
+                and f.value.id == "random"
+                and f.attr not in _PY_SEEDED_OK
+            ):
+                sym = f"random.{f.attr}"
+            if sym is None:
+                continue
+            yield Violation(
+                rule="TL007",
+                rel=ctx.rel,
+                line=call.lineno,
+                col=call.col_offset,
+                scope=scope,
+                symbol=sym,
+                message=(
+                    f"{sym}() draws from process-global RNG state — "
+                    "non-reproducible; use np.random.default_rng(seed) / "
+                    "random.Random(seed) / jax.random keys"
+                ),
+            )
+
+
+RULES = {
+    "TL001": tl001_guarded_by,
+    "TL002": tl002_no_blocking_under_lock,
+    "TL003": tl003_hot_path_sync,
+    "TL004": tl004_monotonic_durations,
+    "TL005": tl005_no_swallowed_exceptions,
+    "TL006": tl006_mutable_module_global,
+    "TL007": tl007_unseeded_rng,
+}
