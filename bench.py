@@ -137,6 +137,26 @@ def _budget_left() -> float:
     return _CHILD_BUDGET_S - (time.monotonic() - _T_CHILD_START)
 
 
+def _prior_bench_extras() -> list:
+    """``(round_file, extra)`` for every prior round's BENCH_r*.json in
+    round order — the driver wraps the bench line under ``"parsed"``.
+    Shared by the TPU-outage streak and the train-MFU trajectory guard
+    so the wrapper format lives in one place."""
+    out = []
+    for f in sorted(glob.glob(os.path.join(
+            os.path.dirname(_SELF), "BENCH_r*.json"))):
+        try:
+            with open(f) as fh:
+                d = json.load(fh)
+            out.append(
+                (os.path.basename(f), (d.get("parsed") or d).get("extra", {}))
+            )
+        # tlint: disable=TL005(scanning prior bench JSONs — missing/malformed files are skipped by design)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
 def run_bench() -> None:
     import jax
 
@@ -885,6 +905,151 @@ def run_bench() -> None:
         except Exception as e:
             sched_extra = {"sched_error": str(e)[:500]}
 
+    # ---- unified ragged step: the prefill-stall seam is gone --------------
+    # PR-6 regime: N co-resident decodes at steady state vs the SAME
+    # decodes while one long admission prefills. The legacy two-program
+    # path dispatches the admission's prefill chunks ahead of every decode
+    # chunk (the seam: decode inter-token latency inflates while any slot
+    # prefills); the unified ragged step carries prefill tokens and decode
+    # tokens in ONE dispatch, so decode ITL with a prefill in flight must
+    # stay ~flat vs decode-only steady state. Both paths warmed; medians.
+    ragged_extra = {}
+    if on_tpu and _budget_left() < 400:
+        ragged_extra = {"ragged_skipped": "low time budget"}
+    else:
+        try:
+            from tensorlink_tpu.engine.continuous import (
+                ContinuousEngine as _RCE,
+            )
+
+            RG_SLOTS = 4
+            rg_dec_len, rg_long_len = 8, 160
+            rg_chunk_steps, rg_prefill_chunk, rg_page = 4, 16, 16
+            rg_max = rg_long_len + 32
+            rg_rng = np.random.default_rng(13)
+            rg_dec_prompts = [
+                rg_rng.integers(1, cfg.vocab_size, rg_dec_len).tolist()
+                for _ in range(RG_SLOTS - 1)
+            ]
+            rg_long = rg_rng.integers(
+                1, cfg.vocab_size, rg_long_len
+            ).tolist()
+            eng_rg = GenerationEngine(
+                cfg, params, seq_buckets=(16, rg_max), batch_buckets=(1,),
+                max_seq_len=rg_max,
+            )
+
+            def ragged_leg(unified: bool) -> dict:
+                ce = _RCE(
+                    eng_rg, max_slots=RG_SLOTS, page_size=rg_page,
+                    chunk_steps=rg_chunk_steps,
+                    prefill_chunk=rg_prefill_chunk, unified_step=unified,
+                )
+                try:
+                    # warm every program this leg can hit: a multi-chunk
+                    # admission compiles the step program(s), then drains
+                    w = ce.submit(
+                        rg_rng.integers(1, cfg.vocab_size, 40).tolist(),
+                        max_new_tokens=4, seed=0,
+                    )
+                    ce.run_until_idle()
+                    assert w.finished
+                    decs = [
+                        ce.submit(p, max_new_tokens=200, seed=i)
+                        for i, p in enumerate(rg_dec_prompts)
+                    ]
+                    # occupancy-matched steady state: a 4th DECODING slot
+                    # stands where the admission will later go, so both
+                    # phases gather 4 slots' worth of real pages (at
+                    # steady the empty slot would re-gather the cache-hot
+                    # scratch page — flattering the baseline on CPU)
+                    helper = ce.submit(
+                        rg_rng.integers(
+                            1, cfg.vocab_size, rg_dec_len
+                        ).tolist(),
+                        max_new_tokens=1 + 11 * rg_chunk_steps, seed=99,
+                    )
+                    ce.step_chunk()  # admit; first tokens out
+                    steady: list[float] = []
+                    for _ in range(8):
+                        t0 = time.perf_counter()
+                        ce.step_chunk()
+                        steady.append(time.perf_counter() - t0)
+                    while not helper.finished:  # free the 4th slot
+                        ce.step_chunk()
+                    long_req = ce.submit(rg_long, max_new_tokens=4, seed=9)
+                    during: list[float] = []
+                    while long_req.slot < 0 or (
+                        not long_req.finished
+                        and long_req.prefill_pos < rg_long_len
+                    ):
+                        t0 = time.perf_counter()
+                        ce.step_chunk()
+                        during.append(time.perf_counter() - t0)
+                    emitted = [len(d.tokens) for d in decs]
+                finally:
+                    ce.close()
+                return {
+                    # per-token decode ITL: chunk wall time / steps
+                    "steady_itl_ms": float(np.median(steady))
+                    / rg_chunk_steps * 1e3,
+                    "during_itl_ms": float(np.median(during))
+                    / rg_chunk_steps * 1e3,
+                    "prefill_steps": len(during),
+                    "dec_tokens": emitted,
+                }
+
+            rg_uni = ragged_leg(True)
+            rg_leg = ragged_leg(False)
+            del eng_rg
+            ragged_extra = {
+                "ragged_slots": RG_SLOTS,
+                "ragged_long_prompt": rg_long_len,
+                "ragged_steady_itl_ms": round(rg_uni["steady_itl_ms"], 2),
+                "ragged_during_prefill_itl_ms": round(
+                    rg_uni["during_itl_ms"], 2
+                ),
+                # THE seam metric: decode ITL while a co-resident prefill
+                # is in flight, as a multiple of decode-only steady state
+                "ragged_itl_ratio": round(
+                    rg_uni["during_itl_ms"]
+                    / max(rg_uni["steady_itl_ms"], 1e-9), 2
+                ),
+                "ragged_legacy_steady_itl_ms": round(
+                    rg_leg["steady_itl_ms"], 2
+                ),
+                "ragged_legacy_during_prefill_itl_ms": round(
+                    rg_leg["during_itl_ms"], 2
+                ),
+                "ragged_legacy_itl_ratio": round(
+                    rg_leg["during_itl_ms"]
+                    / max(rg_leg["steady_itl_ms"], 1e-9), 2
+                ),
+                **(
+                    {}
+                    if on_tpu
+                    else {
+                        "ragged_note": (
+                            "CPU fallback: the unified step's fixed-shape "
+                            "block makes its per-step cost ~constant by "
+                            "construction here, so the flat ITL ratio is "
+                            "faithful but the absolute win is understated "
+                            "— on TPU the ragged kernel's cost follows "
+                            "each slot's live tokens (pages past "
+                            "start+n_valid skip compute), which is where "
+                            "the MXU-occupancy gain on mixed batches "
+                            "lives. The legacy ratio shows the seam the "
+                            "unified step removes. Both phases run at "
+                            "equal slot occupancy (a 4th decoder stands "
+                            "in at steady state) so CPU page-gather "
+                            "locality can't skew the ratio."
+                        )
+                    }
+                ),
+            }
+        except Exception as e:
+            ragged_extra = {"ragged_error": str(e)[:500]}
+
     # ---- flash vs einsum prefill (the Pallas kernel's actual TPU win) -----
     flash_extra = {}
     if (on_tpu and _budget_left() > 1200) or force_all:
@@ -1075,20 +1240,10 @@ def run_bench() -> None:
     outage_extra = {}
     if os.environ.get("TLTPU_TUNNEL_DOWN"):
         try:
-            prior = []
-            for f in sorted(glob.glob(os.path.join(
-                    os.path.dirname(_SELF), "BENCH_r*.json"))):
-                try:
-                    with open(f) as fh:
-                        d = json.load(fh)
-                    # the driver wraps the bench line under "parsed"
-                    parsed = d.get("parsed") or d
-                    prior.append(
-                        bool(parsed.get("extra", {}).get("tpu_tunnel_down"))
-                    )
-                # tlint: disable=TL005(scanning prior bench JSONs — missing/malformed files are skipped by design)
-                except (OSError, ValueError):
-                    continue
+            prior = [
+                bool(e.get("tpu_tunnel_down"))
+                for _, e in _prior_bench_extras()
+            ]
             streak = 1  # this run
             for down in reversed(prior):
                 if down:
@@ -1125,6 +1280,7 @@ def run_bench() -> None:
         **serving_extra,
         **prefix_extra,
         **sched_extra,
+        **ragged_extra,
         **flash_extra,
         **spec_extra,
         **int8_extra,
@@ -1181,19 +1337,59 @@ def run_bench() -> None:
         # standard 6·N·D convention (remat's extra forward eats into MFU)
         train_flops = 6.0 * tcfg.param_count() * tbatch * tseq
         mfu = train_flops / step_dt / peak_flops
+        train_config_str = (
+            f"{train_name} "
+            f"{'bf16' if tcfg.dtype == jnp.bfloat16 else 'fp32'} "
+            f"B={tbatch} T={tseq}"
+        )
         extra.update(
             {
-                "train_config": (
-                    f"{train_name} "
-                    f"{'bf16' if tcfg.dtype == jnp.bfloat16 else 'fp32'} "
-                    f"B={tbatch} T={tseq}"
-                ),
+                "train_config": train_config_str,
                 "train_step_s": round(step_dt, 4),
                 "train_tokens_s": round(tbatch * tseq / step_dt, 2),
                 "train_mfu": round(mfu, 4),
                 "train_remat": remat_used,
             }
         )
+        # ---- train-MFU rot guard (ROADMAP item 5) ---------------------
+        # train_mfu decayed 0.036 → 0.0092 across r03–r05 with nobody
+        # noticing while serving work landed. Trajectory assertion: this
+        # round's MFU must stay within 2x of the best COMPARABLE prior
+        # round recorded in BENCH_r*.json — comparable = same
+        # train_config string AND the same remat setting (r03–r05
+        # measured remat=False, a configuration the sharding planner
+        # never schedules, so the remat=True trajectory restarts here
+        # rather than inheriting a phantom baseline). The flag is the
+        # teeth: tests/test_bench_smoke.py fails the suite on it.
+        try:
+            trajectory = {
+                name: float(pe["train_mfu"])
+                for name, pe in _prior_bench_extras()
+                if pe.get("train_config") == train_config_str
+                and bool(pe.get("train_remat")) == remat_used
+                and "train_mfu" in pe
+            }
+            best_prior = max(trajectory.values(), default=None)
+            regressed = bool(best_prior) and mfu < 0.5 * best_prior
+            extra.update(
+                {
+                    "train_mfu_best_prior": best_prior,
+                    "train_mfu_vs_best_prior": (
+                        round(mfu / best_prior, 3) if best_prior else None
+                    ),
+                    "train_mfu_regressed": regressed,
+                    "train_mfu_trajectory": trajectory,
+                }
+            )
+            if regressed:
+                extra["train_mfu_escalation"] = (
+                    f"train_mfu {mfu:.4f} is >2x below the best prior "
+                    f"comparable round ({best_prior:.4f}) — training perf "
+                    f"rotted while serving work landed; trajectory: "
+                    f"{trajectory}"
+                )
+        except Exception as e:
+            extra["train_mfu_guard_error"] = str(e)[:200]
     except Exception as e:  # keep the decode metric even if training OOMs
         # full text: a truncated dtype-mismatch message cost round 2 the
         # self-contained diagnosis (ADVICE r2)

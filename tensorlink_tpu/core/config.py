@@ -101,6 +101,16 @@ class MLConfig:
     # evict LRU when the allocator runs dry. Hits are bitwise the KV the
     # slot would have computed — streams are identical cache on or off.
     prefix_cache: bool = True
+    # unified ragged prefill+decode step (engine/continuous.py,
+    # docs/SERVING.md): every engine step is ONE compiled program — a
+    # packed [slots, chunk] token block where each slot's (start,
+    # n_valid) are data, so decode slots never wait behind a co-resident
+    # admission's prefill dispatches and a completing prefill samples its
+    # first token in the same dispatch. False restores the legacy
+    # two-program path (≤1 prefill chunk per mid-prefill slot before a
+    # separate decode chunk) for one release; prefill_chunk=0
+    # (monolithic admission) implies the legacy path.
+    unified_step: bool = True
     # -- SLO-aware request scheduling (engine/scheduler.py) --------------
     # priority class a request gets when the API body carries none:
     # "interactive" | "batch" | "best_effort". Classes order admission

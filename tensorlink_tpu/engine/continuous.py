@@ -10,15 +10,20 @@ finished row stops consuming decode steps immediately — the two failure
 modes of the static batcher (queue-until-drain, dead ``done``-masked
 rows) are structurally gone.
 
-Admission prefills through the **automatic prefix cache** + **chunked
-prefill** (docs/SERVING.md): the longest cached chain of full KV pages
-maps into the new slot's block table with zero prefill compute, the
-first divergent page is copy-on-write, and the remaining suffix runs as
-fixed-shape ``prefill_chunk`` chunks interleaved with decode chunks — a
-long admission never stalls co-resident decodes for more than one chunk.
-Finished slots promote their prompt-region pages back into the cache
-(ref-counted, LRU-leaf eviction under memory pressure), which also makes
-crash-recovery re-prefill near-free while the prefix stays resident.
+Admission prefills through the **automatic prefix cache** + the
+**unified ragged step** (docs/SERVING.md): the longest cached chain of
+full KV pages maps into the new slot's block table with zero prefill
+compute, the first divergent page is copy-on-write, and the remaining
+suffix rides the packed ``[slots, chunk]`` block of the one step
+program — each mid-prefill slot's next prompt piece (its grant from
+:func:`pack_prefill_budgets`) and each decode slot's next token in the
+SAME ragged dispatch, so a long admission never stalls co-resident
+decodes at all (``unified_step=False`` keeps the legacy two-program
+schedule: ≤1 prefill chunk per mid-prefill slot before a separate
+decode chunk). Finished slots promote their prompt-region pages back
+into the cache (ref-counted, LRU-leaf eviction under memory pressure),
+which also makes crash-recovery re-prefill near-free while the prefix
+stays resident.
 
 Determinism contract (the parity tests' anchor): each slot samples with
 its OWN stateless key chain — token n of a request draws from
@@ -56,6 +61,7 @@ from .paged import (
     paged_decode_chunk,
     paged_decode_step,
     paged_prefill_chunk,
+    paged_ragged_step,
     pages_needed,
     scatter_prefill,
 )
@@ -66,6 +72,51 @@ from .scheduler import (
     SchedulerOverloaded,
     normalize_priority,
 )
+
+
+# tlint: hot-path
+def pack_prefill_budgets(
+    remaining: "list[int]", chunk: int, budget: "int | None" = None,
+    phase: int = 0,
+) -> list[int]:
+    """The unified ragged step's per-step token-budget assembly: how many
+    prefill tokens each mid-prefill slot gets this step.
+
+    Pure host-side and deterministic — given each mid-prefill slot's
+    remaining prompt-token count (in slot order), grant up to ``chunk``
+    tokens per slot (the packed block's row width), subject to an
+    optional TOTAL ``budget`` shared across slots. Under a budget the
+    split is round-robin one token at a time starting from slot index
+    ``phase % n`` — the caller advances ``phase`` every step, so a
+    budget smaller than the number of concurrent admissions rotates
+    who gets this step's tokens instead of starving the tail slots
+    forever. The split for a given (remaining, chunk, budget, phase) is
+    a pure function of its inputs — which is what makes it unit-testable
+    in isolation AND what the ragged framing-invariance contract
+    quantifies over: ANY grant schedule that eventually covers the
+    prompt yields bitwise the same KV (test-pinned in
+    tests/test_ops.py)."""
+    n = len(remaining)
+    want = [min(int(chunk), max(int(r), 0)) for r in remaining]
+    if budget is None or sum(want) <= int(budget):
+        return want
+    grants = [0] * n
+    left = int(budget)
+    # token-granular round-robin: bounds are small (budget < slots*chunk
+    # here, else the fast path above returned) so the exact-fairness
+    # loop stays trivial
+    start = int(phase) % n if n else 0
+    while left > 0:
+        progressed = False
+        for j in range(n):
+            i = (start + j) % n
+            if grants[i] < want[i] and left > 0:
+                grants[i] += 1
+                left -= 1
+                progressed = True
+        if not progressed:
+            break
+    return grants
 
 
 # tlint: hot-path
@@ -150,6 +201,8 @@ class ContinuousEngine:
         chunk_steps: int = 8,
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
+        unified_step: bool = True,
+        prefill_budget: int = 0,
         sched_queue_cap: int = 64,
         sched_aging_ticks: int = 32,
         sched_preemption: bool = True,
@@ -193,7 +246,25 @@ class ContinuousEngine:
             PrefixCache(self.page_size)
             if prefix_cache and self.prefill_chunk > 0 else None
         )
+        # unified ragged prefill+decode step (the default): every engine
+        # step is ONE compiled program — a packed [slots, chunk] token
+        # block where each slot's (start, n_valid) are data, so decode
+        # slots never stall behind a co-resident admission's prefill
+        # chunks. False restores the legacy two-program path (≤1 prefill
+        # chunk per mid-prefill slot BEFORE a separate decode chunk) for
+        # one release; monolithic admission (prefill_chunk=0) implies it.
+        self.unified = bool(unified_step) and self.prefill_chunk > 0
+        # optional TOTAL prefill tokens per unified step shared across
+        # mid-prefill slots (0 = each slot gets a full chunk row): bounds
+        # the per-step prefill compute on TPU where the kernel's cost is
+        # ragged (follows n_valid), trading admission latency for an even
+        # tighter inter-token bound
+        self.prefill_budget = int(prefill_budget)
         self._prefilling: dict[int, ContinuousRequest] = {}
+        # rotates the budgeted packing's round-robin origin so a
+        # prefill_budget smaller than the number of concurrent
+        # admissions never starves the tail slots
+        self._pack_phase = 0
         self._lock = threading.Lock()
         # the policy layer owning the queued side of the lifecycle:
         # priority classes, aging, preemption decisions, backpressure
@@ -306,16 +377,21 @@ class ContinuousEngine:
     def jit_cache_sizes(self) -> dict:
         """Compiled-program counts of the slot-batched hot loop — the
         "no unbounded compile set" guarantee, asserted by the engine
-        tests: these stay fixed no matter the request mix. Chunked
-        prefill adds exactly two entries (the fixed-shape chunk program
-        and the COW page copy); prompt length, cache-hit offset and
-        chunk count are all DATA to them."""
+        tests: these stay fixed no matter the request mix. On the
+        unified path the entire serving hot loop is ONE top-level step
+        program (``ragged_step``; prompt length, cache-hit offset,
+        prefill/decode mix and budget split are all DATA to it) plus the
+        COW ``copy_page``; the legacy path's pair (``decode_chunk`` +
+        ``prefill_chunk``) stays cold. ``decode_step`` / ``sample_rows``
+        / ``row_keys`` are traced INSIDE whichever step program runs —
+        never dispatched from the host loop."""
         return {
             "decode_chunk": paged_decode_chunk._cache_size(),
             "decode_step": paged_decode_step._cache_size(),
             "sample_rows": _sample_rows._cache_size(),
             "row_keys": _row_keys._cache_size(),
             "prefill_chunk": paged_prefill_chunk._cache_size(),
+            "ragged_step": paged_ragged_step._cache_size(),
             "copy_page": copy_page._cache_size(),
         }
 
@@ -461,6 +537,11 @@ class ContinuousEngine:
         req.prefill_pos = hit_len
         self._slots[slot] = req
         self._prefilling[slot] = req
+        if self.unified:
+            # the completing step samples the first token IN-program, so
+            # the slot's sampling state must be armed before its first
+            # packed block — not at activation like the legacy path
+            self._arm_slot(req, slot)
         self.stats["admitted"] += 1
         self.stats["prefill_tokens_skipped"] += hit_len
         if self.prefix is not None:
@@ -557,12 +638,36 @@ class ContinuousEngine:
         self._activate(req, slot, logits)
         return True
 
+    def _set_knob_mirrors(self, slot: int, sp: SamplingParams) -> None:
+        """Scalarize a request's sampling knobs into the per-slot host
+        mirrors the compiled chunk consumes."""
+        t = np.asarray(sp.temperature)
+        self._temp[slot] = float(t.reshape(-1)[0])
+        self._topk[slot] = int(np.asarray(sp.top_k).reshape(-1)[0])
+        self._topp[slot] = float(np.asarray(sp.top_p).reshape(-1)[0])
+        self._pres[slot] = float(np.asarray(sp.presence_penalty).reshape(-1)[0])
+        self._freq[slot] = float(np.asarray(sp.frequency_penalty).reshape(-1)[0])
+
+    def _arm_slot(self, req: ContinuousRequest, slot: int) -> None:
+        """Unified-path admission arming: the sampling state the legacy
+        path sets in ``_activate`` lands on the host at ADMISSION, before
+        the slot's first packed block — so the step that completes its
+        prefill draws the first token in-program with the request's own
+        key chain (index ``start_step + len(tokens)``, counting recovery
+        and pre-preemption tokens), the request's knobs, and the prefill
+        sequence's context histogram: exactly the draw ``_activate``
+        makes on the legacy path."""
+        self._seeds[slot] = req.seed
+        self._steps[slot] = req.start_step + len(req.tokens)
+        self._set_knob_mirrors(slot, req.sampling)
+        self._counts = self._counts.at[slot].set(self._prompt_counts(req))
+
     def _activate(self, req: ContinuousRequest, slot: int, logits) -> None:
-        """Prefill done: draw the next token from the last prefilled
-        position's logits with the request's own key chain — exactly what
-        an uninterrupted run draws at this step (``base`` counts recovery
-        AND pre-preemption tokens, both already in the prefill sequence)
-        — and open the slot for decode chunks."""
+        """Prefill done (legacy path): draw the next token from the last
+        prefilled position's logits with the request's own key chain —
+        exactly what an uninterrupted run draws at this step (``base``
+        counts recovery AND pre-preemption tokens, both already in the
+        prefill sequence) — and open the slot for decode chunks."""
         sp = req.sampling
         base = req.start_step + len(req.tokens)
         key = jax.random.fold_in(jax.random.PRNGKey(req.seed), base)
@@ -577,12 +682,7 @@ class ContinuousEngine:
         self._steps[slot] = base + 1  # next draw's index
         self._tok[slot] = tok
         self._active[slot] = True
-        t = np.asarray(sp.temperature)
-        self._temp[slot] = float(t.reshape(-1)[0])
-        self._topk[slot] = int(np.asarray(sp.top_k).reshape(-1)[0])
-        self._topp[slot] = float(np.asarray(sp.top_p).reshape(-1)[0])
-        self._pres[slot] = float(np.asarray(sp.presence_penalty).reshape(-1)[0])
-        self._freq[slot] = float(np.asarray(sp.frequency_penalty).reshape(-1)[0])
+        self._set_knob_mirrors(slot, sp)
         if self._emit(req, tok):
             self._evict(slot)
 
@@ -825,53 +925,162 @@ class ContinuousEngine:
     _EOS_WIDTH = 8
 
     # tlint: hot-path
-    def step_chunk(self, *, admit_only: bool = False) -> bool:
-        """Admit queued requests, then run ONE compiled decode chunk
-        (``chunk_steps`` fixed-shape slot steps in a single on-device
-        while_loop — one host round trip per chunk, not per token),
-        delivering each slot's tokens up to its own done-point and
-        evicting finished slots at the boundary. Returns True while any
-        work (live slots or queued requests) remains — the driver's
-        requeue signal."""
-        self._admit()
-        if admit_only:
-            return self.has_work()
-        if self._prefilling:
-            # one prefill chunk per mid-prefill slot, THEN the decode
-            # chunk: a long admission interleaves with running decodes
-            # instead of stalling them for its whole prompt
-            self._prefill_tick()
-        if not self._active.any():
-            return self.has_work()
-        S = self.max_slots
+    def _pack_ragged(self):
+        """Assemble the unified step's packed ``[S, C]`` token block — the
+        pure host side of the zero-seam schedule: each mid-prefill slot's
+        next prompt piece (its grant from :func:`pack_prefill_budgets`)
+        and each decoding slot's current token ride ONE block, with
+        per-slot ``(start, n_valid)`` as data. ``emit`` marks the slots
+        that sample this step (decoders, and prefills whose prompt
+        completes in this block). Returns None when nothing is live."""
+        if not self._prefilling and not self._active.any():
+            return None
+        S, C = self.max_slots, self.prefill_chunk
+        blk = np.zeros((S, C), np.int32)
+        starts = np.zeros(S, np.int32)
+        n_valid = np.zeros(S, np.int32)
+        emit = np.zeros(S, bool)
         remaining = np.zeros(S, np.int32)
         eos_arr = np.full((S, self._EOS_WIDTH), -1, np.int32)
+        completing: list[int] = []
+        grants: dict[int, int] = {}
+        pf_slots = sorted(self._prefilling)
+        pf_rem = [
+            len(self._prefilling[s].prefill_tokens)
+            - self._prefilling[s].prefill_pos
+            for s in pf_slots
+        ]
+        budgets = pack_prefill_budgets(
+            pf_rem, C,
+            self.prefill_budget if self.prefill_budget > 0 else None,
+            phase=self._pack_phase,
+        )
+        self._pack_phase += 1
+        for s, g in zip(pf_slots, budgets):
+            if g <= 0:
+                continue  # budget exhausted: the slot idles this step
+            req = self._prefilling[s]
+            blk[s, :g] = req.prefill_tokens[
+                req.prefill_pos : req.prefill_pos + g
+            ]
+            starts[s] = req.prefill_pos
+            n_valid[s] = g
+            grants[s] = g
+            if req.prefill_pos + g >= len(req.prefill_tokens):
+                completing.append(s)
+                emit[s] = True
         for s in range(S):
             req = self._slots[s]
-            if req is not None:
+            if req is None:
+                continue
+            if self._active[s]:
+                blk[s, 0] = self._tok[s]
+                # the slot's current length: every emitted token except
+                # the last has been written — the last rides this block
+                starts[s] = len(req.prompt) + len(req.tokens) - 1
+                n_valid[s] = 1
+                emit[s] = True
+            if emit[s]:
                 remaining[s] = req.budget - len(req.tokens)
                 ids = sorted(req.eos)[: self._EOS_WIDTH]
                 eos_arr[s, : len(ids)] = ids
-        tokens, n_exec, self.cache, _done, steps_dev, self._counts, _rem = (
-            paged_decode_chunk(
-                self.engine.params, jnp.asarray(self._tok), self.cache,
-                jnp.asarray(self._active),
-                jnp.asarray(self._seeds), jnp.asarray(self._steps),
-                jnp.asarray(self._temp), jnp.asarray(self._topk),
-                jnp.asarray(self._topp), jnp.asarray(self._pres),
-                jnp.asarray(self._freq), self._counts,
-                jnp.asarray(remaining), jnp.asarray(eos_arr),
-                self.cfg, self.chunk_steps, self.use_kernel,
-            )
-        )
-        n_exec = int(n_exec)
-        if n_exec <= 0:
+        return blk, starts, n_valid, emit, remaining, eos_arr, completing, grants
+
+    # tlint: hot-path
+    def step_chunk(self, *, admit_only: bool = False) -> bool:
+        """Admit queued requests, then run ONE compiled step program.
+
+        Unified path (the default): the packed ragged block — every
+        mid-prefill slot's next prompt piece AND every decode slot's next
+        token in one dispatch — followed by the decode continuation loop,
+        all inside the single ``ragged_step`` program: a decode slot's
+        inter-token latency is one step whether or not a co-resident
+        admission is prefilling (no separate prefill dispatches to wait
+        behind), and a completing prefill samples its first token in the
+        same dispatch that finishes its prompt. Legacy path
+        (``unified_step=False``): ≤1 ``prefill_chunk`` program per
+        mid-prefill slot, THEN the ``decode_chunk`` program. Both run
+        ``chunk_steps`` fixed-shape slot steps per host round trip,
+        deliver each slot's tokens up to its own done-point, and evict
+        finished slots at the boundary. Returns True while any work
+        (live slots or queued requests) remains — the driver's requeue
+        signal."""
+        self._admit()
+        if admit_only:
             return self.has_work()
-        toks_host = np.asarray(tokens)[:, :n_exec]
-        self.stats["decode_steps"] += n_exec
-        self.stats["slot_steps_total"] += n_exec * S
+        S = self.max_slots
+        if self.unified:
+            pack = self._pack_ragged()
+            if pack is None:
+                return self.has_work()
+            blk, starts, n_valid, emit, remaining, eos_arr, completing, \
+                grants = pack
+            tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
+                _rem = paged_ragged_step(
+                    self.engine.params, jnp.asarray(blk), self.cache,
+                    jnp.asarray(starts), jnp.asarray(n_valid),
+                    jnp.asarray(emit),
+                    jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._pres),
+                    jnp.asarray(self._freq), self._counts,
+                    jnp.asarray(remaining), jnp.asarray(eos_arr),
+                    self.cfg, self.chunk_steps, self.use_kernel,
+                )
+            n_exec = int(n_exec)
+            toks_host = np.asarray(tokens)[:, :n_exec]
+            # prefill bookkeeping: the grants landed on device; completed
+            # prompts switch to decode mode before delivery (their first
+            # token is column 0 of this very chunk)
+            for s, g in grants.items():
+                self._prefilling[s].prefill_pos += g
+                self.stats["prefill_chunks"] += 1
+                self.stats["prefill_tokens"] += g
+            for s in completing:
+                del self._prefilling[s]
+                self._active[s] = True
+            if emit.any():
+                # prefill-only steps decode nothing — don't count them
+                # (the legacy path's numbers for the same workload)
+                self.stats["decode_steps"] += n_exec
+                self.stats["slot_steps_total"] += n_exec * S
+            deliver = emit
+        else:
+            if self._prefilling:
+                # one prefill chunk per mid-prefill slot, THEN the decode
+                # chunk: a long admission interleaves with running decodes
+                # instead of stalling them for its whole prompt
+                self._prefill_tick()
+            if not self._active.any():
+                return self.has_work()
+            remaining = np.zeros(S, np.int32)
+            eos_arr = np.full((S, self._EOS_WIDTH), -1, np.int32)
+            for s in range(S):
+                req = self._slots[s]
+                if req is not None:
+                    remaining[s] = req.budget - len(req.tokens)
+                    ids = sorted(req.eos)[: self._EOS_WIDTH]
+                    eos_arr[s, : len(ids)] = ids
+            tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
+                _rem = paged_decode_chunk(
+                    self.engine.params, jnp.asarray(self._tok), self.cache,
+                    jnp.asarray(self._active),
+                    jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._pres),
+                    jnp.asarray(self._freq), self._counts,
+                    jnp.asarray(remaining), jnp.asarray(eos_arr),
+                    self.cfg, self.chunk_steps, self.use_kernel,
+                )
+            n_exec = int(n_exec)
+            if n_exec <= 0:
+                return self.has_work()
+            toks_host = np.asarray(tokens)[:, :n_exec]
+            self.stats["decode_steps"] += n_exec
+            self.stats["slot_steps_total"] += n_exec * S
+            deliver = self._active
         for s in range(S):
-            if not self._active[s]:
+            if not deliver[s]:
                 continue
             req = self._slots[s]
             finished = False
@@ -920,4 +1129,4 @@ class ContinuousEngine:
         self.check_page_conservation()
 
 
-__all__ = ["ContinuousEngine", "ContinuousRequest"]
+__all__ = ["ContinuousEngine", "ContinuousRequest", "pack_prefill_budgets"]

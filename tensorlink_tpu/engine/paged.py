@@ -18,11 +18,14 @@ invisible) per-step KV writes land on scratch instead of a page another
 slot owns — that invariant is what makes eviction safe with zero
 cross-slot contamination.
 
-Attention routes through ops/attention.py: the Pallas
-:func:`~tensorlink_tpu.ops.attention.paged_attention` kernel on TPU
-(gathers KV page-by-page via a scalar-prefetched block table), the
-pure-jnp :func:`~tensorlink_tpu.ops.attention.paged_attention_ref` on CPU
-and in parity tests.
+Attention routes through ops/attention.py: on the default unified path
+the Pallas :func:`~tensorlink_tpu.ops.attention.ragged_paged_attention`
+kernel on TPU (whole mixed prefill+decode block, KV gathered page-by-page
+via a scalar-prefetched block table) with
+:func:`~tensorlink_tpu.ops.attention.ragged_paged_attention_ref` on CPU
+and in parity tests; the legacy path keeps the
+:func:`~tensorlink_tpu.ops.attention.paged_attention` /
+:func:`~tensorlink_tpu.ops.attention.paged_prefill_attention` pair.
 """
 
 from __future__ import annotations
@@ -51,6 +54,8 @@ from ..ops.attention import (
     paged_attention_ref,
     paged_prefill_attention,
     paged_prefill_attention_ref,
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
 )
 
 
@@ -400,6 +405,27 @@ def _attn_scale(cfg: ModelConfig) -> float:
     return cfg.attn_scale if cfg.attn_scale is not None else cfg.head_dim**-0.5
 
 
+def _ragged_write_indices(block_tables, starts, n_valid, page, n_pp, C):
+    """Physical ``(page, offset)`` write targets for a ragged ``[S, C]``
+    token block: position ``j`` of slot ``s`` lands at absolute position
+    ``starts[s] + j`` when ``j < n_valid[s]``; every other (padding row,
+    idle slot) write lands on the scratch page, unreachable from any
+    block table. THE one page-write path: prefill-written and
+    decode-written KV route through this same computation — a decode
+    token is just the ``C = 1`` / ``n_valid = 1`` case (the clamp is
+    belt-and-braces; the host evicts a slot before it reaches capacity).
+    Also returns the uncapped absolute positions (the rope offsets) and
+    the validity mask."""
+    idx = jnp.arange(C)[None, :]
+    pos = starts[:, None] + idx  # [S, C]
+    valid = idx < n_valid[:, None]
+    cpos = jnp.minimum(pos, n_pp * page - 1)
+    pg = jnp.take_along_axis(block_tables, cpos // page, axis=1)
+    write_pg = jnp.where(valid, pg, 0)
+    write_off = jnp.where(valid, cpos % page, 0)
+    return write_pg, write_off, pos, valid
+
+
 def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
                  write_off, att_len, block_tables, kernel: bool):
     """One transformer block over a slot batch of single tokens (T=1),
@@ -451,15 +477,14 @@ def paged_decode_step(
     lengths = cache.lengths
     page = cache.page_size
     n_pp = cache.pages_per_slot
-    # physical write position for each slot's new token; free slots have a
-    # zeroed block-table row and length 0 → scratch page 0. The clamp is
-    # belt-and-braces: the host evicts a slot before it can reach capacity
-    pos = jnp.minimum(lengths, n_pp * page - 1)
-    pg = jnp.take_along_axis(
-        cache.block_tables, (pos // page)[:, None], axis=1
-    )[:, 0]
-    write_pg = jnp.where(active, pg, 0)
-    write_off = jnp.where(active, pos % page, 0)
+    # physical write position for each slot's new token via the shared
+    # ragged write path (C=1, n_valid=active); free slots have a zeroed
+    # block-table row and length 0 → scratch page 0
+    write_pg, write_off, _, _ = _ragged_write_indices(
+        cache.block_tables, lengths, active.astype(jnp.int32), page, n_pp, 1
+    )
+    write_pg = write_pg[:, 0]
+    write_off = write_off[:, 0]
     att_len = jnp.where(active, lengths + 1, 0)
 
     x = _embed_tokens(params, tok[:, None], cfg)  # [S, 1, d]
@@ -527,14 +552,36 @@ def paged_decode_chunk(
     Returns ``(tokens [S, n_steps], n_exec, cache, done, steps, counts,
     remaining)``; the host delivers each slot's tokens up to its own
     done-point and evicts at the chunk boundary."""
-    from .continuous import _row_keys, _sample_rows
-
     S = tok.shape[0]
     tokens = jnp.zeros((S, n_steps), jnp.int32)
     done0 = ~active | (remaining <= 0)
+    body = _decode_loop_body(
+        params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel
+    )
 
     def cond(st):
         return (st[0] < n_steps) & ~st[3].all()
+
+    init = (jnp.int32(0), tok, cache, done0, steps, counts, remaining, tokens)
+    n_exec, _tok, cache, done, steps, counts, remaining, tokens = (
+        jax.lax.while_loop(cond, body, init)
+    )
+    return tokens, n_exec, cache, done, steps, counts, remaining
+
+
+def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
+                      cfg: ModelConfig, kernel: bool):
+    """The slot-decode while_loop body, shared VERBATIM by the legacy
+    ``paged_decode_chunk`` and the unified ``paged_ragged_step``'s decode
+    continuation — one copy is what keeps the two paths' in-chunk math
+    (freeze semantics, key-chain advance, penalty counts) identical by
+    construction. A slot that finishes mid-chunk (EOS / budget) freezes:
+    its length stops advancing, it re-feeds its own token, and its
+    per-slot key index stops — so the emitted stream is BIT-IDENTICAL to
+    stepping one token at a time."""
+    from .continuous import _row_keys, _sample_rows
+
+    S = seeds.shape[0]
 
     def body(st):
         i, tok, cache, done, steps, counts, remaining, tokens = st
@@ -556,11 +603,7 @@ def paged_decode_chunk(
             tokens.at[:, i].set(nxt),
         )
 
-    init = (jnp.int32(0), tok, cache, done0, steps, counts, remaining, tokens)
-    n_exec, _tok, cache, done, steps, counts, remaining, tokens = (
-        jax.lax.while_loop(cond, body, init)
-    )
-    return tokens, n_exec, cache, done, steps, counts, remaining
+    return body
 
 
 def _paged_prefill_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv,
@@ -618,12 +661,11 @@ def paged_prefill_chunk(
     page = cache.page_size
     n_pp = cache.pages_per_slot
     bt_row = cache.block_tables[slot]  # [n_pp]
-    idx = jnp.arange(C)
-    pos = start + idx
-    valid = idx < n_valid
-    cpos = jnp.minimum(pos, n_pp * page - 1)
-    write_pg = jnp.where(valid, bt_row[cpos // page], 0)
-    write_off = jnp.where(valid, cpos % page, 0)
+    write_pg, write_off, pos, valid = _ragged_write_indices(
+        bt_row[None], jnp.asarray(start, jnp.int32).reshape(1),
+        jnp.asarray(n_valid, jnp.int32).reshape(1), page, n_pp, C,
+    )
+    write_pg, write_off, pos = write_pg[0], write_off[0], pos[0]
 
     x = _embed_tokens(params, toks[None, :], cfg)  # [1, C, d]
     positions = pos[None, :]
@@ -651,6 +693,150 @@ def paged_prefill_chunk(
         lengths=cache.lengths.at[slot].set(start + n_valid),
     )
     return h_last, new_cache
+
+
+def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
+                  write_off, block_tables, starts, n_valid, kernel: bool):
+    """One transformer block over the ragged ``[S, C]`` token block,
+    reading/writing KV through every slot's pages at once. Shares
+    ``_paged_block``'s prologue/epilogue (scatter-then-attend order
+    preserved) but carries the whole mixed prefill+decode block: a
+    decode slot's single token and a mid-prefill slot's chunk go through
+    the SAME projection, the SAME page scatter and the SAME ragged
+    attention — the kernel-level erasure of the prefill/decode split."""
+    h = x if cfg.norm_position == "post" else _norm(x, lp["ln1"], cfg)
+    q, k, v = _paged_qkv(h, lp, cfg, cos, sin)  # [S, C, H, hd]
+
+    ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
+    # block scatter through the one write path: position (s, j) lands at
+    # (write_pg[s, j], write_off[s, j]); padding rows and idle slots land
+    # on scratch page 0, unreachable from any block table
+    ck = ck.at[write_pg, :, write_off].set(k.astype(ck.dtype))
+    cv = cv.at[write_pg, :, write_off].set(v.astype(cv.dtype))
+
+    attn = ragged_paged_attention if kernel else ragged_paged_attention_ref
+    attn_raw = attn(
+        q, ck.astype(q.dtype), cv.astype(q.dtype), block_tables,
+        starts, n_valid, scale=_attn_scale(cfg),
+    )  # [S, C, Hq, hd]
+    return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
+
+
+# tlint: hot-path
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps", "kernel"),
+    donate_argnames=("cache", "counts"),
+)
+def paged_ragged_step(
+    params,
+    blk: jax.Array,  # int32 [S, C] — packed ragged token block (0-padded)
+    cache: PagedKVCache,
+    starts: jax.Array,  # int32 [S] — absolute position of blk[s, 0]
+    n_valid: jax.Array,  # int32 [S] — valid tokens per slot (0 = idle)
+    emit: jax.Array,  # bool [S] — slot samples from its last valid row
+    seeds: jax.Array,  # int32 [S] — per-slot RNG seeds
+    steps: jax.Array,  # int32 [S] — per-slot next draw index
+    temp: jax.Array,  # f32 [S] sampling knobs …
+    top_k: jax.Array,  # int32 [S]
+    top_p: jax.Array,  # f32 [S]
+    pres: jax.Array,  # f32 [S]
+    freq: jax.Array,  # f32 [S]
+    counts: jax.Array,  # int32 [S, V] context histograms (penalties)
+    remaining: jax.Array,  # int32 [S] — tokens still wanted per slot
+    eos: jax.Array,  # int32 [S, E] per-slot EOS ids (pad with -1)
+    cfg: ModelConfig,
+    n_steps: int,
+    kernel: bool = False,
+):
+    """THE serving hot loop's single compiled program: one ragged
+    prefill+decode forward over the packed ``[S, C]`` token block, then
+    up to ``n_steps - 1`` decode continuation steps in the same
+    on-device while_loop — one host round trip per chunk, zero
+    scheduling seams between prefilling and decoding slots.
+
+    The packed block (assembled by the host-side
+    ``engine/continuous.py::pack_prefill_budgets`` packing) carries every
+    slot's role as DATA: a decode slot contributes its 1 current token at
+    ``starts = length``, a mid-prefill slot its next prompt piece, an
+    idle slot 0 tokens. Slots with ``emit`` set (decode slots, and
+    prefills whose prompt completes in this block) sample their next
+    token from their last valid row's logits with the request's own key
+    chain — exactly the draw the legacy path makes in ``_activate`` /
+    the decode chunk — and continue through the decode loop (whose body
+    is shared VERBATIM with ``paged_decode_chunk``); mid-prefill slots
+    that didn't finish stay frozen for the rest of the chunk and get
+    their next grant at the next step boundary. One compiled program
+    serves every (prefill/decode mix, prompt length, offset, budget
+    split) — asserted next to the legacy bounds in
+    tests/test_continuous.py.
+
+    Returns ``(tokens [S, n_steps], n_exec, cache, done, steps, counts,
+    remaining)`` — the legacy chunk's exact host contract, with column 0
+    holding the ragged block's draws (meaningful where ``emit``)."""
+    S, C = blk.shape
+    page = cache.page_size
+    n_pp = cache.pages_per_slot
+    bt = cache.block_tables
+    write_pg, write_off, pos, _valid = _ragged_write_indices(
+        bt, starts, n_valid, page, n_pp, C
+    )
+
+    x = _embed_tokens(params, blk, cfg)  # [S, C, d]
+    positions = pos
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
+
+    def scan_fn(carry, xs):
+        lp, ck, cv = xs
+        y, ckv = _ragged_block(
+            carry, lp, cfg, cos, sin, (ck, cv), write_pg, write_off,
+            bt, starts, n_valid, kernel,
+        )
+        return y, ckv
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_fn, x, (params["layers"], cache.k, cache.v)
+    )
+    x = _norm(x, params["final_norm"], cfg)
+    # per-slot last valid row → vocab head over [S] rows only (idle slots
+    # read row 0 — garbage, masked out of sampling by `emit`)
+    h_last = x[jnp.arange(S), jnp.maximum(n_valid - 1, 0)]  # [S, d]
+    logits = _logits(params, h_last[:, None], cfg)[:, 0]  # [S, V]
+
+    from .continuous import _row_keys, _sample_rows
+
+    keys = _row_keys(seeds, steps)
+    nxt = _sample_rows(logits, keys, temp, top_k, top_p, pres, freq, counts)
+    nxt = jnp.where(emit, nxt, 0)
+    live = emit.astype(jnp.int32)
+    counts = counts.at[jnp.arange(S), nxt].add(live)
+    steps = steps + live
+    remaining = remaining - live
+    done = ~emit | (nxt[:, None] == eos).any(-1) | (remaining <= 0)
+    cache = replace(
+        cache, k=k_new, v=v_new,
+        lengths=jnp.where(n_valid > 0, starts + n_valid, cache.lengths),
+    )
+    tokens = jnp.zeros((S, n_steps), jnp.int32).at[:, 0].set(nxt)
+
+    # decode continuation: the legacy chunk's exact loop (shared body),
+    # starting past the ragged block's step
+    body = _decode_loop_body(
+        params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel
+    )
+
+    def cond(st):
+        return (st[0] < n_steps) & ~st[3].all()
+
+    init = (jnp.int32(1), nxt, cache, done, steps, counts, remaining, tokens)
+    n_exec, _tok, cache, done, steps, counts, remaining, tokens = (
+        jax.lax.while_loop(cond, body, init)
+    )
+    return tokens, n_exec, cache, done, steps, counts, remaining
 
 
 # tlint: hot-path
@@ -734,6 +920,7 @@ __all__ = [
     "PrefixCache",
     "paged_decode_step",
     "paged_prefill_chunk",
+    "paged_ragged_step",
     "copy_page",
     "scatter_prefill",
     "bind_slot",

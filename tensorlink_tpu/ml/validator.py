@@ -324,6 +324,7 @@ class DistributedValidator:
                 job.model, job.tokenizer.eos_ids,
                 max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
                 chunk_steps=ml_cfg.cont_chunk_steps,
+                unified_step=ml_cfg.unified_step,
                 default_priority=ml_cfg.default_priority,
                 sched_queue_cap=ml_cfg.sched_queue_cap,
                 sched_aging_ticks=ml_cfg.sched_aging_ticks,

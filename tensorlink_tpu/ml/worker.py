@@ -1573,6 +1573,7 @@ class DistributedWorker:
                     chunk_steps=int(ml.cont_chunk_steps),
                     prefill_chunk=int(ml.prefill_chunk),
                     prefix_cache=bool(ml.prefix_cache),
+                    unified_step=bool(ml.unified_step),
                     default_priority=str(ml.default_priority),
                     sched_queue_cap=int(ml.sched_queue_cap),
                     sched_aging_ticks=int(ml.sched_aging_ticks),

@@ -8,12 +8,19 @@ grid ``(batch·kv_head·group, q_blocks, k_blocks)`` with the k loop
 innermost, carrying running max/denominator/accumulator in VMEM scratch
 (the standard FlashAttention recurrence).
 
-:func:`paged_attention` is the continuous-batching decode kernel
-(engine/paged.py): one query token per serving slot, KV gathered page by
-page through a scalar-prefetched block table — ragged sequence lengths
-share one fixed-shape program, and only each slot's LIVE pages stream
-from HBM. :func:`paged_attention_ref` is the pure-jax.numpy reference the
-CPU path and the parity tests run.
+:func:`ragged_paged_attention` is the continuous-batching engine's
+unified prefill+decode kernel (engine/paged.py::paged_ragged_step): one
+fixed-shape ``[slots, chunk]`` query block where per-slot ``(start,
+n_valid)`` are data — a decode-only slot carries 1 valid query, a
+mid-prefill slot up to a chunk, padding slots 0 — with KV gathered page
+by page through a scalar-prefetched block table; only each slot's LIVE
+pages stream from HBM and compute follows ``start + n_valid``, not
+capacity. :func:`paged_attention` (decode-only) and
+:func:`paged_prefill_attention` (one slot's offset chunk) are the legacy
+two-program pair it unified; the ``*_ref`` functions are the
+pure-jax.numpy references the CPU path and the parity tests run — the
+ragged reference is pinned bitwise against the legacy pair's
+composition.
 
 Scope: **forward-only, causal, offset-0 prefill** — exactly the serving
 engine's fresh-cache prefill (engine/generate.py::_prefill). Training and
@@ -483,6 +490,254 @@ def paged_prefill_attention(
     )
 
 
+# ---------------------------------------------------------------------------
+# Ragged paged attention (unified prefill+decode step, engine/continuous.py)
+# ---------------------------------------------------------------------------
+
+
+# tlint: hot-path
+def ragged_paged_attention_ref(
+    q: jax.Array,  # [S, C, Hq, hd] — per-slot query block (ragged valid span)
+    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    v_pages: jax.Array,  # [P, Hkv, page, hd]
+    block_tables: jax.Array,  # int32 [S, pages_per_slot]
+    starts: jax.Array,  # int32 [S] — absolute position of q[s, 0]
+    n_valid: jax.Array,  # int32 [S] — valid queries per slot (0 = padding)
+    *,
+    scale: float,
+) -> jax.Array:
+    """Pure-jnp ragged paged attention — the CPU serving path of the
+    unified prefill+decode step, and the ground truth the Pallas kernel is
+    pinned against.
+
+    One fixed-shape ``[S, C]`` block where per-slot ``(start, n_valid)``
+    are DATA (the Ragged Paged Attention framing): a decode-only slot
+    carries 1 valid query at its current length, a mid-prefill slot
+    carries up to C prompt queries at its prefill offset, and a padding
+    slot carries 0 and outputs zeros. Query ``j`` of slot ``s`` sits at
+    absolute position ``starts[s] + j`` and attends every key position
+    ``<= starts[s] + j`` through the slot's own pages (the caller
+    scatters the block's KV into the pages BEFORE attention, exactly
+    like the decode step and the prefill chunk). Per valid row this is
+    bitwise the same masked-softmax GQA math as
+    ``paged_prefill_attention_ref`` (and, for a 1-valid-token slot,
+    ``paged_attention_ref`` at length ``start + 1``) — the composition
+    the parity tests pin. Rows at or past ``n_valid`` zero out instead
+    of carrying garbage."""
+    S, C, Hq, hd = q.shape
+    P, Hkv, page, _ = k_pages.shape
+    n_pp = block_tables.shape[1]
+    K = n_pp * page
+    k = (
+        k_pages[block_tables]
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(S, K, Hkv, hd)
+    )
+    v = (
+        v_pages[block_tables]
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(S, K, Hkv, hd)
+    )
+    G = Hq // Hkv
+    qg = q.reshape(S, C, Hkv, G, hd).astype(jnp.float32)
+    scores = (
+        jnp.einsum(
+            "sckgd,sxkd->sckgx", qg, k.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [S, C, Hkv, G, K]
+    q_pos = starts[:, None] + jnp.arange(C)[None, :]  # [S, C]
+    k_pos = jnp.arange(K)[None, None, :]  # [1, 1, K]
+    causal = k_pos <= q_pos[:, :, None]  # [S, C, K]
+    scores = jnp.where(causal[:, :, None, None, :], scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    # invalid rows (j >= n_valid, including whole padding slots) masked
+    # all-NEG_INF rows would softmax to NaN upstream of the zeroing, so
+    # the zero guard rides the weights like paged_attention_ref's
+    row_ok = jnp.arange(C)[None, :] < n_valid[:, None]  # [S, C]
+    w = jnp.where(row_ok[:, :, None, None, None], w, 0.0)
+    out = jnp.einsum("sckgx,sxkd->sckgd", w, v.astype(jnp.float32))
+    return out.reshape(S, C, Hq, hd).astype(q.dtype)
+
+
+def _ragged_kernel(
+    bt_ref,  # scalar-prefetch: block tables [S, n_pp]
+    start_ref,  # scalar-prefetch: per-slot start positions [S]
+    nv_ref,  # scalar-prefetch: per-slot valid counts [S]
+    q_ref,  # [1, 1, C·G, hd]
+    k_ref,  # [1, 1, page, hd] — page bt[s, i] of kv head h
+    v_ref,  # [1, 1, page, hd]
+    o_ref,  # [1, 1, C·G, hd]
+    m_ref,  # [C·G, 1] running max (VMEM scratch)
+    l_ref,  # [C·G, 1] running denominator
+    acc_ref,  # [C·G, hd] f32 accumulator
+    *,
+    scale: float,
+    page: int,
+    n_pp: int,
+    G: int,
+):
+    s = pl.program_id(0)
+    i = pl.program_id(2)
+    start = start_ref[s]
+    nv = nv_ref[s]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    CG = q_ref.shape[2]
+    # pages wholly past the slot's LAST VALID query position hold no
+    # attendable KV — skip their compute entirely (padding slots skip
+    # everything); the BlockSpec index map clamps their fetch to the
+    # scratch page, so both FLOPs and HBM traffic follow each slot's
+    # live span (start + n_valid), not the block or page capacity —
+    # the ragged win: a decode-only slot costs a decode slot, a
+    # prefill-heavy slot costs its chunk, in ONE dispatch
+    @pl.when((nv > 0) & (i * page <= start + nv - 1))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [C·G, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [C·G, page]
+        # query row r is block position r // G at absolute start + r // G
+        row = jax.lax.broadcasted_iota(jnp.int32, (CG, page), 0) // G
+        q_pos = start + row
+        k_pos = i * page + jax.lax.broadcasted_iota(
+            jnp.int32, (CG, page), 1
+        )
+        ok = (k_pos <= q_pos) & (row < nv)
+        sc = jnp.where(ok, sc, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+        p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(i == n_pp - 1)
+    def _finalize():
+        # invalid rows (and whole padding slots) never ran _compute with
+        # an unmasked key: l == 0 there and the floor yields a zero row,
+        # matching ragged_paged_attention_ref's zeroing
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+# tlint: hot-path
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def ragged_paged_attention(
+    q: jax.Array,  # [S, C, Hq, hd]
+    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    v_pages: jax.Array,  # [P, Hkv, page, hd]
+    block_tables: jax.Array,  # int32 [S, pages_per_slot]
+    starts: jax.Array,  # int32 [S]
+    n_valid: jax.Array,  # int32 [S]
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged paged attention (TPU); returns ``[S, C, Hq, hd]``.
+
+    Grid ``(slot, kv_head, page_idx)`` — the decode kernel's grid with
+    the prefill kernel's whole-chunk query block: block tables, per-slot
+    starts and valid counts ride scalar prefetch, each grid step's k/v
+    BlockSpec indexes the PHYSICAL page ``block_tables[s, i]`` (clamped
+    to the scratch page once past the slot's live span, so the pipeline
+    skips the copy), GQA queries group on the kv-head axis, and the
+    online softmax carries ``[C·G, 1]`` running max/denominator. ONE
+    compiled program serves every (prefill/decode mix, offset, length,
+    page assignment) — slot roles are data, not shape, which is what
+    deletes the separate-prefill-then-decode dispatch seam."""
+    S, C, Hq, hd = q.shape
+    P, Hkv, page, _ = k_pages.shape
+    n_pp = block_tables.shape[1]
+    G = Hq // Hkv
+    # [S, C, Hq, hd] -> [S, Hkv, C·G, hd]: kv-head-major so one grid
+    # row's queries share the page block prefetch pulled in
+    qg = (
+        q.reshape(S, C, Hkv, G, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(S, Hkv, C * G, hd)
+    )
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, page=page, n_pp=n_pp, G=G
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(S, Hkv, n_pp),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, C * G, hd),
+                    lambda s, h, i, bt, st, nv: (s, h, 0, 0),
+                ),
+                # pages wholly past the slot's live span clamp their
+                # fetch to scratch page 0 (repeated block indexes are
+                # not re-copied by the pipeline): HBM traffic follows
+                # start + n_valid per slot, not the slot's capacity
+                pl.BlockSpec(
+                    (1, 1, page, hd),
+                    lambda s, h, i, bt, st, nv, p=page: (
+                        jnp.where(
+                            (nv[s] > 0) & (i * p <= st[s] + nv[s] - 1),
+                            bt[s, i], 0,
+                        ),
+                        h, 0, 0,
+                    ),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page, hd),
+                    lambda s, h, i, bt, st, nv, p=page: (
+                        jnp.where(
+                            (nv[s] > 0) & (i * p <= st[s] + nv[s] - 1),
+                            bt[s, i], 0,
+                        ),
+                        h, 0, 0,
+                    ),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, C * G, hd),
+                lambda s, h, i, bt, st, nv: (s, h, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((C * G, 1), jnp.float32),
+                pltpu.VMEM((C * G, 1), jnp.float32),
+                pltpu.VMEM((C * G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, C * G, hd), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_tables,
+        jnp.asarray(starts, jnp.int32),
+        jnp.asarray(n_valid, jnp.int32),
+        qg,
+        k_pages,
+        v_pages,
+    )
+    return (
+        out.reshape(S, Hkv, C, G, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(S, C, Hq, hd)
+    )
+
+
 def _paged_kernel(
     bt_ref,  # scalar-prefetch: block tables [S, n_pp]
     len_ref,  # scalar-prefetch: lengths [S]
@@ -610,4 +865,6 @@ __all__ = [
     "paged_attention_ref",
     "paged_prefill_attention",
     "paged_prefill_attention_ref",
+    "ragged_paged_attention",
+    "ragged_paged_attention_ref",
 ]

@@ -163,6 +163,158 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
 
 
 # ---------------------------------------------------------------------------
+# unified ragged prefill+decode step (the default path)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # compiles the legacy two-program pair on top of the
+# module's unified set — tier-1 wall-time; CI's engine job runs this
+# file unfiltered on every push
+def test_unified_and_legacy_streams_bit_identical(tiny_engine):
+    """THE fallback-flag pin: the same request trace — greedy and sampled
+    rows, multi-chunk prompts, staggered mid-flight admission, prefix
+    cache on — emits BIT-identical streams through the unified ragged
+    step and the legacy two-program path. The unified step changes
+    scheduling (one dispatch, zero seams), never a token."""
+    eng = tiny_engine
+    mixes = [
+        (SYS + [21], 8, SamplingParams.make(temperature=0.9, top_k=5), 1),
+        ([4, 5], 6, SamplingParams.make(), 2),
+        (SYS + [22, 23], 10, SamplingParams.make(temperature=0.7, top_p=0.9), 3),
+        ([9, 8, 7, 6] * 5, 7, SamplingParams.make(temperature=1.0), 4),
+    ]
+
+    def trace(unified):
+        ce = _cont(eng, unified_step=unified)
+        reqs = []
+        for prompt, n, sp, seed in mixes:
+            reqs.append(
+                ce.submit(prompt, max_new_tokens=n, sampling=sp, seed=seed)
+            )
+            ce.step_chunk()  # later requests join mid-flight
+        ce.run_until_idle()
+        assert all(r.finished for r in reqs)
+        ce.check_page_conservation()
+        return [r.tokens for r in reqs]
+
+    assert trace(True) == trace(False)
+
+
+def test_unified_step_is_one_program(tiny_engine):
+    """The tentpole's acceptance bar: on the unified path the ENTIRE
+    serving hot loop is one compiled step program (plus the COW
+    ``copy_page``) — admission, mixed prefill/decode churn, preemption
+    and recovery-shaped resume add ZERO compiles, and the legacy
+    two-program pair (``decode_chunk``/``prefill_chunk``) stays cold.
+    Deltas, not absolutes: jit caches are process-global (the TL006
+    order-dependence note on the legacy guard above)."""
+    eng = tiny_engine
+    ce = _cont(eng, sched_aging_ticks=1000)
+    pre = ce.jit_cache_sizes()
+    # warm: a multi-chunk miss (promoted at eviction), then a mid-page
+    # divergence so the COW copy fires once
+    long = [5, 9] * 12
+    ce.submit(long, max_new_tokens=3, seed=7)
+    ce.run_until_idle()
+    ce.submit(long[:20] + [2, 2, 2, 2], max_new_tokens=3, seed=8)
+    ce.run_until_idle()
+    base = ce.jit_cache_sizes()
+    assert 0 <= base["ragged_step"] - pre["ragged_step"] <= 1
+    assert 0 <= base["copy_page"] - pre["copy_page"] <= 1
+    # churn: staggered mixed admissions (prefill riding decode chunks),
+    # deterministic preemption (batch residents, interactive arrival),
+    # and a recovery-shaped resume — all DATA to the one program
+    holders = [
+        ce.submit([3 + i] * 9, max_new_tokens=30, seed=i, priority="batch")
+        for i in range(ce.max_slots)
+    ]
+    ce.step_chunk()
+    vip = ce.submit(long + [3], max_new_tokens=4, seed=9,
+                    priority="interactive")
+    ce.run_until_idle()
+    assert vip.finished and all(r.finished for r in holders)
+    assert ce.stats["preemptions"] >= 1
+    sp = SamplingParams.make(temperature=1.0, top_p=0.9)
+    full = ce.submit([5, 6, 7], max_new_tokens=10, sampling=sp, seed=9)
+    ce.run_until_idle()
+    resumed = ce.submit(
+        [5, 6, 7] + full.tokens[:4], max_new_tokens=6, sampling=sp,
+        seed=9, start_step=4,
+    )
+    ce.run_until_idle()
+    assert full.tokens[:4] + resumed.tokens == full.tokens
+    after = ce.jit_cache_sizes()
+    assert after == base, (base, after)
+    assert after["decode_chunk"] == pre["decode_chunk"]  # legacy pair cold
+    assert after["prefill_chunk"] == pre["prefill_chunk"]
+    ce.check_page_conservation()
+
+
+def test_pack_prefill_budgets_unit():
+    """The host-side token-budget assembly in isolation: full-chunk
+    grants with no budget, exact round-robin fairness under one, and the
+    degenerate inputs the engine can hand it."""
+    from tensorlink_tpu.engine.continuous import pack_prefill_budgets
+
+    # no budget: every slot gets min(chunk, remaining)
+    assert pack_prefill_budgets([100, 3, 8], 8) == [8, 3, 8]
+    # budget below demand: round-robin one token at a time, slot order
+    assert pack_prefill_budgets([8, 8], 8, budget=10) == [5, 5]
+    assert pack_prefill_budgets([8, 2, 8], 8, budget=9) == [4, 2, 3]
+    # budget above demand: the cap never inflates a grant
+    assert pack_prefill_budgets([4, 4], 8, budget=100) == [4, 4]
+    # degenerate: nothing to prefill / nothing allowed
+    assert pack_prefill_budgets([], 8) == []
+    assert pack_prefill_budgets([5, 0], 8, budget=0) == [0, 0]
+    # determinism: a pure function of its inputs
+    assert pack_prefill_budgets([7, 7, 7], 4, budget=5) == \
+        pack_prefill_budgets([7, 7, 7], 4, budget=5) == [2, 2, 1]
+    # phase rotation: a budget smaller than the slot count rotates who
+    # gets this step's tokens — across consecutive phases every slot
+    # makes progress (no tail-slot starvation)
+    assert pack_prefill_budgets([8, 8, 8], 8, budget=2, phase=0) == [1, 1, 0]
+    assert pack_prefill_budgets([8, 8, 8], 8, budget=2, phase=1) == [0, 1, 1]
+    assert pack_prefill_budgets([8, 8, 8], 8, budget=2, phase=2) == [1, 0, 1]
+    total = [0, 0, 0]
+    for ph in range(3):
+        for i, g in enumerate(
+            pack_prefill_budgets([8, 8, 8], 8, budget=2, phase=ph)
+        ):
+            total[i] += g
+    assert min(total) >= 1
+
+
+@pytest.mark.slow  # two full budgeted traces — tier-1 wall-time; CI's
+# engine job runs this file unfiltered on every push
+def test_unified_prefill_budget_throttles_admission_not_streams(tiny_engine):
+    """A total per-step prefill budget slows admission (more steps to
+    cover a prompt) but never moves a token: streams are bit-identical
+    to the unbudgeted engine's, and co-resident decodes keep emitting
+    every step while the budgeted prefill trickles in."""
+    eng = tiny_engine
+    sp = SamplingParams.make(temperature=0.8)
+
+    def run(budget):
+        ce = _cont(eng, prefill_budget=budget)
+        bg = ce.submit([1, 2], max_new_tokens=20, seed=0)
+        ce.step_chunk()
+        long_req = ce.submit(list(range(1, 41)), max_new_tokens=4,
+                             sampling=sp, seed=1)
+        stalls = 0
+        while not long_req.finished:
+            before = len(bg.tokens)
+            ce.step_chunk()
+            if not bg.finished and len(bg.tokens) == before:
+                stalls += 1
+        ce.run_until_idle()
+        assert bg.finished and long_req.finished
+        return bg.tokens, long_req.tokens, stalls
+
+    bg0, long0, _ = run(0)
+    bg1, long1, stalls = run(7)  # 40-token prompt -> ≥6 budgeted steps
+    assert (bg1, long1) == (bg0, long0)
+    assert stalls == 0, "a budgeted prefill step starved the running decode"
+
+
+# ---------------------------------------------------------------------------
 # pages: lifecycle + isolation
 # ---------------------------------------------------------------------------
 def test_eviction_returns_pages_and_isolates_slots(tiny_engine):

@@ -19,6 +19,8 @@ from tensorlink_tpu.ops.attention import (
     paged_attention_ref,
     paged_prefill_attention,
     paged_prefill_attention_ref,
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
 )
 
 
@@ -292,6 +294,178 @@ def test_paged_prefill_ref_matches_dense_causal():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention (unified prefill+decode step)
+# ---------------------------------------------------------------------------
+def _ragged_case(rng, S, C, Hq, Hkv, hd, page, n_pp, starts, nv):
+    P = 1 + S * n_pp
+    q = jnp.asarray(rng.normal(size=(S, C, Hq, hd)).astype(np.float32))
+    kp = jnp.asarray(rng.normal(size=(P, Hkv, page, hd)).astype(np.float32))
+    vp = jnp.asarray(rng.normal(size=(P, Hkv, page, hd)).astype(np.float32))
+    bt = jnp.asarray(
+        rng.permutation(np.arange(1, P))[: S * n_pp]
+        .reshape(S, n_pp).astype(np.int32)
+    )
+    return q, kp, vp, bt, jnp.asarray(starts, jnp.int32), \
+        jnp.asarray(nv, jnp.int32)
+
+
+@pytest.mark.parametrize(
+    "S,C,Hq,Hkv,hd,page,n_pp,starts,nv",
+    [
+        # mixed: decode slot + fresh prefill + mid-prefill offset + padding
+        (4, 8, 8, 2, 32, 8, 4, [13, 0, 11, 0], [1, 8, 5, 0]),
+        # decode-only block (every slot 1 valid token, ragged lengths)
+        (4, 8, 4, 4, 16, 8, 4, [0, 7, 15, 30], [1, 1, 1, 1]),
+        # prefill-only block, MQA, mid-page offsets (COW landings)
+        pytest.param(3, 16, 8, 1, 64, 4, 8, [0, 3, 17], [16, 16, 9],
+                     marks=pytest.mark.slow),
+        # all-padding block (idle engine shape: all-zero output, no NaN)
+        pytest.param(2, 8, 4, 2, 16, 8, 2, [0, 0], [0, 0],
+                     marks=pytest.mark.slow),
+    ],
+)
+def test_ragged_kernel_matches_ref(S, C, Hq, Hkv, hd, page, n_pp, starts, nv):
+    """The ragged Pallas kernel (decode grid + whole-chunk query blocks,
+    per-slot (start, n_valid) via scalar prefetch) matches the pure-jnp
+    reference across decode-only / prefill-only / mixed / all-padding
+    slot configurations — the one-kernel claim of the unified step."""
+    rng = np.random.default_rng(8)
+    q, kp, vp, bt, st, nvj = _ragged_case(
+        rng, S, C, Hq, Hkv, hd, page, n_pp, starts, nv
+    )
+    scale = hd**-0.5
+    ref = ragged_paged_attention_ref(q, kp, vp, bt, st, nvj, scale=scale)
+    got = ragged_paged_attention(
+        q, kp, vp, bt, st, nvj, scale=scale, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    # invalid rows (and whole padding slots) are exactly zero, not garbage
+    for s in range(S):
+        assert np.abs(np.asarray(ref)[s, nv[s]:]).max(initial=0) == 0
+        assert np.abs(np.asarray(got)[s, nv[s]:]).max(initial=0) == 0
+
+
+def test_ragged_ref_matches_decode_and_prefill_refs_bitwise():
+    """THE composition pin the unified step's stream contract stands on:
+    a 1-valid-token slot of the ragged reference is BITWISE
+    ``paged_attention_ref`` at length ``start + 1``, and a prefilling
+    slot's valid rows are BITWISE ``paged_prefill_attention_ref`` at the
+    same offset — so swapping the two legacy programs for the one ragged
+    program cannot move a single bit of attention output."""
+    rng = np.random.default_rng(9)
+    S, C, Hq, Hkv, hd, page, n_pp = 4, 8, 8, 2, 32, 8, 4
+    starts = [13, 0, 11, 22]
+    nv = [1, 8, 5, 1]
+    q, kp, vp, bt, st, nvj = _ragged_case(
+        rng, S, C, Hq, Hkv, hd, page, n_pp, starts, nv
+    )
+    scale = hd**-0.5
+    ref = np.asarray(
+        ragged_paged_attention_ref(q, kp, vp, bt, st, nvj, scale=scale)
+    )
+    for s in (0, 3):  # decode-shaped slots
+        dec = paged_attention_ref(
+            q[s : s + 1, 0], kp, vp, bt[s : s + 1],
+            jnp.asarray([starts[s] + 1], jnp.int32), scale=scale,
+        )
+        assert np.array_equal(ref[s, 0], np.asarray(dec)[0]), s
+    for s in (1, 2):  # prefill-shaped slots
+        pf = paged_prefill_attention_ref(
+            q[s], kp, vp, bt[s], jnp.int32(starts[s]), scale=scale
+        )
+        assert np.array_equal(ref[s, : nv[s]], np.asarray(pf)[: nv[s]]), s
+
+
+@pytest.mark.slow  # compiles dedicated ragged shapes — CI engine job runs
+# it unfiltered on every push (tier-1 wall-time)
+def test_ragged_packing_framing_is_bitwise_invariant():
+    """The chunk-framing contract extended to ragged packing: prefilling
+    the same prompt through ``paged_ragged_step`` under DIFFERENT
+    per-step token budgets — with a co-resident decode token riding
+    every packed block — produces bitwise identical KV pages for both
+    slots and the same first greedy draw. This is what lets the host
+    packing function hand out any grant schedule (fair-share, budget-
+    capped, full-chunk) without moving a bit of any stream."""
+    from tensorlink_tpu.engine.paged import (
+        PagedKVCache, bind_slot, paged_ragged_step,
+    )
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = np.random.default_rng(6).integers(1, 128, 24).tolist()
+    dec_toks = np.random.default_rng(7).integers(1, 128, 8).tolist()
+    page, C, T, S = 8, 8, 24, 4
+    bt0 = np.zeros(8, np.int32)
+    bt0[:4] = range(1, 5)
+    bt1 = np.zeros(8, np.int32)
+    bt1[:4] = range(5, 9)
+
+    def run(schedule):
+        cache = PagedKVCache.init(cfg, S, page_size=page, max_len=64)
+        cache = bind_slot(
+            cache, jnp.int32(0), jnp.asarray(bt0), jnp.int32(0)
+        )
+        cache = bind_slot(
+            cache, jnp.int32(1), jnp.asarray(bt1), jnp.int32(0)
+        )
+        zeros_i = jnp.zeros(S, jnp.int32)
+        zeros_f = jnp.zeros(S, jnp.float32)
+        counts = jnp.zeros((S, cfg.vocab_size), jnp.int32)
+        eos = jnp.full((S, 2), -1, jnp.int32)
+        pos = 0
+        first_draw = None
+        for step_i, g in enumerate(schedule):
+            blk = np.zeros((S, C), np.int32)
+            starts = np.zeros(S, np.int32)
+            nv = np.zeros(S, np.int32)
+            emit = np.zeros(S, bool)
+            blk[0, :g] = prompt[pos : pos + g]
+            starts[0], nv[0] = pos, g
+            # slot 1 plays a co-resident decode: one pinned token per
+            # step at its running length — its KV must come out bitwise
+            # identical no matter how slot 0's prefill is framed
+            blk[1, 0] = dec_toks[step_i]
+            starts[1], nv[1] = step_i, 1
+            done_prefill = pos + g >= T
+            emit[0] = done_prefill  # final chunk: greedy first draw
+            tokens, n_exec, cache, _d, _s, counts, _r = paged_ragged_step(
+                params, jnp.asarray(blk), cache, jnp.asarray(starts),
+                jnp.asarray(nv), jnp.asarray(emit),
+                zeros_i, zeros_i, zeros_f, zeros_i,
+                jnp.ones(S, jnp.float32), zeros_f, zeros_f, counts,
+                jnp.ones(S, jnp.int32), eos, cfg, 1, False,
+            )
+            if done_prefill:
+                first_draw = int(np.asarray(tokens)[0, 0])
+            pos += g
+        k = np.asarray(cache.k)
+        real = np.stack(
+            [k[:, bt0[p // page], :, p % page] for p in range(T)], 1
+        )
+        dec = np.stack(
+            [k[:, bt1[p // page], :, p % page]
+             for p in range(len(schedule))], 1
+        )
+        return real, dec, first_draw
+
+    k_ref, d_ref, t_ref = run([8, 8, 8])
+    for schedule in ([8, 8, 5, 3], [5, 8, 8, 3], [2, 8, 8, 6]):
+        k_got, d_got, t_got = run(schedule)
+        assert np.array_equal(k_got, k_ref), schedule
+        assert np.array_equal(
+            d_got[:, : min(len(schedule), 3)], d_ref[:, : min(len(schedule), 3)]
+        ), schedule
+        assert t_got == t_ref, schedule
 
 
 @pytest.mark.slow  # compiles a dedicated small-chunk shape — CI engine

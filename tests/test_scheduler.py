@@ -432,17 +432,23 @@ def test_jit_cache_fixed_across_preemption_and_readmission(tiny_engine):
     re-admission are all DATA — zero new compiled programs."""
     eng = tiny_engine
     ce = _cont(eng, sched_aging_ticks=1000)
-    # warm every program preemption can touch: decode + prefill chunks,
-    # AND the COW page copy — a preempted request's re-admission walks
-    # the cache like any admission, so a partial-page hit may fire
-    # copy_page (it is warmed ONCE here; churn below must add nothing)
+    pre = ce.jit_cache_sizes()
+    # warm every program preemption can touch: the step program AND the
+    # COW page copy — a preempted request's re-admission walks the cache
+    # like any admission, so a partial-page hit may fire copy_page (it
+    # is warmed ONCE here; churn below must add nothing)
     ce.submit(list(range(1, 25)), max_new_tokens=3, seed=0)  # 3 full pages
     ce.run_until_idle()
     # diverges at position 22, mid-cached-page 3 -> fires the COW copy
     ce.submit(list(range(1, 23)) + [99, 98], max_new_tokens=3, seed=0)
     ce.run_until_idle()
     base = ce.jit_cache_sizes()
-    assert base["copy_page"] == 1  # the COW program really is warm
+    # the COW copy really ran (warm); its compile-count is a DELTA, not
+    # an absolute — jit caches are process-global and an earlier module
+    # serving a different engine shape leaves its own copy_page program
+    # resident (tlint TL006's order-dependence class)
+    assert ce.prefix.stats["cow_copies"] >= 1
+    assert 0 <= base["copy_page"] - pre["copy_page"] <= 1
     for i in range(4):
         ce.submit([i + 1, i + 2], max_new_tokens=10, seed=i,
                   priority="best_effort")
