@@ -80,8 +80,9 @@ class MLConfig:
     # of window-coalescing into run-to-completion static batches. Single-
     # stage jobs decode on the worker's slot engine; pipelined jobs run
     # slot admission through the session path (ml/batching.py). Models the
-    # paged engine can't serve (int8 KV cache, sliding-window attention)
-    # fall back to the windowed batcher automatically.
+    # paged engine can't serve (sliding-window attention) fall back to the
+    # windowed batcher automatically; int8-KV models ("int8+kv") serve
+    # CONTINUOUS — the paged cache stores int8 pages natively (kv_quant).
     continuous_batching: bool = True
     cont_max_slots: int = 8  # concurrent requests per model (B of the slot batch)
     cont_page_size: int = 16  # KV positions per page
@@ -101,16 +102,25 @@ class MLConfig:
     # evict LRU when the allocator runs dry. Hits are bitwise the KV the
     # slot would have computed — streams are identical cache on or off.
     prefix_cache: bool = True
-    # unified ragged prefill+decode step (engine/continuous.py,
-    # docs/SERVING.md): every engine step is ONE compiled program — a
-    # packed [slots, chunk] token block where each slot's (start,
-    # n_valid) are data, so decode slots never wait behind a co-resident
-    # admission's prefill dispatches and a completing prefill samples its
-    # first token in the same dispatch. False restores the legacy
-    # two-program path (≤1 prefill chunk per mid-prefill slot before a
-    # separate decode chunk) for one release; prefill_chunk=0
-    # (monolithic admission) implies the legacy path.
-    unified_step: bool = True
+    # paged KV cache storage dtype (engine/paged.py, docs/SERVING.md
+    # "Quantized KV"): "int8" stores KV pages int8 with per-(page,
+    # position, head) symmetric scales, quantized at the one page-write
+    # path and dequantized in-kernel at the page fetch — KV bytes halve,
+    # so ~2x serving slots and ~2x prefix-cache residency at fixed HBM.
+    # Streams stay bit-identical to each other across every lifecycle
+    # path (solo/co-batched/recovered/preempted, cache on/off); only the
+    # fp-vs-int8 comparison differs, bounded in tests. Default off for
+    # one release. Models served with quant="int8+kv" force int8 pages.
+    kv_quant: str = "none"  # "none" | "int8"
+    # EQuARX-style quantized collectives (parallel/ring.py): ring-attention
+    # K/V hops move int8 chunks + scales over ICI with a deterministic f32
+    # reduction — ~half the hop bytes at a bounded, test-pinned divergence.
+    # Applied via ModelConfig.collective_quant at SERVING stage load only
+    # (the quantize round() has a zero gradient — training keeps exact
+    # collectives). GSPMD tensor-parallel collectives are XLA-inserted and
+    # unaffected; ring.quantized_psum/quantized_all_gather are the
+    # building blocks for explicit shard_map paths.
+    collective_quant: bool = False
     # -- SLO-aware request scheduling (engine/scheduler.py) --------------
     # priority class a request gets when the API body carries none:
     # "interactive" | "batch" | "best_effort". Classes order admission

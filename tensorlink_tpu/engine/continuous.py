@@ -18,12 +18,20 @@ suffix rides the packed ``[slots, chunk]`` block of the one step
 program — each mid-prefill slot's next prompt piece (its grant from
 :func:`pack_prefill_budgets`) and each decode slot's next token in the
 SAME ragged dispatch, so a long admission never stalls co-resident
-decodes at all (``unified_step=False`` keeps the legacy two-program
-schedule: ≤1 prefill chunk per mid-prefill slot before a separate
-decode chunk). Finished slots promote their prompt-region pages back
-into the cache (ref-counted, LRU-leaf eviction under memory pressure),
-which also makes crash-recovery re-prefill near-free while the prefix
-stays resident.
+decodes at all. (The legacy two-program schedule — ≤1 prefill chunk per
+mid-prefill slot before a separate decode chunk — and the monolithic
+dense-prefill admission were retired after their one-release fallback
+window; ``prefill_chunk`` must be ≥ 1.) Finished slots promote their
+prompt-region pages back into the cache (ref-counted, LRU-leaf eviction
+under memory pressure), which also makes crash-recovery re-prefill
+near-free while the prefix stays resident.
+
+``kv_quant="int8"`` stores the KV pages int8 with per-(page, position,
+head) scales (engine/paged.py): ~2× slots and ~2× prefix-cache residency
+per HBM byte. Quantized streams keep every determinism contract below
+among themselves (a quantized page + scales IS the cache value, moved
+byte-exactly by COW/promotion/eviction/recovery); only the fp-vs-int8
+comparison differs, bounded in tests/test_ops.py.
 
 Determinism contract (the parity tests' anchor): each slot samples with
 its OWN stateless key chain — token n of a request draws from
@@ -50,7 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import GenerationEngine, _head_from_hidden
+from .generate import GenerationEngine
 from .paged import (
     PageAllocator,
     PagedKVCache,
@@ -58,12 +66,9 @@ from .paged import (
     bind_slot,
     clear_slot,
     copy_page,
-    paged_decode_chunk,
     paged_decode_step,
-    paged_prefill_chunk,
     paged_ragged_step,
     pages_needed,
-    scatter_prefill,
 )
 from .sampling import SamplingParams, sample
 from .scheduler import (
@@ -72,6 +77,18 @@ from .scheduler import (
     SchedulerOverloaded,
     normalize_priority,
 )
+
+
+def paged_unsupported(cfg) -> str | None:
+    """Why the paged engine can't serve a model config — None when it
+    can. THE hosting-time routing predicate (ml/validator.py): models it
+    rejects get the windowed static batcher. An int8 KV cache is
+    deliberately NOT a reason anymore — the paged cache stores int8
+    pages natively (``kv_quant``), so ``quant="int8+kv"`` model specs
+    serve continuous (regression-pinned in tests/test_quant.py)."""
+    if getattr(cfg, "sliding_window", None) is not None:
+        return "sliding-window attention"
+    return None
 
 
 # tlint: hot-path
@@ -201,7 +218,7 @@ class ContinuousEngine:
         chunk_steps: int = 8,
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
-        unified_step: bool = True,
+        kv_quant: str = "none",
         prefill_budget: int = 0,
         sched_queue_cap: int = 64,
         sched_aging_ticks: int = 32,
@@ -210,16 +227,25 @@ class ContinuousEngine:
         sched_max_wait_s: float = 60.0,
         default_priority: str = DEFAULT_PRIORITY,
     ):
-        if engine.cache_quant:
-            raise ValueError(
-                "continuous batching does not support the int8 KV cache — "
-                "serve quantized-cache models through the static batcher"
-            )
         if engine.cfg.sliding_window is not None:
             raise ValueError(
                 "continuous batching does not support sliding-window "
                 "attention yet — serve through the static batcher"
             )
+        if int(prefill_chunk) <= 0:
+            raise ValueError(
+                "prefill_chunk must be >= 1 — the monolithic dense-prefill "
+                "admission was retired with the legacy two-program step"
+            )
+        kv_quant = str(kv_quant or "none")
+        if engine.cache_quant and kv_quant == "none":
+            # the model spec asked for an int8 KV cache ("int8+kv"): the
+            # paged engine serves it natively as int8 pages — this is what
+            # used to (wrongly) route such models to the dense engine
+            kv_quant = "int8"
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(f"unknown kv_quant mode {kv_quant!r}")
+        self.kv_quant = kv_quant
         self.engine = engine
         self.cfg = engine.cfg
         self.max_slots = int(max_slots)
@@ -232,28 +258,14 @@ class ContinuousEngine:
         self.cache = PagedKVCache.init(
             self.cfg, self.max_slots, page_size=self.page_size,
             max_len=self.max_seq_len, dtype=engine.cache_dtype,
+            quantized=kv_quant == "int8",
         )
         self.alloc = PageAllocator(self.cache.n_pages)
         # chunked prefill: the prompt suffix beyond any cache hit prefills
-        # in fixed-shape chunks interleaved with decode chunks, so a long
-        # admission never stalls running slots for more than one chunk.
-        # 0 = legacy monolithic admission (dense bucketed prefill +
-        # scatter) — the automatic prefix cache requires the chunked path
-        # (the suffix must be computable at an arbitrary page offset).
-        self.prefill_chunk = min(int(prefill_chunk), self.max_seq_len) \
-            if prefill_chunk and prefill_chunk > 0 else 0
-        self.prefix = (
-            PrefixCache(self.page_size)
-            if prefix_cache and self.prefill_chunk > 0 else None
-        )
-        # unified ragged prefill+decode step (the default): every engine
-        # step is ONE compiled program — a packed [slots, chunk] token
-        # block where each slot's (start, n_valid) are data, so decode
-        # slots never stall behind a co-resident admission's prefill
-        # chunks. False restores the legacy two-program path (≤1 prefill
-        # chunk per mid-prefill slot BEFORE a separate decode chunk) for
-        # one release; monolithic admission (prefill_chunk=0) implies it.
-        self.unified = bool(unified_step) and self.prefill_chunk > 0
+        # in fixed-shape grants of the packed [slots, chunk] block, so a
+        # long admission never stalls running slots at all
+        self.prefill_chunk = min(int(prefill_chunk), self.max_seq_len)
+        self.prefix = PrefixCache(self.page_size) if prefix_cache else None
         # optional TOTAL prefill tokens per unified step shared across
         # mid-prefill slots (0 = each slot gets a full chunk row): bounds
         # the per-step prefill compute on TPU where the kernel's cost is
@@ -377,20 +389,19 @@ class ContinuousEngine:
     def jit_cache_sizes(self) -> dict:
         """Compiled-program counts of the slot-batched hot loop — the
         "no unbounded compile set" guarantee, asserted by the engine
-        tests: these stay fixed no matter the request mix. On the
-        unified path the entire serving hot loop is ONE top-level step
-        program (``ragged_step``; prompt length, cache-hit offset,
-        prefill/decode mix and budget split are all DATA to it) plus the
-        COW ``copy_page``; the legacy path's pair (``decode_chunk`` +
-        ``prefill_chunk``) stays cold. ``decode_step`` / ``sample_rows``
-        / ``row_keys`` are traced INSIDE whichever step program runs —
-        never dispatched from the host loop."""
+        tests: these stay fixed no matter the request mix. The entire
+        serving hot loop is ONE top-level step program (``ragged_step``;
+        prompt length, cache-hit offset, prefill/decode mix, budget
+        split AND the kv_quant storage mode are all DATA or trace-time
+        constants to it) plus the COW ``copy_page``. ``decode_step`` /
+        ``sample_rows`` / ``row_keys`` are traced INSIDE the step
+        program — never dispatched from the host loop. (The legacy
+        two-program pair ``decode_chunk``/``prefill_chunk`` was retired
+        with its fallback flag.)"""
         return {
-            "decode_chunk": paged_decode_chunk._cache_size(),
             "decode_step": paged_decode_step._cache_size(),
             "sample_rows": _sample_rows._cache_size(),
             "row_keys": _row_keys._cache_size(),
-            "prefill_chunk": paged_prefill_chunk._cache_size(),
             "ragged_step": paged_ragged_step._cache_size(),
             "copy_page": copy_page._cache_size(),
         }
@@ -450,9 +461,7 @@ class ContinuousEngine:
         req.prefill_tokens = seq
         req.prefill_target = len(seq)
         total = min(len(seq) + eff, self.max_seq_len)
-        if self.prefill_chunk > 0:
-            return self._admit_paged(req, slot, total)
-        return self._admit_monolithic(req, slot, total)
+        return self._admit_paged(req, slot, total)
 
     def _alloc_pages(self, n: int) -> list[int] | None:
         """All-or-nothing page grab with eviction-on-demand: when the
@@ -537,11 +546,9 @@ class ContinuousEngine:
         req.prefill_pos = hit_len
         self._slots[slot] = req
         self._prefilling[slot] = req
-        if self.unified:
-            # the completing step samples the first token IN-program, so
-            # the slot's sampling state must be armed before its first
-            # packed block — not at activation like the legacy path
-            self._arm_slot(req, slot)
+        # the completing step samples the first token IN-program, so the
+        # slot's sampling state must be armed before its first packed block
+        self._arm_slot(req, slot)
         self.stats["admitted"] += 1
         self.stats["prefill_tokens_skipped"] += hit_len
         if self.prefix is not None:
@@ -551,91 +558,6 @@ class ContinuousEngine:
             if hit_len > 0:
                 self.prefix.stats["hits"] += 1
             self.prefix.stats["hit_tokens"] += hit_len
-        return True
-
-    # tlint: hot-path
-    def _prefill_tick(self) -> None:
-        """One fixed-shape prefill chunk for EVERY mid-prefill slot, then
-        back to the decode chunk — the chunked-prefill TTFT guarantee:
-        co-resident decodes are never stalled by more than one chunk of
-        prefill compute per step, no matter how long an admitted prompt
-        is. A slot whose prompt completes activates immediately (its
-        first token samples from the final chunk's logits and it joins
-        this step's decode chunk)."""
-        C = self.prefill_chunk
-        for slot in sorted(self._prefilling):
-            req = self._prefilling[slot]
-            T = len(req.prefill_tokens)
-            n = min(C, T - req.prefill_pos)
-            toks = np.zeros(C, np.int32)
-            toks[:n] = req.prefill_tokens[
-                req.prefill_pos : req.prefill_pos + n
-            ]
-            h_last, self.cache = paged_prefill_chunk(
-                self.engine.params, jnp.asarray(toks), self.cache,
-                jnp.int32(slot), jnp.int32(req.prefill_pos), jnp.int32(n),
-                self.cfg, self.use_kernel,
-            )
-            req.prefill_pos += n
-            self.stats["prefill_chunks"] += 1
-            self.stats["prefill_tokens"] += n
-            if req.prefill_pos >= T:
-                del self._prefilling[slot]
-                logits = _head_from_hidden(
-                    self.engine.params, h_last, self.cfg
-                )
-                self._activate(req, slot, logits)
-
-    def _admit_monolithic(self, req: ContinuousRequest, slot: int,
-                          total: int) -> bool:
-        """Legacy one-shot admission (``prefill_chunk=0``): the whole
-        prompt prefills through the engine's bucketed dense program, then
-        its KV rows land on the allocated pages in one scatter."""
-        pages = self.alloc.alloc(pages_needed(total, self.page_size))
-        if pages is None:
-            return False
-        try:
-            logits, dense, lens, _B = self.engine.prefill(
-                [req.prefill_tokens]
-            )
-            T = len(req.prefill_tokens)
-            T_pad = dense.k.shape[2]  # full dense cache span
-            # bucketed scatter span: smallest seq bucket covering the
-            # prompt (bounded program set); positions past the prompt
-            # land on scratch
-            spans = [b for b in self.engine.seq_buckets if b >= T]
-            T_sc = spans[0] if spans else T_pad
-            T_sc = min(T_sc, T_pad)
-            bt_row = np.zeros(self.cache.pages_per_slot, np.int32)
-            bt_row[: len(pages)] = pages
-            pos = np.arange(T_sc)
-            pg_idx = np.where(
-                pos < T, bt_row[pos // self.page_size], 0
-            ).astype(np.int32)
-            off_idx = np.where(
-                pos < T, pos % self.page_size, 0
-            ).astype(np.int32)
-            self.cache = scatter_prefill(
-                self.cache,
-                dense.k[:, 0, :T_sc], dense.v[:, 0, :T_sc],
-                jnp.asarray(pg_idx), jnp.asarray(off_idx),
-            )
-            del dense
-            self.cache = bind_slot(
-                self.cache, jnp.int32(slot), jnp.asarray(bt_row),
-                jnp.int32(T)
-            )
-        except BaseException:
-            # failed admission must not leak pages past close()'s
-            # conservation check
-            self.alloc.free(pages)
-            raise
-        req.slot = slot
-        req.pages = pages
-        req.prefill_pos = T
-        self._slots[slot] = req
-        self.stats["admitted"] += 1
-        self._activate(req, slot, logits)
         return True
 
     def _set_knob_mirrors(self, slot: int, sp: SamplingParams) -> None:
@@ -649,42 +571,16 @@ class ContinuousEngine:
         self._freq[slot] = float(np.asarray(sp.frequency_penalty).reshape(-1)[0])
 
     def _arm_slot(self, req: ContinuousRequest, slot: int) -> None:
-        """Unified-path admission arming: the sampling state the legacy
-        path sets in ``_activate`` lands on the host at ADMISSION, before
-        the slot's first packed block — so the step that completes its
-        prefill draws the first token in-program with the request's own
-        key chain (index ``start_step + len(tokens)``, counting recovery
-        and pre-preemption tokens), the request's knobs, and the prefill
-        sequence's context histogram: exactly the draw ``_activate``
-        makes on the legacy path."""
+        """Admission arming: the sampling state lands on the host at
+        ADMISSION, before the slot's first packed block — so the step
+        that completes its prefill draws the first token in-program with
+        the request's own key chain (index ``start_step + len(tokens)``,
+        counting recovery and pre-preemption tokens), the request's
+        knobs, and the prefill sequence's context histogram."""
         self._seeds[slot] = req.seed
         self._steps[slot] = req.start_step + len(req.tokens)
         self._set_knob_mirrors(slot, req.sampling)
         self._counts = self._counts.at[slot].set(self._prompt_counts(req))
-
-    def _activate(self, req: ContinuousRequest, slot: int, logits) -> None:
-        """Prefill done (legacy path): draw the next token from the last
-        prefilled position's logits with the request's own key chain —
-        exactly what an uninterrupted run draws at this step (``base``
-        counts recovery AND pre-preemption tokens, both already in the
-        prefill sequence) — and open the slot for decode chunks."""
-        sp = req.sampling
-        base = req.start_step + len(req.tokens)
-        key = jax.random.fold_in(jax.random.PRNGKey(req.seed), base)
-        counts_row = self._prompt_counts(req)
-        tok = int(
-            np.asarray(sample(logits[:1], key, sp, counts_row[None]))[0]
-        )
-        self._counts = self._counts.at[slot].set(
-            counts_row.at[tok].add(1)
-        )
-        self._seeds[slot] = req.seed
-        self._steps[slot] = base + 1  # next draw's index
-        self._tok[slot] = tok
-        self._active[slot] = True
-        self._set_knob_mirrors(slot, sp)
-        if self._emit(req, tok):
-            self._evict(slot)
 
     def _prompt_counts(self, req: ContinuousRequest) -> jax.Array:
         """Context histogram for presence/frequency penalties (row-local,
@@ -855,6 +751,18 @@ class ContinuousEngine:
         queue-wait/TTFT percentiles, preemptions, rejections), plus
         prefix-cache occupancy."""
         out = dict(self.stats)
+        # KV storage mode + occupancy: the capacity math operators size
+        # slots-per-chip with (kv_quant="int8" halves kv_page_bytes)
+        c = self.cache
+        page_bytes = (c.k.nbytes + c.v.nbytes) // c.n_pages
+        if c.quantized:
+            page_bytes += (c.k_scale.nbytes + c.v_scale.nbytes) // c.n_pages
+        out.update({
+            "kv_quant": self.kv_quant,
+            "kv_pages_total": c.n_pages - 1,
+            "kv_pages_free": self.alloc.n_free,
+            "kv_page_bytes": int(page_bytes),
+        })
         with self._lock:
             out.update(self.sched.snapshot())
         if self.prefix is not None:
@@ -990,95 +898,56 @@ class ContinuousEngine:
     def step_chunk(self, *, admit_only: bool = False) -> bool:
         """Admit queued requests, then run ONE compiled step program.
 
-        Unified path (the default): the packed ragged block — every
-        mid-prefill slot's next prompt piece AND every decode slot's next
-        token in one dispatch — followed by the decode continuation loop,
-        all inside the single ``ragged_step`` program: a decode slot's
-        inter-token latency is one step whether or not a co-resident
-        admission is prefilling (no separate prefill dispatches to wait
-        behind), and a completing prefill samples its first token in the
-        same dispatch that finishes its prompt. Legacy path
-        (``unified_step=False``): ≤1 ``prefill_chunk`` program per
-        mid-prefill slot, THEN the ``decode_chunk`` program. Both run
-        ``chunk_steps`` fixed-shape slot steps per host round trip,
-        deliver each slot's tokens up to its own done-point, and evict
-        finished slots at the boundary. Returns True while any work
-        (live slots or queued requests) remains — the driver's requeue
-        signal."""
+        The packed ragged block — every mid-prefill slot's next prompt
+        piece AND every decode slot's next token in one dispatch —
+        followed by the decode continuation loop, all inside the single
+        ``ragged_step`` program: a decode slot's inter-token latency is
+        one step whether or not a co-resident admission is prefilling
+        (no separate prefill dispatches to wait behind), and a
+        completing prefill samples its first token in the same dispatch
+        that finishes its prompt. Runs ``chunk_steps`` fixed-shape slot
+        steps per host round trip, delivers each slot's tokens up to its
+        own done-point, and evicts finished slots at the boundary.
+        Returns True while any work (live slots or queued requests)
+        remains — the driver's requeue signal."""
         self._admit()
         if admit_only:
             return self.has_work()
         S = self.max_slots
-        if self.unified:
-            pack = self._pack_ragged()
-            if pack is None:
-                return self.has_work()
-            blk, starts, n_valid, emit, remaining, eos_arr, completing, \
-                grants = pack
-            tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
-                _rem = paged_ragged_step(
-                    self.engine.params, jnp.asarray(blk), self.cache,
-                    jnp.asarray(starts), jnp.asarray(n_valid),
-                    jnp.asarray(emit),
-                    jnp.asarray(self._seeds), jnp.asarray(self._steps),
-                    jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._pres),
-                    jnp.asarray(self._freq), self._counts,
-                    jnp.asarray(remaining), jnp.asarray(eos_arr),
-                    self.cfg, self.chunk_steps, self.use_kernel,
-                )
-            n_exec = int(n_exec)
-            toks_host = np.asarray(tokens)[:, :n_exec]
-            # prefill bookkeeping: the grants landed on device; completed
-            # prompts switch to decode mode before delivery (their first
-            # token is column 0 of this very chunk)
-            for s, g in grants.items():
-                self._prefilling[s].prefill_pos += g
-                self.stats["prefill_chunks"] += 1
-                self.stats["prefill_tokens"] += g
-            for s in completing:
-                del self._prefilling[s]
-                self._active[s] = True
-            if emit.any():
-                # prefill-only steps decode nothing — don't count them
-                # (the legacy path's numbers for the same workload)
-                self.stats["decode_steps"] += n_exec
-                self.stats["slot_steps_total"] += n_exec * S
-            deliver = emit
-        else:
-            if self._prefilling:
-                # one prefill chunk per mid-prefill slot, THEN the decode
-                # chunk: a long admission interleaves with running decodes
-                # instead of stalling them for its whole prompt
-                self._prefill_tick()
-            if not self._active.any():
-                return self.has_work()
-            remaining = np.zeros(S, np.int32)
-            eos_arr = np.full((S, self._EOS_WIDTH), -1, np.int32)
-            for s in range(S):
-                req = self._slots[s]
-                if req is not None:
-                    remaining[s] = req.budget - len(req.tokens)
-                    ids = sorted(req.eos)[: self._EOS_WIDTH]
-                    eos_arr[s, : len(ids)] = ids
-            tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
-                _rem = paged_decode_chunk(
-                    self.engine.params, jnp.asarray(self._tok), self.cache,
-                    jnp.asarray(self._active),
-                    jnp.asarray(self._seeds), jnp.asarray(self._steps),
-                    jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._pres),
-                    jnp.asarray(self._freq), self._counts,
-                    jnp.asarray(remaining), jnp.asarray(eos_arr),
-                    self.cfg, self.chunk_steps, self.use_kernel,
-                )
-            n_exec = int(n_exec)
-            if n_exec <= 0:
-                return self.has_work()
-            toks_host = np.asarray(tokens)[:, :n_exec]
+        pack = self._pack_ragged()
+        if pack is None:
+            return self.has_work()
+        blk, starts, n_valid, emit, remaining, eos_arr, completing, \
+            grants = pack
+        tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
+            _rem = paged_ragged_step(
+                self.engine.params, jnp.asarray(blk), self.cache,
+                jnp.asarray(starts), jnp.asarray(n_valid),
+                jnp.asarray(emit),
+                jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._pres),
+                jnp.asarray(self._freq), self._counts,
+                jnp.asarray(remaining), jnp.asarray(eos_arr),
+                self.cfg, self.chunk_steps, self.use_kernel,
+            )
+        n_exec = int(n_exec)
+        toks_host = np.asarray(tokens)[:, :n_exec]
+        # prefill bookkeeping: the grants landed on device; completed
+        # prompts switch to decode mode before delivery (their first
+        # token is column 0 of this very chunk)
+        for s, g in grants.items():
+            self._prefilling[s].prefill_pos += g
+            self.stats["prefill_chunks"] += 1
+            self.stats["prefill_tokens"] += g
+        for s in completing:
+            del self._prefilling[s]
+            self._active[s] = True
+        if emit.any():
+            # prefill-only steps decode nothing — don't count them
             self.stats["decode_steps"] += n_exec
             self.stats["slot_steps_total"] += n_exec * S
-            deliver = self._active
+        deliver = emit
         for s in range(S):
             if not deliver[s]:
                 continue
@@ -1129,4 +998,7 @@ class ContinuousEngine:
         self.check_page_conservation()
 
 
-__all__ = ["ContinuousEngine", "ContinuousRequest", "pack_prefill_budgets"]
+__all__ = [
+    "ContinuousEngine", "ContinuousRequest", "pack_prefill_budgets",
+    "paged_unsupported",
+]

@@ -18,14 +18,26 @@ invisible) per-step KV writes land on scratch instead of a page another
 slot owns — that invariant is what makes eviction safe with zero
 cross-slot contamination.
 
-Attention routes through ops/attention.py: on the default unified path
-the Pallas :func:`~tensorlink_tpu.ops.attention.ragged_paged_attention`
-kernel on TPU (whole mixed prefill+decode block, KV gathered page-by-page
-via a scalar-prefetched block table) with
+Attention routes through ops/attention.py: the Pallas
+:func:`~tensorlink_tpu.ops.attention.ragged_paged_attention` kernel on
+TPU (whole mixed prefill+decode block, KV gathered page-by-page via a
+scalar-prefetched block table) with
 :func:`~tensorlink_tpu.ops.attention.ragged_paged_attention_ref` on CPU
-and in parity tests; the legacy path keeps the
-:func:`~tensorlink_tpu.ops.attention.paged_attention` /
-:func:`~tensorlink_tpu.ops.attention.paged_prefill_attention` pair.
+and in parity tests; the decode continuation inside the step runs the
+:func:`~tensorlink_tpu.ops.attention.paged_attention` kernel per token.
+
+**Quantized pages** (``quantized=True`` / ``MLConfig.kv_quant="int8"``):
+the page pool stores KV int8 with per-(page, position, head) symmetric
+f32 scales carried page-granular alongside the payload. Quantization
+happens at THE one page-write path (``_ragged_write_indices`` feeds every
+program), one position at a time — a position's (int8 bytes, scale) pair
+depends only on its own KV row, so the bitwise cache contract survives by
+construction: a quantized page + its scale rows IS the cache value, and
+COW ``copy_page``, trie promotion, LRU eviction, crash-recovery
+re-prefill and preemption resume all move it byte-exactly. The kernels
+dequantize at the page fetch (scale multiply fused into the HBM read),
+so KV bytes halve while the MXU math stays in the model dtype — ~2×
+serving slots and ~2× prefix-cache residency at fixed HBM.
 """
 
 from __future__ import annotations
@@ -49,11 +61,10 @@ from ..models.transformer import (
     rope_tables,
 )
 from ..models.quant import matmul as _mm
+from ..models.quant import quantize_kv as _quant_kv
 from ..ops.attention import (
     paged_attention,
     paged_attention_ref,
-    paged_prefill_attention,
-    paged_prefill_attention_ref,
     ragged_paged_attention,
     ragged_paged_attention_ref,
 )
@@ -67,12 +78,20 @@ class PagedKVCache:
     (0 = the reserved scratch page), ``lengths`` counts valid positions
     per slot ``[S]``. Stacked over layers like the dense cache so the
     decode ``lax.scan`` indexes its layer slice; donated into the step so
-    XLA updates pages in place."""
+    XLA updates pages in place.
+
+    **int8 mode** (``quantized=True``): ``k``/``v`` hold int8 and
+    ``k_scale``/``v_scale`` ``[L, P, n_kv, page]`` carry the
+    per-(page, position, head) symmetric f32 scales — page-granular
+    storage, so every page operation (COW, promotion, eviction, clear)
+    moves payload and scales together byte-exactly."""
 
     k: jax.Array
     v: jax.Array
     block_tables: jax.Array  # int32 [S, pages_per_slot]
     lengths: jax.Array  # int32 [S]
+    k_scale: jax.Array | None = None  # f32 [L, P, n_kv, page] — int8 mode
+    v_scale: jax.Array | None = None
 
     @classmethod
     def init(
@@ -83,11 +102,21 @@ class PagedKVCache:
         page_size: int = 16,
         max_len: int | None = None,
         dtype=None,
+        quantized: bool = False,
     ) -> "PagedKVCache":
         S_max = max_len or cfg.max_seq_len
         n_pp = -(-S_max // page_size)  # pages per slot (ceil)
         P = 1 + max_slots * n_pp  # page 0 = scratch, never allocated
         shape = (cfg.n_layers, P, cfg.n_kv_heads, page_size, cfg.head_dim)
+        if quantized:
+            return cls(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                block_tables=jnp.zeros((max_slots, n_pp), jnp.int32),
+                lengths=jnp.zeros((max_slots,), jnp.int32),
+                k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                v_scale=jnp.zeros(shape[:-1], jnp.float32),
+            )
         dt = dtype or cfg.dtype
         return cls(
             k=jnp.zeros(shape, dt),
@@ -95,6 +124,10 @@ class PagedKVCache:
             block_tables=jnp.zeros((max_slots, n_pp), jnp.int32),
             lengths=jnp.zeros((max_slots,), jnp.int32),
         )
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @property
     def page_size(self) -> int:
@@ -426,6 +459,49 @@ def _ragged_write_indices(block_tables, starts, n_valid, page, n_pp, C):
     return write_pg, write_off, pos, valid
 
 
+def _cache_kv(cache: PagedKVCache) -> tuple:
+    """One layer-stacked KV tuple for the decode scan — ``(k, v)`` plain,
+    ``(k, v, k_scale, v_scale)`` in int8 mode; the blocks branch on the
+    tuple arity (a trace-time constant)."""
+    if cache.k_scale is None:
+        return (cache.k, cache.v)
+    return (cache.k, cache.v, cache.k_scale, cache.v_scale)
+
+
+def _with_kv(cache: PagedKVCache, kv: tuple, **kw) -> PagedKVCache:
+    """Rebuild the cache from a scan's stacked KV output (inverse of
+    :func:`_cache_kv`)."""
+    if len(kv) == 4:
+        return replace(
+            cache, k=kv[0], v=kv[1], k_scale=kv[2], v_scale=kv[3], **kw
+        )
+    return replace(cache, k=kv[0], v=kv[1], **kw)
+
+
+# tlint: hot-path
+def _scatter_kv(cache_kv: tuple, write_pg, write_off, k, v) -> tuple:
+    """THE one page-write path's scatter: land this block's KV rows at
+    their ``(page, offset)`` targets across every program. In int8 mode
+    this is the single quantize site — each position's row quantizes
+    independently (per-(position, head) scale over ``head_dim``,
+    models/quant.py::quantize_kv), which is exactly what keeps chunk
+    framing, COW and promotion byte-exact under quantization. ``k``/``v``
+    are ``[..., Hkv, hd]`` with leading dims matching ``write_pg``."""
+    if len(cache_kv) == 4:
+        ck, cv, cks, cvs = cache_kv
+        k8, ks = _quant_kv(k)
+        v8, vs = _quant_kv(v)
+        ck = ck.at[write_pg, :, write_off].set(k8)
+        cv = cv.at[write_pg, :, write_off].set(v8)
+        cks = cks.at[write_pg, :, write_off].set(ks)
+        cvs = cvs.at[write_pg, :, write_off].set(vs)
+        return ck, cv, cks, cvs
+    ck, cv = cache_kv
+    ck = ck.at[write_pg, :, write_off].set(k.astype(ck.dtype))
+    cv = cv.at[write_pg, :, write_off].set(v.astype(cv.dtype))
+    return ck, cv
+
+
 def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
                  write_off, att_len, block_tables, kernel: bool):
     """One transformer block over a slot batch of single tokens (T=1),
@@ -437,19 +513,21 @@ def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
     h = x if cfg.norm_position == "post" else _norm(x, lp["ln1"], cfg)
     q, k, v = _paged_qkv(h, lp, cfg, cos, sin)  # [S, 1, H, hd]
 
-    ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
-    # per-slot scatter of the new token's KV: (page, offset) index pairs
-    # (advanced-first indexing puts the slot axis in front, matching the
-    # [S, n_kv, hd] update)
-    ck = ck.at[write_pg, :, write_off].set(k[:, 0].astype(ck.dtype))
-    cv = cv.at[write_pg, :, write_off].set(v[:, 0].astype(cv.dtype))
-
+    # per-slot scatter of the new token's KV through THE one write path
+    # (quantizes in int8 mode); cache_kv is this layer's pages
+    kv = _scatter_kv(cache_kv, write_pg, write_off, k[:, 0], v[:, 0])
     attn = paged_attention if kernel else paged_attention_ref
-    attn_raw = attn(
-        q[:, 0], ck.astype(q.dtype), cv.astype(q.dtype),
-        block_tables, att_len, scale=_attn_scale(cfg),
-    )[:, None]  # [S, 1, Hq, hd]
-    return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
+    if len(kv) == 4:
+        attn_raw = attn(
+            q[:, 0], kv[0], kv[1], block_tables, att_len,
+            scale=_attn_scale(cfg), k_scale=kv[2], v_scale=kv[3],
+        )[:, None]
+    else:
+        attn_raw = attn(
+            q[:, 0], kv[0].astype(q.dtype), kv[1].astype(q.dtype),
+            block_tables, att_len, scale=_attn_scale(cfg),
+        )[:, None]  # [S, 1, Hq, hd]
+    return _paged_residual(x, attn_raw, lp, cfg), kv
 
 
 # tlint: hot-path
@@ -496,89 +574,33 @@ def paged_decode_step(
         cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
 
     def scan_fn(carry, xs):
-        lp, ck, cv = xs
+        lp, ckv = xs[0], xs[1:]
         y, ckv = _paged_block(
-            carry, lp, cfg, cos, sin, (ck, cv), write_pg, write_off,
+            carry, lp, cfg, cos, sin, ckv, write_pg, write_off,
             att_len, cache.block_tables, kernel,
         )
         return y, ckv
 
-    x, (k_new, v_new) = jax.lax.scan(
-        scan_fn, x, (params["layers"], cache.k, cache.v)
+    x, kv_new = jax.lax.scan(
+        scan_fn, x, (params["layers"], *_cache_kv(cache))
     )
     x = _norm(x, params["final_norm"], cfg)
     logits = _logits(params, x, cfg)[:, 0]
-    new_cache = replace(
-        cache, k=k_new, v=v_new,
-        lengths=jnp.where(active, lengths + 1, lengths),
+    new_cache = _with_kv(
+        cache, kv_new, lengths=jnp.where(active, lengths + 1, lengths)
     )
     return logits, new_cache
 
 
-# tlint: hot-path
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "n_steps", "kernel"),
-    donate_argnames=("cache", "counts"),
-)
-def paged_decode_chunk(
-    params,
-    tok: jax.Array,  # int32 [S] — each slot's last token
-    cache: PagedKVCache,
-    active: jax.Array,  # bool [S]
-    seeds: jax.Array,  # int32 [S] — per-slot RNG seeds
-    steps: jax.Array,  # int32 [S] — per-slot next draw index
-    temp: jax.Array,  # f32 [S] sampling knobs …
-    top_k: jax.Array,  # int32 [S]
-    top_p: jax.Array,  # f32 [S]
-    pres: jax.Array,  # f32 [S]
-    freq: jax.Array,  # f32 [S]
-    counts: jax.Array,  # int32 [S, V] context histograms (penalties)
-    remaining: jax.Array,  # int32 [S] — tokens still wanted per slot
-    eos: jax.Array,  # int32 [S, E] per-slot EOS ids (pad with -1)
-    cfg: ModelConfig,
-    n_steps: int,
-    kernel: bool = False,
-):
-    """Up to ``n_steps`` fixed-shape slot decode steps in ONE on-device
-    while_loop — the host is touched once per CHUNK, not once per token
-    (the same lever as engine/generate.py::_decode_loop, now over paged
-    slots). A slot that finishes mid-chunk (EOS / budget) freezes: its
-    length stops advancing, it re-feeds its own token, and its per-slot
-    key index stops — so the emitted stream is BIT-IDENTICAL to stepping
-    one token at a time, which is what keeps the solo/co-batched/recovery
-    parity contract intact. Early-exits when every slot is done.
-
-    Returns ``(tokens [S, n_steps], n_exec, cache, done, steps, counts,
-    remaining)``; the host delivers each slot's tokens up to its own
-    done-point and evicts at the chunk boundary."""
-    S = tok.shape[0]
-    tokens = jnp.zeros((S, n_steps), jnp.int32)
-    done0 = ~active | (remaining <= 0)
-    body = _decode_loop_body(
-        params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel
-    )
-
-    def cond(st):
-        return (st[0] < n_steps) & ~st[3].all()
-
-    init = (jnp.int32(0), tok, cache, done0, steps, counts, remaining, tokens)
-    n_exec, _tok, cache, done, steps, counts, remaining, tokens = (
-        jax.lax.while_loop(cond, body, init)
-    )
-    return tokens, n_exec, cache, done, steps, counts, remaining
-
-
 def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
                       cfg: ModelConfig, kernel: bool):
-    """The slot-decode while_loop body, shared VERBATIM by the legacy
-    ``paged_decode_chunk`` and the unified ``paged_ragged_step``'s decode
-    continuation — one copy is what keeps the two paths' in-chunk math
-    (freeze semantics, key-chain advance, penalty counts) identical by
-    construction. A slot that finishes mid-chunk (EOS / budget) freezes:
+    """The decode-continuation while_loop body of ``paged_ragged_step``
+    (one fixed-shape slot decode step + in-program sampling per
+    iteration). A slot that finishes mid-chunk (EOS / budget) freezes:
     its length stops advancing, it re-feeds its own token, and its
     per-slot key index stops — so the emitted stream is BIT-IDENTICAL to
-    stepping one token at a time."""
+    stepping one token at a time, which is what keeps the
+    solo/co-batched/recovery parity contract intact."""
     from .continuous import _row_keys, _sample_rows
 
     S = seeds.shape[0]
@@ -606,95 +628,6 @@ def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
     return body
 
 
-def _paged_prefill_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv,
-                         write_pg, write_off, bt_row, start, kernel: bool):
-    """One transformer block over ONE slot's prefill chunk of C tokens,
-    reading/writing KV through the slot's pages. Shares ``_paged_block``'s
-    prologue/epilogue (scatter-then-attend order preserved) but carries a
-    whole chunk of queries at offset ``start`` — the offset-carrying
-    attention is what lets a prompt suffix prefill in pieces that each
-    attend everything before them."""
-    h = x if cfg.norm_position == "post" else _norm(x, lp["ln1"], cfg)
-    q, k, v = _paged_qkv(h, lp, cfg, cos, sin)  # [1, C, H, hd]
-
-    ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
-    # chunk scatter: position j of the chunk lands at (write_pg[j],
-    # write_off[j]); invalid tail positions land on scratch page 0, so
-    # their garbage KV is unreachable from any block table
-    ck = ck.at[write_pg, :, write_off].set(k[0].astype(ck.dtype))
-    cv = cv.at[write_pg, :, write_off].set(v[0].astype(cv.dtype))
-
-    attn = paged_prefill_attention if kernel else paged_prefill_attention_ref
-    attn_raw = attn(
-        q[0], ck.astype(q.dtype), cv.astype(q.dtype), bt_row, start,
-        scale=_attn_scale(cfg),
-    )[None]  # [1, C, Hq, hd]
-    return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
-
-
-# tlint: hot-path
-@partial(
-    jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
-)
-def paged_prefill_chunk(
-    params,
-    toks: jax.Array,  # int32 [C] — one slot's next prompt piece (0-padded)
-    cache: PagedKVCache,
-    slot: jax.Array,  # int32 scalar
-    start: jax.Array,  # int32 scalar — absolute position of toks[0]
-    n_valid: jax.Array,  # int32 scalar — real tokens in this chunk
-    cfg: ModelConfig,
-    kernel: bool = False,
-):
-    """One CHUNK of a slot's prompt prefill, straight onto its pages.
-
-    Fixed shape ``[C]`` (C = the engine's prefill_chunk) with slot, start
-    offset and valid count as DATA — the whole chunked-prefill feature
-    adds exactly ONE compiled program to the serving engine regardless of
-    prompt lengths or cache-hit mix (asserted next to the decode-chunk
-    bound in tests/test_continuous.py). Returns the final-norm hidden
-    state of the chunk's last valid token ``[1, d]`` (the engine applies
-    the vocab head only on the final chunk, via the same
-    ``_head_from_hidden`` program the dense chunked prefill uses) and the
-    cache with this slot's length advanced to ``start + n_valid``."""
-    C = toks.shape[0]
-    page = cache.page_size
-    n_pp = cache.pages_per_slot
-    bt_row = cache.block_tables[slot]  # [n_pp]
-    write_pg, write_off, pos, valid = _ragged_write_indices(
-        bt_row[None], jnp.asarray(start, jnp.int32).reshape(1),
-        jnp.asarray(n_valid, jnp.int32).reshape(1), page, n_pp, C,
-    )
-    write_pg, write_off, pos = write_pg[0], write_off[0], pos[0]
-
-    x = _embed_tokens(params, toks[None, :], cfg)  # [1, C, d]
-    positions = pos[None, :]
-    if cfg.pos == "learned":
-        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
-    cos = sin = None
-    if cfg.pos == "rope":
-        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
-
-    def scan_fn(carry, xs):
-        lp, ck, cv = xs
-        y, ckv = _paged_prefill_block(
-            carry, lp, cfg, cos, sin, (ck, cv), write_pg, write_off,
-            bt_row, start, kernel,
-        )
-        return y, ckv
-
-    x, (k_new, v_new) = jax.lax.scan(
-        scan_fn, x, (params["layers"], cache.k, cache.v)
-    )
-    x = _norm(x, params["final_norm"], cfg)
-    h_last = x[0, jnp.maximum(n_valid - 1, 0)][None]  # [1, d]
-    new_cache = replace(
-        cache, k=k_new, v=v_new,
-        lengths=cache.lengths.at[slot].set(start + n_valid),
-    )
-    return h_last, new_cache
-
-
 def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
                   write_off, block_tables, starts, n_valid, kernel: bool):
     """One transformer block over the ragged ``[S, C]`` token block,
@@ -707,19 +640,23 @@ def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
     h = x if cfg.norm_position == "post" else _norm(x, lp["ln1"], cfg)
     q, k, v = _paged_qkv(h, lp, cfg, cos, sin)  # [S, C, H, hd]
 
-    ck, cv = cache_kv  # [P, Hkv, page, hd] — this layer's pages
-    # block scatter through the one write path: position (s, j) lands at
-    # (write_pg[s, j], write_off[s, j]); padding rows and idle slots land
-    # on scratch page 0, unreachable from any block table
-    ck = ck.at[write_pg, :, write_off].set(k.astype(ck.dtype))
-    cv = cv.at[write_pg, :, write_off].set(v.astype(cv.dtype))
-
+    # block scatter through the one write path (quantizes in int8 mode):
+    # position (s, j) lands at (write_pg[s, j], write_off[s, j]); padding
+    # rows and idle slots land on scratch page 0, unreachable from any
+    # block table
+    kv = _scatter_kv(cache_kv, write_pg, write_off, k, v)
     attn = ragged_paged_attention if kernel else ragged_paged_attention_ref
-    attn_raw = attn(
-        q, ck.astype(q.dtype), cv.astype(q.dtype), block_tables,
-        starts, n_valid, scale=_attn_scale(cfg),
-    )  # [S, C, Hq, hd]
-    return _paged_residual(x, attn_raw, lp, cfg), (ck, cv)
+    if len(kv) == 4:
+        attn_raw = attn(
+            q, kv[0], kv[1], block_tables, starts, n_valid,
+            scale=_attn_scale(cfg), k_scale=kv[2], v_scale=kv[3],
+        )
+    else:
+        attn_raw = attn(
+            q, kv[0].astype(q.dtype), kv[1].astype(q.dtype), block_tables,
+            starts, n_valid, scale=_attn_scale(cfg),
+        )  # [S, C, Hq, hd]
+    return _paged_residual(x, attn_raw, lp, cfg), kv
 
 
 # tlint: hot-path
@@ -762,18 +699,17 @@ def paged_ragged_step(
     idle slot 0 tokens. Slots with ``emit`` set (decode slots, and
     prefills whose prompt completes in this block) sample their next
     token from their last valid row's logits with the request's own key
-    chain — exactly the draw the legacy path makes in ``_activate`` /
-    the decode chunk — and continue through the decode loop (whose body
-    is shared VERBATIM with ``paged_decode_chunk``); mid-prefill slots
+    chain and continue through the decode loop; mid-prefill slots
     that didn't finish stay frozen for the rest of the chunk and get
     their next grant at the next step boundary. One compiled program
     serves every (prefill/decode mix, prompt length, offset, budget
-    split) — asserted next to the legacy bounds in
-    tests/test_continuous.py.
+    split) — asserted in tests/test_continuous.py. With a quantized
+    cache the same program stores int8 pages: the scatter quantizes,
+    the kernels dequantize at the fetch.
 
     Returns ``(tokens [S, n_steps], n_exec, cache, done, steps, counts,
-    remaining)`` — the legacy chunk's exact host contract, with column 0
-    holding the ragged block's draws (meaningful where ``emit``)."""
+    remaining)``, with column 0 holding the ragged block's draws
+    (meaningful where ``emit``)."""
     S, C = blk.shape
     page = cache.page_size
     n_pp = cache.pages_per_slot
@@ -791,15 +727,15 @@ def paged_ragged_step(
         cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
 
     def scan_fn(carry, xs):
-        lp, ck, cv = xs
+        lp, ckv = xs[0], xs[1:]
         y, ckv = _ragged_block(
-            carry, lp, cfg, cos, sin, (ck, cv), write_pg, write_off,
+            carry, lp, cfg, cos, sin, ckv, write_pg, write_off,
             bt, starts, n_valid, kernel,
         )
         return y, ckv
 
-    x, (k_new, v_new) = jax.lax.scan(
-        scan_fn, x, (params["layers"], cache.k, cache.v)
+    x, kv_new = jax.lax.scan(
+        scan_fn, x, (params["layers"], *_cache_kv(cache))
     )
     x = _norm(x, params["final_norm"], cfg)
     # per-slot last valid row → vocab head over [S] rows only (idle slots
@@ -817,14 +753,13 @@ def paged_ragged_step(
     steps = steps + live
     remaining = remaining - live
     done = ~emit | (nxt[:, None] == eos).any(-1) | (remaining <= 0)
-    cache = replace(
-        cache, k=k_new, v=v_new,
+    cache = _with_kv(
+        cache, kv_new,
         lengths=jnp.where(n_valid > 0, starts + n_valid, cache.lengths),
     )
     tokens = jnp.zeros((S, n_steps), jnp.int32).at[:, 0].set(nxt)
 
-    # decode continuation: the legacy chunk's exact loop (shared body),
-    # starting past the ragged block's step
+    # decode continuation, starting past the ragged block's step
     body = _decode_loop_body(
         params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel
     )
@@ -846,37 +781,21 @@ def copy_page(
 ) -> PagedKVCache:
     """Copy-on-write: duplicate a cached page's KV (every layer) into a
     page the admitting slot owns, so the slot can overwrite its tail
-    without touching the shared original."""
-    return replace(
+    without touching the shared original. In int8 mode the scale rows
+    move with the payload — the copy is byte-exact, so a COW'd quantized
+    page dequantizes to exactly what the original does."""
+    out = replace(
         cache,
         k=cache.k.at[:, dst].set(cache.k[:, src]),
         v=cache.v.at[:, dst].set(cache.v[:, src]),
     )
-
-
-# tlint: hot-path
-@partial(jax.jit, donate_argnames=("cache",))
-def scatter_prefill(
-    cache: PagedKVCache,
-    k_rows: jax.Array,  # [L, T, n_kv, hd] — one prompt's dense KV rows
-    v_rows: jax.Array,
-    page_idx: jax.Array,  # int32 [T] — destination page per position
-    off_idx: jax.Array,  # int32 [T] — offset within the page
-) -> PagedKVCache:
-    """Land a dense prefill's KV rows on a slot's pages. The prefill
-    itself runs the engine's existing bucketed program (same math as a
-    solo decode — the parity anchor); this scatter is one device-side
-    copy, so admission costs prefill + O(T) page writes and compiles one
-    program per seq bucket."""
-    # cache.k is [L, P, Hkv, page, hd]; advanced-first indexing puts the
-    # T axis in front, so the rows transpose to [T, L, Hkv, hd]
-    k = cache.k.at[:, page_idx, :, off_idx].set(
-        k_rows.transpose(1, 0, 2, 3).astype(cache.k.dtype)
-    )
-    v = cache.v.at[:, page_idx, :, off_idx].set(
-        v_rows.transpose(1, 0, 2, 3).astype(cache.v.dtype)
-    )
-    return replace(cache, k=k, v=v)
+    if cache.k_scale is not None:
+        out = replace(
+            out,
+            k_scale=cache.k_scale.at[:, dst].set(cache.k_scale[:, src]),
+            v_scale=cache.v_scale.at[:, dst].set(cache.v_scale[:, src]),
+        )
+    return out
 
 
 # tlint: hot-path
@@ -919,10 +838,8 @@ __all__ = [
     "PageAllocator",
     "PrefixCache",
     "paged_decode_step",
-    "paged_prefill_chunk",
     "paged_ragged_step",
     "copy_page",
-    "scatter_prefill",
     "bind_slot",
     "clear_slot",
     "pages_needed",

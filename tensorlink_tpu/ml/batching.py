@@ -660,7 +660,7 @@ class ContinuousBatcher:
         chunk_steps: int = 8,
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
-        unified_step: bool = True,
+        kv_quant: str = "none",
         seed: int = 0,
         default_priority: str = DEFAULT_PRIORITY,
         sched_queue_cap: int = 64,
@@ -703,7 +703,7 @@ class ContinuousBatcher:
                 else ContinuousEngine(
                     engine, max_slots=max_slots, page_size=page_size,
                     chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache, unified_step=unified_step,
+                    prefix_cache=prefix_cache, kv_quant=kv_quant,
                     default_priority=self.default_priority,
                     sched_queue_cap=sched_queue_cap,
                     sched_aging_ticks=sched_aging_ticks,

@@ -311,11 +311,14 @@ class DistributedValidator:
         merged = any(s.coworkers for s in job.model.plan.stages)
         # models the paged slot engine refuses must get the WINDOWED
         # batcher here — routing them continuous would degrade each
-        # request to a serialized solo generate on the worker's fallback
-        unpageable = (
-            cfg.sliding_window is not None
-            or model_spec.get("quant") == "int8+kv"
-        )
+        # request to a serialized solo generate on the worker's fallback.
+        # The predicate lives with the engine (paged_unsupported) so this
+        # routing can never drift from what the engine actually accepts:
+        # int8-KV models ("int8+kv") serve CONTINUOUS now — the paged
+        # cache stores int8 pages natively (kv_quant, docs/SERVING.md)
+        from tensorlink_tpu.engine.continuous import paged_unsupported
+
+        unpageable = paged_unsupported(cfg) is not None
         if ml_cfg.continuous_batching and not merged and not unpageable:
             # continuous batching (docs/SERVING.md): no arrival window, no
             # drain barrier — requests join the model's running slot batch
@@ -324,7 +327,7 @@ class DistributedValidator:
                 job.model, job.tokenizer.eos_ids,
                 max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
                 chunk_steps=ml_cfg.cont_chunk_steps,
-                unified_step=ml_cfg.unified_step,
+                kv_quant=ml_cfg.kv_quant,
                 default_priority=ml_cfg.default_priority,
                 sched_queue_cap=ml_cfg.sched_queue_cap,
                 sched_aging_ticks=ml_cfg.sched_aging_ticks,

@@ -331,6 +331,14 @@ class DistributedWorker:
         if mesh is not None:
             params = self._shard_params(params, cfg, stage, mesh)
         training = bool(p.get("training", False))
+        if self.node.config.ml.collective_quant and not training:
+            # EQuARX-style quantized collectives (parallel/ring.py): the
+            # sequence-parallel ring rotates int8 K/V + scales over ICI.
+            # SERVING only — quantize_kv's round() has a zero gradient,
+            # so a training vjp through a quantized ring would silently
+            # lose the K/V gradient (same rule as weight quant below:
+            # training needs exact math)
+            cfg = cfg.with_(collective_quant=True)
         quant = p.get("model", {}).get("quant")
         if p.get("model", {}).get("flash"):
             # Pallas flash prefill for this job's serving ENGINE — i.e.
@@ -1573,7 +1581,9 @@ class DistributedWorker:
                     chunk_steps=int(ml.cont_chunk_steps),
                     prefill_chunk=int(ml.prefill_chunk),
                     prefix_cache=bool(ml.prefix_cache),
-                    unified_step=bool(ml.unified_step),
+                    # `or` before str(): a null kv_quant in an operator
+                    # config must read as "none", not the string "None"
+                    kv_quant=str(ml.kv_quant or "none"),
                     default_priority=str(ml.default_priority),
                     sched_queue_cap=int(ml.sched_queue_cap),
                     sched_aging_ticks=int(ml.sched_aging_ticks),
@@ -1582,7 +1592,9 @@ class DistributedWorker:
                     sched_max_wait_s=float(ml.sched_max_wait_s),
                 )
             except ValueError as e:
-                # int8 KV cache / sliding window: static batcher territory
+                # sliding window (or a bad knob): static batcher territory.
+                # int8-KV models ("int8+kv") are NOT refused anymore — the
+                # paged engine stores int8 pages natively (kv_quant)
                 self.log.info("continuous batching unavailable: %s", e)
                 return False
         t, k, tp, pp, fp = knobs

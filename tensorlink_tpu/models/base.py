@@ -90,6 +90,12 @@ class ModelConfig:
     # (ops/attention.py): blockwise online softmax, no [T, T] score tensor
     # in HBM. Opt-in; decode and training keep the einsum path.
     flash_attention: bool = False
+    # EQuARX-style quantized collectives (parallel/ring.py): sequence-
+    # parallel ring attention rotates int8 K/V chunks + per-(position,
+    # head) scales over ICI instead of full-precision blocks — half the
+    # hop bytes at a bounded, test-pinned divergence. Opt-in
+    # (MLConfig.collective_quant applies it at stage load).
+    collective_quant: bool = False
 
     @property
     def q_dim(self) -> int:

@@ -66,6 +66,30 @@ def matmul(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
+# tlint: hot-path
+def quantize_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over ``head_dim`` for KV rows headed into the paged
+    cache (engine/paged.py) or over an ICI hop (parallel/ring.py):
+    ``[..., hd] -> (int8 [..., hd], f32 scale [...])`` — one scale per
+    (position, head), the dense int8 cache's granularity
+    (models/transformer.py::_quant_kv). Per-position scales are what make
+    the paged cache's bitwise contract survive quantization: a position's
+    (int8 bytes, scale) pair depends only on its own KV row, so chunk
+    framing, COW copies, trie promotion and re-prefill all reproduce it
+    byte-exactly."""
+    tf = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(tf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(tf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+# tlint: hot-path
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`; the multiply fuses into the read."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 # Parameter-tree paths quantized for serving: the large matmul weights.
 # Norm scales, biases, and qk-norm vectors stay exact (tiny, and precision
 # there is cheap insurance).
@@ -117,6 +141,6 @@ def quantized_bytes(params: dict) -> int:
 
 
 __all__ = [
-    "QTensor", "dequantize", "matmul", "quantize_params", "quantize_tensor",
-    "quantized_bytes",
+    "QTensor", "dequantize", "dequantize_kv", "matmul", "quantize_kv",
+    "quantize_params", "quantize_tensor", "quantized_bytes",
 ]

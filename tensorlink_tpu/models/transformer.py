@@ -605,7 +605,8 @@ def _stage_impl(
         def attn_fn(q, k, v, _bias, scale):  # causal masking is global-
             # position arithmetic inside the ring; _bias is unused
             return ring_attention(
-                q, k, v, seq_mesh, axis_name=seq_axis, scale=scale, causal=True
+                q, k, v, seq_mesh, axis_name=seq_axis, scale=scale,
+                causal=True, quantized=cfg.collective_quant,
             )
 
     if first:

@@ -33,6 +33,14 @@ GQA without KV repetition: queries reshape to ``[B·Hkv·G, T, hd]`` and the
 kernel's batch axis runs over (B, Hkv, G) while the k/v block specs index
 ``b // G`` — repeated KV heads are never materialized, matching the einsum
 path's memory behavior.
+
+Quantized paged KV (``MLConfig.kv_quant="int8"``): every paged entry point
+accepts optional ``k_scale``/``v_scale`` arrays ``[P, Hkv, page]`` marking
+the pages int8 — the kernels fetch half the KV bytes per page and fuse the
+per-(position, head) dequant multiply into the VMEM read (the
+models/quant.py weight pattern), so the MXU arithmetic is unchanged. The
+``_ref`` twins dequantize at the same gather, pinned against the kernels
+in tests/test_ops.py.
 """
 
 from __future__ import annotations
@@ -220,15 +228,31 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
+def _gather_pages(pages, scales, block_tables, shape):
+    """Contiguous f32 per-slot KV view over a (possibly int8) page pool:
+    gathers each block table's pages, dequantizing with the per-(page,
+    position, head) scales when present — the scale multiply rides the
+    gather read, exactly the models/quant.py weight pattern."""
+    x = pages[block_tables].astype(jnp.float32)
+    if scales is not None:
+        x = x * scales[block_tables].astype(jnp.float32)[..., None]
+    # [.., n_pp, Hkv, page, hd] -> [.., n_pp, page, Hkv, hd] -> [.., K, ..]
+    nd = x.ndim
+    perm = tuple(range(nd - 4)) + (nd - 4, nd - 2, nd - 3, nd - 1)
+    return x.transpose(perm).reshape(shape)
+
+
 # tlint: hot-path
 def paged_attention_ref(
     q: jax.Array,  # [S, Hq, hd] — one query token per slot
-    k_pages: jax.Array,  # [P, Hkv, page, hd]
+    k_pages: jax.Array,  # [P, Hkv, page, hd] — cache dtype, or int8
     v_pages: jax.Array,  # [P, Hkv, page, hd]
     block_tables: jax.Array,  # int32 [S, pages_per_slot]
     lengths: jax.Array,  # int32 [S] — valid positions per slot
     *,
     scale: float,
+    k_scale: jax.Array | None = None,  # f32 [P, Hkv, page] — int8 pages
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Pure-jnp paged attention — the CPU serving path and the ground truth
     the Pallas kernel is pinned against.
@@ -237,7 +261,10 @@ def paged_attention_ref(
     per-(page, head) blocks have TPU-native ``(page, hd)`` trailing tiles.
     This gathers each slot's pages into a contiguous ``[S, K, Hkv, hd]``
     view (K = pages_per_slot·page) and runs the same masked-softmax GQA
-    math as models/transformer.py::attention. Positions at or beyond
+    math as models/transformer.py::attention. With ``k_scale``/``v_scale``
+    the pages are int8 (quantized paged KV cache): the per-(page, position,
+    head) scale multiply is fused into the gather, so arithmetic stays f32
+    while the cache bytes halve. Positions at or beyond
     ``lengths`` mask to NEG_INF (exp underflows to exactly 0, matching
     the dense path's -inf bias); a slot with length 0 (free slot riding
     the fixed batch shape) outputs zeros instead of a NaN row."""
@@ -246,16 +273,8 @@ def paged_attention_ref(
     n_pp = block_tables.shape[1]
     K = n_pp * page
     # whole-page gather: [S, n_pp, Hkv, page, hd] -> [S, K, Hkv, hd]
-    k = (
-        k_pages[block_tables]
-        .transpose(0, 1, 3, 2, 4)
-        .reshape(S, K, Hkv, hd)
-    )
-    v = (
-        v_pages[block_tables]
-        .transpose(0, 1, 3, 2, 4)
-        .reshape(S, K, Hkv, hd)
-    )
+    k = _gather_pages(k_pages, k_scale, block_tables, (S, K, Hkv, hd))
+    v = _gather_pages(v_pages, v_scale, block_tables, (S, K, Hkv, hd))
     G = Hq // Hkv
     qg = q.reshape(S, Hkv, G, hd).astype(jnp.float32)
     scores = (
@@ -282,6 +301,8 @@ def paged_prefill_attention_ref(
     start: jax.Array,  # int32 scalar — absolute position of q[0]
     *,
     scale: float,
+    k_scale: jax.Array | None = None,  # f32 [P, Hkv, page] — int8 pages
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Pure-jnp offset-carrying paged prefill attention — the CPU serving
     path and the ground truth the Pallas kernel is pinned against.
@@ -300,16 +321,8 @@ def paged_prefill_attention_ref(
     P, Hkv, page, _ = k_pages.shape
     n_pp = bt_row.shape[0]
     K = n_pp * page
-    k = (
-        k_pages[bt_row]
-        .transpose(0, 2, 1, 3)
-        .reshape(K, Hkv, hd)
-    )
-    v = (
-        v_pages[bt_row]
-        .transpose(0, 2, 1, 3)
-        .reshape(K, Hkv, hd)
-    )
+    k = _gather_pages(k_pages, k_scale, bt_row, (K, Hkv, hd))
+    v = _gather_pages(v_pages, v_scale, bt_row, (K, Hkv, hd))
     G = Hq // Hkv
     qg = q.reshape(C, Hkv, G, hd).astype(jnp.float32)
     scores = (
@@ -334,16 +347,18 @@ def _paged_prefill_kernel(
     q_ref,  # [1, C·G, hd]
     k_ref,  # [1, 1, page, hd] — page bt[0, i] of kv head h
     v_ref,  # [1, 1, page, hd]
-    o_ref,  # [1, C·G, hd]
-    m_ref,  # [C·G, 1] running max (VMEM scratch)
-    l_ref,  # [C·G, 1] running denominator
-    acc_ref,  # [C·G, hd] f32 accumulator
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, page] then out + scratch
     scale: float,
     page: int,
     n_pp: int,
     G: int,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     i = pl.program_id(1)
     start = start_ref[0]
 
@@ -364,6 +379,13 @@ def _paged_prefill_kernel(
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [C·G, hd]
         k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # int8 pages: the per-(position, head) scale multiply fuses
+            # into the VMEM read — arithmetic stays f32 on the MXU while
+            # the HBM page fetch carried half the bytes
+            k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -383,7 +405,7 @@ def _paged_prefill_kernel(
         p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
@@ -408,6 +430,8 @@ def paged_prefill_attention(
     *,
     scale: float,
     interpret: bool = False,
+    k_scale: jax.Array | None = None,  # f32 [P, Hkv, page] — int8 pages
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Offset-carrying paged prefill attention (TPU); returns
     ``[C, Hq, hd]``.
@@ -431,37 +455,41 @@ def paged_prefill_attention(
         .transpose(1, 0, 2, 3)
         .reshape(Hkv, C * G, hd)
     )
+    quantized = k_scale is not None
     kernel = functools.partial(
-        _paged_prefill_kernel, scale=scale, page=page, n_pp=n_pp, G=G
+        _paged_prefill_kernel, scale=scale, page=page, n_pp=n_pp, G=G,
+        quantized=quantized,
     )
+    # pages wholly past the last visible position clamp their fetch to
+    # scratch page 0: the pipeline skips copies when the mapped block
+    # repeats, so HBM traffic follows the chunk's live span (start + C),
+    # not the slot's capacity
+    def page_idx(h, i, bt, st, p=page, c=C):
+        return (jnp.where(i * p <= st[0] + c - 1, bt[0, i], 0), h, 0, 0)
+
+    def scale_idx(h, i, bt, st, p=page, c=C):
+        return (jnp.where(i * p <= st[0] + c - 1, bt[0, i], 0), h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, C * G, hd), lambda h, i, bt, st: (h, 0, 0)),
+        pl.BlockSpec((1, 1, page, hd), page_idx),
+        pl.BlockSpec((1, 1, page, hd), page_idx),
+    ]
+    args = [qg, k_pages, v_pages]
+    if quantized:
+        # int8 pages ride with their per-(position, head) scales — same
+        # physical page index, dequant fused in-kernel at the VMEM read
+        in_specs += [
+            pl.BlockSpec((1, 1, page), scale_idx),
+            pl.BlockSpec((1, 1, page), scale_idx),
+        ]
+        args += [k_scale, v_scale]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(Hkv, n_pp),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, C * G, hd), lambda h, i, bt, st: (h, 0, 0)
-                ),
-                # pages wholly past the last visible position clamp their
-                # fetch to scratch page 0: the pipeline skips copies when
-                # the mapped block repeats, so HBM traffic follows the
-                # chunk's live span (start + C), not the slot's capacity
-                pl.BlockSpec(
-                    (1, 1, page, hd),
-                    lambda h, i, bt, st, p=page, c=C: (
-                        jnp.where(i * p <= st[0] + c - 1, bt[0, i], 0),
-                        h, 0, 0,
-                    ),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page, hd),
-                    lambda h, i, bt, st, p=page, c=C: (
-                        jnp.where(i * p <= st[0] + c - 1, bt[0, i], 0),
-                        h, 0, 0,
-                    ),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, C * G, hd), lambda h, i, bt, st: (h, 0, 0)
             ),
@@ -479,9 +507,7 @@ def paged_prefill_attention(
     )(
         bt_row.reshape(1, n_pp),
         jnp.asarray(start, jnp.int32).reshape(1),
-        qg,
-        k_pages,
-        v_pages,
+        *args,
     )
     return (
         out.reshape(Hkv, C, G, hd)
@@ -505,6 +531,8 @@ def ragged_paged_attention_ref(
     n_valid: jax.Array,  # int32 [S] — valid queries per slot (0 = padding)
     *,
     scale: float,
+    k_scale: jax.Array | None = None,  # f32 [P, Hkv, page] — int8 pages
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Pure-jnp ragged paged attention — the CPU serving path of the
     unified prefill+decode step, and the ground truth the Pallas kernel is
@@ -528,16 +556,8 @@ def ragged_paged_attention_ref(
     P, Hkv, page, _ = k_pages.shape
     n_pp = block_tables.shape[1]
     K = n_pp * page
-    k = (
-        k_pages[block_tables]
-        .transpose(0, 1, 3, 2, 4)
-        .reshape(S, K, Hkv, hd)
-    )
-    v = (
-        v_pages[block_tables]
-        .transpose(0, 1, 3, 2, 4)
-        .reshape(S, K, Hkv, hd)
-    )
+    k = _gather_pages(k_pages, k_scale, block_tables, (S, K, Hkv, hd))
+    v = _gather_pages(v_pages, v_scale, block_tables, (S, K, Hkv, hd))
     G = Hq // Hkv
     qg = q.reshape(S, C, Hkv, G, hd).astype(jnp.float32)
     scores = (
@@ -568,16 +588,18 @@ def _ragged_kernel(
     q_ref,  # [1, 1, C·G, hd]
     k_ref,  # [1, 1, page, hd] — page bt[s, i] of kv head h
     v_ref,  # [1, 1, page, hd]
-    o_ref,  # [1, 1, C·G, hd]
-    m_ref,  # [C·G, 1] running max (VMEM scratch)
-    l_ref,  # [C·G, 1] running denominator
-    acc_ref,  # [C·G, hd] f32 accumulator
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, page] then out + scratch
     scale: float,
     page: int,
     n_pp: int,
     G: int,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     s = pl.program_id(0)
     i = pl.program_id(2)
     start = start_ref[s]
@@ -601,6 +623,12 @@ def _ragged_kernel(
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # [C·G, hd]
         k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # int8 pages: dequant fused into the VMEM read — the HBM
+            # fetch carried half the bytes, the MXU math stays f32
+            k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -619,7 +647,7 @@ def _ragged_kernel(
         p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
@@ -646,6 +674,8 @@ def ragged_paged_attention(
     *,
     scale: float,
     interpret: bool = False,
+    k_scale: jax.Array | None = None,  # f32 [P, Hkv, page] — int8 pages
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Ragged paged attention (TPU); returns ``[S, C, Hq, hd]``.
 
@@ -670,44 +700,52 @@ def ragged_paged_attention(
         .transpose(0, 2, 1, 3, 4)
         .reshape(S, Hkv, C * G, hd)
     )
+    quantized = k_scale is not None
     kernel = functools.partial(
-        _ragged_kernel, scale=scale, page=page, n_pp=n_pp, G=G
+        _ragged_kernel, scale=scale, page=page, n_pp=n_pp, G=G,
+        quantized=quantized,
     )
+    # pages wholly past the slot's live span clamp their fetch to scratch
+    # page 0 (repeated block indexes are not re-copied by the pipeline):
+    # HBM traffic follows start + n_valid per slot, not the capacity
+    def page_idx(s, h, i, bt, st, nv, p=page):
+        return (
+            jnp.where(
+                (nv[s] > 0) & (i * p <= st[s] + nv[s] - 1), bt[s, i], 0
+            ),
+            h, 0, 0,
+        )
+
+    def scale_idx(s, h, i, bt, st, nv, p=page):
+        return (
+            jnp.where(
+                (nv[s] > 0) & (i * p <= st[s] + nv[s] - 1), bt[s, i], 0
+            ),
+            h, 0,
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, C * G, hd), lambda s, h, i, bt, st, nv: (s, h, 0, 0)
+        ),
+        pl.BlockSpec((1, 1, page, hd), page_idx),
+        pl.BlockSpec((1, 1, page, hd), page_idx),
+    ]
+    args = [qg, k_pages, v_pages]
+    if quantized:
+        # int8 pages ride with their per-(position, head) scales — same
+        # physical page index, dequant fused in-kernel at the VMEM read
+        in_specs += [
+            pl.BlockSpec((1, 1, page), scale_idx),
+            pl.BlockSpec((1, 1, page), scale_idx),
+        ]
+        args += [k_scale, v_scale]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(S, Hkv, n_pp),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, C * G, hd),
-                    lambda s, h, i, bt, st, nv: (s, h, 0, 0),
-                ),
-                # pages wholly past the slot's live span clamp their
-                # fetch to scratch page 0 (repeated block indexes are
-                # not re-copied by the pipeline): HBM traffic follows
-                # start + n_valid per slot, not the slot's capacity
-                pl.BlockSpec(
-                    (1, 1, page, hd),
-                    lambda s, h, i, bt, st, nv, p=page: (
-                        jnp.where(
-                            (nv[s] > 0) & (i * p <= st[s] + nv[s] - 1),
-                            bt[s, i], 0,
-                        ),
-                        h, 0, 0,
-                    ),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page, hd),
-                    lambda s, h, i, bt, st, nv, p=page: (
-                        jnp.where(
-                            (nv[s] > 0) & (i * p <= st[s] + nv[s] - 1),
-                            bt[s, i], 0,
-                        ),
-                        h, 0, 0,
-                    ),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, C * G, hd),
                 lambda s, h, i, bt, st, nv: (s, h, 0, 0),
@@ -727,9 +765,7 @@ def ragged_paged_attention(
         block_tables,
         jnp.asarray(starts, jnp.int32),
         jnp.asarray(n_valid, jnp.int32),
-        qg,
-        k_pages,
-        v_pages,
+        *args,
     )
     return (
         out.reshape(S, Hkv, C, G, hd)
@@ -744,15 +780,17 @@ def _paged_kernel(
     q_ref,  # [1, 1, G, hd]
     k_ref,  # [1, 1, page, hd] — page bt[s, i] of kv head h
     v_ref,  # [1, 1, page, hd]
-    o_ref,  # [1, 1, G, hd]
-    m_ref,  # [G, 1] running max (VMEM scratch)
-    l_ref,  # [G, 1] running denominator
-    acc_ref,  # [G, hd] f32 accumulator
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, page] then out + scratch
     scale: float,
     page: int,
     n_pp: int,
+    quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     s = pl.program_id(0)
     i = pl.program_id(2)
     length = len_ref[s]
@@ -769,6 +807,12 @@ def _paged_kernel(
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
         k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # int8 pages: dequant fused into the VMEM read — the HBM
+            # fetch carried half the bytes, the MXU math stays f32
+            k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -782,7 +826,7 @@ def _paged_kernel(
         p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)  # [G, page]
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
@@ -806,6 +850,8 @@ def paged_attention(
     *,
     scale: float,
     interpret: bool = False,
+    k_scale: jax.Array | None = None,  # f32 [P, Hkv, page] — int8 pages
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Paged decode attention; returns ``[S, Hq, hd]``.
 
@@ -822,25 +868,41 @@ def paged_attention(
     n_pp = block_tables.shape[1]
     G = Hq // Hkv
     qg = q.reshape(S, Hkv, G, hd)
+    quantized = k_scale is not None
     kernel = functools.partial(
-        _paged_kernel, scale=scale, page=page, n_pp=n_pp
+        _paged_kernel, scale=scale, page=page, n_pp=n_pp,
+        quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, G, hd), lambda s, h, i, bt, ln: (s, h, 0, 0)),
+        pl.BlockSpec(
+            (1, 1, page, hd),
+            lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, 1, page, hd),
+            lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
+        ),
+    ]
+    args = [qg, k_pages, v_pages]
+    if quantized:
+        # int8 pages ride with their per-(position, head) scales — same
+        # physical page index, dequant fused in-kernel at the VMEM read
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, page), lambda s, h, i, bt, ln: (bt[s, i], h, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, page), lambda s, h, i, bt, ln: (bt[s, i], h, 0)
+            ),
+        ]
+        args += [k_scale, v_scale]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(S, Hkv, n_pp),
-            in_specs=[
-                pl.BlockSpec((1, 1, G, hd), lambda s, h, i, bt, ln: (s, h, 0, 0)),
-                pl.BlockSpec(
-                    (1, 1, page, hd),
-                    lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page, hd),
-                    lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, G, hd), lambda s, h, i, bt, ln: (s, h, 0, 0)
             ),
@@ -855,7 +917,7 @@ def paged_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_tables, lengths, qg, k_pages, v_pages)
+    )(block_tables, lengths, *args)
     return out.reshape(S, Hq, hd)
 
 

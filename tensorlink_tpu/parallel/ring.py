@@ -17,6 +17,21 @@ sharded over a ``seq`` mesh axis and attention runs as a ring:
 Compute/communication overlap and per-block skipping of fully-masked tiles
 are XLA's job once the ring is expressed this way (scaling-book recipe:
 annotate, let the compiler schedule).
+
+**Quantized collectives** (EQuARX, arxiv 2506.17615 — the KV-cache logic
+applied to ICI traffic): ``ring_attention(..., quantized=True)`` rotates
+int8 K/V blocks + per-row scales around the ring — roughly half the bf16
+hop bytes; this is the one explicit collective on the serving path and
+the only one ``collective_quant`` switches today (tensor-parallel
+matmuls are GSPMD-sharded — XLA inserts those collectives, so there is
+no call site to swap). :func:`quantized_psum` /
+:func:`quantized_all_gather` are the allreduce/allgather building
+blocks for explicit shard_map paths that want the same trade. The
+reduction dequantizes and sums in f32 over the gathered axis in a FIXED
+order, so every participant computes bitwise the same result (plain
+``psum``'s ring order can differ per device); divergence vs the
+full-precision collective is bounded and test-pinned
+(tests/test_ring.py).
 """
 
 from __future__ import annotations
@@ -29,6 +44,59 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Quantized collectives (EQuARX-style): int8 over the wire, f32 reduction
+# ---------------------------------------------------------------------------
+
+
+# tlint: hot-path
+def _quant_chunk(x):
+    """Symmetric int8 over the last axis with per-row f32 scales — the
+    same granularity as the paged KV cache's page rows
+    (models/quant.py::quantize_kv), applied to the tensor headed over
+    ICI. Returns ``(int8 [..., d], f32 scale [...])``."""
+    from tensorlink_tpu.models.quant import quantize_kv
+
+    return quantize_kv(x)
+
+
+# tlint: hot-path
+def _dequant_chunk(q, scale):
+    """f32 view of a quantized chunk; the multiply fuses into the read."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+# tlint: hot-path
+def quantized_all_gather(x, axis_name: str, *, axis: int = 0):
+    """``lax.all_gather`` with int8 payload: each device quantizes its
+    shard once, the gather moves int8 + per-row scales (≈½ the bf16
+    bytes, ¼ of f32), and the result dequantizes locally to ``x.dtype``.
+    Must run inside shard_map over ``axis_name``."""
+    q, s = _quant_chunk(x)
+    qg = lax.all_gather(q, axis_name, axis=axis)
+    sg = lax.all_gather(s, axis_name, axis=axis)
+    return _dequant_chunk(qg, sg).astype(x.dtype)
+
+
+# tlint: hot-path
+def quantized_psum(x, axis_name: str):
+    """EQuARX-style quantized allreduce: int8 chunk quantize → gather →
+    reduce in f32 → rescale to ``x.dtype``. Must run inside shard_map
+    over ``axis_name``.
+
+    Determinism: every device gathers the SAME int8 chunks + scales and
+    sums them over the gathered axis in the same fixed order, so the
+    result is bitwise identical on every participant and across runs —
+    unlike a ring-reduce ``psum`` whose accumulation order can vary with
+    the device's ring position. That property is what lets the quantized
+    collective live on the serving path without breaking the engine's
+    bit-determinism contracts (pinned in tests/test_ring.py)."""
+    q, s = _quant_chunk(x)
+    qg = lax.all_gather(q, axis_name, axis=0)  # [n, ...]
+    sg = lax.all_gather(s, axis_name, axis=0)
+    return jnp.sum(_dequant_chunk(qg, sg), axis=0).astype(x.dtype)
 
 
 def _block_scores(q, k, scale):
@@ -47,9 +115,13 @@ def _ring_attention_local(
     axis_name: str,
     scale: float,
     causal: bool,
+    quantized: bool,
 ):
     """Runs inside shard_map: full ring of n_dev steps, blockwise-stable
-    softmax accumulation."""
+    softmax accumulation. ``quantized`` rotates int8 K/V blocks + per-row
+    scales instead of full-precision blocks (each shard quantizes ONCE
+    before the ring, so hop count never compounds the error), roughly
+    halving the per-hop ICI bytes of bf16 activations."""
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, Tq, Hq, hd = q.shape
@@ -61,7 +133,13 @@ def _ring_attention_local(
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, _):
-        k_blk, v_blk, kv_start, m, l, o = carry
+        kv_c, kv_start, m, l, o = carry
+        if quantized:
+            k8, ks, v8, vs = kv_c
+            k_blk = _dequant_chunk(k8, ks)
+            v_blk = _dequant_chunk(v8, vs)
+        else:
+            k_blk, v_blk = kv_c
         s = _block_scores(qg, k_blk, scale)  # [B, Hkv, G, Tq, Tk]
         if causal:
             kv_pos = kv_start + jnp.arange(k_blk.shape[1])
@@ -74,11 +152,11 @@ def _ring_attention_local(
         l_new = l * corr + p.sum(-1)
         pv = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_blk.astype(jnp.float32))
         o_new = o * corr.transpose(0, 3, 1, 2)[..., None] + pv
-        # rotate K/V (+ their global start offset) one hop around the ring
-        k_nxt = lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        kv_nxt = lax.ppermute(kv_start, axis_name, perm)
-        return (k_nxt, v_nxt, kv_nxt, new_m, l_new, o_new), None
+        # rotate K/V (+ their global start offset) one hop around the
+        # ring — in quantized mode the hop moves int8 payload + scales
+        kv_nxt = tuple(lax.ppermute(x, axis_name, perm) for x in kv_c)
+        start_nxt = lax.ppermute(kv_start, axis_name, perm)
+        return (kv_nxt, start_nxt, new_m, l_new, o_new), None
 
     # initial accumulators must be marked varying over the ring axis or the
     # scan carry types disagree (jax VMA check under shard_map)
@@ -88,8 +166,14 @@ def _ring_attention_local(
     l0 = mark_varying(jnp.zeros((B, Hkv, G, Tq), jnp.float32), axis_name)
     o0 = mark_varying(jnp.zeros((B, Tq, Hkv, G, hd), jnp.float32), axis_name)
     kv_start0 = idx * k.shape[1]
-    (_, _, _, m, l, o), _ = lax.scan(
-        step, (k, v, kv_start0, m0, l0, o0), None, length=n
+    if quantized:
+        k8, ks = _quant_chunk(k)
+        v8, vs = _quant_chunk(v)
+        kv_c0 = (k8, ks, v8, vs)
+    else:
+        kv_c0 = (k, v)
+    (_, _, m, l, o), _ = lax.scan(
+        step, (kv_c0, kv_start0, m0, l0, o0), None, length=n
     )
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 3, 1, 2)[..., None]
@@ -105,12 +189,16 @@ def ring_attention(
     axis_name: str = "seq",
     scale: float | None = None,
     causal: bool = True,
+    quantized: bool = False,
 ):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     Equivalent to full (causal) attention on the unsharded arrays — that
     equivalence is the unit test (tests/test_ring.py). Sequence length must
-    divide the axis size."""
+    divide the axis size. ``quantized`` (ModelConfig.collective_quant)
+    rotates int8 K/V + scales around the ring instead of full-precision
+    blocks: ≈½ the bf16 ICI bytes per hop, divergence bounded and
+    test-pinned."""
     from tensorlink_tpu.parallel.mesh import get_shard_map
 
     shard_map = get_shard_map()
@@ -123,6 +211,7 @@ def ring_attention(
             axis_name=axis_name,
             scale=scale,
             causal=causal,
+            quantized=bool(quantized),
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

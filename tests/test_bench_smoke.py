@@ -52,7 +52,9 @@ def test_bench_all_legs_cpu():
                 "sched_fcfs_interactive_ttft_ms_p50",
                 "sched_preemptions", "sched_rejected", "sched_starved",
                 "ragged_itl_ratio", "ragged_steady_itl_ms",
-                "ragged_during_prefill_itl_ms", "ragged_legacy_itl_ratio",
+                "ragged_during_prefill_itl_ms",
+                "kv_slots_ratio", "kv_residency_ratio",
+                "kv_int8_slots", "kv_int8_resident_pages",
                 "train_mfu", "train_step_s",
                 "train_mfu_best_prior", "train_mfu_regressed"):
         assert key in extra, (key, extra)
@@ -64,6 +66,15 @@ def test_bench_all_legs_cpu():
     # stalls, bit-exact streams, one compiled program — live in
     # tests/test_continuous.py)
     assert extra["ragged_itl_ratio"] <= 3.0, extra["ragged_itl_ratio"]
+    # the quantized-KV capacity bar: at a fixed page-pool byte budget the
+    # int8 engine must ADMIT >=1.8x the slots and HOLD >=1.8x the
+    # prefix-cache resident pages of the fp engine. These are structural
+    # counts (real admissions on real pools, conservation-checked inside
+    # the leg), not wall-clock — deterministic on CPU, and the exact
+    # claim the TPU capacity math stands on (bf16: 2*hd vs hd+4 bytes
+    # per position-head = 1.94x at hd=128)
+    assert extra["kv_slots_ratio"] >= 1.8, extra["kv_slots_ratio"]
+    assert extra["kv_residency_ratio"] >= 1.8, extra["kv_residency_ratio"]
     # train-MFU rot guard (ROADMAP item 5): this round's train_mfu must
     # stay within 2x of the best comparable prior round in BENCH_r*.json
     # — training perf can't silently rot while serving work lands

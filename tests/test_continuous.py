@@ -115,9 +115,9 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
     # jit caches are PROCESS-global (module-level jitted functions in
     # engine/paged.py) — any earlier test module that served a different
     # model config leaves its programs in the same cache, so an absolute
-    # `decode_chunk == 1` was order-dependent (failed at tier-1 position,
-    # passed solo; tlint TL006's leak class). Count THIS engine's
-    # contribution as a delta from the process state at test start.
+    # `ragged_step == 1` would be order-dependent (tlint TL006's leak
+    # class). Count THIS engine's contribution as a delta from the
+    # process state at test start.
     ce = _cont(eng)
     pre = ce.jit_cache_sizes()  # before this engine compiled anything
     ce.submit([1], max_new_tokens=3)
@@ -136,25 +136,25 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
     assert all(r.finished for r in [*reqs, late])
     after = ce.jit_cache_sizes()
     assert after == base, (base, after)
-    # at most ONE slot-batched decode compile across this whole test —
-    # zero when an earlier test already compiled the same-shaped program
-    # (same process-global cache, same tiny config: even this module's
-    # own earlier tests do), one when this test ran first. The teeth are
-    # the delta bound + `after == base` above: request-mix churn never
-    # adds a program (delta, not absolute — the order-dependence note)
-    assert 0 <= after["decode_chunk"] - pre["decode_chunk"] <= 1
-    # chunked prefill + prefix cache must not add per-mix compiles either:
-    # once every feature program has fired ONCE (prefill chunk at base,
-    # COW copy on the first divergent hit), multi-chunk prompts, cache
-    # hits (full-page and COW-partial), misses and evictions are all
-    # DATA — the compiled set stays frozen across any further mix
+    # at most ONE step-program compile across this whole test — zero when
+    # an earlier test already compiled the same-shaped program (same
+    # process-global cache, same tiny config: even this module's own
+    # earlier tests do), one when this test ran first. The teeth are the
+    # delta bound + `after == base` above: request-mix churn never adds a
+    # program (delta, not absolute — the order-dependence note)
+    assert 0 <= after["ragged_step"] - pre["ragged_step"] <= 1
+    # the prefix cache must not add per-mix compiles either: once every
+    # feature program has fired ONCE (the step program at base, COW copy
+    # on the first divergent hit), multi-chunk prompts, cache hits
+    # (full-page and COW-partial), misses and evictions are all DATA —
+    # the compiled set stays frozen across any further mix
     long = [5, 9] * 12
     ce.submit(long, max_new_tokens=3, seed=7)  # miss -> promoted
     ce.run_until_idle()
     ce.submit(long[:20] + [2, 2, 2, 2], max_new_tokens=3, seed=8)  # COW
     ce.run_until_idle()
     warm = ce.jit_cache_sizes()
-    assert warm["prefill_chunk"] == after["prefill_chunk"]  # no growth yet
+    assert warm["ragged_step"] == after["ragged_step"]  # no growth yet
     ce.submit(long + [3], max_new_tokens=3, seed=9)  # full-page + COW hit
     ce.submit(long[:-1] + [2, 2], max_new_tokens=4, seed=10)
     ce.submit([6] * 31, max_new_tokens=2, seed=11)  # different miss shape
@@ -163,49 +163,30 @@ def test_slot_batched_decode_program_count_is_fixed(tiny_engine):
 
 
 # ---------------------------------------------------------------------------
-# unified ragged prefill+decode step (the default path)
+# unified ragged prefill+decode step (the only serving path — the legacy
+# two-program fallback completed its one-release window and was retired)
 # ---------------------------------------------------------------------------
-@pytest.mark.slow  # compiles the legacy two-program pair on top of the
-# module's unified set — tier-1 wall-time; CI's engine job runs this
-# file unfiltered on every push
-def test_unified_and_legacy_streams_bit_identical(tiny_engine):
-    """THE fallback-flag pin: the same request trace — greedy and sampled
-    rows, multi-chunk prompts, staggered mid-flight admission, prefix
-    cache on — emits BIT-identical streams through the unified ragged
-    step and the legacy two-program path. The unified step changes
-    scheduling (one dispatch, zero seams), never a token."""
-    eng = tiny_engine
-    mixes = [
-        (SYS + [21], 8, SamplingParams.make(temperature=0.9, top_k=5), 1),
-        ([4, 5], 6, SamplingParams.make(), 2),
-        (SYS + [22, 23], 10, SamplingParams.make(temperature=0.7, top_p=0.9), 3),
-        ([9, 8, 7, 6] * 5, 7, SamplingParams.make(temperature=1.0), 4),
-    ]
-
-    def trace(unified):
-        ce = _cont(eng, unified_step=unified)
-        reqs = []
-        for prompt, n, sp, seed in mixes:
-            reqs.append(
-                ce.submit(prompt, max_new_tokens=n, sampling=sp, seed=seed)
-            )
-            ce.step_chunk()  # later requests join mid-flight
-        ce.run_until_idle()
-        assert all(r.finished for r in reqs)
-        ce.check_page_conservation()
-        return [r.tokens for r in reqs]
-
-    assert trace(True) == trace(False)
+def test_legacy_path_is_retired(tiny_engine):
+    """The PR-6 fallback window closed: the monolithic dense-prefill
+    admission (prefill_chunk=0) refuses loudly, the unified_step flag is
+    gone from the engine API, and the compile-set keys no longer carry
+    the legacy two-program pair."""
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _cont(tiny_engine, prefill_chunk=0)
+    with pytest.raises(TypeError):
+        _cont(tiny_engine, unified_step=True)
+    sizes = _cont(tiny_engine).jit_cache_sizes()
+    assert "decode_chunk" not in sizes and "prefill_chunk" not in sizes
+    assert "ragged_step" in sizes and "copy_page" in sizes
 
 
 def test_unified_step_is_one_program(tiny_engine):
-    """The tentpole's acceptance bar: on the unified path the ENTIRE
-    serving hot loop is one compiled step program (plus the COW
-    ``copy_page``) — admission, mixed prefill/decode churn, preemption
-    and recovery-shaped resume add ZERO compiles, and the legacy
-    two-program pair (``decode_chunk``/``prefill_chunk``) stays cold.
+    """The PR-6 acceptance bar, still standing after the legacy path's
+    retirement: the ENTIRE serving hot loop is one compiled step program
+    (plus the COW ``copy_page``) — admission, mixed prefill/decode
+    churn, preemption and recovery-shaped resume add ZERO compiles.
     Deltas, not absolutes: jit caches are process-global (the TL006
-    order-dependence note on the legacy guard above)."""
+    order-dependence note on the guard above)."""
     eng = tiny_engine
     ce = _cont(eng, sched_aging_ticks=1000)
     pre = ce.jit_cache_sizes()
@@ -243,8 +224,6 @@ def test_unified_step_is_one_program(tiny_engine):
     assert full.tokens[:4] + resumed.tokens == full.tokens
     after = ce.jit_cache_sizes()
     assert after == base, (base, after)
-    assert after["decode_chunk"] == pre["decode_chunk"]  # legacy pair cold
-    assert after["prefill_chunk"] == pre["prefill_chunk"]
     ce.check_page_conservation()
 
 
@@ -496,21 +475,21 @@ def test_prefix_cache_streams_bit_identical_on_off(tiny_engine):
         ce2.check_page_conservation()
 
 
-@pytest.mark.slow  # compiles three extra chunk shapes + the monolithic
-# path — tier-1 wall-time; the CI engine job runs this file unfiltered
-def test_chunked_prefill_matches_monolithic(tiny_engine):
-    """Greedy parity between the chunked-prefill admission (any chunk
-    size) and the legacy monolithic dense-prefill admission — chunking
-    changes scheduling, never the emitted stream."""
+@pytest.mark.slow  # compiles three extra chunk shapes — tier-1
+# wall-time; the CI engine job runs this file unfiltered
+def test_prefill_chunk_size_never_moves_a_token(tiny_engine):
+    """Greedy parity across prefill chunk sizes: the chunk width is
+    schedule, never math (the framing-invariance contract at the engine
+    level — the bitwise KV pin lives in tests/test_ops.py)."""
     eng = tiny_engine
     prompts = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3], SYS + [30], [8] * 17]
     mixes = [(p, 10, SamplingParams.make(), i) for i, p in enumerate(prompts)]
-    mono, _ = _run_set(eng, mixes, prefix_cache=False, prefill_chunk=0)
+    ref, _ = _run_set(eng, mixes, prefix_cache=False, prefill_chunk=128)
     for chunk in (4, 8, 64):
         got, _ = _run_set(
             eng, mixes, prefix_cache=False, prefill_chunk=chunk
         )
-        assert got == mono, chunk
+        assert got == ref, chunk
 
 
 def test_prefix_cache_cow_divergent_page(tiny_engine):
@@ -682,15 +661,6 @@ def test_failed_admission_unwinds_pages_and_refs(tiny_engine, monkeypatch):
     # admission would show as a permanently pinned resident node
     assert all(n.refs == 0 for n in ce.prefix._by_page.values())
 
-    # the legacy monolithic path unwinds its pages too
-    ce0 = _cont(eng, prefix_cache=False, prefill_chunk=0)
-    monkeypatch.setattr(cont_mod, "scatter_prefill", boom)
-    ce0.submit(SYS + [33], max_new_tokens=2, seed=0)
-    with pytest.raises(RuntimeError, match="synthetic"):
-        ce0.run_until_idle()
-    monkeypatch.undo()
-    ce0.check_page_conservation()
-
 
 def test_page_conservation_asserted_at_teardown(tiny_engine):
     """close() itself asserts free + slot-owned + cache-resident == total
@@ -710,9 +680,185 @@ def test_page_conservation_asserted_at_teardown(tiny_engine):
     assert len(acc["free"]) + len(acc["cached"]) == ce.cache.n_pages - 1
 
 
+# ---------------------------------------------------------------------------
+# quantized paged KV cache (kv_quant="int8"): the lifecycle pins
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # compiles the int8 step-program shape — tier-1
+# wall-time; CI's engine job runs this file unfiltered on every push
+def test_kv_quant_streams_bit_identical_across_lifecycle(tiny_engine):
+    """THE quantized acceptance pin: with ``kv_quant="int8"`` every
+    existing stream-identity contract holds AMONG quantized streams —
+    solo == co-batched == mid-flight-admitted == recovery-resumed, with
+    the prefix cache on or off. (int8 streams may differ from fp
+    streams; that divergence is bounded in tests/test_ops.py — the
+    engine contract is that quantization never breaks determinism.)"""
+    eng = tiny_engine
+
+    def solo_q(prompt, n, sp, seed, prefix_cache=True):
+        ce = _cont(eng, kv_quant="int8", prefix_cache=prefix_cache)
+        req = ce.submit(prompt, max_new_tokens=n, sampling=sp, seed=seed)
+        ce.run_until_idle()
+        assert req.finished
+        ce.check_page_conservation()
+        return req.tokens
+
+    mixes = [
+        (SYS + [21], 8, SamplingParams.make(temperature=0.9, top_k=5), 1),
+        ([4, 5], 6, SamplingParams.make(), 2),
+        (SYS + [22, 23], 8,
+         SamplingParams.make(temperature=0.7, top_p=0.9), 3),
+    ]
+    # co-batched + mid-flight admission, cache on
+    ce = _cont(eng, kv_quant="int8")
+    reqs = []
+    for prompt, n, sp, seed in mixes:
+        reqs.append(ce.submit(prompt, max_new_tokens=n, sampling=sp,
+                              seed=seed))
+        ce.step_chunk()  # later requests join mid-flight
+    ce.run_until_idle()
+    assert all(r.finished for r in reqs)
+    ce.check_page_conservation()
+    for req, (prompt, n, sp, seed) in zip(reqs, mixes):
+        assert req.tokens == solo_q(prompt, n, sp, seed), (prompt, seed)
+        # cache off == cache on (quantized hit pages are byte-exactly
+        # what a cold quantized prefill writes)
+        assert req.tokens == solo_q(prompt, n, sp, seed,
+                                    prefix_cache=False)
+    # recovery resume: the crash-recovery re-prefill shape continues the
+    # quantized stream bit-identically
+    sp = SamplingParams.make(temperature=1.0, top_p=0.9)
+    full = solo_q([5, 6, 7], 10, sp, 9)
+    cut = 4
+    ce2 = _cont(eng, kv_quant="int8")
+    resumed = ce2.submit(
+        [5, 6, 7] + full[:cut], max_new_tokens=10 - cut, sampling=sp,
+        seed=9, start_step=cut,
+    )
+    ce2.run_until_idle()
+    assert full[:cut] + resumed.tokens == full
+    ce2.close()
+
+
+@pytest.mark.slow  # int8 COW/preemption churn on top of the module's
+# compile set — tier-1 wall-time; CI's engine job runs this unfiltered
+def test_kv_quant_page_lifecycle_byte_exact(tiny_engine):
+    """Quantized pages round-trip BYTE-exactly through the page
+    lifecycle: a COW copy reproduces the source page's int8 payload AND
+    scale rows bit for bit, promoted (cache-resident) pages are never
+    mutated by the admissions that hit them, and preemption + resume
+    emits the uninterrupted quantized stream."""
+    import jax.numpy as jnp
+    from tensorlink_tpu.engine.paged import PagedKVCache, copy_page
+
+    # -- copy_page: the COW primitive moves payload + scales together --
+    cfg = tiny_engine.cfg
+    cache = PagedKVCache.init(cfg, 2, page_size=8, max_len=64,
+                              quantized=True)
+    rng = np.random.default_rng(3)
+    cache = type(cache)(
+        k=jnp.asarray(rng.integers(-127, 128, cache.k.shape, np.int8)),
+        v=jnp.asarray(rng.integers(-127, 128, cache.v.shape, np.int8)),
+        block_tables=cache.block_tables,
+        lengths=cache.lengths,
+        k_scale=jnp.asarray(
+            rng.random(cache.k_scale.shape).astype(np.float32)
+        ),
+        v_scale=jnp.asarray(
+            rng.random(cache.v_scale.shape).astype(np.float32)
+        ),
+    )
+    src_k = np.asarray(cache.k[:, 3])
+    src_ks = np.asarray(cache.k_scale[:, 3])
+    src_vs = np.asarray(cache.v_scale[:, 3])
+    cache = copy_page(cache, jnp.int32(3), jnp.int32(7))
+    assert np.array_equal(np.asarray(cache.k[:, 7]), src_k)
+    assert np.array_equal(np.asarray(cache.k_scale[:, 7]), src_ks)
+    assert np.array_equal(np.asarray(cache.v_scale[:, 7]), src_vs)
+
+    # -- engine level: promotion -> hit -> COW never mutates a resident
+    # quantized page (followers of the original chain still see its
+    # exact bytes: their streams equal their solo runs) --
+    eng = tiny_engine
+    base = SYS + [21, 22, 23, 24]
+    fork = SYS + [21, 22, 99, 98]  # diverges mid-page: COW fires
+    ce = _cont(eng, kv_quant="int8")
+    w = ce.submit(base, max_new_tokens=2, seed=0)
+    ce.run_until_idle()
+    assert w.finished  # base chain promoted + resident
+    resident0 = {
+        p: (np.asarray(ce.cache.k[:, p]), np.asarray(ce.cache.k_scale[:, p]))
+        for p in sorted(ce.prefix.resident_pages)
+    }
+    f = ce.submit(fork, max_new_tokens=6,
+                  sampling=SamplingParams.make(temperature=0.8), seed=2)
+    b = ce.submit(base, max_new_tokens=6, sampling=SamplingParams.make(),
+                  seed=3)
+    ce.run_until_idle()
+    assert f.finished and b.finished
+    assert ce.prefix.stats["cow_copies"] >= 1
+    for p, (k0, ks0) in resident0.items():
+        if p in ce.prefix.resident_pages:  # still resident: byte-exact
+            assert np.array_equal(np.asarray(ce.cache.k[:, p]), k0), p
+            assert np.array_equal(
+                np.asarray(ce.cache.k_scale[:, p]), ks0
+            ), p
+    ce.check_page_conservation()
+
+    # -- preemption: the quantized victim resumes bit-identically --
+    ce3 = _cont(eng, kv_quant="int8", max_slots=1, sched_aging_ticks=1000)
+    victim = ce3.submit([3, 1, 4], max_new_tokens=8, seed=7,
+                        priority="best_effort")
+    ce3.step_chunk()
+    pre = ce3.submit([8, 8], max_new_tokens=2, seed=9,
+                     priority="interactive")
+    ce3.run_until_idle()
+    assert ce3.stats["preemptions"] >= 1
+    assert victim.finished and pre.finished
+    solo = _cont(eng, kv_quant="int8")
+    sr = solo.submit([3, 1, 4], max_new_tokens=8, seed=7)
+    solo.run_until_idle()
+    assert victim.tokens == sr.tokens
+    ce3.close()
+    solo.close()
+
+
+@pytest.mark.slow  # drives a second (int8) step-program shape through
+# admission/churn — tier-1 wall-time; CI's engine job runs it unfiltered
+def test_kv_quant_is_one_program(tiny_engine):
+    """The compile-set bar extends to quantization: the int8 engine is
+    ONE ragged_step program (+ copy_page) of its own — storage dtype is
+    a trace-time constant, and admission, mixed churn, hits, COW and
+    eviction with quant on add ZERO compiles beyond it."""
+    eng = tiny_engine
+    ce = _cont(eng, kv_quant="int8")
+    pre = ce.jit_cache_sizes()
+    long = [5, 9] * 12
+    ce.submit(long, max_new_tokens=3, seed=7)  # miss -> promoted
+    ce.run_until_idle()
+    ce.submit(long[:20] + [2, 2, 2, 2], max_new_tokens=3, seed=8)  # COW
+    ce.run_until_idle()
+    base = ce.jit_cache_sizes()
+    assert 0 <= base["ragged_step"] - pre["ragged_step"] <= 1
+    assert 0 <= base["copy_page"] - pre["copy_page"] <= 1
+    reqs = [
+        ce.submit([3 + i] * (2 + i), max_new_tokens=3 + i, seed=i)
+        for i in range(4)
+    ]
+    ce.step_chunk()
+    late = ce.submit(long + [3], max_new_tokens=3, seed=30)  # cache hit
+    ce.submit([6] * 31, max_new_tokens=2, seed=31)  # different miss
+    ce.run_until_idle()
+    assert all(r.finished for r in [*reqs, late])
+    assert ce.jit_cache_sizes() == base, (base, ce.jit_cache_sizes())
+    ce.check_page_conservation()
+    ce.close()
+
+
 def test_continuous_refuses_unsupported_cache_modes(tiny_engine):
-    """int8 KV and sliding windows stay on the static batcher: the engine
-    refuses loudly (the worker catches this and falls back)."""
+    """Sliding windows stay on the static batcher: the engine refuses
+    loudly (the worker catches this and falls back). int8 KV is NOT
+    refused anymore — kv_quant serves it natively on the paged path
+    (routing regression pinned in tests/test_quant.py)."""
     cfg = tiny_engine.cfg.with_(sliding_window=8)
     eng = GenerationEngine(
         cfg, tiny_engine.params, seq_buckets=(8, 32), batch_buckets=(1,),
@@ -720,3 +866,5 @@ def test_continuous_refuses_unsupported_cache_modes(tiny_engine):
     )
     with pytest.raises(ValueError, match="sliding-window"):
         ContinuousEngine(eng)
+    with pytest.raises(ValueError, match="kv_quant"):
+        ContinuousEngine(tiny_engine, kv_quant="int4")

@@ -105,31 +105,41 @@ def test_dht_xor_routing_metric():
     assert d.nearest("f1" * 32)[0] == "f0" * 32
 
 
+def _epoch_ago(seconds: float) -> float:
+    """A DHT record/tombstone timestamp ``seconds`` in the past. These ts
+    values are cross-node EPOCH stamps by the DHT's LWW contract —
+    digest()'s tombstone TTL compares them against time.time(), so a
+    monotonic stamp (PR 5's blanket TL004 sweep briefly used one here)
+    looks ~50 years stale and the tombstone GCs instantly, which is the
+    deterministic failure this helper fixes."""
+    return time.time() - seconds  # tlint: disable=TL004(DHT ts values are cross-node epoch stamps — the LWW/TTL contract, not an elapsed-time measurement)
+
+
 def test_dht_tombstones_block_resurrection():
     """A deleted replicated record must not come back via anti-entropy: the
     tombstone outlives the record, beats older writes, and ships to peers."""
-    t0 = time.monotonic()
     d = DHT("00" * 32)
-    d.store("job:x", {"v": 1}, ts=t0 - 30)
-    assert d.delete("job:x", ts=t0 - 20)
+    d.store("job:x", {"v": 1}, ts=_epoch_ago(30))
+    t_del = _epoch_ago(20)
+    assert d.delete("job:x", ts=t_del)
     # an older replicated write loses to the tombstone
-    d.store("job:x", {"v": 1}, ts=t0 - 25)
+    d.store("job:x", {"v": 1}, ts=_epoch_ago(25))
     assert d.get_local("job:x") is None
     # sync from a peer still holding the stale record: merge rejects it
-    assert d.merge({"job:x": {"value": {"v": 1}, "ts": t0 - 25}}) == []
+    assert d.merge({"job:x": {"value": {"v": 1}, "ts": _epoch_ago(25)}}) == []
     # and the tombstone itself replicates to peers that missed the delete
-    entries = d.missing_for({"job:x": t0 - 25}, ("job:",))
-    assert entries == {"job:x": {"deleted": True, "ts": t0 - 20}}
+    entries = d.missing_for({"job:x": _epoch_ago(25)}, ("job:",))
+    assert entries == {"job:x": {"deleted": True, "ts": t_del}}
     peer = DHT("11" * 32)
-    peer.store("job:x", {"v": 1}, ts=t0 - 25)
+    peer.store("job:x", {"v": 1}, ts=_epoch_ago(25))
     assert peer.merge(entries) == ["job:x"]
     assert peer.get_local("job:x") is None
     # a genuinely newer write re-creates the record
-    d.store("job:x", {"v": 2}, ts=t0 - 10)
+    d.store("job:x", {"v": 2}, ts=_epoch_ago(10))
     assert d.get_local("job:x") == {"v": 2}
     # live-record LWW: an older timestamped store loses to a newer record
     # (e.g. a stale query-cache write racing a fanout store)
-    d.store("job:x", {"v": "stale"}, ts=t0 - 15)
+    d.store("job:x", {"v": "stale"}, ts=_epoch_ago(15))
     assert d.get_local("job:x") == {"v": 2}
     # ...but an untimestamped local write always wins (fresh local state)
     d.store("job:x", {"v": 3})
@@ -140,14 +150,16 @@ def test_dht_query_cache_respects_tombstones():
     """A stale copy fetched from a lagging peer must not resurrect a
     tombstoned record: the remote answer caches with its ORIGIN ts, which
     loses to the newer local tombstone."""
-    t0 = time.monotonic()
+    # epoch, not monotonic: the same cross-node LWW contract _epoch_ago
+    # documents above
+    t_stale = _epoch_ago(30)
 
     async def forward(peer, key, hops=0):
-        return {"v": "stale"}, t0 - 30  # (value, origin_ts)
+        return {"v": "stale"}, t_stale  # (value, origin_ts)
 
     d = DHT("00" * 32, forward=forward)
-    d.store("job:x", {"v": 1}, ts=t0 - 30)
-    d.delete("job:x", ts=t0 - 20)
+    d.store("job:x", {"v": 1}, ts=t_stale)
+    d.delete("job:x", ts=_epoch_ago(20))
 
     async def run():
         return await d.query("job:x", route_pool=["bb" * 32])

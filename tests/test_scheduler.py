@@ -388,8 +388,9 @@ def test_preemption_mid_prefill_is_safe(tiny_engine):
     victim = ce.submit(long_prompt, max_new_tokens=6, seed=3,
                        priority="best_effort")
     ce.step_chunk(admit_only=True)
-    ce._prefill_tick()  # partially prefilled, zero tokens emitted
-    assert victim.prefill_pos < len(long_prompt)
+    ce.step_chunk()  # one 8-token grant lands: partially prefilled,
+    # zero tokens emitted (the prompt needs 4 grants)
+    assert 0 < victim.prefill_pos < len(long_prompt)
     pre = ce.submit([5], max_new_tokens=3, seed=4, priority="interactive")
     ce.run_until_idle()
     assert ce.stats["preemptions"] >= 1
