@@ -21,6 +21,22 @@ Wired sites:
   supports error / crash.
 - ``worker.cont_step``    — every continuous-batching decode chunk over the
   worker's slot engine (ml/worker.py::_cont_step); supports error / crash.
+- ``worker.drain``        — a DRAIN verb arriving at a worker
+  (ml/worker.py::_drain); supports error / crash (a worker that dies the
+  moment it is asked to shed its slots).
+- ``migrate.export``      — per live slot a drain tries to freeze+export
+  (ml/worker.py::_drain_engine); supports error / crash.
+- ``migrate.wire``        — the MIGRATE page-transfer send on the source
+  (ml/worker.py::_ship_migration); supports drop / delay / dup / crash —
+  dup really sends the staging frame twice (idempotency is the
+  destination's job), drop skips the send (the fallback ladder's trigger).
+- ``migrate.import``      — a MIGRATE staging arriving at the destination
+  (ml/worker.py::_migrate_in); supports error / crash (the
+  kill-the-destination-mid-migration case).
+
+Site names are REGISTERED (:data:`SITES`): a rule naming an unknown site
+fails loudly at plan construction instead of silently never firing — a
+chaos config can't typo a site into a no-op.
 
 Zero overhead when disabled: the network process guards every site with
 ``if faults.ENABLED:`` (a module bool that is False unless a plan was
@@ -47,6 +63,22 @@ import hashlib
 from dataclasses import dataclass, field
 
 OPS = ("drop", "delay", "dup", "crash", "error")
+
+# The registered fault-site names — every site wired in the stack. A rule
+# naming anything else raises at construction (FaultRule.__post_init__),
+# so a typo'd chaos config fails the test that installs it instead of
+# silently injecting nothing.
+SITES = (
+    "p2p.send",
+    "connection.frame",
+    "worker.session_step",
+    "worker.train_step",
+    "worker.cont_step",
+    "worker.drain",
+    "migrate.export",
+    "migrate.wire",
+    "migrate.import",
+)
 
 
 class FaultInjected(RuntimeError):
@@ -76,6 +108,12 @@ class FaultRule:
     def __post_init__(self):
         if self.op not in OPS:
             raise ValueError(f"unknown fault op {self.op!r} (want one of {OPS})")
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} — registered sites: "
+                f"{', '.join(SITES)} (a typo here would make the rule a "
+                "silent no-op)"
+            )
 
 
 @dataclass
@@ -180,6 +218,7 @@ def inject(site: str, key: str = ""):
 
 
 __all__ = [
+    "SITES",
     "FaultPlan",
     "FaultRule",
     "FaultInjected",

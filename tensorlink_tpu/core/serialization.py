@@ -199,6 +199,17 @@ def decode(data: bytes | memoryview, *, copy: bool = False) -> Any:
     return walk(header["tree"])
 
 
+def content_digest(obj: Any) -> str:
+    """Stable sha256 over an object's TLTS encoding — an integrity tag for
+    payloads that cross the wire AND a process boundary (migration blobs:
+    the importer recomputes the digest before adopting KV bytes, so a
+    corrupted or reordered-and-reassembled transfer fails loudly into the
+    re-prefill fallback instead of decoding from garbage pages)."""
+    import hashlib
+
+    return hashlib.sha256(bytes(encode(obj))).hexdigest()
+
+
 def encode_to_file(obj: Any, path) -> int:
     """Spill large frames to disk (reference connection.py:110-128 spills
     >20 MB buffers to tmp files). Returns bytes written."""

@@ -66,9 +66,11 @@ from .paged import (
     bind_slot,
     clear_slot,
     copy_page,
+    gather_page,
     paged_decode_step,
     paged_ragged_step,
     pages_needed,
+    scatter_page,
 )
 from .sampling import SamplingParams, sample
 from .scheduler import (
@@ -190,6 +192,13 @@ class ContinuousRequest:
     prefill_target: int = 0
     error: BaseException | None = None
     done: threading.Event = field(default_factory=threading.Event)
+    # -- live migration (docs/FAILURE_MODEL.md "Migration & drain") ------
+    # staged-adoption ticket id: admission binds the shipped KV pages
+    # instead of prefilling (engine._migrations); cleared on fallback
+    adopt: str | None = None
+    # opaque client/transport context (peer, rid, stream id) the worker
+    # layer attaches so a drain can redirect the stream mid-flight
+    client_meta: dict | None = None
     # -- scheduling (engine/scheduler.py) -------------------------------
     priority: str = DEFAULT_PRIORITY
     sched_seq: int = 0  # arrival order; preserved across preemption
@@ -226,6 +235,7 @@ class ContinuousEngine:
         sched_policy: str = "slo",
         sched_max_wait_s: float = 60.0,
         default_priority: str = DEFAULT_PRIORITY,
+        migration_ttl_s: float = 120.0,
     ):
         if engine.cfg.sliding_window is not None:
             raise ValueError(
@@ -273,6 +283,20 @@ class ContinuousEngine:
         # tighter inter-token bound
         self.prefill_budget = int(prefill_budget)
         self._prefilling: dict[int, ContinuousRequest] = {}
+        # -- live slot migration (docs/FAILURE_MODEL.md) -----------------
+        # slots frozen for export: excluded from stepping, their pages
+        # counted IN TRANSIT by page_accounting until commit/abort
+        self._frozen: set[int] = set()
+        # staged inbound adoptions: mig_id -> {pages, nodes, chain,
+        # length, last_tok, prefill_target, t}. Pages are allocated and
+        # byte-filled at staging (MIGRATE put), so the later attach only
+        # binds a slot; idempotent by mig_id (wire dups are no-ops).
+        self._migrations: dict[str, dict] = {}
+        # staged tickets whose client never attaches (it died mid-drain)
+        # are garbage-collected after this many seconds so their pages
+        # can't leak; close() frees the rest before the conservation check
+        self.migration_ttl_s = float(migration_ttl_s)
+        self.drain_state = "serving"  # "serving" | "draining"
         # rotates the budgeted packing's round-robin origin so a
         # prefill_budget smaller than the number of concurrent
         # admissions never starves the tail slots
@@ -315,6 +339,11 @@ class ContinuousEngine:
             "slot_steps_live": 0, "slot_steps_total": 0,
             "prefill_chunks": 0, "prefill_tokens": 0,
             "prefill_tokens_skipped": 0,
+            # live migration (source side: started/completed/failed/
+            # fell_back; destination side: adopted)
+            "migrations_started": 0, "migrations_completed": 0,
+            "migrations_failed": 0, "migrations_fell_back": 0,
+            "migrations_adopted": 0,
         }
 
     # -- client side -----------------------------------------------------
@@ -330,6 +359,7 @@ class ContinuousEngine:
         priority: str | None = None,
         stream_cb: Callable[[int], bool | None] | None = None,
         on_finish: Callable[[ContinuousRequest], None] | None = None,
+        adopt: str | None = None,
     ) -> ContinuousRequest:
         """Queue a request; the scheduler decides when (and at whose
         expense) it joins the slot batch. ``start_step`` > 0 resumes a
@@ -338,7 +368,10 @@ class ContinuousEngine:
         scheduler's classes (None → the engine default); past the class
         queue cap the request fails immediately with
         :class:`SchedulerOverloaded` on ``req.error`` instead of queueing
-        forever — the API layer's 429 backstop."""
+        forever — the API layer's 429 backstop. ``adopt`` names a staged
+        migration ticket (:meth:`stage_migration`): admission binds the
+        shipped KV pages instead of prefilling, falling back to the
+        normal (re-)prefill path when the ticket is missing or stale."""
         req = ContinuousRequest(
             rid=next(self._rid),
             prompt=[int(t) for t in prompt],
@@ -352,6 +385,7 @@ class ContinuousEngine:
             ),
             stream_cb=stream_cb,
             on_finish=on_finish,
+            adopt=adopt,
         )
         req.submit_t = time.monotonic()
         overload: SchedulerOverloaded | None = None
@@ -361,6 +395,13 @@ class ContinuousEngine:
             except SchedulerOverloaded as e:
                 overload = e
         if overload is not None:
+            # a rejected resume must release its staged-adoption ticket —
+            # otherwise the shipped pages stay pinned in-transit for the
+            # full TTL on exactly the engine absorbing a drain. submit()
+            # may run on a client thread, so the pages are NOT freed here
+            # (the allocator/trie are driver-thread state): the ticket is
+            # expired in place and the driver's next GC sweep frees it.
+            self._expire_ticket(req)
             req.error = overload
             self._finish(req, finished=False)
         return req
@@ -404,6 +445,11 @@ class ContinuousEngine:
             "row_keys": _row_keys._cache_size(),
             "ragged_step": paged_ragged_step._cache_size(),
             "copy_page": copy_page._cache_size(),
+            # migration export/import move ONE page per dispatch (fixed
+            # shape), so live slot migration adds exactly these two keys
+            # and can never grow the serving-step program set
+            "gather_page": gather_page._cache_size(),
+            "scatter_page": scatter_page._cache_size(),
         }
 
     # -- admission / eviction -------------------------------------------
@@ -447,6 +493,7 @@ class ContinuousEngine:
                 f"prompt length {len(seq)} exceeds max_seq_len "
                 f"{self.max_seq_len}"
             )
+            self._drop_ticket(req)
             self._finish(req, finished=False)
             return True
         room = self.max_seq_len - len(seq)
@@ -455,12 +502,22 @@ class ContinuousEngine:
         if eff <= 0:
             # zero room: report finished with an empty completion, matching
             # the static paths' contract
+            self._drop_ticket(req)
             self._finish(req, finished=True)
             return True
         req.budget = len(req.tokens) + eff
+        total = min(len(seq) + eff, self.max_seq_len)
+        if req.adopt is not None:
+            ticket = self._migrations.get(req.adopt)
+            if ticket is not None and self._ticket_matches(ticket, seq):
+                return self._admit_adopted(req, slot, total, ticket)
+            # missing / stale / mismatched ticket: the request already
+            # carries the full resume shape (prompt + delivered,
+            # start_step), so the fallback ladder's next rung is simply
+            # the crash-recovery re-prefill below
+            self._drop_ticket(req)
         req.prefill_tokens = seq
         req.prefill_target = len(seq)
-        total = min(len(seq) + eff, self.max_seq_len)
         return self._admit_paged(req, slot, total)
 
     def _alloc_pages(self, n: int) -> list[int] | None:
@@ -560,6 +617,94 @@ class ContinuousEngine:
             self.prefix.stats["hit_tokens"] += hit_len
         return True
 
+    # -- live slot migration (adopt side) --------------------------------
+    def _drop_ticket(self, req: ContinuousRequest) -> None:
+        """Release a request's staged-adoption ticket (fallback / early
+        finish): the staged pages return to the free-list so they cannot
+        leak past the conservation check. DRIVER THREAD ONLY — it mutates
+        the allocator; client threads use :meth:`_expire_ticket`."""
+        if req.adopt is not None:
+            self.drop_staged_migration(req.adopt)
+            req.adopt = None
+
+    def _expire_ticket(self, req: ContinuousRequest) -> None:
+        """Client-thread-safe ticket release: expire the staged ticket in
+        place (one GIL-atomic float store) so the driver's next GC sweep
+        frees its pages — never touch the allocator off the driver."""
+        if req.adopt is None:
+            return
+        ticket = self._migrations.get(req.adopt)
+        if ticket is not None:
+            ticket["t"] = float("-inf")
+        req.adopt = None
+
+    @staticmethod
+    def _ticket_matches(ticket: dict, seq: list[int]) -> bool:
+        """A staged ticket is usable only when the resubmitted sequence is
+        EXACTLY the chain whose KV was shipped — anything else (a retry
+        that lost tokens, a stale ticket from an earlier drain) must take
+        the re-prefill rung instead of adopting mismatched pages."""
+        return (
+            ticket["chain"] == seq
+            and ticket["length"] == len(seq) - 1
+            and ticket["last_tok"] == seq[-1]
+        )
+
+    def _admit_adopted(self, req: ContinuousRequest, slot: int,
+                       total: int, ticket: dict) -> bool:
+        """Bind a staged migration's pages into ``slot`` and resume
+        decoding — the page-shipping fast path of a live migration. The
+        shipped pages (byte-exact source KV) plus any locally-resident
+        prefix chain become the slot's block table, growth pages cover
+        the remaining budget, and the sampling state re-arms at
+        ``fold_in(seed, start_step)`` — the same draw the source's next
+        step would have made, so the migrated stream is bit-identical to
+        an uninterrupted one BY CONSTRUCTION (identical KV bytes ⇒
+        identical logits ⇒ identical draws). Returns False while the
+        allocator can't cover the growth pages (request stays queued,
+        ticket retained)."""
+        seq = req.prompt + req.tokens
+        length = int(ticket["length"])
+        n_skip = len(ticket["nodes"])
+        n_have = n_skip + len(ticket["pages"])
+        grow = self._alloc_pages(
+            max(pages_needed(total, self.page_size) - n_have, 0)
+        )
+        if grow is None:
+            return False
+        bt_row = np.zeros(self.cache.pages_per_slot, np.int32)
+        bt_row[:n_skip] = [n.page for n in ticket["nodes"]]
+        bt_row[n_skip:n_have] = ticket["pages"]
+        bt_row[n_have : n_have + len(grow)] = grow
+        self.cache = bind_slot(
+            self.cache, jnp.int32(slot), jnp.asarray(bt_row),
+            jnp.int32(length),
+        )
+        req.slot = slot
+        req.pages = list(ticket["pages"]) + grow
+        req.shared_nodes = list(ticket["nodes"])
+        # promotion semantics carry over from the source admission: only
+        # the prefill-written region [0, prefill_target) may enter the
+        # trie on a later teardown (shipped decode-written pages are
+        # byte-exact for THIS stream but not bitwise a prefill recompute,
+        # which is the cache's contract)
+        req.prefill_target = int(ticket["prefill_target"])
+        req.prefill_tokens = seq[: req.prefill_target]
+        req.prefill_pos = length
+        self._slots[slot] = req
+        # decode-ready arming: the slot resumes mid-stream, so the next
+        # draw index is start_step (= every token the stream has emitted,
+        # across all prior submissions) and the context histogram covers
+        # the WHOLE chain — exactly the uninterrupted run's state here
+        self._arm_slot(req, slot, ctx=seq)
+        self._tok[slot] = int(ticket["last_tok"])
+        self._active[slot] = True
+        del self._migrations[req.adopt]
+        req.adopt = None
+        self.stats["admitted"] += 1
+        self.stats["migrations_adopted"] += 1
+        return True
+
     def _set_knob_mirrors(self, slot: int, sp: SamplingParams) -> None:
         """Scalarize a request's sampling knobs into the per-slot host
         mirrors the compiled chunk consumes."""
@@ -570,29 +715,33 @@ class ContinuousEngine:
         self._pres[slot] = float(np.asarray(sp.presence_penalty).reshape(-1)[0])
         self._freq[slot] = float(np.asarray(sp.frequency_penalty).reshape(-1)[0])
 
-    def _arm_slot(self, req: ContinuousRequest, slot: int) -> None:
+    def _arm_slot(self, req: ContinuousRequest, slot: int,
+                  ctx=None) -> None:
         """Admission arming: the sampling state lands on the host at
         ADMISSION, before the slot's first packed block — so the step
         that completes its prefill draws the first token in-program with
         the request's own key chain (index ``start_step + len(tokens)``,
         counting recovery and pre-preemption tokens), the request's
-        knobs, and the prefill sequence's context histogram."""
+        knobs, and the context histogram. ``ctx`` defaults to the prefill
+        sequence (prompt + any pre-preemption tokens — exactly an
+        uninterrupted run's context here); an adopted (migrated-in) slot
+        passes its full chain instead."""
         self._seeds[slot] = req.seed
         self._steps[slot] = req.start_step + len(req.tokens)
         self._set_knob_mirrors(slot, req.sampling)
-        self._counts = self._counts.at[slot].set(self._prompt_counts(req))
+        if ctx is None:
+            ctx = req.prefill_tokens or req.prompt
+        self._counts = self._counts.at[slot].set(self._ctx_counts(req, ctx))
 
-    def _prompt_counts(self, req: ContinuousRequest) -> jax.Array:
-        """Context histogram for presence/frequency penalties (row-local,
-        like everything else about a slot)."""
+    def _ctx_counts(self, req: ContinuousRequest, ctx) -> jax.Array:
+        """Histogram of ``ctx`` when the request's penalties need one
+        (zeros otherwise). An adopted (migrated-in) slot passes the full
+        chain — prompt + every emitted token — which equals the
+        uninterrupted run's integer counts at the same step."""
         if not (self._any(req.sampling.presence_penalty)
                 or self._any(req.sampling.frequency_penalty)):
             return jnp.zeros((self.cfg.vocab_size,), jnp.int32)
         c = np.zeros(self.cfg.vocab_size, np.int32)
-        # the prefill sequence (prompt + any pre-preemption tokens) IS
-        # the context at this step — an uninterrupted run's counts would
-        # be exactly this histogram here
-        ctx = req.prefill_tokens or req.prompt
         np.add.at(c, np.asarray(ctx, np.int64), 1)
         return jnp.asarray(c)
 
@@ -628,6 +777,7 @@ class ContinuousEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._prefilling.pop(slot, None)
+        self._frozen.discard(slot)
         self._active[slot] = False
         self._tok[slot] = 0
         self._temp[slot] = 0.0
@@ -702,43 +852,355 @@ class ContinuousEngine:
                 free_list.append(pid)
         self.alloc.free(free_list)
 
+    # -- live slot migration (export side) + drain -----------------------
+    # Protocol (docs/FAILURE_MODEL.md "Migration & drain"): the DRIVER
+    # freezes a decoding slot at a chunk boundary, exports its KV pages
+    # byte-exactly, ships them to a destination engine that stages them
+    # into freshly-allocated pages, and commits (teardown WITHOUT
+    # finishing — the stream continues elsewhere). Every rung degrades to
+    # the crash-recovery re-prefill: a failed export/wire/import just
+    # means the resume request adopts nothing and prefills instead.
+
+    def freeze_slot(self, slot: int) -> None:
+        """Freeze a DECODING slot for export: it stops stepping (the
+        packed block skips it) but keeps its pages and request — page
+        accounting reports them in transit. Mid-prefill slots refuse
+        (their cheap exit is the re-prefill fallback; they have no
+        decode-written KV worth shipping). Driver-thread only, at a chunk
+        boundary."""
+        req = self._slots[slot]
+        if req is None or not self._active[slot] or slot in self._prefilling:
+            raise ValueError(
+                f"slot {slot} is not a steady decoding slot — only active "
+                "decode slots freeze for migration (mid-prefill and idle "
+                "slots take the re-prefill fallback)"
+            )
+        self._active[slot] = False
+        self._frozen.add(slot)
+        self.stats["migrations_started"] += 1
+
+    def migration_chain(self, slot: int) -> tuple[list[int], int]:
+        """The frozen slot's token chain (prompt + emitted — the cache key
+        of every valid position) and the prefix-probe limit: resident
+        pages on the destination may substitute for shipped bytes only in
+        the PREFILL-written region (cache hits are bitwise a prefill;
+        decode-written positions are only byte-exact as shipped bytes)."""
+        req = self._slots[slot]
+        assert req is not None and slot in self._frozen
+        length = int(np.asarray(self.cache.lengths)[slot])
+        return req.prompt + req.tokens, min(length, req.prefill_target)
+
+    def export_slot(self, slot: int, *, n_skip: int = 0) -> dict:
+        """Serialize a frozen slot into a TLTS-encodable migration blob:
+        request/resume metadata plus the byte-exact KV of every valid
+        page past the first ``n_skip`` (pages the destination's probe
+        reported resident — the PR-3 trie short-circuit). The gather is
+        one fixed-shape dispatch per page (``gather_page``), so exports
+        never grow the compiled-program set."""
+        req = self._slots[slot]
+        if req is None or slot not in self._frozen:
+            raise ValueError(f"slot {slot} is not frozen for export")
+        length = int(np.asarray(self.cache.lengths)[slot])
+        chain, limit = self.migration_chain(slot)
+        n_valid_pages = pages_needed(length, self.page_size)
+        n_skip = max(0, min(int(n_skip), limit // self.page_size,
+                            n_valid_pages))
+        row = [n.page for n in req.shared_nodes] + list(req.pages)
+        ship = row[n_skip:n_valid_pages]
+        payload: dict[str, list] = {"k": [], "v": [], "ks": [], "vs": []}
+        for pid in ship:
+            got = gather_page(self.cache, jnp.int32(pid))
+            payload["k"].append(np.asarray(got[0]))
+            payload["v"].append(np.asarray(got[1]))
+            if len(got) == 4:
+                payload["ks"].append(np.asarray(got[2]))
+                payload["vs"].append(np.asarray(got[3]))
+        blob = {
+            "v": 1,
+            "chain": np.asarray(chain, np.int32),
+            "length": int(length),
+            "last_tok": int(self._tok[slot]),
+            "prefill_target": int(req.prefill_target),
+            "n_skip": int(n_skip),
+            "page_size": int(self.page_size),
+            "kv_quant": self.kv_quant,
+            "k": np.stack(payload["k"]) if ship else np.zeros(0, np.int8),
+            "v": np.stack(payload["v"]) if ship else np.zeros(0, np.int8),
+        }
+        if payload["ks"]:
+            blob["k_scale"] = np.stack(payload["ks"])
+            blob["v_scale"] = np.stack(payload["vs"])
+        from ..core.serialization import content_digest
+
+        # integrity tag over the KV payload: the importer recomputes it,
+        # so corrupted bytes degrade into the re-prefill fallback instead
+        # of silently decoding from garbage pages
+        blob["digest"] = content_digest(
+            {k: blob[k] for k in ("k", "v", "k_scale", "v_scale")
+             if k in blob}
+        )
+        return blob
+
+    def commit_migration(
+        self, slot: int, *, fell_back: bool = False
+    ) -> ContinuousRequest | None:
+        """The frozen slot's stream now lives elsewhere (destination
+        adopted its pages, or the caller redirected it down the
+        re-prefill rung): tear the slot down through the normal release
+        path — prefill-region pages PROMOTE into the prefix cache, the
+        rest free — WITHOUT finishing the request (no on_finish, no done:
+        the stream is not over, it just left this engine)."""
+        if slot not in self._frozen:
+            raise ValueError(f"slot {slot} is not frozen")
+        req = self._teardown_slot(slot)
+        if fell_back:
+            self.stats["migrations_failed"] += 1
+            self.stats["migrations_fell_back"] += 1
+        else:
+            self.stats["migrations_completed"] += 1
+        return req
+
+    def abort_migration(self, slot: int) -> None:
+        """Un-freeze: the migration was abandoned and the slot resumes
+        decoding HERE, exactly where it stopped (the freeze moved no
+        bytes — export is read-only)."""
+        if slot not in self._frozen:
+            raise ValueError(f"slot {slot} is not frozen")
+        self._frozen.discard(slot)
+        self.stats["migrations_failed"] += 1
+        if self._slots[slot] is not None:
+            self._active[slot] = True
+
+    def shed_slot(self, slot: int) -> ContinuousRequest | None:
+        """Drain fallback for slots that cannot page-ship (mid-prefill,
+        or a failed freeze): release the slot without finishing the
+        request — the caller redirects the stream down the re-prefill
+        rung."""
+        req = self._teardown_slot(slot)
+        if req is not None:
+            self.stats["migrations_fell_back"] += 1
+        return req
+
+    def shed_queued(self) -> list[ContinuousRequest]:
+        """Pop every queued (not-yet-admitted) request for redirection
+        during a drain — they carry no KV, so their 'migration' is a pure
+        resubmission at the destination."""
+        with self._lock:
+            pending = self.sched.pending()
+            for r in pending:
+                self.sched.remove(r)
+        for r in pending:
+            # a queued resume's staged ticket names THIS engine's pages —
+            # dead the moment the stream redirects elsewhere (driver
+            # thread: shed_queued runs from the drain loop)
+            self._drop_ticket(r)
+        self.stats["migrations_fell_back"] += len(pending)
+        return pending
+
+    def fail_queued(self, req: ContinuousRequest, err: BaseException) -> None:
+        """Fail a request popped by :meth:`shed_queued` that has nowhere
+        to be redirected (no transport context) — loud, never stranded."""
+        self._drop_ticket(req)
+        req.error = err
+        self._finish(req, finished=False)
+
+    def begin_drain(self) -> None:
+        """Admission fence: stop taking new work (submit fails fast,
+        admission_check rejects) so the drain loop can shed every live
+        slot without racing fresh arrivals."""
+        self.drain_state = "draining"
+        with self._lock:
+            self.sched.set_draining(True)
+
+    def end_drain(self) -> None:
+        """Lower the fence — a drain that aborted before shedding (e.g.
+        the destination can't host the job) resumes serving in place."""
+        self.drain_state = "serving"
+        with self._lock:
+            self.sched.set_draining(False)
+
+    def frozen_slots(self) -> list[int]:
+        return sorted(self._frozen)
+
+    def live_manifest(self) -> list[tuple[str, int, ContinuousRequest]]:
+        """Snapshot of what a drain must move: ("decode"|"prefill", slot,
+        request) for every live slot. Driver-thread only."""
+        out: list[tuple[str, int, ContinuousRequest]] = []
+        for s in range(self.max_slots):
+            req = self._slots[s]
+            if req is None or s in self._frozen:
+                continue
+            kind = "prefill" if s in self._prefilling else "decode"
+            out.append((kind, s, req))
+        return out
+
+    # -- live slot migration (import side) -------------------------------
+    def resident_prefix_pages(self, chain, limit: int) -> int:
+        """The probe: how many leading FULL pages of ``chain`` are
+        resident in this engine's prefix cache — pages the exporter may
+        skip shipping (bitwise-identical by the cache contract)."""
+        if self.prefix is None:
+            return 0
+        return len(self.prefix.match(chain, int(limit)))
+
+    def stage_migration(self, mig_id: str, blob: dict) -> bool:
+        """Stage an inbound migration blob: pin the promised resident
+        prefix, allocate pages for the shipped remainder, and write the
+        bytes in (one fixed-shape ``scatter_page`` dispatch per page).
+        Idempotent by ``mig_id`` — duplicated or reordered wire frames
+        re-stage nothing. Returns False when this engine can't honor the
+        blob (storage-mode mismatch, promised prefix evicted since the
+        probe, allocator dry): the source then takes the re-prefill rung.
+        Pages stay IN TRANSIT (conservation-tracked) until the stream's
+        resume request adopts them, or the TTL/close GC frees them."""
+        if mig_id in self._migrations:
+            return True
+        if self.drain_state != "serving":
+            return False  # a draining engine must not adopt new streams
+        if str(blob.get("kv_quant", "none")) != self.kv_quant:
+            return False
+        if int(blob["page_size"]) != self.page_size:
+            return False
+        chain = [int(t) for t in np.asarray(blob["chain"]).reshape(-1)]
+        length = int(blob["length"])
+        limit = min(length, int(blob["prefill_target"]))
+        n_skip = int(blob["n_skip"])
+        nodes: list = []
+        if n_skip:
+            if self.prefix is None:
+                return False
+            nodes = self.prefix.match(chain, limit)[:n_skip]
+            if len(nodes) < n_skip:
+                # the prefix the probe promised was evicted meanwhile —
+                # the unshipped bytes are unrecoverable here
+                return False
+        k = np.asarray(blob["k"])
+        v = np.asarray(blob["v"])
+        n_ship = int(k.shape[0]) if k.ndim > 1 else 0
+        if n_skip + n_ship != pages_needed(length, self.page_size):
+            return False
+        if n_ship and k.dtype != np.dtype(self.cache.k.dtype):
+            return False  # cache dtype mismatch: bytes aren't portable
+        if blob.get("digest"):
+            from ..core.serialization import content_digest
+
+            got = content_digest(
+                {f: np.asarray(blob[f])
+                 for f in ("k", "v", "k_scale", "v_scale") if f in blob}
+            )
+            if got != blob["digest"]:
+                return False  # corrupted transfer → re-prefill rung
+        pages = self._alloc_pages(n_ship)
+        if pages is None:
+            return False
+        if self.prefix is not None:
+            self.prefix.acquire(nodes)
+        try:
+            for i, pid in enumerate(pages):
+                if self.cache.quantized:
+                    self.cache = scatter_page(
+                        self.cache, jnp.int32(pid),
+                        jnp.asarray(k[i]), jnp.asarray(v[i]),
+                        jnp.asarray(blob["k_scale"][i]),
+                        jnp.asarray(blob["v_scale"][i]),
+                    )
+                else:
+                    self.cache = scatter_page(
+                        self.cache, jnp.int32(pid),
+                        jnp.asarray(k[i]), jnp.asarray(v[i]),
+                    )
+        except BaseException:
+            # a failed staging must not leak: pages back to the free-list,
+            # pinned refs dropped, so conservation holds on the error path
+            self.alloc.free(pages)
+            if self.prefix is not None:
+                self.prefix.release(nodes)
+            raise
+        self._migrations[mig_id] = {
+            "pages": pages,
+            "nodes": nodes,
+            "chain": chain,
+            "length": length,
+            "last_tok": int(blob["last_tok"]),
+            "prefill_target": int(blob["prefill_target"]),
+            "t": time.monotonic(),
+        }
+        return True
+
+    def drop_staged_migration(self, mig_id: str) -> None:
+        """Free a staged migration's pages (fallback, TTL GC, close)."""
+        ticket = self._migrations.pop(mig_id, None)
+        if ticket is None:
+            return
+        self.alloc.free(ticket["pages"])
+        if self.prefix is not None:
+            self.prefix.release(ticket["nodes"])
+
+    def _gc_staged_migrations(self) -> None:
+        """Free staged tickets whose resume request never arrived (the
+        draining source or its client died mid-handoff) so abandoned
+        migrations can't leak pages."""
+        now = time.monotonic()
+        for mig_id in [
+            m for m, t in self._migrations.items()
+            if now - t["t"] > self.migration_ttl_s
+        ]:
+            self.drop_staged_migration(mig_id)
+
     # -- page accounting -------------------------------------------------
     def page_accounting(self) -> dict:
         """Ownership snapshot over physical pages 1..P-1: the free-list,
-        the cache-resident set, and each live slot's private pages."""
+        the cache-resident set, each live slot's private pages, and the
+        IN-TRANSIT set — pages a migration currently holds (a frozen
+        slot's pages awaiting commit on the source; a staged ticket's
+        pages awaiting adoption on the destination)."""
         slot_pages: list[int] = []
+        in_transit: list[int] = []
         for s in range(self.max_slots):
             req = self._slots[s]
             if req is not None:
-                slot_pages.extend(req.pages)
+                (in_transit if s in self._frozen else slot_pages).extend(
+                    req.pages
+                )
+        for ticket in self._migrations.values():
+            in_transit.extend(ticket["pages"])
         return {
             "free": set(self.alloc._free),
             "cached": self.prefix.resident_pages if self.prefix else set(),
             "slots": slot_pages,
+            "in_transit": in_transit,
         }
 
     def check_page_conservation(self) -> None:
         """The hardened free-list invariant: free + slot-owned +
-        cache-resident == total usable pages, pairwise disjoint, scratch
-        page 0 in none of them. Raises AssertionError on violation —
-        asserted at engine teardown (close) and by the engine/chaos
-        tests after recovery."""
+        cache-resident + in-transit == total usable pages, pairwise
+        disjoint, scratch page 0 in none of them. Raises AssertionError
+        on violation — asserted at engine teardown (close) and by the
+        engine/chaos tests after recovery AND mid-migration (the
+        in-transit term is what keeps the invariant checkable while a
+        migration is in flight on either side)."""
         acc = self.page_accounting()
-        free, cached, slots = acc["free"], acc["cached"], acc["slots"]
+        free, cached = acc["free"], acc["cached"]
+        slots, transit = acc["slots"], acc["in_transit"]
         total = self.cache.n_pages - 1
         problems = []
         if len(slots) != len(set(slots)):
             problems.append("a page is owned by two slots")
+        if len(transit) != len(set(transit)):
+            problems.append("a page is in transit twice")
         if free & cached:
             problems.append("free-list and cache overlap")
         if set(slots) & (free | cached):
             problems.append("slot-owned page also free or cached")
-        if 0 in (free | cached | set(slots)):
+        if set(transit) & (free | cached | set(slots)):
+            problems.append("in-transit page also free, cached, or owned")
+        if 0 in (free | cached | set(slots) | set(transit)):
             problems.append("scratch page 0 entered an ownership set")
-        if len(free) + len(cached) + len(slots) != total:
+        if len(free) + len(cached) + len(slots) + len(transit) != total:
             problems.append(
                 f"leak: free={len(free)} + cached={len(cached)} + "
-                f"slots={len(slots)} != total={total}"
+                f"slots={len(slots)} + in_transit={len(transit)} != "
+                f"total={total}"
             )
         if problems:
             raise AssertionError(
@@ -762,6 +1224,18 @@ class ContinuousEngine:
             "kv_pages_total": c.n_pages - 1,
             "kv_pages_free": self.alloc.n_free,
             "kv_page_bytes": int(page_bytes),
+            # live migration telemetry (migrations_* counters ride
+            # self.stats above): drain fence state + pages currently held
+            # by an in-flight migration on either side
+            "drain_state": self.drain_state,
+            "pages_in_transit": (
+                sum(len(t["pages"]) for t in self._migrations.values())
+                + sum(
+                    len(self._slots[s].pages)
+                    for s in self._frozen
+                    if self._slots[s] is not None
+                )
+            ),
         })
         with self._lock:
             out.update(self.sched.snapshot())
@@ -788,6 +1262,10 @@ class ContinuousEngine:
         client submit() calls never stack behind admission compute
         (single-driver discipline means nobody else pops the selection
         meanwhile)."""
+        if self._migrations:
+            # abandoned staged adoptions (their resume never arrived)
+            # must not hold pages forever
+            self._gc_staged_migrations()
         with self._lock:
             self.sched.tick()
         while True:
@@ -801,7 +1279,7 @@ class ContinuousEngine:
                 req = self.sched.select()
                 victim = None
                 if req is not None and not free:
-                    victim = self.sched.victim(list(self._slots), req)
+                    victim = self.sched.victim(self._preemptable(), req)
             if req is None:
                 return
             if not free:
@@ -816,7 +1294,7 @@ class ContinuousEngine:
                 # is near-free too); without a victim the candidate
                 # waits head-of-line like before
                 with self._lock:
-                    victim = self.sched.victim(list(self._slots), req)
+                    victim = self.sched.victim(self._preemptable(), req)
                 if victim is None:
                     return  # head-of-line waits for pages
                 self._preempt(victim.slot)
@@ -825,6 +1303,15 @@ class ContinuousEngine:
                 if req.slot >= 0:
                     self.sched.note_admitted(req)
                     req.admit_t = time.monotonic()
+
+    def _preemptable(self) -> list:
+        """Resident requests a preemption may consider: a slot frozen for
+        migration is mid-handoff — tearing it down would corrupt the
+        export — so it is invisible to the victim search."""
+        return [
+            r if s not in self._frozen else None
+            for s, r in enumerate(self._slots)
+        ]
 
     # -- the decode loop -------------------------------------------------
     # per-slot EOS ids carried INTO the compiled chunk (freeze
@@ -992,9 +1479,13 @@ class ContinuousEngine:
         for req in pending:
             req.error = err
             self._finish(req, finished=False)
-        # teardown invariant: with every slot evicted, the free-list plus
-        # the cache-resident set must account for every usable page —
-        # a violation here means a leak or a double-ownership upstream
+        # staged adoptions whose resume never arrived die with the engine
+        for mig_id in list(self._migrations):
+            self.drop_staged_migration(mig_id)
+        # teardown invariant: with every slot evicted and every staged
+        # migration released, the free-list plus the cache-resident set
+        # must account for every usable page — a violation here means a
+        # leak or a double-ownership upstream
         self.check_page_conservation()
 
 

@@ -799,6 +799,51 @@ def copy_page(
 
 
 # tlint: hot-path
+@jax.jit
+def gather_page(cache: PagedKVCache, page: jax.Array) -> tuple:
+    """Read one physical page's KV across every layer — the migration
+    EXPORT device path. Returns ``(k, v)`` (``[L, n_kv, page, hd]``) or
+    ``(k, v, k_scale, v_scale)`` in int8 mode. The bytes are the cache
+    value itself (no dequantize, no cast), which is what makes a shipped
+    page byte-exact on the destination: an adopted quantized page
+    dequantizes to exactly what the source's kernels read."""
+    if cache.k_scale is None:
+        return cache.k[:, page], cache.v[:, page]
+    return (
+        cache.k[:, page], cache.v[:, page],
+        cache.k_scale[:, page], cache.v_scale[:, page],
+    )
+
+
+# tlint: hot-path
+@partial(jax.jit, donate_argnames=("cache",))
+def scatter_page(
+    cache: PagedKVCache,
+    page: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> PagedKVCache:
+    """Write one shipped page's KV into a destination-owned physical page —
+    the migration IMPORT device path (inverse of :func:`gather_page`,
+    byte-exact; page shape is fixed, so any migration compiles this ONCE
+    per engine mode regardless of how many pages move)."""
+    out = replace(
+        cache,
+        k=cache.k.at[:, page].set(k),
+        v=cache.v.at[:, page].set(v),
+    )
+    if k_scale is not None:
+        out = replace(
+            out,
+            k_scale=cache.k_scale.at[:, page].set(k_scale),
+            v_scale=cache.v_scale.at[:, page].set(v_scale),
+        )
+    return out
+
+
+# tlint: hot-path
 @partial(jax.jit, donate_argnames=("cache",))
 def bind_slot(
     cache: PagedKVCache, slot: jax.Array, bt_row: jax.Array, length: jax.Array
@@ -840,6 +885,8 @@ __all__ = [
     "paged_decode_step",
     "paged_ragged_step",
     "copy_page",
+    "gather_page",
+    "scatter_page",
     "bind_slot",
     "clear_slot",
     "pages_needed",

@@ -159,6 +159,11 @@ class RequestScheduler:
         # touch happens with the ENGINE's lock held by the caller, so
         # touching methods carry `# tlint: holds-lock(the engine lock)`
         self._queued: list = []  #: guarded by the engine lock
+        # drain fence (live slot migration, docs/FAILURE_MODEL.md): a
+        # draining engine takes no new work — push fails fast and
+        # admission_check rejects, so the drain loop never races fresh
+        # arrivals while it sheds the live slots
+        self.draining = False  #: guarded by the engine lock
         self._seq = 0
         self._admit_seq = 0  # admission order — victim-recency tiebreak
         self._tick = 0
@@ -201,6 +206,11 @@ class RequestScheduler:
         rejects before the request gets this far)."""
         req.priority = normalize_priority(getattr(req, "priority", None))
         depth = self.depth(req.priority)
+        if self.draining:
+            # the admission fence: a draining engine is shedding its live
+            # slots — new work must land on the destination instead
+            self.by_class[req.priority].rejected += 1
+            raise SchedulerOverloaded(req.priority, depth, self.queue_cap, 1.0)
         if depth >= self.queue_cap:
             self.by_class[req.priority].rejected += 1
             raise SchedulerOverloaded(
@@ -227,6 +237,12 @@ class RequestScheduler:
         req.enqueue_t = time.monotonic()
         self._queued.append(req)
         self.by_class[req.priority].preempted += 1
+
+    # tlint: holds-lock(the engine lock)
+    def set_draining(self, draining: bool) -> None:
+        """Raise/lower the drain admission fence (live slot migration —
+        the engine's ``begin_drain`` flips this before shedding slots)."""
+        self.draining = bool(draining)
 
     def tick(self) -> int:
         """One admission round has begun (the engine calls this once per
@@ -341,6 +357,15 @@ class RequestScheduler:
         exceeds ``max_wait_s`` (0 disables the wait check)."""
         cls = normalize_priority(priority)
         depth = self.depth(cls)
+        if self.draining:
+            self.by_class[cls].rejected += n
+            return {
+                "priority": cls,
+                "queue_depth": depth,
+                "cap": self.queue_cap,
+                "retry_after": 1.0,
+                "draining": True,
+            }
         est = self.estimate_wait(cls)
         if depth + n > self.queue_cap or (
             self.max_wait_s > 0 and est > self.max_wait_s

@@ -983,6 +983,69 @@ class DistributedModel:
         if isinstance(snap, dict):
             self.cont_serving_stats = snap
 
+    def _merge_migrated_tokens(
+        self, mig: dict, delivered_prior: list[int],
+        seen_total: list[int], stream_cb,
+    ) -> list[int]:
+        """Reconcile a migrated stream's token state: the redirect's
+        ``tokens_so_far`` is the authoritative list of everything the
+        draining worker emitted THIS submission (fire-and-forget relay
+        frames may have dropped some). Tokens the caller hasn't seen yet
+        are fed to ``stream_cb`` here — exactly once, in order — BEFORE
+        any re-pointing that could fail, so a later repair can never
+        re-emit or lose them."""
+        auth = [int(t) for t in mig.get("tokens_so_far") or []]
+        merged = list(delivered_prior) + auth
+        for tok in merged[len(seen_total):]:
+            if stream_cb is not None:
+                stream_cb([tok])
+        return merged
+
+    @staticmethod
+    def _count_redirect(redirects: int, cap: int) -> int:
+        """Bound migration-redirect hops for one request: tokens already
+        merged are preserved (the caller raises AFTER merging), but a
+        redirect cycle must fail loudly instead of bouncing forever."""
+        if redirects + 1 > cap:
+            raise RuntimeError(
+                f"migration redirect loop: request bounced {cap} times "
+                "(draining workers pointing at each other?)"
+            )
+        return redirects + 1
+
+    def _attach_migrated(self, old_wid: str, mig: dict) -> str | None:
+        """Re-point this job at a migration redirect's destination worker
+        (connect, rewrite the plan stage, record the repair mapping so
+        concurrent requests chase to it too). Returns the staged-adoption
+        ticket id (None = plain re-prefill resume). An unreachable
+        destination raises :class:`WorkerLost` — the caller's recovery
+        path then pulls a validator replacement, the ladder's last rung."""
+        dest_id = str(mig.get("worker") or "")
+        addr = list(mig.get("addr") or [])
+        if not dest_id or len(addr) != 2:
+            raise WorkerLost(
+                old_wid, RuntimeError("malformed migration redirect")
+            )
+        with self._repair_lock:
+            if dest_id not in self.workers:
+                try:
+                    conn_id = self.node.connect_to(addr[0], int(addr[1]))
+                except Exception as e:
+                    raise WorkerLost(old_wid, e) from e
+                self.workers[dest_id] = conn_id
+                self.worker_addrs[dest_id] = [addr[0], int(addr[1])]
+            for s in self.plan.stages:
+                if s.worker_id == old_wid:
+                    s.worker_id = dest_id
+            if old_wid != dest_id:
+                self._repaired[old_wid] = dest_id
+        self.log.info(
+            "stream migrated %s -> %s (%s)",
+            old_wid[:8], dest_id[:8],
+            "page-shipped" if mig.get("mig") else "re-prefill resume",
+        )
+        return mig.get("mig") or None
+
     def _generate_continuous_remote(
         self, prompt: list[int], *, max_new_tokens: int, temperature: float,
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
@@ -1004,6 +1067,12 @@ class DistributedModel:
         delivered: list[int] = []
         recoveries = 0
         MAX_RECOVERIES = 3
+        adopt: str | None = None  # staged-migration ticket on the dest
+        redirects = 0
+        # redirect hops are bounded separately from crash recoveries: a
+        # drain cycle (A drained onto B, B later drained onto A before A
+        # was stopped) must surface as an error, not an infinite bounce
+        MAX_REDIRECTS = 8
         while True:
             # capture the id this attempt ISSUES to: a concurrent request's
             # repair may rewrite the plan mid-flight, and recovery must
@@ -1029,19 +1098,45 @@ class DistributedModel:
                 # the worker's scheduler reads the class off the wire; an
                 # old worker simply ignores the extra key (FCFS for it)
                 body["priority"] = str(priority)
+            if adopt:
+                # resume-after-migration: the destination staged our KV
+                # pages under this ticket — admission binds them instead
+                # of re-prefilling (and quietly falls back if it can't)
+                body["adopt"] = adopt
             try:
                 if stream_cb is None:
                     resp = self._request(
                         wid, proto.GENERATE, body, _repaired=True
                     )
                     self._note_serving(resp)
+                    mig = resp.get("migrated")
+                    if mig is not None:
+                        # the worker is draining: our slot moved (or was
+                        # redirected) — top up delivered from the
+                        # authoritative list, re-point at the
+                        # destination, and re-issue there
+                        delivered = self._merge_migrated_tokens(
+                            mig, delivered, delivered, None
+                        )
+                        redirects = self._count_redirect(redirects,
+                                                         MAX_REDIRECTS)
+                        adopt = self._attach_migrated(wid, mig)
+                        continue
                     return [
                         delivered
                         + [int(t) for t in resp["sequences"][0]]
                     ]
-                out, finished = self._drain_continuous_stream(
+                out, finished, mig = self._drain_continuous_stream(
                     wid, body, delivered, stream_cb
                 )
+                if mig is not None:
+                    delivered = self._merge_migrated_tokens(
+                        mig, delivered, out, stream_cb
+                    )
+                    redirects = self._count_redirect(redirects,
+                                                     MAX_REDIRECTS)
+                    adopt = self._attach_migrated(wid, mig)
+                    continue
                 if finished:
                     return [out]
                 delivered = out  # resume from what the relay delivered
@@ -1068,11 +1163,14 @@ class DistributedModel:
 
     def _drain_continuous_stream(
         self, wid: str, body: dict, delivered: list[int], stream_cb
-    ) -> tuple[list[int], bool]:
+    ) -> tuple[list[int], bool, dict | None]:
         """Issue a streamed continuous GENERATE and drain its relay.
-        Returns ``(tokens_so_far, finished)`` — ``finished=False`` means
-        the worker died mid-stream and the caller should resume from
-        ``tokens_so_far`` on a replacement."""
+        Returns ``(tokens_so_far, finished, migrated)`` —
+        ``finished=False`` with ``migrated=None`` means the worker died
+        mid-stream and the caller should resume from ``tokens_so_far`` on
+        a replacement; a non-None ``migrated`` dict means the worker
+        DRAINED and redirected this stream (live slot migration) — the
+        caller re-points at the named destination."""
         import threading
 
         stream_id = secrets.token_hex(8)
@@ -1146,17 +1244,24 @@ class DistributedModel:
             # the response is authoritative (fire-and-forget stream frames
             # may drop); it holds THIS submission's tokens only
             self._note_serving(result["resp"])
+            mig = result["resp"].get("migrated")
+            if mig is not None:
+                # drained mid-stream: hand the redirect up with what the
+                # relay delivered so far (the migrated body's
+                # tokens_so_far is the authoritative top-up source)
+                return toks, False, mig
             return (
                 delivered
                 + [int(x) for x in result["resp"]["sequences"][0]],
                 True,
+                None,
             )
         err = result.get("err")
         if err is not None and "no connection" not in str(err):
             # compute errors and plain timeouts surface to the caller —
             # only a dead connection licenses the resume-on-replacement
             raise err
-        return toks, False
+        return toks, False, None
 
     def _generate_pipelined(
         self, prompts, *, max_new_tokens, temperature, top_k=0, top_p=1.0,

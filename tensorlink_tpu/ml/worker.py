@@ -44,6 +44,10 @@ class StageRuntime:
     cfg: Any  # ModelConfig
     stage: dict  # StagePlan as dict (layer_lo/hi, first, last, holds_head)
     params: Any
+    # the ORIGINAL model spec this stage was shipped with (name, config,
+    # ckpt/seed, quant, flash) — what a drain re-ships to the destination
+    # worker so it can load an identical stage before adopting slots
+    model_spec: dict = field(default_factory=dict)
     mesh: Any = None
     engine: Any = None  # GenerationEngine for whole-model jobs
     sessions: dict[str, Any] = field(default_factory=dict)  # session -> KVCache
@@ -120,6 +124,11 @@ class DistributedWorker:
         self.log = get_logger(f"ml.worker{node.config.duplicate}")
         self.jobs: dict[str, StageRuntime] = {}
         self._lock = threading.Lock()
+        # drain state (live slot migration): set to the DRAIN verb's
+        # destination {"id", "addr"} — new continuous requests are
+        # redirected there instead of admitted, and the recruiting
+        # capacity is zeroed. None = serving normally.
+        self.draining: dict | None = None
         # per-node fault plan (core/faults.py) — an INSTANCE, not the module
         # global, so several worker nodes living in one test process never
         # share fault counters; None (the default) keeps the hot paths free
@@ -233,6 +242,8 @@ class DistributedWorker:
                         proto.PARAMS_REQ: proto.PARAMETERS,
                         proto.CHECKPOINT: proto.CHECKPOINT_RESP,
                         proto.PROOF_REQ: proto.PROOF_RESP,
+                        proto.MIGRATE: proto.MIGRATE_RESP,
+                        proto.DRAIN: proto.DRAIN_RESP,
                         "load_stage": proto.MODULE_LOADED,
                         "beam_continue": proto.GENERATE_RESP,
                     }.get(kind, proto.FORWARD_RESP)
@@ -269,6 +280,10 @@ class DistributedWorker:
             self._proof_req(p)
         elif kind == proto.CHECKPOINT:
             self._checkpoint(p)
+        elif kind == proto.DRAIN:
+            self._drain(p)
+        elif kind == proto.MIGRATE:
+            self._migrate_in(p)
         elif kind == "shutdown_job":
             with self._lock:
                 rt = self.jobs.pop(p.get("job_id", ""), None)
@@ -383,6 +398,7 @@ class DistributedWorker:
             cfg=cfg,
             stage=stage,
             params=params,
+            model_spec=dict(model),
             mesh=mesh,
             training=training,
             cache_quant=cache_quant,
@@ -1566,37 +1582,20 @@ class DistributedWorker:
             or p.get("lookahead")
         ):
             return False
-        cont = rt.cont
-        if cont is None or cont.engine is not rt.engine:
-            # (re)build after load_stage swapped the engine — old slots
-            # died with their engine's cache
-            from tensorlink_tpu.engine.continuous import ContinuousEngine
-
-            ml = self.node.config.ml
-            try:
-                rt.cont = cont = ContinuousEngine(
-                    rt.engine,
-                    max_slots=int(ml.cont_max_slots),
-                    page_size=int(ml.cont_page_size),
-                    chunk_steps=int(ml.cont_chunk_steps),
-                    prefill_chunk=int(ml.prefill_chunk),
-                    prefix_cache=bool(ml.prefix_cache),
-                    # `or` before str(): a null kv_quant in an operator
-                    # config must read as "none", not the string "None"
-                    kv_quant=str(ml.kv_quant or "none"),
-                    default_priority=str(ml.default_priority),
-                    sched_queue_cap=int(ml.sched_queue_cap),
-                    sched_aging_ticks=int(ml.sched_aging_ticks),
-                    sched_preemption=bool(ml.sched_preemption),
-                    sched_policy=str(ml.sched_policy),
-                    sched_max_wait_s=float(ml.sched_max_wait_s),
-                )
-            except ValueError as e:
-                # sliding window (or a bad knob): static batcher territory.
-                # int8-KV models ("int8+kv") are NOT refused anymore — the
-                # paged engine stores int8 pages natively (kv_quant)
-                self.log.info("continuous batching unavailable: %s", e)
-                return False
+        if self.draining is not None:
+            # admission fence: this worker is shedding its slots — redirect
+            # the request to the drain destination (the client re-issues
+            # there; an empty tokens_so_far means a plain resubmission)
+            self._respond_migrated(
+                rt.cont,
+                {"peer": p["peer"], "rid": p["rid"],
+                 "stream": p.get("stream")},
+                self.draining, None, [],
+            )
+            return True
+        cont = self._ensure_cont(rt)
+        if cont is None:
+            return False
         t, k, tp, pp, fp = knobs
         sampling = SamplingParams.make(
             temperature=float(t), top_k=int(k), top_p=float(tp),
@@ -1658,7 +1657,7 @@ class DistributedWorker:
                  "serving": cont.serving_snapshot()},
             )
 
-        cont.submit(
+        req = cont.submit(
             prompts[0],
             max_new_tokens=int(p.get("max_new_tokens", 128)),
             sampling=sampling,
@@ -1668,9 +1667,55 @@ class DistributedWorker:
             priority=p.get("priority"),
             stream_cb=stream_cb if stream_id else None,
             on_finish=on_finish,
+            # resume-after-migration: bind the staged KV pages instead of
+            # re-prefilling (engine falls back when the ticket is stale)
+            adopt=p.get("adopt") or None,
         )
+        # transport context for live migration: a drain must redirect this
+        # stream mid-flight, which needs the original peer/rid/stream —
+        # the on_finish/stream closures are opaque, this is not
+        req.client_meta = {
+            "peer": peer, "rid": p["rid"], "stream": stream_id,
+        }
         self._schedule_cont(rt)
         return True
+
+    def _ensure_cont(self, rt: "StageRuntime"):
+        """The job's slot engine, (re)built after load_stage swapped the
+        generation engine (old slots died with their engine's cache).
+        None when the model can't serve continuous — callers fall back to
+        the static paths."""
+        cont = rt.cont
+        if cont is not None and cont.engine is rt.engine:
+            return cont
+        from tensorlink_tpu.engine.continuous import ContinuousEngine
+
+        ml = self.node.config.ml
+        try:
+            rt.cont = cont = ContinuousEngine(
+                rt.engine,
+                max_slots=int(ml.cont_max_slots),
+                page_size=int(ml.cont_page_size),
+                chunk_steps=int(ml.cont_chunk_steps),
+                prefill_chunk=int(ml.prefill_chunk),
+                prefix_cache=bool(ml.prefix_cache),
+                # `or` before str(): a null kv_quant in an operator
+                # config must read as "none", not the string "None"
+                kv_quant=str(ml.kv_quant or "none"),
+                default_priority=str(ml.default_priority),
+                sched_queue_cap=int(ml.sched_queue_cap),
+                sched_aging_ticks=int(ml.sched_aging_ticks),
+                sched_preemption=bool(ml.sched_preemption),
+                sched_policy=str(ml.sched_policy),
+                sched_max_wait_s=float(ml.sched_max_wait_s),
+            )
+        except ValueError as e:
+            # sliding window (or a bad knob): static batcher territory.
+            # int8-KV models ("int8+kv") are NOT refused anymore — the
+            # paged engine stores int8 pages natively (kv_quant)
+            self.log.info("continuous batching unavailable: %s", e)
+            return None
+        return cont
 
     def _schedule_cont(self, rt: "StageRuntime") -> None:
         if not rt.cont_scheduled:
@@ -1702,6 +1747,344 @@ class DistributedWorker:
             return
         if more:
             self._schedule_cont(rt)
+
+    # -- live slot migration + drain (docs/FAILURE_MODEL.md) -------------
+    # DRAIN (validator → this worker): fence admissions, then move every
+    # live continuous stream to the destination worker — KV-page shipping
+    # for steady decode slots (bit-identical resume), the crash-recovery
+    # re-prefill rung for everything else (mid-prefill slots, queued
+    # requests, and any failed export/wire/import). The client learns via
+    # a {"migrated": ...} GENERATE_RESP and re-issues at the destination;
+    # a stream is never dropped, only redirected.
+
+    def _drain(self, p: dict) -> None:
+        dest = dict(p.get("dest") or {})
+        if self.faults is not None:
+            # fault site "worker.drain": a worker that dies the moment it
+            # is asked to shed its slots (crash) or refuses (error)
+            self.faults.inject("worker.drain", str(dest.get("id", "")))
+        if not dest.get("id") or not dest.get("addr"):
+            self._respond(
+                p["peer"], proto.DRAIN_RESP, p["rid"],
+                {"ok": False, "error": "drain needs a destination {id, addr}"},
+            )
+            return
+        if dest["id"] == self.node.node_id:
+            # a self-targeted drain would make this worker permanently
+            # redirect every request back to itself
+            self._respond(
+                p["peer"], proto.DRAIN_RESP, p["rid"],
+                {"ok": False, "error": "refusing to drain a worker onto itself"},
+            )
+            return
+        self.draining = dest
+        try:
+            # recruiting fence: advertise zero capacity so planners stop
+            # placing new stages here while the worker sheds its slots
+            self.bridge.request(
+                "set_capacity", {"hbm_bytes": 0.0}, timeout=10.0
+            )
+        except Exception as e:
+            self.log.warning("drain: capacity fence failed: %s", e)
+        summary = {"ok": True, "jobs": 0, "migrated": 0, "fell_back": 0,
+                   "aborted": 0}
+        with self._lock:
+            jobs = list(self.jobs.items())
+        for _job_id, rt in jobs:
+            if rt.cont is None:
+                continue
+            summary["jobs"] += 1
+            self._drain_engine(rt, dest, summary)
+        if summary["aborted"]:
+            # a job the destination can't host keeps serving HERE:
+            # redirecting its streams into a jobless worker would drop
+            # them. Lower the worker fence and restore the recruiting
+            # capacity — the drain failed, loudly, with nothing lost.
+            self.draining = None
+            try:
+                self.bridge.request(
+                    "set_capacity", self.capacity(), timeout=30.0
+                )
+            except Exception as e:
+                self.log.warning("drain abort: capacity restore failed: %s", e)
+            summary["ok"] = False
+            summary["error"] = (
+                "destination could not host every job; drain aborted for "
+                f"{summary['aborted']} job(s), streams kept serving locally"
+            )
+        self.log.info(
+            "drained to %s: %d migrated, %d fell back, %d aborted",
+            str(dest.get("id", ""))[:8], summary["migrated"],
+            summary["fell_back"], summary["aborted"],
+        )
+        self._respond(p["peer"], proto.DRAIN_RESP, p["rid"], summary)
+
+    def _drain_engine(self, rt: "StageRuntime", dest: dict,
+                      summary: dict) -> None:
+        """Shed one job's slot engine. Runs on the worker's serial run
+        loop, so every freeze happens at a chunk boundary by
+        construction."""
+        cont = rt.cont
+        cont.begin_drain()
+        if not self._prepare_dest(rt, dest):
+            # the destination can't host this job (unreachable, refuses,
+            # stage load failed): redirecting streams there would strand
+            # them against a jobless worker. Abort THIS job's drain —
+            # nothing was shed yet, so lowering the fence resumes serving
+            # exactly where it stood.
+            cont.end_drain()
+            summary["aborted"] += 1
+            return
+        manifest = cont.live_manifest()
+        queued = cont.shed_queued()
+        for kind, slot, req in manifest:
+            meta = req.client_meta
+            if meta is None:
+                # no transport context (in-process driver): nothing to
+                # redirect — the slot finishes locally under the fence
+                continue
+            if kind == "decode":
+                try:
+                    if self.faults is not None:
+                        self.faults.inject(
+                            "migrate.export", str(meta.get("rid", ""))
+                        )
+                    cont.freeze_slot(slot)
+                    mig_id = self._ship_migration(rt, cont, slot, dest)
+                    moved = cont.commit_migration(slot)
+                    self._respond_migrated(
+                        cont, meta, dest, mig_id, moved.tokens
+                    )
+                    summary["migrated"] += 1
+                    continue
+                except FaultCrash:
+                    raise  # the run loop takes the node down
+                except Exception as e:
+                    self.log.warning(
+                        "migration of slot %d failed (%s); falling back "
+                        "to re-prefill on the destination", slot, e,
+                    )
+            # fallback ladder: mid-prefill slot, or a failed
+            # export/wire/import — redirect for re-prefill resume (the
+            # destination hosts the job; only the page transfer failed)
+            if slot in cont.frozen_slots():
+                moved = cont.commit_migration(slot, fell_back=True)
+            else:
+                moved = cont.shed_slot(slot)
+            self._respond_migrated(
+                cont, meta, dest, None, (moved or req).tokens
+            )
+            summary["fell_back"] += 1
+        for req in queued:
+            if req.client_meta is not None:
+                self._respond_migrated(
+                    cont, req.client_meta, dest, None, req.tokens
+                )
+            else:
+                # an in-process submitter can't be redirected: fail fast
+                # rather than strand it in a popped-from-queue limbo
+                cont.fail_queued(
+                    req, RuntimeError("worker draining; resubmit elsewhere")
+                )
+
+    def _dial_dest(self, dest: dict) -> str:
+        """Peer id of a live connection to the destination worker (the
+        network process dedupes dials by address)."""
+        return self.bridge.request(
+            "connect",
+            {"host": dest["addr"][0], "port": int(dest["addr"][1])},
+            timeout=15.0,
+        )
+
+    def _mig_request(self, peer: str, body: dict, timeout: float = 60.0):
+        return self.bridge.request(
+            "tensor_request",
+            {"peer": peer, "tag": proto.MIGRATE, "body": body,
+             "timeout": timeout},
+            timeout=timeout + 10.0,
+        )
+
+    def _prepare_dest(self, rt: "StageRuntime", dest: dict) -> bool:
+        """Make sure the destination can adopt this job's slots: probe it,
+        and ship the stage (same model spec → same seeded params → an
+        engine whose streams are bit-identical to ours) when it doesn't
+        host the job yet. False = page-shipping unavailable; every slot
+        takes the re-prefill rung instead."""
+        try:
+            peer = self._dial_dest(dest)
+            pr = self._mig_request(
+                peer,
+                {"op": "probe", "job_id": rt.job_id,
+                 "chain": np.zeros(0, np.int32), "limit": 0},
+            )
+            if not pr.get("ok"):
+                return False
+            if not pr.get("loaded"):
+                resp = self.bridge.request(
+                    "tensor_request",
+                    {"peer": peer, "tag": proto.MODULE,
+                     "body": {
+                         "job_id": rt.job_id,
+                         "model": rt.model_spec,
+                         "stage": dict(rt.stage, worker_id=dest["id"]),
+                         "training": False,
+                     },
+                     "timeout": 120.0},
+                    timeout=130.0,
+                )
+                if not resp.get("ok"):
+                    return False
+            return True
+        except Exception as e:
+            self.log.warning(
+                "drain destination %s unreachable/unready: %s",
+                str(dest.get("id", ""))[:8], e,
+            )
+            return False
+
+    def _ship_migration(self, rt: "StageRuntime", cont, slot: int,
+                        dest: dict) -> str:
+        """Probe + export + transfer one frozen slot's pages. Returns the
+        staged ticket id the client's resume request will adopt. Raises
+        on any failure — the caller falls back to re-prefill."""
+        import secrets
+
+        peer = self._dial_dest(dest)
+        chain, limit = cont.migration_chain(slot)
+        n_skip = 0
+        try:
+            pr = self._mig_request(
+                peer,
+                {"op": "probe", "job_id": rt.job_id,
+                 "chain": np.asarray(chain, np.int32), "limit": int(limit)},
+            )
+            n_skip = int(pr.get("resident_pages", 0) or 0)
+        except Exception as e:
+            self.log.debug("migration probe failed (%s); shipping all", e)
+        blob = cont.export_slot(slot, n_skip=n_skip)
+        mig_id = secrets.token_hex(8)
+        act = (
+            self.faults.inject("migrate.wire", mig_id)
+            if self.faults is not None else None
+        )
+        if act == "drop":
+            raise RuntimeError("migrate.wire: transfer dropped")
+        if isinstance(act, tuple):  # ("delay", seconds)
+            time.sleep(act[1])
+        reply = None
+        # dup really sends the staging frame twice — idempotency by
+        # mig_id is the destination's contract, chaos-tested
+        for _ in range(2 if act == "dup" else 1):
+            reply = self._mig_request(
+                peer,
+                {"op": "put", "job_id": rt.job_id, "mig": mig_id,
+                 "blob": blob},
+            )
+        if not (reply or {}).get("ok"):
+            raise RuntimeError(
+                f"destination refused migration: "
+                f"{(reply or {}).get('error', 'not ok')}"
+            )
+        return mig_id
+
+    def _respond_migrated(self, cont, meta: dict, dest: dict,
+                          mig_id: str | None, tokens) -> None:
+        """Tell the waiting client its stream moved: where to re-issue,
+        which staged ticket to adopt (None = plain re-prefill resume), and
+        the authoritative emitted-so-far list (fire-and-forget stream
+        frames may have dropped — the client tops up exactly-once from
+        this). ``cont`` may be None (the admission-fence redirect fires
+        before any slot engine exists)."""
+        body = {
+            "migrated": {
+                "worker": dest["id"],
+                "addr": list(dest["addr"]),
+                "mig": mig_id,
+                "tokens_so_far": [int(t) for t in tokens],
+            },
+        }
+        if cont is not None:
+            body["serving"] = cont.serving_snapshot()
+        self._respond(meta["peer"], proto.GENERATE_RESP, meta["rid"], body)
+        if meta.get("stream"):
+            try:
+                # close the relay so a streaming client's drain loop
+                # unblocks immediately instead of riding out its timeout
+                self.bridge.request(
+                    "send_token",
+                    {"peer": meta["peer"], "stream": meta["stream"],
+                     "tokens": [], "done": True},
+                )
+            except Exception as e:
+                self.log.debug("migrate stream close failed: %s", e)
+
+    def _migrate_in(self, p: dict) -> None:
+        """Destination side of a migration: ``probe`` answers whether the
+        job is loaded and how many leading pages of the chain are
+        prefix-cache-resident (the exporter skips shipping those);
+        ``put`` stages the blob's pages into this engine (idempotent by
+        mig id). The staged ticket is adopted by the client's resume
+        request (``adopt`` on GENERATE)."""
+        op = p.get("op")
+        rt = self.jobs.get(p.get("job_id", ""))
+        if op in ("probe", "put") and self.draining is not None:
+            # worker-level fence: a draining worker must not adopt inbound
+            # streams — its engines are fenced, so a staged ticket here
+            # could never be adopted (the resume gets redirected away) and
+            # its pages would pin until process exit
+            self._respond(
+                p["peer"], proto.MIGRATE_RESP, p["rid"],
+                {"ok": False, "error": "destination is draining"},
+            )
+            return
+        if op == "probe":
+            loaded = rt is not None and rt.engine is not None
+            body: dict = {"ok": True, "loaded": loaded}
+            if loaded:
+                cont = self._ensure_cont(rt)
+                if cont is None or cont.drain_state != "serving":
+                    body = {"ok": False,
+                            "error": "destination cannot adopt (no slot "
+                                     "engine, or draining itself)"}
+                else:
+                    chain = [
+                        int(t)
+                        for t in np.asarray(p.get("chain", [])).reshape(-1)
+                    ]
+                    body["resident_pages"] = cont.resident_prefix_pages(
+                        chain, int(p.get("limit", 0))
+                    )
+            self._respond(p["peer"], proto.MIGRATE_RESP, p["rid"], body)
+            return
+        if op == "put":
+            if self.faults is not None:
+                # fault site "migrate.import": error refuses the staging
+                # (source falls back), crash kills the destination mid-
+                # migration — the chaos suite's kill-the-receiver case
+                self.faults.inject("migrate.import", str(p.get("mig", "")))
+            if rt is None or rt.engine is None:
+                self._respond(
+                    p["peer"], proto.MIGRATE_RESP, p["rid"],
+                    {"ok": False, "error": "job not loaded"},
+                )
+                return
+            cont = self._ensure_cont(rt)
+            if cont is None:
+                self._respond(
+                    p["peer"], proto.MIGRATE_RESP, p["rid"],
+                    {"ok": False, "error": "continuous unsupported"},
+                )
+                return
+            ok = cont.stage_migration(str(p.get("mig", "")), p["blob"])
+            self._respond(
+                p["peer"], proto.MIGRATE_RESP, p["rid"],
+                {"ok": bool(ok)} if ok else
+                {"ok": False,
+                 "error": "staging refused (mode mismatch, evicted "
+                          "prefix, bad digest, or allocator dry)"},
+            )
+            return
+        raise ValueError(f"unknown migrate op {op!r}")
 
     def _beam_step(self, job_id: str, rid: str) -> None:
         """Advance an in-flight beam session one bounded chunk. Unfinished

@@ -277,6 +277,9 @@ class WorkerServer(RoleServer):
             proto.FORWARD, proto.BACKWARD, proto.GENERATE,
             proto.PARAMS_REQ, proto.OPTIMIZER, proto.TRAIN_MODE,
             proto.CHECKPOINT, proto.PROOF_REQ,
+            # live slot migration: DRAIN from a validator, MIGRATE
+            # (probe / page transfer) worker-to-worker
+            proto.DRAIN, proto.MIGRATE,
         ):
             self.register(tag, self._relay_to_ml)
 
@@ -779,6 +782,56 @@ class ValidatorServer(RoleServer):
             float(s.get("hbm_bytes", 0.0)) for s in out
         )
         return out
+
+    def _resolve_worker(self, prefix: str) -> str | None:
+        """Unique connected worker whose id starts with ``prefix`` (ops
+        surfaces pass truncated ids); ambiguity matches nothing."""
+        matches = [
+            nid for nid in self.connections
+            if self.roles.get(nid) == "worker" and nid.startswith(prefix)
+        ]
+        return matches[0] if len(matches) == 1 else None
+
+    async def cmd_drain_worker(self, p) -> dict:
+        """Operator surface for live slot migration (docs/SERVING.md
+        "Draining a worker"): tell ``worker`` to shed every live serving
+        slot onto ``dest`` — page-shipping migration with the
+        crash-recovery re-prefill as the fallback rung, zero dropped
+        streams. ``dest`` defaults to the connected worker with the most
+        free capacity; the DRAIN body carries the destination's id and
+        LISTEN address so the source can dial it worker-to-worker."""
+        src = self._resolve_worker(str(p.get("worker", "")))
+        if src is None:
+            return {"ok": False, "error": "unknown or ambiguous worker"}
+        dest = None
+        if p.get("dest"):
+            dest = self._resolve_worker(str(p["dest"]))
+        else:
+            # destination choice: most free capacity among the OTHER
+            # connected workers with a known listen address
+            stats = await self._own_worker_stats()
+            ranked = sorted(
+                (s for s in stats
+                 if s.get("id") != src and s.get("id") in self.addresses),
+                key=lambda s: -float(
+                    s.get("free_bytes", s.get("hbm_bytes", 0.0))
+                ),
+            )
+            dest = ranked[0]["id"] if ranked else None
+        if dest is None or dest == src or dest not in self.addresses:
+            return {"ok": False, "error": "no usable destination worker"}
+        reply = await self.request(
+            self._conn(src), proto.DRAIN,
+            {"dest": {"id": dest, "addr": list(self.addresses[dest])}},
+            # generous default: a drain to a COLD destination ships the
+            # whole stage (up to ~130s) before the per-slot transfers
+            # (60s each) — a shorter operator timeout would report a
+            # still-succeeding drain as failed and lose its summary
+            timeout=float(p.get("timeout", 600.0)),
+        )
+        reply.pop("_rid", None)
+        reply.pop("_resp", None)
+        return {**reply, "dest": dest}
 
     async def _handle_request_workers(self, conn, kind, tag, body) -> None:
         """A validator peer asks for this validator's spare workers. Answer

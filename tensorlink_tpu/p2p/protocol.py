@@ -85,6 +85,15 @@ STREAM_END = "stream.end"
 # generate; the worker's compiled chunked decode checks these at chunk
 # boundaries and stops early instead of running out its token budget
 STREAM_CANCEL = "stream.cancel"
+# live slot migration (docs/FAILURE_MODEL.md "Migration & drain"):
+# validator → worker DRAIN (shed every live serving slot to a destination
+# worker, zero dropped streams); worker → worker MIGRATE (probe the
+# destination's resident prefix, then ship a frozen slot's KV pages
+# byte-exactly as one bulk TLTS frame)
+MIGRATE = "mig"
+MIGRATE_RESP = "mig.resp"
+DRAIN = "drain"
+DRAIN_RESP = "drain.resp"
 PARAMS_REQ = "params.req"
 PARAMETERS = "params"
 OPTIMIZER = "opt"

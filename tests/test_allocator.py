@@ -247,6 +247,101 @@ def test_prefix_batch_evict_is_lru_with_cascade():
 
 
 # ---------------------------------------------------------------------------
+# page conservation with the IN-TRANSIT term (live slot migration)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def mig_engine():
+    """A ContinuousEngine whose accounting we drive BY HAND — engine
+    construction allocates device zeros but compiles nothing, keeping
+    this module's no-compiles contract."""
+    from tensorlink_tpu.engine.continuous import ContinuousEngine
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import init_params
+
+    eng = GenerationEngine(
+        TINY, init_params(TINY, jax.random.PRNGKey(0)),
+        seq_buckets=(8,), batch_buckets=(1,), max_seq_len=32,
+    )
+    ce = ContinuousEngine(eng, max_slots=2, page_size=8, chunk_steps=2)
+    yield ce
+    ce._migrations.clear()  # hand-built tickets; close() would free them
+
+
+def test_conservation_counts_staged_migrations_in_transit(mig_engine):
+    ce = mig_engine
+    ce.check_page_conservation()
+    pages = ce.alloc.alloc(2)
+    # allocated-but-unowned pages are a leak...
+    with pytest.raises(AssertionError, match="leak"):
+        ce.check_page_conservation()
+    # ...until a staged migration ticket claims them as in-transit
+    ce._migrations["m1"] = {"pages": pages, "nodes": [], "t": 0.0}
+    ce.check_page_conservation()
+    assert ce.page_accounting()["in_transit"] == pages
+    assert ce.serving_snapshot()["pages_in_transit"] == 2
+    # releasing the ticket returns the pages to the free-list
+    ce.drop_staged_migration("m1")
+    ce.check_page_conservation()
+    assert ce.serving_snapshot()["pages_in_transit"] == 0
+
+
+def test_conservation_rejects_double_ownership_across_transit(mig_engine):
+    from tensorlink_tpu.engine.continuous import ContinuousRequest
+    from tensorlink_tpu.engine.sampling import SamplingParams
+
+    ce = mig_engine
+    pages = ce.alloc.alloc(2)
+    ce._migrations["m1"] = {"pages": pages, "nodes": [], "t": 0.0}
+    # the same page claimed by a slot AND a ticket must be caught
+    req = ContinuousRequest(
+        rid=1, prompt=[1], budget=1, sampling=SamplingParams.make(),
+        eos=frozenset(), seed=0,
+    )
+    req.pages = [pages[0]]
+    ce._slots[0] = req
+    with pytest.raises(AssertionError, match="in-transit"):
+        ce.check_page_conservation()
+    ce._slots[0] = None
+    ce.check_page_conservation()
+
+
+def test_frozen_slot_pages_count_in_transit_not_owned(mig_engine):
+    from tensorlink_tpu.engine.continuous import ContinuousRequest
+    from tensorlink_tpu.engine.sampling import SamplingParams
+
+    ce = mig_engine
+    pages = ce.alloc.alloc(3)
+    req = ContinuousRequest(
+        rid=1, prompt=[1], budget=1, sampling=SamplingParams.make(),
+        eos=frozenset(), seed=0,
+    )
+    req.pages = list(pages)
+    ce._slots[1] = req
+    acc = ce.page_accounting()
+    assert acc["slots"] == pages and acc["in_transit"] == []
+    ce._frozen.add(1)  # freeze-for-export reclassifies, conserves
+    acc = ce.page_accounting()
+    assert acc["slots"] == [] and acc["in_transit"] == pages
+    ce.check_page_conservation()
+    ce._frozen.discard(1)
+    ce._slots[1] = None
+    ce.alloc.free(pages)
+    ce.check_page_conservation()
+
+
+def test_staged_migration_ttl_gc_frees_abandoned_pages(mig_engine):
+    ce = mig_engine
+    ce.migration_ttl_s = 0.0  # everything staged is immediately stale
+    pages = ce.alloc.alloc(2)
+    ce._migrations["m1"] = {"pages": pages, "nodes": [], "t": 0.0}
+    free_before = ce.alloc.n_free
+    ce._gc_staged_migrations()
+    assert "m1" not in ce._migrations
+    assert ce.alloc.n_free == free_before + 2
+    ce.check_page_conservation()
+
+
+# ---------------------------------------------------------------------------
 # batch bucket sizing (the serving batch-shape contract)
 # ---------------------------------------------------------------------------
 @pytest.fixture(scope="module")

@@ -55,6 +55,8 @@ def test_bench_all_legs_cpu():
                 "ragged_during_prefill_itl_ms",
                 "kv_slots_ratio", "kv_residency_ratio",
                 "kv_int8_slots", "kv_int8_resident_pages",
+                "migration_resume_ms", "migration_reprefill_resume_ms",
+                "migration_resume_speedup",
                 "train_mfu", "train_step_s",
                 "train_mfu_best_prior", "train_mfu_regressed"):
         assert key in extra, (key, extra)
@@ -75,6 +77,14 @@ def test_bench_all_legs_cpu():
     # per position-head = 1.94x at hd=128)
     assert extra["kv_slots_ratio"] >= 1.8, extra["kv_slots_ratio"]
     assert extra["kv_residency_ratio"] >= 1.8, extra["kv_residency_ratio"]
+    # the migration leg's robustness bar: draining a worker mid-stream
+    # drops ZERO streams (every resume bit-identical — deterministic on
+    # CPU), and both resume latencies are real numbers. The latency
+    # RATIO is wall-clock on a tiny model and deliberately un-barred
+    # (the leg's migration_note explains the CPU magnitude caveat)
+    assert extra["migration_dropped_streams"] == 0, extra
+    assert extra["migration_resume_ms"] > 0
+    assert extra["migration_reprefill_resume_ms"] > 0
     # train-MFU rot guard (ROADMAP item 5): this round's train_mfu must
     # stay within 2x of the best comparable prior round in BENCH_r*.json
     # — training perf can't silently rot while serving work lands

@@ -314,7 +314,7 @@ def test_worker_crash_mid_continuous_batch_recovers_all_sessions(tmp_path):
         assert first_wid == workers[0].node_id
 
         prompts = [[7, 3, 200], [9, 1, 2, 300]]
-        n_toks = 24
+        n_toks = 56  # must outlive the drain (see the zero-drop test)
         streams: list[list[int]] = [[], []]
         results: list[list[int] | None] = [None, None]
         errors: list[BaseException | None] = [None, None]
@@ -409,6 +409,282 @@ def test_pipelined_slot_admission_with_crash_recovery(tmp_path):
             baseline = _engine_greedy(cfg, 11, prompts[i], n_toks[i])
             assert out.get(i) == baseline, (i, out.get(i), baseline)
             assert streams[i] == baseline, (i, streams[i], baseline)
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
+# ---------------------------------------------------------------------------
+# live slot migration + drain (KV-page shipping between workers)
+# ---------------------------------------------------------------------------
+def _start_streams(model, prompts, n_toks, priorities=None):
+    """Launch one continuous streamed generate per prompt on daemon
+    threads; returns (threads, streams, results, errors)."""
+    import threading
+
+    k = len(prompts)
+    streams: list[list[int]] = [[] for _ in range(k)]
+    results: list[list[int] | None] = [None] * k
+    errors: list[BaseException | None] = [None] * k
+
+    def go(i):
+        try:
+            seqs = model.generate(
+                [prompts[i]], max_new_tokens=n_toks, continuous=True,
+                priority=(priorities or [None] * k)[i],
+                stream_cb=lambda toks, i=i: streams[i].extend(
+                    t for t in toks if t is not None
+                ),
+            )
+            results[i] = seqs[0]
+        except BaseException as e:  # surfaced by the caller's assert
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=go, args=(i,), daemon=True)
+        for i in range(k)
+    ]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)  # tight stagger: all slots co-resident fast
+    return threads, streams, results, errors
+
+
+def _wait_tokens(streams, k, deadline_s=45):
+    """Block until every stream has at least ``k`` tokens (all slots live
+    and DECODING before the drain fires)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(len(s) >= k for s in streams):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.mark.slow  # full multi-process cluster — CI chaos job runs this
+# file unfiltered; excluded from the tier-1 'not slow' pass for wall-time
+def test_drain_migrates_live_slots_zero_dropped_streams(tmp_path):
+    """THE drain acceptance pin: a worker hosting 4 live decoding slots
+    is drained onto a second worker — every stream completes
+    BIT-IDENTICAL to its uninterrupted solo run (KV pages shipped
+    byte-exact, resume draw unchanged), zero streams dropped, and the
+    validator's drain summary + the destination's serving snapshot carry
+    the migration telemetry."""
+    validator, workers, user = _cluster(tmp_path, n_workers=2)
+    try:
+        # planner ranks by capacity: the single stage lands on workers[0]
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        prompts = [[7, 3, 200], [9, 1, 2, 300], [5, 5, 8], [2, 4, 6, 8]]
+        # big budgets: every slot must still be mid-decode when the drain
+        # lands (tiny CPU models emit fast; a finished slot has nothing
+        # to migrate and would make the ==4 accounting racy)
+        n_toks = 56
+        threads, streams, results, errors = _start_streams(
+            model, prompts, n_toks,
+            priorities=["interactive", "batch", None, "best_effort"],
+        )
+        assert _wait_tokens(streams, 2), "streams never reached steady decode"
+        summary = validator.send_request(
+            "drain_worker",
+            {"worker": workers[0].node_id, "dest": workers[1].node_id},
+            timeout=120.0,
+        )
+        for t in threads:
+            t.join(120)
+        assert errors == [None] * 4, errors
+        assert summary.get("ok"), summary
+        # zero dropped: every stream moved (page-shipped or re-prefill)
+        # and finished bit-identical to the fault-free solo run
+        assert summary["migrated"] >= 1, summary
+        assert summary["migrated"] + summary["fell_back"] == 4, summary
+        for i in range(4):
+            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == base, (i, results[i], base)
+            assert streams[i] == base, (i, streams[i], base)
+        # the plan now points at the destination, and its snapshot (rode
+        # the final GENERATE_RESP) carries the adoption telemetry
+        assert model.plan.stages[0].worker_id == workers[1].node_id
+        snap = model.cont_serving_stats
+        assert snap["migrations_adopted"] == summary["migrated"], snap
+        assert snap["drain_state"] == "serving"
+        assert snap["pages_in_transit"] == 0
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage
+def test_migrate_frames_duplicated_staging_is_idempotent(tmp_path):
+    """Every MIGRATE frame out of the source's net process is sent TWICE
+    (p2p.send dup on the "mig" tag): staging is idempotent by ticket id,
+    so duplicated/reordered transfer frames stage once and the migrated
+    streams stay bit-identical."""
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=2,
+        worker_faults={0: {"seed": 1, "rules": [
+            {"site": "p2p.send", "op": "dup", "prob": 1.0,
+             "key_substr": "mig", "max_fires": None},
+        ]}},
+    )
+    try:
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        prompts = [[7, 3, 200], [9, 1, 2, 300]]
+        n_toks = 56  # must outlive the drain (see the zero-drop test)
+        threads, streams, results, errors = _start_streams(
+            model, prompts, n_toks
+        )
+        assert _wait_tokens(streams, 2)
+        summary = validator.send_request(
+            "drain_worker",
+            {"worker": workers[0].node_id, "dest": workers[1].node_id},
+            timeout=120.0,
+        )
+        for t in threads:
+            t.join(120)
+        assert errors == [None, None], errors
+        assert summary.get("ok") and summary["migrated"] >= 1, summary
+        for i in range(2):
+            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == base, (i, results[i], base)
+            assert streams[i] == base, (i, streams[i], base)
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage
+def test_kill_destination_mid_migration_falls_back_re_prefill(tmp_path):
+    """Either-side kill, receiver edition: the DESTINATION dies on the
+    first MIGRATE staging (migrate.import crash). The source's transfer
+    fails, the drain falls back to redirecting the streams — and because
+    the redirect target is dead, the clients descend the final rung:
+    validator repair recruits the spare and the streams resume via
+    re-prefill, still bit-identical, nothing dropped."""
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=3,
+        worker_faults={1: {"seed": 5, "rules": [
+            {"site": "migrate.import", "op": "crash", "nth": 1},
+        ]}},
+    )
+    try:
+        caps = [8e9, 4e9, 1_000_000.0]  # stage lands on w0; w2 too small
+        for w, c in zip(workers, caps):
+            w.send_request("set_capacity", {"hbm_bytes": c, "n_devices": 1})
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        # now the spare may host the repair-recruited replacement stage
+        workers[2].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        prompts = [[7, 3, 200], [9, 1, 2, 300]]
+        n_toks = 56  # must outlive the drain (see the zero-drop test)
+        threads, streams, results, errors = _start_streams(
+            model, prompts, n_toks
+        )
+        assert _wait_tokens(streams, 2)
+        summary = validator.send_request(
+            "drain_worker",
+            {"worker": workers[0].node_id, "dest": workers[1].node_id},
+            timeout=120.0,
+        )
+        for t in threads:
+            t.join(180)
+        assert errors == [None, None], errors
+        # the kill really happened: nothing page-shipped, everything fell
+        # back down the ladder
+        assert summary.get("ok"), summary
+        assert summary["migrated"] == 0, summary
+        assert summary["fell_back"] >= 1, summary
+        for i in range(2):
+            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == base, (i, results[i], base)
+            assert streams[i] == base, (i, streams[i], base)
+        # the clients descended to validator repair — onto the spare, not
+        # the dead destination or the draining source
+        assert model.plan.stages[0].worker_id == workers[2].node_id
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage
+def test_kill_source_mid_migration_streams_recover(tmp_path):
+    """Either-side kill, sender edition: the SOURCE dies mid-transfer
+    (migrate.wire crash) — before any redirect reached the clients. The
+    in-flight requests die with the connection, the existing
+    crash-recovery path repairs onto a live worker and re-prefills, and
+    the streams stay bit-identical: a botched migration is never worse
+    than a crash."""
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=2,
+        worker_faults={0: {"seed": 3, "rules": [
+            {"site": "migrate.wire", "op": "crash", "nth": 1},
+        ]}},
+    )
+    try:
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        first_wid = model.plan.stages[0].worker_id
+        assert first_wid == workers[0].node_id
+        prompts = [[7, 3, 200], [9, 1, 2, 300]]
+        n_toks = 56  # must outlive the drain (see the zero-drop test)
+        threads, streams, results, errors = _start_streams(
+            model, prompts, n_toks
+        )
+        assert _wait_tokens(streams, 2)
+        try:
+            validator.send_request(
+                "drain_worker",
+                {"worker": workers[0].node_id,
+                 "dest": workers[1].node_id},
+                timeout=60.0,
+            )
+        except Exception:
+            pass  # the source died mid-drain: no summary is the point
+        for t in threads:
+            t.join(180)
+        assert errors == [None, None], errors
+        assert model.plan.stages[0].worker_id != first_wid
+        for i in range(2):
+            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == base, (i, results[i], base)
+            assert streams[i] == base, (i, streams[i], base)
         model.shutdown()
     finally:
         _stop_all([user, *workers, validator])

@@ -854,6 +854,285 @@ def test_kv_quant_is_one_program(tiny_engine):
     ce.close()
 
 
+# ---------------------------------------------------------------------------
+# live slot migration (KV-page shipping between engines + drain fence)
+# ---------------------------------------------------------------------------
+def _drive_until(ce, req, n):
+    """Step until ``req`` has emitted at least ``n`` tokens (mid-decode
+    freeze point)."""
+    while len(req.tokens) < n and not req.finished:
+        ce.step_chunk()
+    assert not req.finished, "budget too small to freeze mid-decode"
+
+
+def _migrate(src, dst, req, mig_id, *, probe=True, roundtrip=True):
+    """The full engine-level migration protocol: freeze at the chunk
+    boundary, probe the destination's resident prefix, export, TLTS
+    round-trip (the real wire encoding), stage, commit, resume-with-adopt
+    — returning the destination request."""
+    from tensorlink_tpu.core import serialization as ser
+
+    slot = req.slot
+    src.freeze_slot(slot)
+    src.check_page_conservation()  # frozen pages count in transit
+    chain, limit = src.migration_chain(slot)
+    n_skip = dst.resident_prefix_pages(chain, limit) if probe else 0
+    blob = src.export_slot(slot, n_skip=n_skip)
+    if roundtrip:
+        blob = ser.decode(ser.encode(blob), copy=True)
+    assert dst.stage_migration(mig_id, blob)
+    dst.check_page_conservation()  # staged pages count in transit
+    moved = src.commit_migration(slot)
+    src.check_page_conservation()
+    return dst.submit(
+        moved.prompt + moved.tokens,
+        max_new_tokens=moved.budget - len(moved.tokens),
+        sampling=moved.sampling,
+        eos_ids=sorted(moved.eos),
+        seed=moved.seed,
+        start_step=moved.start_step + len(moved.tokens),
+        priority=moved.priority,
+        adopt=mig_id,
+    ), moved
+
+
+@pytest.mark.slow  # drives full decode traces on two engines — tier-1
+# wall-time; CI's engine job runs this file unfiltered on every push
+def test_migrated_stream_bit_identical_solo_and_cobatched(tiny_engine):
+    """THE migration acceptance pin: a stream migrated between two live
+    engines mid-decode (pages shipped byte-exact, resume draw at
+    fold_in(seed, start_step + emitted)) is bit-identical to the same
+    stream run uninterrupted — greedy and sampled, with co-resident
+    neighbors live on BOTH engines throughout, and page conservation
+    holding on both sides at every stage."""
+    eng = tiny_engine
+    mixes = [
+        ([5, 6, 7], 14, SamplingParams.make(temperature=0.9, top_k=5), 9),
+        ([1, 2, 3, 4], 12, SamplingParams.make(), 3),
+    ]
+    solos = [
+        _solo(eng, p, n, sampling=sp, seed=s) for p, n, sp, s in mixes
+    ]
+    src, dst = _cont(eng), _cont(eng)
+    # neighbors: one decoding on each engine while the migration happens
+    nb_src = src.submit([9, 9, 1], max_new_tokens=20, seed=41)
+    nb_dst = dst.submit([8, 8, 2], max_new_tokens=20, seed=42)
+    reqs = [
+        src.submit(p, max_new_tokens=n, sampling=sp, seed=s)
+        for p, n, sp, s in mixes
+    ]
+    for r in reqs:
+        _drive_until(src, r, 5)
+    outs = []
+    for i, r in enumerate(reqs):
+        dst.step_chunk()  # the destination keeps serving mid-migration
+        r2, moved = _migrate(src, dst, r, f"mig{i}")
+        outs.append((moved, r2))
+    src.run_until_idle()
+    dst.run_until_idle()
+    for (moved, r2), solo in zip(outs, solos):
+        assert r2.finished
+        assert moved.tokens + r2.tokens == solo
+    # the neighbors never noticed (row-local contract)
+    assert nb_src.tokens == _solo(eng, [9, 9, 1], 20, seed=41)
+    assert nb_dst.tokens == _solo(eng, [8, 8, 2], 20, seed=42)
+    assert src.stats["migrations_completed"] == 2
+    assert dst.stats["migrations_adopted"] == 2
+    assert src.serving_snapshot()["pages_in_transit"] == 0
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # see above — CI engine job coverage
+def test_migration_prefix_short_circuit_ships_fewer_pages(tiny_engine):
+    """Destination-resident prefix pages short-circuit the transfer (the
+    PR-3 trie digest): the exporter skips them, the adopted slot maps the
+    resident chain — and the stream is still bit-identical, because a
+    cache hit is bitwise the prefill the source ran."""
+    eng = tiny_engine
+    prompt = SYS + [40, 41]
+    base = _solo(eng, prompt, 10, seed=7)
+    src, dst = _cont(eng), _cont(eng)
+    warm = dst.submit(prompt, max_new_tokens=2, seed=1)
+    dst.run_until_idle()
+    assert warm.finished  # prompt pages promoted into dst's trie
+    r = src.submit(prompt, max_new_tokens=10, seed=7)
+    _drive_until(src, r, 4)
+    slot = r.slot
+    src.freeze_slot(slot)
+    chain, limit = src.migration_chain(slot)
+    n_skip = dst.resident_prefix_pages(chain, limit)
+    assert n_skip >= 2  # the warmed prompt really is resident
+    full_blob = src.export_slot(slot, n_skip=0)
+    blob = src.export_slot(slot, n_skip=n_skip)
+    assert blob["k"].shape[0] == full_blob["k"].shape[0] - n_skip
+    assert dst.stage_migration("m", blob)
+    moved = src.commit_migration(slot)
+    r2 = dst.submit(
+        moved.prompt + moved.tokens,
+        max_new_tokens=moved.budget - len(moved.tokens),
+        seed=7, start_step=len(moved.tokens), adopt="m",
+    )
+    dst.run_until_idle()
+    assert moved.tokens + r2.tokens == base
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # see above — CI engine job coverage
+def test_migration_failure_falls_back_to_re_prefill(tiny_engine):
+    """The fallback ladder: when staging fails (refused blob / stale
+    ticket), the stream resumes via the crash-recovery re-prefill rung —
+    still bit-identical, with conservation holding on BOTH engines and
+    the failure counted. A corrupted transfer (bad digest) is refused the
+    same way."""
+    eng = tiny_engine
+    prompt = [3, 1, 4, 1, 5]
+    base = _solo(eng, prompt, 12, seed=5)
+    src, dst = _cont(eng), _cont(eng)
+    r = src.submit(prompt, max_new_tokens=12, seed=5)
+    _drive_until(src, r, 5)
+    slot = r.slot
+    src.freeze_slot(slot)
+    blob = src.export_slot(slot)
+    # storage-mode mismatch refuses staging...
+    assert not dst.stage_migration("m", dict(blob, kv_quant="int8"))
+    # ...and so does a corrupted payload (integrity digest)
+    bad = dict(blob, digest="0" * 64)
+    assert not dst.stage_migration("m", bad)
+    dst.check_page_conservation()  # refusals leak nothing
+    moved = src.commit_migration(slot, fell_back=True)
+    src.check_page_conservation()
+    assert src.stats["migrations_failed"] == 1
+    assert src.stats["migrations_fell_back"] == 1
+    # the resume carries a ticket id that was never staged: admission
+    # quietly takes the re-prefill rung
+    r2 = dst.submit(
+        moved.prompt + moved.tokens,
+        max_new_tokens=moved.budget - len(moved.tokens),
+        seed=5, start_step=len(moved.tokens), adopt="m",
+    )
+    dst.run_until_idle()
+    assert moved.tokens + r2.tokens == base
+    assert dst.stats["migrations_adopted"] == 0
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # see above — CI engine job coverage
+def test_migration_abort_resumes_locally_bit_identical(tiny_engine):
+    """abort_migration un-freezes (export is read-only): the slot resumes
+    decoding HERE exactly where it stopped."""
+    eng = tiny_engine
+    prompt = [2, 7, 1, 8]
+    base = _solo(eng, prompt, 12, seed=6)
+    ce = _cont(eng)
+    r = ce.submit(prompt, max_new_tokens=12, seed=6)
+    _drive_until(ce, r, 4)
+    ce.freeze_slot(r.slot)
+    ce.export_slot(r.slot)  # gathered bytes, then the handoff dies
+    ce.abort_migration(r.slot)
+    ce.run_until_idle()
+    assert r.finished and r.tokens == base
+    assert ce.stats["migrations_failed"] == 1
+    ce.close()
+
+
+@pytest.mark.slow  # see above — CI engine job coverage
+def test_migrated_stream_composed_with_preemption(tiny_engine):
+    """Migration composes with the scheduler lifecycle: an adopted slot
+    preempted on the DESTINATION resumes through the normal cache-backed
+    preemption contract — the full stream (source tokens + destination
+    tokens across the preemption) is still bit-identical."""
+    eng = tiny_engine
+    prompt = [6, 5, 4]
+    base = _solo(eng, prompt, 14, seed=8)
+    src = _cont(eng)
+    dst = _cont(eng, max_slots=1)  # one slot: the flood must preempt
+    r = src.submit(
+        prompt, max_new_tokens=14, seed=8,
+        priority="best_effort",  # preemptable at the destination
+    )
+    _drive_until(src, r, 5)
+    r2, moved = _migrate(src, dst, r, "mp")
+    dst.step_chunk()  # adopted + decoding on the destination
+    assert len(r2.tokens) > 0 and not r2.finished
+    hi = dst.submit([1, 1], max_new_tokens=3, seed=1, priority="interactive")
+    dst.run_until_idle()
+    assert hi.finished and r2.finished
+    assert dst.stats["preemptions"] >= 1  # the adopted slot was preempted
+    assert moved.tokens + r2.tokens == base
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # exercises the migration device paths' compile keys —
+# referenced by CI's compile-count-guard step
+def test_migration_adds_zero_new_programs(tiny_engine):
+    """Compile-set guard: a full migration (freeze/export/stage/adopt/
+    resume) adds ZERO compiled programs beyond the explicit gather/scatter
+    page keys it registers in jit_cache_sizes — the serving step set
+    (ragged_step, copy_page) stays exactly where it was."""
+    eng = tiny_engine
+    src, dst = _cont(eng), _cont(eng)
+    r = src.submit([4, 2, 4, 2], max_new_tokens=12, seed=2)
+    _drive_until(src, r, 4)
+    base = src.jit_cache_sizes()
+    r2, moved = _migrate(src, dst, r, "mz")
+    src.run_until_idle()
+    dst.run_until_idle()
+    assert r2.finished
+    after = src.jit_cache_sizes()
+    for key in ("ragged_step", "copy_page", "decode_step"):
+        assert after[key] == base[key], (key, base, after)
+    for key in ("gather_page", "scatter_page"):
+        # the page-mover keys exist and stay bounded: ONE program per
+        # engine storage mode, no matter how many pages moved
+        assert after[key] - base[key] <= 1, (key, base, after)
+    src.close()
+    dst.close()
+
+
+def test_drain_fence_sheds_queue_and_refuses_new_work(tiny_engine):
+    """begin_drain is an admission fence: submit fails fast, the
+    backpressure probe rejects with the draining marker, shed_queued
+    hands back the queued requests unfinished (for redirection), and a
+    queued request with nowhere to go fails loudly. Zero compiles — no
+    chunk ever runs."""
+    eng = tiny_engine
+    ce = _cont(eng)
+    q1 = ce.submit([1, 2], max_new_tokens=4, seed=1)
+    q2 = ce.submit([3, 4], max_new_tokens=4, seed=2)
+    ce.begin_drain()
+    assert ce.drain_state == "draining"
+    rej = ce.admission_check()
+    assert rej is not None and rej.get("draining") is True
+    late = ce.submit([5, 6], max_new_tokens=4, seed=3)
+    assert late.error is not None  # failed fast at the fence
+    # a REJECTED resume expires its staged-adoption ticket (submit may run
+    # on a client thread, so the pages are freed by the DRIVER's next GC
+    # sweep, not inline) — they must not stay pinned for the full TTL
+    pages = ce.alloc.alloc(2)
+    ce._migrations["tk"] = {"pages": pages, "nodes": [], "t": 0.0}
+    free_before = ce.alloc.n_free
+    rejected = ce.submit([7, 8], max_new_tokens=4, seed=4, adopt="tk")
+    assert rejected.error is not None
+    assert ce._migrations["tk"]["t"] == float("-inf")  # expired in place
+    ce._gc_staged_migrations()  # the driver's sweep frees it immediately
+    assert "tk" not in ce._migrations
+    assert ce.alloc.n_free == free_before + 2
+    shed = ce.shed_queued()
+    assert {r.rid for r in shed} == {q1.rid, q2.rid}
+    assert not q1.done.is_set()  # shed ≠ finished: the stream redirects
+    assert ce.stats["migrations_fell_back"] == 2
+    ce.fail_queued(q1, RuntimeError("no transport context"))
+    assert q1.done.is_set() and q1.error is not None
+    ce.fail_queued(q2, RuntimeError("no transport context"))
+    # a draining engine refuses to adopt inbound migrations too
+    assert not ce.stage_migration("m", {"kv_quant": "none", "page_size": 8})
+    ce.close()
+
+
 def test_continuous_refuses_unsupported_cache_modes(tiny_engine):
     """Sliding windows stay on the static batcher: the engine refuses
     loudly (the worker catches this and falls back). int8 KV is NOT

@@ -74,7 +74,27 @@ def test_delay_and_dup_actions():
 
 def test_unknown_op_rejected():
     with pytest.raises(ValueError):
-        FaultPlan.from_dict({"rules": [{"site": "x", "op": "explode"}]})
+        FaultPlan.from_dict({"rules": [{"site": "p2p.send", "op": "explode"}]})
+
+
+def test_unknown_site_rejected_loudly():
+    """Regression: from_dict used to accept any site string silently — a
+    typo'd chaos config became a rule that never fired. Sites are now
+    validated against the registered set at plan construction."""
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.from_dict({"rules": [
+            {"site": "worker.sesion_step", "op": "crash", "nth": 1},  # typo
+        ]})
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.from_dict({"rules": [{"site": "", "op": "drop", "nth": 1}]})
+    # every registered site constructs — incl. the migration/drain sites
+    for site in faults.SITES:
+        plan = FaultPlan.from_dict(
+            {"rules": [{"site": site, "op": "error", "nth": 1}]}
+        )
+        assert plan.rules[0].site == site
+    assert {"migrate.export", "migrate.wire", "migrate.import",
+            "worker.drain"} <= set(faults.SITES)
 
 
 # ---------------------------------------------------------------------------
@@ -181,6 +201,54 @@ def test_session_seq_dedup_never_double_applies(worker):
     w._handle("fwd", {"job_id": "j1", "op": "end_session", "session": "s1",
                       "peer": "user", "rid": "r3"})
     assert not rt.session_seq and not rt.session_resp
+
+
+@pytest.mark.slow  # compiles the tiny slot engine's step program — CI's
+# chaos job runs this file unfiltered; tier-1 wall-time protected
+def test_drain_aborts_when_destination_unready(worker):
+    """A drain whose destination can't host the job (unreachable /
+    refuses / stage load fails) must ABORT, not redirect: redirecting
+    streams into a jobless worker would strand them. The fence drops,
+    capacity is restored, and the live stream keeps serving locally."""
+    from tensorlink_tpu.p2p import protocol as proto
+
+    node, w = worker
+    rt = w.jobs["j1"]
+    cont = w._ensure_cont(rt)
+    assert cont is not None
+    req = cont.submit([3, 5, 7], max_new_tokens=40, seed=0)
+    req.client_meta = {"peer": "user", "rid": "rq", "stream": None}
+    while not req.tokens and not req.finished:
+        cont.step_chunk()
+    assert not req.finished
+    # the fake bridge answers every request with True — _prepare_dest's
+    # probe can't succeed, which is exactly the unready-destination shape
+    w._drain({"dest": {"id": "d" * 64, "addr": ["127.0.0.1", 1]},
+              "peer": "user", "rid": "rd"})
+    resp = node.bridge.responses[-1]
+    assert resp["tag"] == proto.DRAIN_RESP and resp["rid"] == "rd"
+    body = resp["body"]
+    assert body["aborted"] == 1 and not body["ok"], body
+    assert w.draining is None  # worker fence lowered
+    assert cont.drain_state == "serving"  # engine fence lowered
+    cont.run_until_idle()
+    assert req.finished and req.error is None  # nothing dropped
+    cont.check_page_conservation()
+
+
+def test_drain_refuses_self_destination(worker):
+    """A DRAIN naming the worker itself as destination is refused — a
+    self-redirect would bounce every request back forever."""
+    from tensorlink_tpu.p2p import protocol as proto
+
+    node, w = worker
+    w._drain({"dest": {"id": w.node.node_id, "addr": ["127.0.0.1", 1]},
+              "peer": "user", "rid": "rs"})
+    resp = node.bridge.responses[-1]
+    assert resp["tag"] == proto.DRAIN_RESP
+    assert not resp["body"].get("ok")
+    assert "itself" in resp["body"]["error"]
+    assert w.draining is None
 
 
 def test_worker_fault_crash_site(worker):

@@ -346,6 +346,109 @@ def test_parameters_download(cluster):
     assert tree["embed"]["tok"].shape == (cfg.vocab_size, cfg.d_model)
 
 
+@pytest.mark.slow  # dedicated multi-process cluster — CI's e2e job runs
+# this file unfiltered; excluded from tier-1 'not slow' for wall-time
+def test_drain_migration_telemetry_over_live_cluster(tmp_path):
+    """Migration telemetry end-to-end over a REAL cluster: a drained
+    worker's streams land on the destination, and the destination's
+    serving snapshot (riding GENERATE_RESP into the batcher/validator
+    /stats path) carries migrations{started,completed,failed,fell_back},
+    migrations_adopted, drain_state and pages_in_transit — while the
+    validator's drain summary reports what moved."""
+    import threading
+
+    from tensorlink_tpu.ml.module import DistributedModel
+    from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
+
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp_path / "keys"),
+        log_dir=str(tmp_path / "logs"),
+        env_file=str(tmp_path / ".env"),
+    )
+    validator = ValidatorNode(
+        ValidatorConfig(endpoint=False, proposal_interval=0.0, **common)
+    ).start()
+    seeds = [["127.0.0.1", validator.port]]
+    w0 = WorkerNode(WorkerConfig(seed_validators=seeds, **common)).start()
+    w1 = WorkerNode(
+        WorkerConfig(seed_validators=seeds, duplicate="1", **common)
+    ).start()
+    user = UserNode(UserConfig(seed_validators=seeds, **common)).start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if len(validator.status()["peers"]) >= 3:
+                break
+            time.sleep(0.2)
+        w0.send_request("set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        w1.send_request("set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        cfg = tiny_cfg(max_seq_len=64)
+        model = DistributedModel(
+            cfg, node=user, seed=7, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.stages[0].worker_id == w0.node_id
+
+        prompts = [[3, 14, 15], [9, 2, 6, 5]]
+        streamed: list[list[int]] = [[], []]
+        results: list[list[int] | None] = [None, None]
+
+        def go(i):
+            results[i] = model.generate(
+                [prompts[i]], max_new_tokens=56, continuous=True,
+                stream_cb=lambda ts, i=i: streamed[i].extend(
+                    t for t in ts if t is not None
+                ),
+            )[0]
+
+        threads = [
+            threading.Thread(target=go, args=(i,), daemon=True)
+            for i in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline and (
+            len(streamed[0]) < 2 or len(streamed[1]) < 2
+        ):
+            time.sleep(0.05)
+        summary = validator.send_request(
+            "drain_worker", {"worker": w0.node_id}, timeout=120.0,
+        )
+        for t in threads:
+            t.join(120)
+        # the validator-side summary: destination auto-chosen, counts
+        assert summary.get("ok"), summary
+        assert summary["dest"] == w1.node_id
+        assert summary["migrated"] + summary["fell_back"] >= 1, summary
+        assert results[0] is not None and results[1] is not None
+        # the destination's engine snapshot rides GENERATE_RESP into the
+        # client — the same dict the validator /stats path surfaces
+        snap = model.cont_serving_stats
+        for key in (
+            "migrations_started", "migrations_completed",
+            "migrations_failed", "migrations_fell_back",
+            "migrations_adopted", "drain_state", "pages_in_transit",
+        ):
+            assert key in snap, (key, sorted(snap))
+        assert snap["migrations_adopted"] == summary["migrated"], (
+            snap, summary,
+        )
+        assert snap["drain_state"] == "serving"
+        assert snap["pages_in_transit"] == 0  # every handoff completed
+        # the recruiting fence: the drained worker advertises zero
+        # capacity, so planners stop placing new stages there
+        stats = validator.send_request("stats_workers", timeout=15.0)
+        drained = [s for s in stats if s["id"] == w0.node_id]
+        assert drained and float(drained[0]["hbm_bytes"]) == 0.0, stats
+        model.shutdown()
+    finally:
+        for n in (user, w1, w0, validator):
+            n.stop()
+
+
 def test_job_placed_via_second_validator(tmp_path):
     """Cross-validator worker aggregation (reference REQUEST-WORKERS,
     validator_thread.py:889-928): the user's validator has NO workers of its

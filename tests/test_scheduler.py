@@ -228,6 +228,25 @@ def test_queue_cap_rejects_with_429_record():
     assert s.admission_check("best_effort") is None
 
 
+def test_drain_fence_rejects_all_classes():
+    """Live-migration admission fence: a draining scheduler takes no new
+    work — push fails fast, admission_check rejects with the draining
+    marker — and lowering the fence restores normal admission."""
+    s = RequestScheduler(max_slots=2, queue_cap=8)
+    s.push(_Req("interactive"))  # pre-drain work stays queued
+    s.set_draining(True)
+    for cls in ("interactive", "batch", "best_effort"):
+        with pytest.raises(SchedulerOverloaded):
+            s.push(_Req(cls))
+        rej = s.admission_check(cls)
+        assert rej is not None and rej.get("draining") is True
+    assert len(s) == 1  # the fence admitted nothing
+    s.set_draining(False)
+    s.push(_Req("batch"))
+    assert s.admission_check("batch") is None
+    assert len(s) == 2
+
+
 def test_estimated_wait_backpressure():
     s = RequestScheduler(max_slots=1, queue_cap=64, max_wait_s=2.0)
     # teach the estimator: ~1s per request on the single slot
