@@ -180,6 +180,7 @@ def run_bench() -> None:
     # execution inside a scarce TPU window (VERDICT r4 weak #2)
     force_all = os.environ.get("TLTPU_BENCH_FORCE_ALL_LEGS") == "1"
 
+    from tensorlink_tpu.core.trace import get_tracer
     from tensorlink_tpu.engine.generate import GenerationEngine
     from tensorlink_tpu.engine.sampling import SamplingParams
     from tensorlink_tpu.engine.training import make_optimizer, make_train_step
@@ -187,6 +188,38 @@ def run_bench() -> None:
     from tensorlink_tpu.models.registry import config_presets
 
     presets = config_presets()
+
+    def trace_decomp(tids) -> dict | None:
+        """Mean trace-derived TTFT decomposition over ``tids``
+        (core/trace.py spans): queue_ms + prefill_ms + first_decode_ms
+        == ttft_trace_ms by construction — the engine records the three
+        parts contiguously (submit→admit, admit→prefill-done,
+        prefill-done→first token). First occurrence of each span name
+        wins, so a preempted request decomposes its FIRST token's path."""
+        parts = []
+        for tid in tids:
+            first: dict = {}
+            for s in get_tracer().collect(tid):  # ts-ordered
+                if "dur_ms" in s and s["name"] not in first:
+                    first[s["name"]] = float(s["dur_ms"])
+            if "first_token" not in first:
+                continue
+            parts.append((
+                first.get("queue_wait", 0.0),
+                first.get("prefill", 0.0),
+                first.get("first_decode", 0.0),
+            ))
+        if not parts:
+            return None
+        q, p, f = (
+            float(np.mean([x[i] for x in parts])) for i in range(3)
+        )
+        return {
+            "queue_ms": round(q, 3),
+            "prefill_ms": round(p, 3),
+            "first_decode_ms": round(f, 3),
+            "ttft_trace_ms": round(q + p + f, 3),
+        }
 
     # ---- decode benchmark -------------------------------------------------
     if on_tpu:
@@ -358,7 +391,7 @@ def run_bench() -> None:
                         r = self.engine.generate(prompts, **kw)
                     return r.sequences
 
-            def serving_leg(batcher):
+            def serving_leg(batcher, trace_prefix=None):
                 import threading as _th
 
                 recs: list[tuple[float, list[float], int]] = []
@@ -372,10 +405,14 @@ def run_bench() -> None:
                         times.append(time.perf_counter())
                         return None
 
+                    kw = (
+                        {"trace_id": f"{trace_prefix}{i}"}
+                        if trace_prefix else {}
+                    )
                     try:
                         out = batcher.generate(
                             sv_prompts[i], max_new_tokens=sv_budget,
-                            stream_cb=cb,
+                            stream_cb=cb, **kw,
                         )
                     except BaseException as e:  # a silent drop would
                         errs.append(e)  # corrupt the leg's metrics
@@ -406,6 +443,7 @@ def run_bench() -> None:
                     "toks_s": total / max(wall, 1e-9),
                     "ttft_ms_p50": float(np.percentile(ttfts, 50)) * 1e3,
                     "ttft_ms_p95": float(np.percentile(ttfts, 95)) * 1e3,
+                    "ttft_ms_mean": float(np.mean(ttfts)) * 1e3,
                     "itl_ms_p50": float(np.percentile(itls, 50)) * 1e3,
                     "itl_ms_p95": float(np.percentile(itls, 95)) * 1e3,
                 }
@@ -447,9 +485,63 @@ def run_bench() -> None:
                 engine=sv_eng, eos_ids=[], max_slots=N_REQ, chunk_steps=8
             )
             cont.generate(sv_prompts[0], max_new_tokens=4)  # warm
-            cont_m = serving_leg(cont)
+            cont_m = serving_leg(cont, trace_prefix="bench-sv-")
             occ = (cont.stats() or {}).get("slot_occupancy")
             cont.close()
+            # trace-derived TTFT decomposition of the continuous leg
+            # (core/trace.py): where a request's time-to-first-token went
+            sv_decomp = trace_decomp(
+                [f"bench-sv-{i}" for i in range(N_REQ)]
+            ) or {}
+            # tracing overhead: disabled-vs-enabled serving-step cost.
+            # Same engine, same compiled programs, interleaved min-of-3
+            # measurements of a fixed chunk count with all slots live —
+            # min-of-k is robust to additive host noise, and the bound
+            # the observability layer must hold is <= 2%.
+            from tensorlink_tpu.engine.continuous import (
+                ContinuousEngine as _OCE,
+            )
+
+            OH_CHUNKS = 12
+
+            def traced_chunk_times(traced: bool, rep: int) -> list[float]:
+                # chunk_steps=2 keeps every slot live through warm + the
+                # timed window (prompt 16 + 32 decode steps < the 64-token
+                # budget), so both modes time identical full-slot chunks
+                ce = _OCE(
+                    sv_eng, max_slots=4, page_size=16, chunk_steps=2,
+                )
+                for i in range(4):
+                    ce.submit(
+                        sv_prompts[i], max_new_tokens=sv_eng.max_seq_len,
+                        seed=i,
+                        trace_id=(
+                            f"bench-oh-{rep}-{i}" if traced else None
+                        ),
+                    )
+                for _ in range(4):  # admit + warm: all programs compiled
+                    ce.step_chunk()
+                times: list[float] = []
+                for _ in range(OH_CHUNKS):
+                    t0 = time.perf_counter()
+                    ce.step_chunk()
+                    times.append(time.perf_counter() - t0)
+                ce.close()
+                return times
+
+            # per-CHUNK minimum over interleaved reps, not min-of-window:
+            # a single ~ms chunk is very likely clean of scheduler noise
+            # in at least one of 3x12 samples per mode, so each mode's
+            # min converges to its true floor even on a contended host
+            oh_off_t: list[float] = []
+            oh_on_t: list[float] = []
+            for r in range(3):
+                oh_off_t.extend(traced_chunk_times(False, r))
+                oh_on_t.extend(traced_chunk_times(True, r))
+            trace_overhead_pct = round(
+                (min(oh_on_t) - min(oh_off_t))
+                / max(min(oh_off_t), 1e-9) * 100.0, 2
+            )
             del sv_eng
             serving_extra = {
                 "serving_n_concurrent": N_REQ,
@@ -481,6 +573,22 @@ def run_bench() -> None:
                 ),
                 "serving_cont_itl_ms_p50": round(cont_m["itl_ms_p50"], 1),
                 "serving_cont_itl_ms_p95": round(cont_m["itl_ms_p95"], 1),
+                # trace-derived TTFT decomposition (core/trace.py): the
+                # three parts are recorded contiguously by the engine, so
+                # they sum to serving_ttft_trace_ms exactly; the external
+                # mean differs only by batcher-dispatch overhead
+                "serving_queue_ms": sv_decomp.get("queue_ms", 0.0),
+                "serving_prefill_ms": sv_decomp.get("prefill_ms", 0.0),
+                "serving_first_decode_ms": sv_decomp.get(
+                    "first_decode_ms", 0.0
+                ),
+                "serving_ttft_trace_ms": sv_decomp.get("ttft_trace_ms", 0.0),
+                "serving_cont_ttft_ms_mean": round(
+                    cont_m["ttft_ms_mean"], 2
+                ),
+                # disabled-vs-enabled tracing cost on the serving step —
+                # the observability layer's <= 2% bound (negative = noise)
+                "serving_trace_overhead_pct": trace_overhead_pct,
                 **(
                     {"serving_cont_slot_occupancy": occ}
                     if occ is not None else {}
@@ -767,6 +875,15 @@ def run_bench() -> None:
                                 sl_prompts[i],
                                 max_new_tokens=sl_budgets[i],
                                 priority=sl_classes[i], stream_cb=cbk,
+                                # trace the SLO leg's interactive turns:
+                                # the decomposition shows whether loaded
+                                # TTFT is queue wait or prefill cost
+                                trace_id=(
+                                    f"bench-sl-{i}"
+                                    if policy == "slo"
+                                    and sl_classes[i] == "interactive"
+                                    else None
+                                ),
                             )
                         except BaseException as e:
                             errs.append(e)
@@ -855,6 +972,12 @@ def run_bench() -> None:
             fcfs_m = sched_leg("fcfs")
             slo_m = sched_leg("slo")
             del eng_sl
+            sl_decomp = trace_decomp(
+                [
+                    f"bench-sl-{i}" for i in range(SL_N)
+                    if sl_classes[i] == "interactive"
+                ]
+            ) or {}
             base_ttft = max(slo_m["unloaded_ttft_ms_p50"], 1e-9)
             sched_extra = {
                 "sched_slots": SL_SLOTS,
@@ -886,6 +1009,15 @@ def run_bench() -> None:
                 "sched_rejected": slo_m["rejected"],
                 "sched_starved": slo_m["starved"] + fcfs_m["starved"],
                 "sched_fcfs_preemptions": fcfs_m["preemptions"],
+                # trace-derived decomposition of the SLO leg's loaded
+                # interactive TTFT (queue + prefill + first decode sum to
+                # sched_ttft_trace_ms by construction)
+                "sched_queue_ms": sl_decomp.get("queue_ms", 0.0),
+                "sched_prefill_ms": sl_decomp.get("prefill_ms", 0.0),
+                "sched_first_decode_ms": sl_decomp.get(
+                    "first_decode_ms", 0.0
+                ),
+                "sched_ttft_trace_ms": sl_decomp.get("ttft_trace_ms", 0.0),
                 **(
                     {}
                     if on_tpu
@@ -1257,6 +1389,13 @@ def run_bench() -> None:
                         src.submit(
                             mg_prompts[i], max_new_tokens=mg_budget,
                             seed=i,
+                            # trace the page-ship leg's source streams:
+                            # their first-token path decomposes like any
+                            # serving request, and the freeze/export/
+                            # commit spans ride the same trace ids
+                            trace_id=(
+                                f"bench-mg-{i}" if page_ship else None
+                            ),
                         )
                         for i in range(N_MG)
                     ]
@@ -1302,11 +1441,25 @@ def run_bench() -> None:
             assert mig_drop == 0 and rep_drop == 0, (mig_drop, rep_drop)
             mig_ms = float(np.median(mig_lat))
             rep_ms = float(np.median(rep_lat))
+            mg_decomp = trace_decomp(
+                [f"bench-mg-{i}" for i in range(N_MG)]
+            ) or {}
             mig_extra = {
                 "migration_streams": N_MG,
                 "migration_dropped_streams": int(mig_drop),
                 "migration_resume_ms": round(mig_ms, 2),
                 "migration_reprefill_resume_ms": round(rep_ms, 2),
+                # trace-derived TTFT decomposition of the migrated
+                # streams' source-side admission (parts sum to
+                # migration_ttft_trace_ms by construction)
+                "migration_queue_ms": mg_decomp.get("queue_ms", 0.0),
+                "migration_prefill_ms": mg_decomp.get("prefill_ms", 0.0),
+                "migration_first_decode_ms": mg_decomp.get(
+                    "first_decode_ms", 0.0
+                ),
+                "migration_ttft_trace_ms": mg_decomp.get(
+                    "ttft_trace_ms", 0.0
+                ),
                 # >1 means page shipping resumed faster than re-prefill
                 "migration_resume_speedup": round(
                     rep_ms / max(mig_ms, 1e-9), 2

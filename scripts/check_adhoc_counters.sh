@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The /stats-feeding modules count through the typed registry
+# (tensorlink_tpu/core/metrics.py) — this guard fails CI if any of them
+# regrows an ad-hoc `self.stats = {...}` dict or a `stats[...] += n`
+# counter bump outside the registry (the pre-PR-10 pattern the registry
+# replaced). PrefixCache's dict in engine/paged.py is exempt until its
+# own migration; the engine exposes it through the registry snapshot.
+set -u
+cd "$(dirname "$0")/.."
+hits=$(grep -nE 'self\.stats *= *\{|self\.stats\[[^]]+\] *[+-]= ' \
+    tensorlink_tpu/engine/continuous.py \
+    tensorlink_tpu/engine/scheduler.py \
+    tensorlink_tpu/ml/worker.py \
+    tensorlink_tpu/ml/batching.py || true)
+if [ -n "$hits" ]; then
+    echo "ad-hoc dict counter outside the metrics registry:" >&2
+    echo "$hits" >&2
+    exit 1
+fi
+echo "ok: no ad-hoc counters outside core/metrics.py"

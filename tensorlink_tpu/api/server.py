@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
@@ -30,6 +31,8 @@ from tensorlink_tpu.api.schemas import (
     ValidationError,
 )
 from tensorlink_tpu.core.logging import get_logger
+from tensorlink_tpu.core.metrics import MetricsRegistry, render_prometheus
+from tensorlink_tpu.core.trace import get_tracer, mint_trace_id
 
 MAX_BODY = 8 << 20
 MAX_CONCURRENT = 100  # reference api/node.py:537
@@ -50,6 +53,12 @@ class HTTPError(Exception):
         self.body = {"error": message, **(extra or {})}
         self.headers = dict(headers or {})
 
+
+# client-supplied X-Request-Id values must be safe to echo into a
+# response header and to use as a tracer key: token charset only,
+# bounded length — anything else (header-injection attempts, unbounded
+# ids that could churn the tracer's LRU) gets a freshly minted id
+_RID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # tlint: disable=TL006(read-only constant table — never mutated at runtime)
 _STATUS = {
@@ -82,6 +91,24 @@ class TensorlinkAPI:
         # the transport-backstop gate: only ever touched on the server's
         # event loop (handler coroutines + the on-loop reject helper)
         self._inflight = 0  #: guarded by the event loop
+        # per-connection request id (X-Request-Id / trace id): keyed by
+        # writer so the response helpers can echo it on every reply path
+        # (success, HTTPError, 500) without threading it through each
+        # handler signature
+        self._req_ids: dict = {}  #: guarded by the event loop
+        # API-level metrics: the server's own registry, merged with every
+        # hosted model's engine registry by the /metrics handler
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "tlink_http_requests_total", "HTTP requests handled"
+        )
+        self._m_errors = self.metrics.counter(
+            "tlink_http_errors_total", "HTTP error responses sent"
+        )
+        self.metrics.gauge(
+            "tlink_http_inflight", "generations in flight",
+            fn=lambda: self._inflight,
+        )
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "TensorlinkAPI":
@@ -140,15 +167,34 @@ class TensorlinkAPI:
             if req is None:
                 return
             method, path, headers, body = req
+            # one trace id per request, echoed as X-Request-Id on every
+            # response path below. A client-supplied id is honored (so a
+            # gateway can pre-mint and correlate) only when it is a safe
+            # header token — else a fresh id is minted
+            client_rid = headers.get("x-request-id", "")
+            rid = (
+                client_rid if _RID_RE.match(client_rid)
+                else mint_trace_id()
+            )
+            self._req_ids[writer] = rid
+            self._m_requests.inc()
             await self._route(method, path, headers, body, writer)
         except HTTPError as e:
+            self._m_errors.inc()
+            rid = self._req_ids.get(writer)
+            if rid and "trace_id" not in e.body:
+                # rejection bodies (429s included) carry the trace id so a
+                # client can hand /trace/<rid> to an operator verbatim
+                e.body["trace_id"] = rid
             await self._send_json(writer, e.status, e.body, headers=e.headers)
         except asyncio.TimeoutError:
+            self._m_errors.inc()
             await self._send_json(writer, 408, {"error": "request timeout"})
         # tlint: disable=TL005(client hung up mid-reply — no one left to answer)
         except (ConnectionError, OSError):
             pass
         except Exception:
+            self._m_errors.inc()
             self.log.exception("request failed")
             try:
                 await self._send_json(writer, 500, {"error": "internal error"})
@@ -156,6 +202,7 @@ class TensorlinkAPI:
             except (ConnectionError, OSError):
                 pass
         finally:
+            self._req_ids.pop(writer, None)
             try:
                 writer.close()
                 await writer.wait_closed()
@@ -197,6 +244,11 @@ class TensorlinkAPI:
             raise HTTPError(400, "JSON body must be an object")
         return d
 
+    # tlint: on-loop — only called from the response coroutines
+    def _rid_header(self, writer) -> str:
+        rid = self._req_ids.get(writer)
+        return f"X-Request-Id: {rid}\r\n" if rid else ""
+
     async def _send_json(
         self, writer, status: int, payload: dict,
         headers: dict | None = None,
@@ -210,6 +262,23 @@ class TensorlinkAPI:
             "Content-Type: application/json\r\n"
             f"Content-Length: {len(data)}\r\n"
             f"{extra}"
+            f"{self._rid_header(writer)}"
+            "Connection: close\r\n\r\n"
+        ).encode()
+        writer.write(head + data)
+        await writer.drain()
+
+    async def _send_text(
+        self, writer, status: int, text: str,
+        content_type: str = "text/plain; version=0.0.4; charset=utf-8",
+    ) -> None:
+        """Plain-text response — the Prometheus exposition's shape."""
+        data = text.encode()
+        head = (
+            f"HTTP/1.1 {status} {_STATUS.get(status, 'OK')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"{self._rid_header(writer)}"
             "Connection: close\r\n\r\n"
         ).encode()
         writer.write(head + data)
@@ -220,7 +289,8 @@ class TensorlinkAPI:
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: text/event-stream\r\n"
             b"Cache-Control: no-cache\r\n"
-            b"Connection: close\r\n\r\n"
+            + self._rid_header(writer).encode()
+            + b"Connection: close\r\n\r\n"
         )
         await writer.drain()
 
@@ -230,6 +300,27 @@ class TensorlinkAPI:
         if method == "GET":
             if path == "/health":
                 return await self._send_json(writer, 200, {"status": "ok"})
+            if path == "/healthz":
+                # the LB/router probe: dict reads only, never an
+                # ML-process round trip (docs/SERVING.md "Telemetry")
+                return await self._send_json(
+                    writer, 200, self.executor.health_snapshot()
+                )
+            if path == "/metrics":
+                # Prometheus text exposition: the API registry merged with
+                # every hosted model's engine registry (or its last remote
+                # serving snapshot as gauges). Rendered off the event loop
+                # — collection takes the executor's host lock.
+                text = await self._ml(self._metrics_text)
+                return await self._send_text(writer, 200, text)
+            if path.startswith("/trace/"):
+                rid = path[len("/trace/"):]
+                spans = get_tracer().collect(rid)
+                if not spans and not get_tracer().known(rid):
+                    raise HTTPError(404, f"no trace {rid}")
+                return await self._send_json(
+                    writer, 200, {"trace_id": rid, "spans": spans}
+                )
             if path == "/models":
                 return await self._send_json(writer, 200, self._models())
             if path == "/v1/models":
@@ -298,6 +389,11 @@ class TensorlinkAPI:
         if path == "/request-model":
             return await self._request_model(data, writer)
         raise HTTPError(404, f"no route {path}")
+
+    def _metrics_text(self) -> str:
+        groups: list = [({}, self.metrics)]
+        groups.extend(self.executor.metrics_groups())
+        return render_prometheus(groups)
 
     # -- route bodies ---------------------------------------------------
     def _models(self) -> dict:
@@ -392,6 +488,7 @@ class TensorlinkAPI:
     ) -> None:
         from tensorlink_tpu.ml.validator import ModelNotReady
 
+        rid = self._req_ids.get(writer, "")
         job = self.executor.hosted.get(gen.hf_name)
         if job is None or job.status != "ready":
             # 503 + auto-load trigger (reference api/node.py:143-155)
@@ -419,8 +516,11 @@ class TensorlinkAPI:
                 # test_api_unit.py::test_n_gt_1_failure_does_not_erode_gate)
                 results = await asyncio.wait_for(
                     asyncio.gather(
-                        *(self._ml(self.executor.generate_api, gen)
-                          for _ in range(n)),
+                        *(self._ml(
+                            lambda: self.executor.generate_api(
+                                gen, trace_id=rid
+                            )
+                        ) for _ in range(n)),
                         return_exceptions=True,
                     ),
                     REQUEST_TIMEOUT,
@@ -471,11 +571,11 @@ class TensorlinkAPI:
                         } or None,
                     ),
                 )
-            await self._stream_generate(gen, fmt, writer)
+            await self._stream_generate(gen, fmt, writer, rid)
         finally:
             self._inflight -= n
 
-    async def _stream_generate(self, gen, fmt, writer) -> None:
+    async def _stream_generate(self, gen, fmt, writer, rid: str = "") -> None:
         """SSE: ML thread pushes deltas through call_soon_threadsafe."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -485,7 +585,9 @@ class TensorlinkAPI:
 
         def work():
             try:
-                res = self.executor.generate_api(gen, on_delta=on_delta)
+                res = self.executor.generate_api(
+                    gen, on_delta=on_delta, trace_id=rid
+                )
                 loop.call_soon_threadsafe(q.put_nowait, ("done", res))
             except Exception as e:
                 loop.call_soon_threadsafe(q.put_nowait, ("err", e))

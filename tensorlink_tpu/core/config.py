@@ -213,6 +213,11 @@ class NodeConfig:
     port: int | None = None  # None = ephemeral / persisted in env file
     debug: bool = True
     debug_level: int = 20  # logging level; 5 = VERBOSE
+    # structured logging (core/logging.py): one JSON object per line
+    # carrying ts/level/tag/msg and the active trace_id when a request
+    # span is live — joinable against /trace. Default keeps the colored
+    # human format.
+    json_logs: bool = False
     local_test: bool = False  # force 127.0.0.1, no UPnP (reference smart_node.py:230)
     upnp: bool = False
     off_chain: bool = True  # reference: on_chain flag inverted; off-chain default

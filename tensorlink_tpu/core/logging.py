@@ -4,14 +4,39 @@ Reference parity: smart_node.py:47,119-125,499-530 — colored tag-prefixed
 ``debug_print`` with custom VERBOSE=5 level and a TimedRotatingFileHandler to
 ``logs/runtime.log`` with 7-day retention. Re-specified on top of stdlib
 logging rather than hand-rolled prints.
+
+**Structured JSON mode** (``NodeConfig.json_logs`` →
+:func:`set_json_logs`): every line becomes one JSON object carrying
+``ts`` (epoch seconds), ``level``, ``tag``, ``msg`` — and ``trace_id``
+when a distributed-trace span is active on the emitting thread
+(core/trace.py ``current_trace``), so cluster log aggregates join
+directly against ``GET /trace/<rid>``. Plain colored mode stays the
+default.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import logging.handlers
 import sys
 from pathlib import Path
+
+# process-wide log-mode switch, flipped once at node start (BaseNode reads
+# NodeConfig.json_logs before any executor thread spawns); a dict cell so
+# formatters see updates without module-global rebinding
+# tlint: disable=TL006(process-wide log-mode flag — set once at node start, reset via set_json_logs(False) in tests)
+_MODE = {"json": False}
+
+
+def set_json_logs(enabled: bool) -> None:
+    """Switch every tensorlink logger (stream and file handlers alike)
+    to/from one-JSON-object-per-line output."""
+    _MODE["json"] = bool(enabled)
+
+
+def json_logs_enabled() -> bool:
+    return _MODE["json"]
 
 VERBOSE = 5
 logging.addLevelName(VERBOSE, "VERBOSE")
@@ -35,6 +60,24 @@ class _TagFormatter(logging.Formatter):
 
     def format(self, record: logging.LogRecord) -> str:
         tag = getattr(record, "tag", record.name.rsplit(".", 1)[-1])
+        if _MODE["json"]:
+            out = {
+                # record.created is the stdlib's epoch stamp — a genuine
+                # wall-clock timestamp for log joining, never used for
+                # durations
+                "ts": round(record.created, 6),
+                "level": record.levelname,
+                "tag": tag,
+                "msg": record.getMessage(),
+            }
+            from tensorlink_tpu.core.trace import current_trace
+
+            tid = current_trace.get()
+            if tid:
+                out["trace_id"] = tid
+            if record.exc_info:
+                out["exc"] = self.formatException(record.exc_info)
+            return json.dumps(out, default=str)
         base = f"[{self.formatTime(record, '%H:%M:%S')}] [{tag}] {record.getMessage()}"
         if record.exc_info:
             base += "\n" + self.formatException(record.exc_info)

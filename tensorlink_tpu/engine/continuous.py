@@ -58,6 +58,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.metrics import MetricsRegistry
+from ..core.trace import FlightRecorder, get_tracer
 from .generate import GenerationEngine
 from .paged import (
     PageAllocator,
@@ -165,6 +167,41 @@ def _sample_rows(logits, keys, temp, top_k, top_p, pres, freq, counts):
     return jax.vmap(one)(logits, keys, temp, top_k, top_p, pres, freq, counts)
 
 
+# the engine's counter families: (legacy /stats key, prometheus name,
+# help). The legacy keys are the test-pinned serving_snapshot() contract;
+# the prometheus names are the /metrics exposition of the SAME cells.
+_ENGINE_COUNTERS = (
+    ("admitted", "tlink_engine_admitted_total",
+     "requests admitted into a slot"),
+    ("evicted", "tlink_engine_evicted_total",
+     "finished slots evicted at a chunk boundary"),
+    ("preemptions", "tlink_engine_preemptions_total",
+     "slots preempted for a higher-ranked candidate"),
+    ("decode_steps", "tlink_engine_decode_steps_total",
+     "compiled decode steps executed"),
+    ("slot_steps_live", "tlink_engine_slot_steps_live_total",
+     "slot-steps that delivered a token"),
+    ("slot_steps_total", "tlink_engine_slot_steps_total",
+     "slot-steps executed including padding rows"),
+    ("prefill_chunks", "tlink_engine_prefill_chunks_total",
+     "prefill grants executed"),
+    ("prefill_tokens", "tlink_engine_prefill_tokens_total",
+     "prompt tokens prefilled on device"),
+    ("prefill_tokens_skipped", "tlink_engine_prefill_tokens_skipped_total",
+     "prompt tokens served from the prefix cache"),
+    ("migrations_started", "tlink_engine_migrations_started_total",
+     "slots frozen for export (source side)"),
+    ("migrations_completed", "tlink_engine_migrations_completed_total",
+     "migrations whose pages shipped and committed (source side)"),
+    ("migrations_failed", "tlink_engine_migrations_failed_total",
+     "migrations aborted or fallen back (source side)"),
+    ("migrations_fell_back", "tlink_engine_migrations_fell_back_total",
+     "streams redirected down the re-prefill rung"),
+    ("migrations_adopted", "tlink_engine_migrations_adopted_total",
+     "staged migrations adopted into a slot (destination side)"),
+)
+
+
 @dataclass
 class ContinuousRequest:
     """One in-flight (or queued) request's host-side state."""
@@ -208,6 +245,11 @@ class ContinuousRequest:
     admit_rank: int = -1  # effective rank AT admission (preemption shield)
     submit_t: float = 0.0
     admit_t: float = 0.0
+    # -- observability (core/trace.py) -----------------------------------
+    # distributed-trace id minted by the API server (empty = untraced:
+    # the engine skips every span-recording call for this request)
+    trace_id: str = ""
+    prefill_done_t: float = 0.0  # when the slot left the prefilling set
 
 
 class ContinuousEngine:
@@ -236,6 +278,9 @@ class ContinuousEngine:
         sched_max_wait_s: float = 60.0,
         default_priority: str = DEFAULT_PRIORITY,
         migration_ttl_s: float = 120.0,
+        trace_site: str = "",
+        metrics: MetricsRegistry | None = None,
+        flight_capacity: int = 256,
     ):
         if engine.cfg.sliding_window is not None:
             raise ValueError(
@@ -308,6 +353,33 @@ class ContinuousEngine:
         # threads (submit/admission_check/serving_snapshot) race the
         # driver on it; every touch goes through the engine lock.
         self.default_priority = normalize_priority(default_priority)
+        # -- observability (core/trace.py, core/metrics.py) --------------
+        # spans are recorded host-side, ONLY at boundaries this engine
+        # already synchronizes (admission, the per-chunk drain, the
+        # migration verbs) and ONLY for requests carrying a trace id —
+        # zero compiled programs, zero extra device syncs, near-zero cost
+        # when tracing is off (bench-measured)
+        self.tracer = get_tracer()
+        self.trace_site = str(trace_site)
+        self.recorder = FlightRecorder(flight_capacity)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._stat = {
+            key: self.metrics.counter(name, help)
+            for key, name, help in _ENGINE_COUNTERS
+        }
+        self.metrics.gauge(
+            "tlink_engine_kv_pages_free", "free KV pages",
+            fn=lambda: self.alloc.n_free,
+        )
+        self.metrics.gauge(
+            "tlink_engine_live_slots", "slots decoding or mid-prefill",
+            fn=lambda: self.live_slots,
+        )
+        self.metrics.gauge(
+            "tlink_engine_pages_in_transit",
+            "pages held by in-flight migrations (either side)",
+            fn=lambda: self._pages_in_transit(),
+        )
         self.sched = RequestScheduler(  #: guarded by self._lock
             max_slots=self.max_slots,
             queue_cap=sched_queue_cap,
@@ -315,6 +387,7 @@ class ContinuousEngine:
             preemption=sched_preemption,
             policy=sched_policy,
             max_wait_s=sched_max_wait_s,
+            metrics=self.metrics,
         )
         self._rid = itertools.count(1)
         self._slots: list[ContinuousRequest | None] = [None] * self.max_slots
@@ -332,19 +405,29 @@ class ContinuousEngine:
         self._counts = jnp.zeros(
             (self.max_slots, self.cfg.vocab_size), jnp.int32
         )
-        # serving telemetry
-        self.stats = {
-            "admitted": 0, "evicted": 0, "preemptions": 0,
-            "decode_steps": 0,
-            "slot_steps_live": 0, "slot_steps_total": 0,
-            "prefill_chunks": 0, "prefill_tokens": 0,
-            "prefill_tokens_skipped": 0,
-            # live migration (source side: started/completed/failed/
-            # fell_back; destination side: adopted)
-            "migrations_started": 0, "migrations_completed": 0,
-            "migrations_failed": 0, "migrations_fell_back": 0,
-            "migrations_adopted": 0,
-        }
+
+    @property
+    def stats(self) -> dict:
+        """Legacy serving-telemetry view: the exact, test-pinned key set
+        the old ad-hoc counter dict exposed, now DERIVED from the typed
+        registry (core/metrics.py) — /stats consumers see byte-compatible
+        keys while /metrics reads the same counters as Prometheus
+        series."""
+        return {k: int(c.value) for k, c in self._stat.items()}
+
+    def _count(self, key: str, n: int = 1) -> None:
+        """Driver-thread counter bump (single-writer discipline)."""
+        self._stat[key].inc(n)
+
+    def _trace(self, req, name: str, dur_s: float | None = None,
+               **attrs) -> None:
+        """Record one span for a traced request (no-op when the request
+        carries no trace id — the disabled-mode fast path)."""
+        if req is not None and req.trace_id:
+            self.tracer.record(
+                req.trace_id, name, site=self.trace_site, dur_s=dur_s,
+                **attrs,
+            )
 
     # -- client side -----------------------------------------------------
     def submit(
@@ -360,6 +443,7 @@ class ContinuousEngine:
         stream_cb: Callable[[int], bool | None] | None = None,
         on_finish: Callable[[ContinuousRequest], None] | None = None,
         adopt: str | None = None,
+        trace_id: str | None = None,
     ) -> ContinuousRequest:
         """Queue a request; the scheduler decides when (and at whose
         expense) it joins the slot batch. ``start_step`` > 0 resumes a
@@ -386,6 +470,7 @@ class ContinuousEngine:
             stream_cb=stream_cb,
             on_finish=on_finish,
             adopt=adopt,
+            trace_id=str(trace_id or ""),
         )
         req.submit_t = time.monotonic()
         overload: SchedulerOverloaded | None = None
@@ -395,6 +480,11 @@ class ContinuousEngine:
             except SchedulerOverloaded as e:
                 overload = e
         if overload is not None:
+            self._trace(
+                req, "rejected", priority=overload.priority,
+                queue_depth=overload.queue_depth,
+                retry_after=overload.retry_after,
+            )
             # a rejected resume must release its staged-adoption ticket —
             # otherwise the shipped pages stay pinned in-transit for the
             # full TTL on exactly the engine absorbing a drain. submit()
@@ -469,10 +559,17 @@ class ContinuousEngine:
             # Under the lock: serving_snapshot() iterates the TTFT
             # sample deque from other threads (/stats), and a deque
             # append racing that iteration raises.
+            now = time.monotonic()
             with self._lock:
-                self.sched.note_first_token(
-                    req, time.monotonic() - req.submit_t
-                )
+                self.sched.note_first_token(req, now - req.submit_t)
+            if req.trace_id:
+                # the TTFT decomposition's last leg: prefill completed →
+                # first token delivered (contiguous with the queue_wait
+                # and prefill spans by construction, so the three parts
+                # sum to the first_token span's TTFT)
+                base = req.prefill_done_t or req.admit_t or req.submit_t
+                self._trace(req, "first_decode", dur_s=now - base)
+                self._trace(req, "first_token", dur_s=now - req.submit_t)
         req.tokens.append(tok)
         cancel = False
         if req.stream_cb is not None:
@@ -606,8 +703,8 @@ class ContinuousEngine:
         # the completing step samples the first token IN-program, so the
         # slot's sampling state must be armed before its first packed block
         self._arm_slot(req, slot)
-        self.stats["admitted"] += 1
-        self.stats["prefill_tokens_skipped"] += hit_len
+        self._count("admitted")
+        self._count("prefill_tokens_skipped", hit_len)
         if self.prefix is not None:
             # counted HERE, not in match(): one lookup per admission, so
             # head-of-line page-wait retries don't skew the hit rate
@@ -701,8 +798,14 @@ class ContinuousEngine:
         self._active[slot] = True
         del self._migrations[req.adopt]
         req.adopt = None
-        self.stats["admitted"] += 1
-        self.stats["migrations_adopted"] += 1
+        self._count("admitted")
+        self._count("migrations_adopted")
+        # adoption closes the migration arc: the shipped chain resumes
+        # decoding here with zero prefill compute
+        self._trace(
+            req, "adopt", slot=slot, length=length,
+            pages=len(req.pages), shared=n_skip,
+        )
         return True
 
     def _set_knob_mirrors(self, slot: int, sp: SamplingParams) -> None:
@@ -756,7 +859,17 @@ class ContinuousEngine:
         scratch, slot → admission pool."""
         req = self._teardown_slot(slot)
         if req is not None:
-            self.stats["evicted"] += 1
+            self._count("evicted")
+            # the decode span covers the DECODE phase only (prefill has
+            # its own span — overlapping them would double-count TTFT
+            # time in any span-layout view); adopted slots have no
+            # prefill phase, so their base is the admission
+            base = req.prefill_done_t or req.admit_t
+            self._trace(
+                req, "decode",
+                dur_s=(time.monotonic() - base) if base else None,
+                tokens=len(req.tokens),
+            )
             if req.admit_t:
                 # under the lock like every other scheduler touch: the
                 # service EWMA this updates is read concurrently by
@@ -811,7 +924,9 @@ class ContinuousEngine:
         req.prefill_pos = 0
         req.prefill_tokens = []
         req.prefill_target = 0
-        self.stats["preemptions"] += 1
+        req.prefill_done_t = 0.0
+        self._count("preemptions")
+        self._trace(req, "preempt", tokens=len(req.tokens))
         with self._lock:
             self.sched.requeue(req)
 
@@ -877,7 +992,8 @@ class ContinuousEngine:
             )
         self._active[slot] = False
         self._frozen.add(slot)
-        self.stats["migrations_started"] += 1
+        self._count("migrations_started")
+        self._trace(req, "freeze", slot=slot, tokens=len(req.tokens))
 
     def migration_chain(self, slot: int) -> tuple[list[int], int]:
         """The frozen slot's token chain (prompt + emitted — the cache key
@@ -900,6 +1016,7 @@ class ContinuousEngine:
         req = self._slots[slot]
         if req is None or slot not in self._frozen:
             raise ValueError(f"slot {slot} is not frozen for export")
+        t_export = time.monotonic()
         length = int(np.asarray(self.cache.lengths)[slot])
         chain, limit = self.migration_chain(slot)
         n_valid_pages = pages_needed(length, self.page_size)
@@ -939,6 +1056,13 @@ class ContinuousEngine:
             {k: blob[k] for k in ("k", "v", "k_scale", "v_scale")
              if k in blob}
         )
+        # the trace id rides the MIGRATE wire frame so the destination's
+        # staging span stitches under the same trace as the source's
+        blob["trace"] = req.trace_id
+        self._trace(
+            req, "export", dur_s=time.monotonic() - t_export,
+            pages=len(ship), skipped=n_skip,
+        )
         return blob
 
     def commit_migration(
@@ -954,10 +1078,12 @@ class ContinuousEngine:
             raise ValueError(f"slot {slot} is not frozen")
         req = self._teardown_slot(slot)
         if fell_back:
-            self.stats["migrations_failed"] += 1
-            self.stats["migrations_fell_back"] += 1
+            self._count("migrations_failed")
+            self._count("migrations_fell_back")
+            self._trace(req, "migrate_fallback", slot=slot)
         else:
-            self.stats["migrations_completed"] += 1
+            self._count("migrations_completed")
+            self._trace(req, "migrate_commit", slot=slot)
         return req
 
     def abort_migration(self, slot: int) -> None:
@@ -967,7 +1093,7 @@ class ContinuousEngine:
         if slot not in self._frozen:
             raise ValueError(f"slot {slot} is not frozen")
         self._frozen.discard(slot)
-        self.stats["migrations_failed"] += 1
+        self._count("migrations_failed")
         if self._slots[slot] is not None:
             self._active[slot] = True
 
@@ -978,7 +1104,8 @@ class ContinuousEngine:
         rung."""
         req = self._teardown_slot(slot)
         if req is not None:
-            self.stats["migrations_fell_back"] += 1
+            self._count("migrations_fell_back")
+            self._trace(req, "migrate_fallback", slot=slot)
         return req
 
     def shed_queued(self) -> list[ContinuousRequest]:
@@ -994,7 +1121,7 @@ class ContinuousEngine:
             # dead the moment the stream redirects elsewhere (driver
             # thread: shed_queued runs from the drain loop)
             self._drop_ticket(r)
-        self.stats["migrations_fell_back"] += len(pending)
+        self._count("migrations_fell_back", len(pending))
         return pending
 
     def fail_queued(self, req: ContinuousRequest, err: BaseException) -> None:
@@ -1057,6 +1184,7 @@ class ContinuousEngine:
             return True
         if self.drain_state != "serving":
             return False  # a draining engine must not adopt new streams
+        t_stage = time.monotonic()
         if str(blob.get("kv_quant", "none")) != self.kv_quant:
             return False
         if int(blob["page_size"]) != self.page_size:
@@ -1125,6 +1253,15 @@ class ContinuousEngine:
             "prefill_target": int(blob["prefill_target"]),
             "t": time.monotonic(),
         }
+        tid = str(blob.get("trace") or "")
+        if tid:
+            # destination-side staging span under the SOURCE's trace id —
+            # the cross-worker stitch the /trace endpoint serves
+            self.tracer.record(
+                tid, "stage", site=self.trace_site,
+                dur_s=time.monotonic() - t_stage,
+                pages=n_ship, shared=n_skip,
+            )
         return True
 
     def drop_staged_migration(self, mig_id: str) -> None:
@@ -1207,11 +1344,25 @@ class ContinuousEngine:
                 "page conservation violated: " + "; ".join(problems)
             )
 
+    def _pages_in_transit(self) -> int:
+        """Pages currently held by an in-flight migration on either side:
+        staged inbound tickets plus frozen outbound slots."""
+        return (
+            sum(len(t["pages"]) for t in self._migrations.values())
+            + sum(
+                len(self._slots[s].pages)
+                for s in self._frozen
+                if self._slots[s] is not None
+            )
+        )
+
     def serving_snapshot(self) -> dict:
         """Telemetry for the validator's /stats endpoint and the bench:
         engine counters, scheduler per-class stats (queue depth,
         queue-wait/TTFT percentiles, preemptions, rejections), plus
-        prefix-cache occupancy."""
+        prefix-cache occupancy. Keys are derived from the metrics
+        registry but stay byte-compatible with the pre-registry dicts
+        (test-pinned; see docs/SERVING.md "Telemetry")."""
         out = dict(self.stats)
         # KV storage mode + occupancy: the capacity math operators size
         # slots-per-chip with (kv_quant="int8" halves kv_page_bytes)
@@ -1228,14 +1379,7 @@ class ContinuousEngine:
             # self.stats above): drain fence state + pages currently held
             # by an in-flight migration on either side
             "drain_state": self.drain_state,
-            "pages_in_transit": (
-                sum(len(t["pages"]) for t in self._migrations.values())
-                + sum(
-                    len(self._slots[s].pages)
-                    for s in self._frozen
-                    if self._slots[s] is not None
-                )
-            ),
+            "pages_in_transit": self._pages_in_transit(),
         })
         with self._lock:
             out.update(self.sched.snapshot())
@@ -1287,6 +1431,7 @@ class ContinuousEngine:
                     return  # every resident outranks the best candidate
                 self._preempt(victim.slot)
                 continue  # the victim's slot is free now
+            t_adm = time.monotonic()
             while not self._admit_one(req, free[0]):
                 # allocator pressure the prefix cache couldn't cover:
                 # preempting a lower-priority resident frees its private
@@ -1303,6 +1448,18 @@ class ContinuousEngine:
                 if req.slot >= 0:
                     self.sched.note_admitted(req)
                     req.admit_t = time.monotonic()
+            if req.slot >= 0 and req.trace_id:
+                # contiguous TTFT decomposition, part 1 and 2: time spent
+                # queued, then the admission work itself (page grab,
+                # prefix-cache walk, COW, any preemption teardown)
+                self._trace(
+                    req, "queue_wait", dur_s=req.admit_t - req.submit_t,
+                    priority=req.priority,
+                )
+                self._trace(
+                    req, "admission", dur_s=req.admit_t - t_adm,
+                    slot=req.slot, cache_hit_tokens=req.prefill_pos,
+                )
 
     def _preemptable(self) -> list:
         """Resident requests a preemption may consider: a slot frozen for
@@ -1406,6 +1563,7 @@ class ContinuousEngine:
             return self.has_work()
         blk, starts, n_valid, emit, remaining, eos_arr, completing, \
             grants = pack
+        t_chunk = time.monotonic()
         tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
             _rem = paged_ragged_step(
                 self.engine.params, jnp.asarray(blk), self.cache,
@@ -1420,21 +1578,39 @@ class ContinuousEngine:
             )
         n_exec = int(n_exec)
         toks_host = np.asarray(tokens)[:, :n_exec]
+        # the chunk's host-visible wall time — measured at the ONE
+        # existing boundary sync (the asarray drain above), so span
+        # recording adds no device round trips of its own
+        chunk_dur = time.monotonic() - t_chunk
         # prefill bookkeeping: the grants landed on device; completed
         # prompts switch to decode mode before delivery (their first
         # token is column 0 of this very chunk)
         for s, g in grants.items():
-            self._prefilling[s].prefill_pos += g
-            self.stats["prefill_chunks"] += 1
-            self.stats["prefill_tokens"] += g
+            req = self._prefilling[s]
+            req.prefill_pos += g
+            self._count("prefill_chunks")
+            self._count("prefill_tokens", g)
+            self._trace(
+                req, "prefill_chunk", dur_s=chunk_dur, tokens=g,
+                pos=req.prefill_pos,
+            )
+        now = time.monotonic()
         for s in completing:
+            req = self._prefilling[s]
+            req.prefill_done_t = now
+            self._trace(
+                req, "prefill",
+                dur_s=(now - req.admit_t) if req.admit_t else None,
+                tokens=req.prefill_pos,
+            )
             del self._prefilling[s]
             self._active[s] = True
         if emit.any():
             # prefill-only steps decode nothing — don't count them
-            self.stats["decode_steps"] += n_exec
-            self.stats["slot_steps_total"] += n_exec * S
+            self._count("decode_steps", n_exec)
+            self._count("slot_steps_total", n_exec * S)
         deliver = emit
+        delivered_total = 0
         for s in range(S):
             if not deliver[s]:
                 continue
@@ -1453,9 +1629,23 @@ class ContinuousEngine:
             # step advance (authoritative over the device mirror when an
             # EOS id overflowed _EOS_WIDTH)
             self._steps[s] += emitted
-            self.stats["slot_steps_live"] += emitted
+            self._count("slot_steps_live", emitted)
+            delivered_total += emitted
             if finished:
                 self._evict(s)
+        # flight recorder (core/trace.py): one bounded append per chunk,
+        # at the same boundary — the postmortem's per-step state
+        self.recorder.record(
+            live_slots=int(self._active.sum()) + len(self._prefilling),
+            prefilling=len(self._prefilling),
+            decode_steps=n_exec if bool(emit.any()) else 0,
+            prefill_granted=int(sum(grants.values())),
+            tokens_emitted=delivered_total,
+            pages_free=self.alloc.n_free,
+            pages_in_transit=self._pages_in_transit(),
+            preemptions=int(self._stat["preemptions"].value),
+            chunk_ms=round(chunk_dur * 1e3, 3),
+        )
         return self.has_work()
 
     def run_until_idle(self) -> None:
@@ -1465,8 +1655,20 @@ class ContinuousEngine:
 
     def close(self, error: BaseException | None = None) -> None:
         """Fail everything still queued or in flight (model unhosting /
-        engine teardown)."""
+        engine teardown). A real error dumps the flight recorder — the
+        last N chunks of slot/page state ride ``recorder.last_dump`` so a
+        chaos postmortem reads data, not prints."""
         err = error or RuntimeError("continuous engine closed")
+        if error is not None:
+            dump = self.recorder.dump(error)
+            from ..core.logging import get_logger
+
+            get_logger("engine.flight").warning(
+                "engine error — flight recorder dumped %d step records "
+                "(last: %s)",
+                dump["n_records"],
+                dump["records"][-1] if dump["records"] else None,
+            )
         with self._lock:
             pending = self.sched.pending()
             for req in pending:

@@ -56,7 +56,8 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass, field
+
+from ..core.metrics import MetricsRegistry
 
 PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
 # tlint: disable=TL006(constant derived from PRIORITY_CLASSES — read-only)
@@ -95,22 +96,43 @@ def _percentile(samples, q: float) -> float:
     return float(s[idx])
 
 
-@dataclass
 class _ClassStats:
-    """Per-class counters + bounded sample windows (host-side only)."""
+    """Per-class typed counters (registry-backed — they ARE the /metrics
+    series) + bounded sample windows for the exact-percentile snapshot
+    keys the /stats contract pins (a fixed-bucket histogram would change
+    the reported p50/p95 values, so the deques stay as the percentile
+    source while the histograms feed /metrics)."""
 
-    admitted: int = 0
-    rejected: int = 0
-    preempted: int = 0
-    queue_waits: deque = field(default_factory=lambda: deque(maxlen=512))
-    ttfts: deque = field(default_factory=lambda: deque(maxlen=512))
+    def __init__(self, cls: str, metrics: MetricsRegistry):
+        self.admitted = metrics.counter(
+            "tlink_sched_admitted_total", "requests admitted", cls=cls
+        )
+        self.rejected = metrics.counter(
+            "tlink_sched_rejected_total",
+            "requests rejected (queue cap / wait bound / drain fence)",
+            cls=cls,
+        )
+        self.preempted = metrics.counter(
+            "tlink_sched_preempted_total", "slots preempted and requeued",
+            cls=cls,
+        )
+        self.queue_wait_hist = metrics.histogram(
+            "tlink_sched_queue_wait_seconds",
+            "submit-to-admission wait", cls=cls,
+        )
+        self.ttft_hist = metrics.histogram(
+            "tlink_sched_ttft_seconds",
+            "submit-to-first-token latency", cls=cls,
+        )
+        self.queue_waits: deque = deque(maxlen=512)
+        self.ttfts: deque = deque(maxlen=512)
 
     def snapshot(self, depth: int) -> dict:
         return {
             "queue_depth": depth,
-            "admitted": self.admitted,
-            "rejected": self.rejected,
-            "preempted": self.preempted,
+            "admitted": int(self.admitted.value),
+            "rejected": int(self.rejected.value),
+            "preempted": int(self.preempted.value),
             "queue_wait_ms_p50": round(
                 _percentile(self.queue_waits, 0.50) * 1e3, 2
             ),
@@ -145,6 +167,7 @@ class RequestScheduler:
         preemption: bool = True,
         policy: str = "slo",
         max_wait_s: float = 60.0,
+        metrics: MetricsRegistry | None = None,
     ):
         if policy not in ("slo", "fcfs"):
             raise ValueError(f"unknown scheduling policy {policy!r}")
@@ -170,9 +193,22 @@ class RequestScheduler:
         # EWMA of per-request service time (admit→finish wall seconds):
         # the unit the wait estimator scales queue depth by
         self._service_ewma = 0.0  #: guarded by the engine lock
+        # typed counters/histograms (core/metrics.py): the engine shares
+        # its registry so one /metrics render covers both layers; a
+        # standalone scheduler (unit tests) gets its own
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.by_class = {  #: guarded by the engine lock
-            c: _ClassStats() for c in PRIORITY_CLASSES
+            c: _ClassStats(c, self.metrics) for c in PRIORITY_CLASSES
         }
+        self.metrics.gauge(
+            "tlink_sched_queue_depth", "queued (not yet admitted) requests",
+            fn=lambda: len(self._queued),
+        )
+        self.metrics.gauge(
+            "tlink_sched_service_ewma_seconds",
+            "EWMA of per-request service time",
+            fn=lambda: self._service_ewma,
+        )
 
     # -- introspection ---------------------------------------------------
     # tlint: holds-lock(the engine lock)
@@ -209,10 +245,10 @@ class RequestScheduler:
         if self.draining:
             # the admission fence: a draining engine is shedding its live
             # slots — new work must land on the destination instead
-            self.by_class[req.priority].rejected += 1
+            self.by_class[req.priority].rejected.inc()
             raise SchedulerOverloaded(req.priority, depth, self.queue_cap, 1.0)
         if depth >= self.queue_cap:
-            self.by_class[req.priority].rejected += 1
+            self.by_class[req.priority].rejected.inc()
             raise SchedulerOverloaded(
                 req.priority, depth, self.queue_cap,
                 self.estimate_wait(req.priority),
@@ -236,7 +272,7 @@ class RequestScheduler:
         req.enqueue_tick = self._tick
         req.enqueue_t = time.monotonic()
         self._queued.append(req)
-        self.by_class[req.priority].preempted += 1
+        self.by_class[req.priority].preempted.inc()
 
     # tlint: holds-lock(the engine lock)
     def set_draining(self, draining: bool) -> None:
@@ -282,12 +318,17 @@ class RequestScheduler:
         self._admit_seq += 1
         req.admit_seq = self._admit_seq
         st = self.by_class[req.priority]
-        st.admitted += 1
-        st.queue_waits.append(max(time.monotonic() - req.enqueue_t, 0.0))
+        st.admitted.inc()
+        wait = max(time.monotonic() - req.enqueue_t, 0.0)
+        st.queue_waits.append(wait)
+        st.queue_wait_hist.observe(wait)
 
     # tlint: holds-lock(the engine lock)
     def note_first_token(self, req, ttft_s: float) -> None:
-        self.by_class[req.priority].ttfts.append(max(float(ttft_s), 0.0))
+        st = self.by_class[req.priority]
+        ttft = max(float(ttft_s), 0.0)
+        st.ttfts.append(ttft)
+        st.ttft_hist.observe(ttft)
 
     # tlint: holds-lock(the engine lock)
     def note_finished(self, req, service_s: float) -> None:
@@ -358,7 +399,7 @@ class RequestScheduler:
         cls = normalize_priority(priority)
         depth = self.depth(cls)
         if self.draining:
-            self.by_class[cls].rejected += n
+            self.by_class[cls].rejected.inc(n)
             return {
                 "priority": cls,
                 "queue_depth": depth,
@@ -370,7 +411,7 @@ class RequestScheduler:
         if depth + n > self.queue_cap or (
             self.max_wait_s > 0 and est > self.max_wait_s
         ):
-            self.by_class[cls].rejected += n
+            self.by_class[cls].rejected.inc(n)
             return {
                 "priority": cls,
                 "queue_depth": depth,
@@ -390,10 +431,10 @@ class RequestScheduler:
             "sched_policy": self.policy,
             "sched_queue_depth": len(self._queued),
             "sched_preemptions": sum(
-                st.preempted for st in self.by_class.values()
+                int(st.preempted.value) for st in self.by_class.values()
             ),
             "sched_rejected": sum(
-                st.rejected for st in self.by_class.values()
+                int(st.rejected.value) for st in self.by_class.values()
             ),
             "sched_service_ewma_s": round(self._service_ewma, 4),
             "sched_classes": classes,

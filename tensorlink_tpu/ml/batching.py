@@ -35,6 +35,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from tensorlink_tpu.core.metrics import MetricsRegistry
+from tensorlink_tpu.core.trace import get_tracer
 from tensorlink_tpu.engine.scheduler import (
     DEFAULT_PRIORITY,
     PRIORITY_RANK,
@@ -65,6 +67,9 @@ class _Pending:
     eos_ids: list[int] = field(default_factory=list)
     # SLO scheduling class (engine/scheduler.py); None → batcher default
     priority: str | None = None
+    # distributed-trace id (core/trace.py); "" = untraced request
+    trace_id: str = ""
+    submit_t: float = 0.0
 
 
 class GenBatcher:
@@ -93,7 +98,17 @@ class GenBatcher:
         from collections import deque
 
         self._stats_lock = threading.Lock()
-        # dispatch stats
+        # dispatch stats: typed counters (core/metrics.py) plus the
+        # bounded sample window stats() derives its batch shape from
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "tlink_batcher_requests_total", "requests dispatched",
+            mode="static",
+        )
+        self._m_dispatches = self.metrics.counter(
+            "tlink_batcher_dispatches_total", "batched dispatches issued",
+            mode="static",
+        )
         self.batch_sizes: deque[int] = deque(maxlen=1000)  #: guarded by self._stats_lock
         self._thread = threading.Thread(
             target=self._loop, name="gen-batcher", daemon=True
@@ -115,11 +130,13 @@ class GenBatcher:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         priority: str | None = None,
+        trace_id: str | None = None,
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode.
         ``priority`` is accepted for API symmetry with the continuous
-        scheduler; the windowed batcher itself stays FCFS."""
+        scheduler; the windowed batcher itself stays FCFS. ``trace_id``
+        (core/trace.py) records the window-wait + batched-decode span."""
         req = _Pending(
             ids=list(ids), max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
@@ -130,7 +147,9 @@ class GenBatcher:
             # greedy's choices, so a penalized request takes the normal loop
             lookahead=bool(lookahead) and float(temperature) == 0.0
             and not presence_penalty and not frequency_penalty,
+            trace_id=str(trace_id or ""),
         )
+        req.submit_t = time.monotonic()
         # check-and-put under the lock close() drains under — a submit
         # racing close() must either land before the sentinel or fail fast,
         # never sit in a dead queue until the timeout
@@ -140,6 +159,14 @@ class GenBatcher:
             self._q.put(req)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out in the batcher")
+        if req.trace_id:
+            # the static batcher has no admission seam to decompose — one
+            # span covers window-wait + the run-to-completion batch
+            get_tracer().record(
+                req.trace_id, "static_batch", site="batcher",
+                dur_s=time.monotonic() - req.submit_t,
+                tokens=len(req.result or ()),
+            )
         if req.error is not None:
             raise req.error
         return req.result or []
@@ -237,6 +264,8 @@ class GenBatcher:
         }
 
     def _run(self, batch: list[_Pending]) -> None:
+        self._m_dispatches.inc()
+        self._m_requests.inc(len(batch))
         with self._stats_lock:
             self.batch_sizes.append(len(batch))
         budgets = [r.max_new_tokens for r in batch]
@@ -565,6 +594,18 @@ class PipelinedSlotSession:
         # a recycled row being re-admitted stays in the reset list: the op
         # zeroes its stale write offset BEFORE the prefill's KV writes land
         recycled = sorted(self.reset_rows)
+        now = time.monotonic()
+        for row, req in placed:
+            if req.trace_id:
+                # the pipelined analogue of the engine's queue_wait span;
+                # the admission op below carries the trace ids so every
+                # stage worker can record its session-prefill hop too
+                get_tracer().record(
+                    req.trace_id, "queue_wait", site="pipeline",
+                    dur_s=(now - req.submit_t) if req.submit_t else None,
+                    row=row,
+                )
+        traces = [req.trace_id for _, req in placed if req.trace_id]
         T = max(len(req.ids) for _, req in placed)
         toks = np.zeros((self.B, T), np.int32)
         mask = np.zeros((self.B, T), bool)
@@ -576,6 +617,7 @@ class PipelinedSlotSession:
         tok = self._forward(
             tokens=toks, attn_mask=mask, sample=self._samp(),
             last_idx=last_idx, reset_rows=recycled,
+            trace=traces or None,
         )
         if tok is not None:
             self._apply_step_tokens(tok, [r for r, _ in placed])
@@ -668,6 +710,7 @@ class ContinuousBatcher:
         sched_preemption: bool = True,
         sched_policy: str = "slo",
         sched_max_wait_s: float = 60.0,
+        trace_site: str = "",
     ):
         from collections import deque
 
@@ -710,6 +753,7 @@ class ContinuousBatcher:
                     sched_preemption=sched_preemption,
                     sched_policy=sched_policy,
                     sched_max_wait_s=sched_max_wait_s,
+                    trace_site=trace_site or "local",
                 )
             )
             self.mode = "local"
@@ -718,11 +762,19 @@ class ContinuousBatcher:
         else:
             self._sess = PipelinedSlotSession(model, max_slots=max_slots)
             self.mode = "pipelined"
+        self.trace_site = trace_site or "batcher"
         if self.mode in ("local", "pipelined"):
             self._thread = threading.Thread(
                 target=self._drive, name="cont-batcher", daemon=True
             )
             self._thread.start()
+
+    def metrics_registry(self):
+        """The engine's metrics registry when it lives in-process (local
+        mode) — the validator's /metrics renders it per hosted model.
+        Remote/pipelined engines expose their counters through the
+        serving snapshot instead (snapshot_gauges)."""
+        return self._cont.metrics if self._cont is not None else None
 
     # -- client side -----------------------------------------------------
     def generate(
@@ -739,6 +791,7 @@ class ContinuousBatcher:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         priority: str | None = None,
+        trace_id: str | None = None,
     ) -> list[int]:
         with self._submit_lock:
             if self._closed:
@@ -746,6 +799,7 @@ class ContinuousBatcher:
             req_seed = self.seed + next(self._seq)
         priority = normalize_priority(priority or self.default_priority)
         penalized = bool(presence_penalty or frequency_penalty)
+        trace_id = str(trace_id or "")
         if self.mode == "remote":
             # drain accounting for close(): unhost must not tear the job
             # down under requests the worker is still decoding. Per-class
@@ -761,7 +815,7 @@ class ContinuousBatcher:
                     stream_cb=stream_cb, lookahead=lookahead,
                     presence_penalty=presence_penalty,
                     frequency_penalty=frequency_penalty, seed=req_seed,
-                    priority=priority,
+                    priority=priority, trace_id=trace_id,
                 )
             finally:
                 with self._idle:
@@ -788,6 +842,22 @@ class ContinuousBatcher:
             )
             self._note_served()
             return [int(t) for t in seqs[0][: int(max_new_tokens)]]
+        if trace_id and self.mode == "pipelined" and stream_cb is not None:
+            # the pipelined session has no engine-side spans; catch the
+            # first delivered token here so the trace still carries TTFT
+            inner_cb = stream_cb
+            first_seen = [False]
+            t_sub = time.monotonic()
+
+            def stream_cb(toks, _cb=inner_cb):
+                if not first_seen[0]:
+                    first_seen[0] = True
+                    get_tracer().record(
+                        trace_id, "first_token", site=self.trace_site,
+                        dur_s=time.monotonic() - t_sub,
+                    )
+                return _cb(toks)
+
         req = _Pending(
             ids=[int(t) for t in ids],
             max_new_tokens=int(max_new_tokens),
@@ -796,7 +866,9 @@ class ContinuousBatcher:
             presence_penalty=float(presence_penalty),
             frequency_penalty=float(frequency_penalty),
             priority=priority,
+            trace_id=trace_id,
         )
+        req.submit_t = time.monotonic()
         req.seed = req_seed
         req.eos_ids = self.eos_ids
         with self._submit_lock:
@@ -814,7 +886,7 @@ class ContinuousBatcher:
     def _generate_remote(
         self, ids, *, max_new_tokens, temperature, top_k, top_p, stream_cb,
         lookahead, presence_penalty, frequency_penalty, seed,
-        priority=None,
+        priority=None, trace_id="",
     ) -> list[int]:
         """Single-stage pass-through: the worker's slot engine is the
         scheduler, so each request ships immediately — concurrency comes
@@ -838,6 +910,7 @@ class ContinuousBatcher:
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
             priority=priority,
+            trace_id=trace_id,
             # speculation runs the solo engine path; everything else joins
             # the worker's slot batch
             continuous=not spec,
@@ -980,6 +1053,7 @@ class ContinuousBatcher:
             eos_ids=self.eos_ids, seed=req.seed,
             priority=req.priority,
             stream_cb=tok_cb, on_finish=on_finish,
+            trace_id=req.trace_id,
         )
 
     def stats(self) -> dict | None:

@@ -83,6 +83,15 @@ def _head_result(resp: dict):
     sampled token ids, speculative per-position argmax ids, or beam
     candidate (vals, idx) — or None when the response carries a plain
     activation/logits array (``resp["out"]``)."""
+    spans = resp.get("trace_spans")
+    if isinstance(spans, dict):
+        # session-op spans shipped home by the responding stage worker
+        # (ml/worker.py::_finish_fwd) — merge so /trace sees them
+        from tensorlink_tpu.core.trace import get_tracer
+
+        tracer = get_tracer()
+        for tid, ss in spans.items():
+            tracer.ingest(str(tid), ss or [])
     if "token" in resp:
         return np.asarray(resp["token"], np.int32)
     if "verify_ids" in resp:
@@ -570,6 +579,7 @@ class DistributedModel:
         reset_len: int | None = None,
         reset_rows: Sequence[int] | None = None,
         seq: int | None = None,
+        trace: Sequence[str] | None = None,
     ) -> np.ndarray:
         """Chain the pipeline stages; returns logits ``[B, T, V]``.
 
@@ -607,6 +617,10 @@ class DistributedModel:
             # recycle finished rows by zeroing their session-cache write
             # offsets on EVERY stage before this op's KV writes land
             body_common["reset_rows"] = [int(r) for r in reset_rows]
+        if trace:
+            # distributed-trace ids of the requests this session op admits
+            # (core/trace.py): each stage worker records its hop under them
+            body_common["trace"] = [str(t) for t in trace if t]
         if attn_mask is not None:
             body_common["attn_mask"] = np.asarray(attn_mask, bool)
 
@@ -739,6 +753,7 @@ class DistributedModel:
         info_out: dict | None = None,
         continuous: bool = False,
         priority: str | None = None,
+        trace_id: str | None = None,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -785,6 +800,7 @@ class DistributedModel:
                     presence_penalty=float(presence_penalty or 0.0),
                     frequency_penalty=float(frequency_penalty or 0.0),
                     priority=priority,
+                    trace_id=str(trace_id or ""),
                 )
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -982,6 +998,19 @@ class DistributedModel:
         snap = resp.get("serving")
         if isinstance(snap, dict):
             self.cont_serving_stats = snap
+        self._note_trace(resp)
+
+    @staticmethod
+    def _note_trace(resp: dict) -> None:
+        """Merge the worker's span payload (riding GENERATE_RESP next to
+        the serving snapshot) into this process's tracer — the stitch
+        that makes ``GET /trace/<rid>`` show a request's spans from every
+        worker it touched, including both sides of a live migration."""
+        tr = resp.get("trace")
+        if isinstance(tr, dict) and tr.get("id"):
+            from tensorlink_tpu.core.trace import get_tracer
+
+            get_tracer().ingest(str(tr["id"]), tr.get("spans") or [])
 
     def _merge_migrated_tokens(
         self, mig: dict, delivered_prior: list[int],
@@ -1050,7 +1079,7 @@ class DistributedModel:
         self, prompt: list[int], *, max_new_tokens: int, temperature: float,
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
         presence_penalty: float, frequency_penalty: float,
-        priority: str | None = None,
+        priority: str | None = None, trace_id: str = "",
     ) -> list[list[int]]:
         """One request through the worker's continuous slot engine
         (B=1 per RPC; the worker co-batches concurrent requests into its
@@ -1098,6 +1127,11 @@ class DistributedModel:
                 # the worker's scheduler reads the class off the wire; an
                 # old worker simply ignores the extra key (FCFS for it)
                 body["priority"] = str(priority)
+            if trace_id:
+                # the trace id rides the GENERATE frame: the worker's
+                # engine records its spans under it and ships them back on
+                # the response (docs/SERVING.md "Telemetry")
+                body["trace"] = trace_id
             if adopt:
                 # resume-after-migration: the destination staged our KV
                 # pages under this ticket — admission binds them instead

@@ -54,6 +54,10 @@ class DistributedValidator:
         self.demand: dict[str, int] = self._load_demand()
         self.hosted: dict[str, HostedJob] = {}
         self._host_lock = threading.Lock()
+        # surfaced by /healthz for load balancers / the cluster router
+        # (ROADMAP item 3): a draining validator keeps serving in-flight
+        # work but should stop receiving new placements
+        self.draining = False
         if node.config.ml.autoload_default_models:
             threading.Thread(
                 target=self._autoload_defaults,
@@ -358,6 +362,51 @@ class DistributedValidator:
                 job.model.shutdown()
         return True
 
+    def health_snapshot(self) -> dict:
+        """The ``GET /healthz`` body: status, hosted model names, drain
+        flag. Deliberately CHEAP — dict reads under the host lock, no
+        batcher stats, no ML-process round trip — so load balancers and
+        the cluster router (ROADMAP item 3) can probe at high frequency
+        without touching the serving path."""
+        with self._host_lock:
+            names = list(self.hosted)
+        return {
+            "status": "ok",
+            "hosted_models": names,
+            "draining": bool(self.draining),
+        }
+
+    def metrics_groups(self) -> list[tuple[dict, Any]]:
+        """(labels, registry) pairs for the /metrics exposition: each
+        hosted model's engine registry when it lives in-process (local
+        continuous batching), or its last remote serving snapshot
+        flattened into gauges (the dict riding every GENERATE_RESP)."""
+        from tensorlink_tpu.core.metrics import (
+            MetricsRegistry,
+            snapshot_gauges,
+        )
+
+        groups: list[tuple[dict, Any]] = []
+        with self._host_lock:
+            jobs = list(self.hosted.values())
+        for j in jobs:
+            labels = {"model": j.name}
+            batcher = j.batcher
+            reg = None
+            if batcher is not None:
+                get_reg = getattr(batcher, "metrics_registry", None)
+                reg = get_reg() if callable(get_reg) else None
+                if reg is None:
+                    reg = getattr(batcher, "metrics", None)
+            if reg is not None:
+                groups.append((labels, reg))
+            snap = getattr(j.model, "cont_serving_stats", None)
+            if isinstance(snap, dict) and snap:
+                sreg = MetricsRegistry()
+                snapshot_gauges(sreg, snap, prefix="tlink_engine_")
+                groups.append((labels, sreg))
+        return groups
+
     def hosted_snapshot(self) -> list[dict]:
         """Consistent view for API threads (the hosted dict is mutated by
         pool threads under _host_lock; readers must take it too)."""
@@ -400,10 +449,26 @@ class DistributedValidator:
         self,
         req,  # schemas.GenerationRequest
         on_delta: Callable[[str], None] | None = None,
+        trace_id: str | None = None,
     ) -> dict:
         """Run one generation on a hosted model. Returns
         ``{text, reasoning, prompt_tokens, completion_tokens, finish_reason}``.
-        ``on_delta`` receives visible-answer text pieces as they decode."""
+        ``on_delta`` receives visible-answer text pieces as they decode.
+        ``trace_id`` (minted by the API server) threads through the
+        batcher to the engine so every hop's spans land under it, and is
+        installed as the ACTIVE trace on this worker thread so json-mode
+        log lines join the trace too (core/logging.py)."""
+        from tensorlink_tpu.core.trace import current_trace
+
+        tid = str(trace_id or "")
+        token = current_trace.set(tid)
+        try:
+            return self._generate_api(req, on_delta, tid)
+        finally:
+            # the pool thread serves many requests — never leak the id
+            current_trace.reset(token)
+
+    def _generate_api(self, req, on_delta, trace_id: str) -> dict:
         from tensorlink_tpu.api.formatter import (
             StopStream,
             ThinkStripStream,
@@ -532,6 +597,7 @@ class DistributedValidator:
                     eos_ids=tok.eos_ids,
                     num_beams=n_beams,
                     info_out=info,
+                    trace_id=trace_id,
                 )
             beams_used = info.get("num_beams_used")
             out_ids = seqs[0]
@@ -549,6 +615,7 @@ class DistributedValidator:
                 stream_cb=stream_cb if use_cb else None,
                 lookahead=spec,
                 priority=getattr(req, "priority", None) or None,
+                trace_id=trace_id,
             )
         else:
             with job.lock:  # serialize per-model generation
@@ -563,6 +630,7 @@ class DistributedValidator:
                     eos_ids=tok.eos_ids,
                     stream_cb=stream_cb if use_cb else None,
                     lookahead=spec,
+                    trace_id=trace_id,
                 )
             out_ids = seqs[0]
         if on_delta is not None:

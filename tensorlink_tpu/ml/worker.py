@@ -722,6 +722,19 @@ class DistributedWorker:
             # fault site "worker.session_step" (core/faults.py): counted per
             # APPLIED op so transport dups never perturb the plan's decisions
             self.faults.inject("worker.session_step", op)
+        if p.get("trace"):
+            # session-op trace propagation (core/trace.py): the admission
+            # op carries the admitted requests' trace ids — record this
+            # stage's hop under each so pipelined traces name the workers
+            # a request's prefill touched
+            from tensorlink_tpu.core.trace import get_tracer
+
+            tracer = get_tracer()
+            for tid in p["trace"]:
+                tracer.record(
+                    str(tid), "session_prefill", site=self.node.node_id,
+                    layers=f"{rt.stage['layer_lo']}-{rt.stage['layer_hi']}",
+                )
         train = bool(p.get("train", False))
         tag = p.get("tag", "")
         if op == "chain" and p.get("head_hop"):
@@ -870,7 +883,7 @@ class DistributedWorker:
     _CHAIN_KEYS = (
         "job_id", "session", "cache_len", "attn_mask", "sample",
         "last_idx", "reply_to", "reorder_idx", "reset_len", "reset_rows",
-        "seq",
+        "seq", "trace",
     )
 
     # -- session-op idempotency (seq dedup) ------------------------------
@@ -954,6 +967,19 @@ class DistributedWorker:
         reply_peer = p.get("reply_to") or p["peer"]
 
         def respond_final(body: dict) -> None:
+            if p.get("trace"):
+                # ship this process's spans for the op's trace ids home
+                # (the pipelined admission op carries them): the client
+                # ingests, so /trace names the workers the prefill
+                # touched. Mid-chain stages in OTHER processes keep
+                # their hop spans local — only the responding process's
+                # tracer rides this reply.
+                from tensorlink_tpu.core.trace import get_tracer
+
+                tracer = get_tracer()
+                body["trace_spans"] = {
+                    str(t): tracer.collect(str(t)) for t in p["trace"]
+                }
             self._session_applied(rt, p, "resp", body)
             self._respond(reply_peer, proto.FORWARD_RESP, p["rid"], body)
 
@@ -1589,13 +1615,15 @@ class DistributedWorker:
             self._respond_migrated(
                 rt.cont,
                 {"peer": p["peer"], "rid": p["rid"],
-                 "stream": p.get("stream")},
+                 "stream": p.get("stream"),
+                 "trace": str(p.get("trace") or "")},
                 self.draining, None, [],
             )
             return True
         cont = self._ensure_cont(rt)
         if cont is None:
             return False
+        tid = str(p.get("trace") or "")
         t, k, tp, pp, fp = knobs
         sampling = SamplingParams.make(
             temperature=float(t), top_k=int(k), top_p=float(tp),
@@ -1646,16 +1674,23 @@ class DistributedWorker:
                      "worker": self.node.node_id},
                 )
                 return
-            self._respond(
-                peer, proto.GENERATE_RESP, p["rid"],
-                {"sequences": [list(map(int, req.tokens))],
-                 "finished": [bool(req.finished)],
-                 "continuous": True,
-                 # engine occupancy + prefix-cache counters ride every
-                 # response so the validator's /stats can surface them
-                 # without a dedicated polling RPC
-                 "serving": cont.serving_snapshot()},
-            )
+            body = {
+                "sequences": [list(map(int, req.tokens))],
+                "finished": [bool(req.finished)],
+                "continuous": True,
+                # engine occupancy + prefix-cache counters ride every
+                # response so the validator's /stats can surface them
+                # without a dedicated polling RPC
+                "serving": cont.serving_snapshot(),
+            }
+            if tid:
+                # this worker's spans for the request ride home the same
+                # way — the validator ingests them so /trace stitches a
+                # request's hops without any polling RPC
+                body["trace"] = {
+                    "id": tid, "spans": cont.tracer.collect(tid),
+                }
+            self._respond(peer, proto.GENERATE_RESP, p["rid"], body)
 
         req = cont.submit(
             prompts[0],
@@ -1670,12 +1705,14 @@ class DistributedWorker:
             # resume-after-migration: bind the staged KV pages instead of
             # re-prefilling (engine falls back when the ticket is stale)
             adopt=p.get("adopt") or None,
+            trace_id=tid,
         )
         # transport context for live migration: a drain must redirect this
         # stream mid-flight, which needs the original peer/rid/stream —
         # the on_finish/stream closures are opaque, this is not
         req.client_meta = {
             "peer": peer, "rid": p["rid"], "stream": stream_id,
+            "trace": tid,
         }
         self._schedule_cont(rt)
         return True
@@ -1694,6 +1731,9 @@ class DistributedWorker:
         try:
             rt.cont = cont = ContinuousEngine(
                 rt.engine,
+                # spans this engine records carry the worker's identity —
+                # the cross-worker stitch /trace serves depends on it
+                trace_site=str(self.node.node_id or ""),
                 max_slots=int(ml.cont_max_slots),
                 page_size=int(ml.cont_page_size),
                 chunk_steps=int(ml.cont_chunk_steps),
@@ -1995,16 +2035,23 @@ class DistributedWorker:
         frames may have dropped — the client tops up exactly-once from
         this). ``cont`` may be None (the admission-fence redirect fires
         before any slot engine exists)."""
+        tid = str(meta.get("trace") or "")
         body = {
             "migrated": {
                 "worker": dest["id"],
                 "addr": list(dest["addr"]),
                 "mig": mig_id,
                 "tokens_so_far": [int(t) for t in tokens],
+                # the redirect carries the request's trace id (and, below,
+                # the source worker's spans): the client re-issues at the
+                # destination under the SAME id, so both halves stitch
+                "trace_id": tid or None,
             },
         }
         if cont is not None:
             body["serving"] = cont.serving_snapshot()
+            if tid:
+                body["trace"] = {"id": tid, "spans": cont.tracer.collect(tid)}
         self._respond(meta["peer"], proto.GENERATE_RESP, meta["rid"], body)
         if meta.get("stream"):
             try:

@@ -1030,4 +1030,10 @@ SERVERS = {
 
 def run_server(role: str, cfg: NodeConfig, queues: BridgeQueues) -> None:
     """Entry point for the spawned network process."""
+    if cfg.json_logs:
+        # the network half logs too — both processes of a node must agree
+        # on the structured format for cluster log aggregation
+        from tensorlink_tpu.core.logging import set_json_logs
+
+        set_json_logs(True)
     SERVERS[role](cfg, queues).main()

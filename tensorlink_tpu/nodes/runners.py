@@ -46,6 +46,12 @@ class BaseNode:
             config = replace(config, **overrides)
         self.config = config
         self.role = config.role
+        if config.json_logs:
+            # flip BEFORE the first logger so every line of this process
+            # (and the executor threads it spawns) is one JSON object
+            from tensorlink_tpu.core.logging import set_json_logs
+
+            set_json_logs(True)
         self.log = get_logger(f"node.{self.role}{config.duplicate}")
         self.queues = self._make_queues()
         self.bridge = MLBridge(self.queues)

@@ -547,6 +547,89 @@ def test_chat_completions_n_choices(api_cluster):
     assert status == 400
 
 
+def _req_raw(api, method, path, body=None, headers=None, timeout=200.0):
+    """Like _req but returns (status, response headers, raw bytes) — for
+    the text /metrics exposition and the X-Request-Id echo."""
+    conn = http.client.HTTPConnection("127.0.0.1", api.port, timeout=timeout)
+    payload = json.dumps(body).encode() if body is not None else None
+    hdrs = dict(headers or {})
+    if payload:
+        hdrs.setdefault("Content-Type", "application/json")
+    conn.request(method, path, body=payload, headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    out_headers = {k.lower(): v for k, v in resp.getheaders()}
+    conn.close()
+    return resp.status, out_headers, data
+
+
+def test_healthz_metrics_trace_and_request_id(api_cluster):
+    """The observability surface (docs/SERVING.md "Telemetry"):
+
+    - /healthz answers {status, hosted_models, draining} with no
+      ML-process round trip;
+    - every response echoes X-Request-Id (honoring a client-minted one);
+    - a generated request's id resolves at /trace/<rid> with spans from
+      the worker that served it (they rode the GENERATE_RESP home);
+    - /metrics parses as Prometheus text exposition and carries the
+      hosted model's engine counters;
+    - error bodies (the 429/404 family) carry the trace_id.
+    """
+    api = api_cluster.api
+    status, body = _req(api, "GET", "/healthz")
+    assert status == 200
+    assert body["status"] == "ok"
+    assert MODEL in body["hosted_models"]
+    assert body["draining"] is False
+
+    # X-Request-Id: minted when absent, echoed verbatim when supplied
+    status, hdrs, _ = _req_raw(api, "GET", "/healthz")
+    assert status == 200 and hdrs.get("x-request-id")
+    rid = "e2e-trace-0001"
+    status, hdrs, raw = _req_raw(
+        api, "POST", "/v1/generate",
+        {"hf_name": MODEL, "message": "trace me", "max_new_tokens": 6,
+         "do_sample": False},
+        headers={"X-Request-Id": rid},
+    )
+    assert status == 200, raw[:300]
+    assert hdrs.get("x-request-id") == rid
+
+    # the trace stitched: worker-side engine spans (shipped on the
+    # GENERATE_RESP) are queryable under the request id
+    status, body = _req(api, "GET", f"/trace/{rid}")
+    assert status == 200 and body["trace_id"] == rid
+    names = {s["name"] for s in body["spans"]}
+    assert {"queue_wait", "first_token", "decode"} <= names, names
+    sites = {s["site"] for s in body["spans"] if s["name"] == "decode"}
+    assert sites, body["spans"]  # recorded by the serving worker
+    status, _ = _req(api, "GET", "/trace/no-such-trace")
+    assert status == 404
+
+    # /metrics: valid Prometheus exposition with the model's counters
+    from test_metrics import parse_exposition
+
+    status, hdrs, raw = _req_raw(api, "GET", "/metrics")
+    assert status == 200
+    assert hdrs.get("content-type", "").startswith("text/plain")
+    fams = parse_exposition(raw.decode())
+    assert fams["tlink_http_requests_total"]["type"] == "counter"
+    # the hosted model serves remote-mode: its engine snapshot (riding
+    # every GENERATE_RESP) flattens into labeled gauges
+    engine_fams = [f for f in fams if f.startswith("tlink_engine_")]
+    assert engine_fams, sorted(fams)
+    assert any(
+        f'model="{MODEL}"' in s
+        for f in engine_fams for s in fams[f]["samples"]
+    )
+
+    # error bodies carry the trace id (the 429 contract shares this path)
+    status, hdrs, raw = _req_raw(api, "GET", "/no-such-route")
+    assert status == 404
+    err = json.loads(raw)
+    assert err["trace_id"] == hdrs.get("x-request-id")
+
+
 def test_stats_and_node_info(api_cluster):
     api = api_cluster.api
     status, body = _req(api, "GET", "/stats")

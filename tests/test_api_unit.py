@@ -278,6 +278,7 @@ def _make_api(job):
     api = TensorlinkAPI.__new__(TensorlinkAPI)
     api.executor = _Exec()
     api._inflight = 0
+    api._req_ids = {}
     return api
 
 
@@ -334,7 +335,7 @@ def test_n_gt_1_failure_does_not_erode_gate():
         def __init__(self):
             self.hosted = {}
 
-        def generate_api(self, gen, on_delta=None):
+        def generate_api(self, gen, on_delta=None, trace_id=None):
             if not release.wait(10):  # both siblings must be in flight
                 raise TimeoutError("sibling never dispatched")
             if gen.temperature == 0.0:  # marker: this one fails
@@ -347,6 +348,7 @@ def test_n_gt_1_failure_does_not_erode_gate():
     api = TensorlinkAPI.__new__(TensorlinkAPI)
     api.executor = _Exec()
     api._inflight = 0
+    api._req_ids = {}
     api._pool = ThreadPoolExecutor(max_workers=4)
 
     class _Writer:

@@ -57,9 +57,39 @@ def test_bench_all_legs_cpu():
                 "kv_int8_slots", "kv_int8_resident_pages",
                 "migration_resume_ms", "migration_reprefill_resume_ms",
                 "migration_resume_speedup",
+                # trace-derived TTFT decompositions (core/trace.py) on the
+                # serving, sched, and migration legs + the tracing
+                # overhead bound
+                "serving_queue_ms", "serving_prefill_ms",
+                "serving_first_decode_ms", "serving_ttft_trace_ms",
+                "serving_cont_ttft_ms_mean", "serving_trace_overhead_pct",
+                "sched_queue_ms", "sched_prefill_ms",
+                "sched_first_decode_ms", "sched_ttft_trace_ms",
+                "migration_queue_ms", "migration_prefill_ms",
+                "migration_first_decode_ms", "migration_ttft_trace_ms",
                 "train_mfu", "train_step_s",
                 "train_mfu_best_prior", "train_mfu_regressed"):
         assert key in extra, (key, extra)
+    # the TTFT decomposition contract: the engine records queue_wait,
+    # prefill, and first_decode CONTIGUOUSLY, so the parts sum to the
+    # trace's TTFT (exactly, modulo per-part rounding), and the trace
+    # TTFT agrees with the leg's externally measured mean TTFT up to
+    # batcher-dispatch overhead (generous bound: wall-clock CI hosts)
+    for leg in ("serving", "sched", "migration"):
+        q = extra[f"{leg}_queue_ms"]
+        p = extra[f"{leg}_prefill_ms"]
+        f = extra[f"{leg}_first_decode_ms"]
+        total = extra[f"{leg}_ttft_trace_ms"]
+        assert total > 0, (leg, total)
+        assert abs((q + p + f) - total) <= 0.05, (leg, q, p, f, total)
+    mean = extra["serving_cont_ttft_ms_mean"]
+    trace = extra["serving_ttft_trace_ms"]
+    assert abs(trace - mean) <= max(0.6 * mean, 40.0), (trace, mean)
+    # tracing must not slow the serving step: disabled-vs-enabled chunk
+    # cost within 2% (min-of-3 interleaved; negative = host noise)
+    assert extra["serving_trace_overhead_pct"] <= 2.0, (
+        extra["serving_trace_overhead_pct"]
+    )
     # the unified ragged step's seam removal: decode-slot inter-token
     # latency while a co-resident prefill is in flight must be ~flat vs
     # (occupancy-matched) decode-only steady state. Noise-tolerant bound

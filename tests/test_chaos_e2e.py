@@ -524,6 +524,93 @@ def test_drain_migrates_live_slots_zero_dropped_streams(tmp_path):
         _stop_all([user, *workers, validator])
 
 
+@pytest.mark.slow  # full multi-process cluster — CI chaos job coverage
+def test_drain_trace_spans_stitch_across_workers(tmp_path):
+    """THE tracing acceptance pin (docs/SERVING.md "Telemetry"): a
+    request drained mid-decode from worker A to worker B yields ONE
+    trace — queue → prefill → first_token/decode on A, freeze/export on
+    A, stage/adopt and the resumed decode on B — under the trace id the
+    client attached to the GENERATE frame. The spans crossed the real
+    wire: A's rode the migration redirect, B's rode the final
+    GENERATE_RESP."""
+    import threading
+
+    from tensorlink_tpu.core.trace import get_tracer
+    from tensorlink_tpu.ml.module import DistributedModel
+
+    validator, workers, user = _cluster(tmp_path, n_workers=2)
+    try:
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        prompts = [[7, 3, 200], [9, 1, 2, 300]]
+        tids = [f"chaos-trace-{i}" for i in range(2)]
+        n_toks = 56  # must outlive the drain (see the zero-drop test)
+        streams: list[list[int]] = [[], []]
+        results: list[list[int] | None] = [None, None]
+        errors: list[BaseException | None] = [None, None]
+
+        def go(i):
+            try:
+                seqs = model.generate(
+                    [prompts[i]], max_new_tokens=n_toks, continuous=True,
+                    trace_id=tids[i],
+                    stream_cb=lambda toks, i=i: streams[i].extend(
+                        t for t in toks if t is not None
+                    ),
+                )
+                results[i] = seqs[0]
+            except BaseException as e:
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=go, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        assert _wait_tokens(streams, 2), "streams never reached steady decode"
+        summary = validator.send_request(
+            "drain_worker",
+            {"worker": workers[0].node_id, "dest": workers[1].node_id},
+            timeout=120.0,
+        )
+        for t in threads:
+            t.join(120)
+        assert errors == [None, None], errors
+        assert summary.get("ok"), summary
+        assert summary["migrated"] >= 1, summary
+        # bit-identity is the zero-drop test's pin; here the teeth are
+        # the stitched trace: at least one page-shipped stream shows the
+        # FULL cross-worker ladder under its one trace id
+        wid_a, wid_b = workers[0].node_id, workers[1].node_id
+        stitched = 0
+        for tid in tids:
+            by_site: dict[str, set] = {}
+            for s in get_tracer().collect(tid):
+                by_site.setdefault(s["site"], set()).add(s["name"])
+            a = by_site.get(wid_a, set())
+            b = by_site.get(wid_b, set())
+            # every stream at least moved: source spans + a resume on B
+            assert {"queue_wait", "prefill", "first_token"} <= a, (tid, a)
+            assert "decode" in b, (tid, by_site)
+            if {"freeze", "export", "migrate_commit"} <= a \
+                    and {"stage", "adopt"} <= b:
+                stitched += 1
+        assert stitched >= 1, "no trace carried the page-ship ladder"
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
 @pytest.mark.slow  # see above — CI chaos job coverage
 def test_migrate_frames_duplicated_staging_is_idempotent(tmp_path):
     """Every MIGRATE frame out of the source's net process is sent TWICE
