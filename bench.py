@@ -1604,6 +1604,136 @@ def run_bench() -> None:
                     "tokens_per_verify_pass"
                 ),
             })
+            # (c) CONTINUOUS speculative decoding (draft/verify as ragged
+            # slots, engine/continuous.py + docs/SERVING.md): an
+            # occupancy-matched decode FLOOD on the same trained model,
+            # spec on vs off, both warmed, identical seeds/budgets — the
+            # serving-shaped version of the demo above. Then the
+            # ADVERSARIAL workload: a repetitive-but-unlearned prompt
+            # whose drafts keep hitting and keep being rejected — the
+            # acceptance-rate kill switch must fire and cap the loss at
+            # the probe window.
+            from tensorlink_tpu.engine.continuous import (
+                ContinuousEngine as _SCE,
+            )
+
+            SP_SLOTS = 4
+            sp_chunk, sp_budget = 2, 48
+            sp_prompts = [
+                stream[o : o + 64].tolist() for o in (0, 4, 8, 12)
+            ]
+
+            def spec_leg(spec_on, prompts_set, budget, trace_prefix=None,
+                         engine=None):
+                ce = _SCE(
+                    engine or seng, max_slots=SP_SLOTS, page_size=16,
+                    chunk_steps=sp_chunk, prefill_chunk=32,
+                    prefix_cache=False,  # measure decode, not prefix hits
+                    spec_decode=spec_on, spec_draft=8,
+                )
+                try:
+                    w = ce.submit(prompts_set[0], max_new_tokens=4,
+                                  seed=0, speculative=spec_on)
+                    ce.run_until_idle()  # warm: the leg never times a compile
+                    assert w.finished
+                    reqs = [
+                        ce.submit(
+                            p, max_new_tokens=budget, seed=100 + i,
+                            speculative=spec_on,
+                            trace_id=(f"{trace_prefix}{i}"
+                                      if trace_prefix else None),
+                        )
+                        for i, p in enumerate(prompts_set)
+                    ]
+                    t0 = time.perf_counter()
+                    ce.run_until_idle()
+                    dt = max(time.perf_counter() - t0, 1e-9)
+                    assert all(r.finished for r in reqs)
+                    ce.check_page_conservation()
+                    snap = ce.serving_snapshot()
+                finally:
+                    ce.close()
+                total = sum(len(r.tokens) for r in reqs)
+                return total / dt, snap, [r.tokens for r in reqs]
+
+            plain_tps, _s0, plain_toks = spec_leg(
+                False, sp_prompts, sp_budget
+            )
+            spec_tps, spec_snap, spec_toks = spec_leg(
+                True, sp_prompts, sp_budget, trace_prefix="bench-spec-"
+            )
+            sp_decomp = trace_decomp(
+                [f"bench-spec-{i}" for i in range(SP_SLOTS)]
+            ) or {}
+            # adversarial: repetitive prompts on an UNTRAINED model of
+            # the SAME config (same compiled programs — params are data):
+            # prompt-lookup drafts confidently from the repetition, but
+            # the model's continuation has nothing to do with it, so
+            # every pass rejects and the acceptance-rate kill switch
+            # must cap the loss after its probe window. (The trained
+            # model is useless here: 60 steps on a periodic stream teach
+            # it period-16 INDUCTION generally, so any repetitive prompt
+            # genuinely accepts — measured 9.0 tokens/pass on held-out
+            # patterns, which is a win, not an adversary.)
+            ueng = GenerationEngine(
+                scfg, init_params(scfg, jax.random.PRNGKey(99)),
+                seq_buckets=(64,), batch_buckets=(1,), max_seq_len=256,
+            )
+            adv_rng = np.random.default_rng(23)
+            adv_pat = adv_rng.integers(1, 256, 16)
+            adv_prompts = [
+                np.tile(np.roll(adv_pat, i), 4).tolist()
+                for i in range(SP_SLOTS)
+            ]
+            adv_plain_tps, _s1, adv_plain = spec_leg(
+                False, adv_prompts, sp_budget, engine=ueng
+            )
+            adv_spec_tps, adv_snap, adv_spec = spec_leg(
+                True, adv_prompts, sp_budget, engine=ueng
+            )
+            del ueng
+            spec_extra.update({
+                "spec_plain_toks_s": round(plain_tps, 1),
+                "spec_decode_toks_s": round(spec_tps, 1),
+                "spec_decode_speedup": round(
+                    spec_tps / max(plain_tps, 1e-9), 2
+                ),
+                "spec_tokens_per_pass": spec_snap["spec_tokens_per_pass"],
+                "spec_drafted": int(spec_snap["spec_drafted"]),
+                "spec_accepted": int(spec_snap["spec_accepted"]),
+                # the bit-identity contract, asserted where it's cheap:
+                # speculation never moves a token, repetitive or not
+                "spec_streams_exact": spec_toks == plain_toks
+                and adv_spec == adv_plain,
+                "spec_adversarial_speedup": round(
+                    adv_spec_tps / max(adv_plain_tps, 1e-9), 2
+                ),
+                "spec_adversarial_killed": int(adv_snap["spec_killed"]),
+                "spec_adversarial_tokens_per_pass": adv_snap[
+                    "spec_tokens_per_pass"
+                ],
+                **{f"spec_{k}": v for k, v in sp_decomp.items()},
+                **(
+                    {}
+                    if on_tpu
+                    else {
+                        "spec_cont_note": (
+                            "CPU fallback: the speedup is real but its "
+                            "mechanism here is pass amortization (fewer "
+                            "compiled dispatches + host trips per token "
+                            "at toy shapes); on TPU the same "
+                            "tokens-per-verify-pass multiplies the "
+                            "bandwidth-bound decode regime where a "
+                            "k-row verify streams the weights once — "
+                            "the claim BENCH_r05 measured at 1.57x with "
+                            "a trained drafter. The deterministic pins "
+                            "(bit-identical streams, kill-switch "
+                            "cap, one compiled program) live in "
+                            "tests/test_continuous.py."
+                        )
+                    }
+                ),
+            })
             del seng, sparams, sstate
         except Exception as e:
             spec_extra["lookahead_error"] = str(e)[:300]

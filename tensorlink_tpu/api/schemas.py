@@ -47,6 +47,14 @@ class GenerationRequest:
     # engine/generate.py::generate_lookahead). Emits exactly the vanilla
     # greedy tokens, so honoring it is always safe; ignored when sampling.
     lookahead: bool = False
+    # opt-in CONTINUOUS speculative decoding (engine/continuous.py,
+    # docs/SERVING.md "Speculative decoding"): the request's decode slot
+    # packs prompt-lookup drafts as extra ragged rows and the one
+    # compiled step verifies them in-program — works under any sampling,
+    # emits the bit-identical stream either way, and is a no-op unless
+    # the hosting replica runs MLConfig.spec_decode (see /healthz
+    # serving_modes). A pure speed hint, like lookahead.
+    speculative: bool = False
     # beam search width (the reference forwards num_beams to HF generate,
     # ml/formatter.py:88-92; here engine/generate.py::generate_beam on
     # whole-model jobs and ml/module.py::_generate_beam_pipelined on
@@ -120,6 +128,7 @@ class GenerationRequest:
                 output_format=str(d.get("output_format", "simple")),
                 enable_thinking=bool(d.get("enable_thinking", False)),
                 lookahead=bool(d.get("lookahead", False)),
+                speculative=bool(d.get("speculative", False)),
                 num_beams=int(d.get("num_beams", 1)),
                 stop=cls._parse_stop(d.get("stop")),
                 priority=cls._parse_priority(d.get("priority")),
@@ -174,6 +183,8 @@ class ChatCompletionRequest:
     top_p: float = 0.95
     stream: bool = False
     lookahead: bool = False  # speculative decode hint (greedy only)
+    # continuous draft/verify hint (see GenerationRequest.speculative)
+    speculative: bool = False
     stop: list[str] = field(default_factory=list)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
@@ -202,6 +213,7 @@ class ChatCompletionRequest:
                 top_p=float(d.get("top_p", 0.95)),
                 stream=bool(d.get("stream", False)),
                 lookahead=bool(d.get("lookahead", False)),
+                speculative=bool(d.get("speculative", False)),
                 stop=GenerationRequest._parse_stop(d.get("stop")),
                 presence_penalty=float(d.get("presence_penalty", 0.0)),
                 frequency_penalty=float(d.get("frequency_penalty", 0.0)),
@@ -240,6 +252,7 @@ class ChatCompletionRequest:
             stream=self.stream,
             output_format="openai",
             lookahead=self.lookahead,
+            speculative=self.speculative,
             stop=self.stop,
             presence_penalty=self.presence_penalty,
             frequency_penalty=self.frequency_penalty,

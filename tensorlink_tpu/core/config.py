@@ -121,6 +121,23 @@ class MLConfig:
     # unaffected; ring.quantized_psum/quantized_all_gather are the
     # building blocks for explicit shard_map paths.
     collective_quant: bool = False
+    # speculative decoding inside the unified ragged step (engine/
+    # continuous.py, docs/SERVING.md "Speculative decoding"): an opted-in
+    # request ({"speculative": true}) packs a host-drafted prompt-lookup
+    # block as extra valid rows of its decode slot and the one compiled
+    # step verifies all of them in-program — multi-token decode per pass
+    # on repetitive/extractive text, bit-identical streams always, with
+    # a per-request acceptance-rate kill switch so a bad draft mix can
+    # never make it a slowdown. Default off for one release (flip after
+    # the bench trajectory confirms the win on real hardware).
+    spec_decode: bool = False
+    # max draft tokens per verify pass (extra ragged rows per
+    # speculating slot; capped by prefill_chunk - 1)
+    spec_draft: int = 8
+    # optional TOTAL draft tokens per step shared round-robin-fair
+    # across speculating slots (0 = each gets a full draft) — bounds the
+    # extra verify compute per step like prefill_budget bounds prefill
+    spec_budget: int = 0
     # -- SLO-aware request scheduling (engine/scheduler.py) --------------
     # priority class a request gets when the API body carries none:
     # "interactive" | "batch" | "best_effort". Classes order admission

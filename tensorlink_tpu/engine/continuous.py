@@ -75,6 +75,7 @@ from .paged import (
     scatter_page,
 )
 from .sampling import SamplingParams, sample
+from .spec import SpecController
 from .scheduler import (
     DEFAULT_PRIORITY,
     RequestScheduler,
@@ -199,6 +200,16 @@ _ENGINE_COUNTERS = (
      "streams redirected down the re-prefill rung"),
     ("migrations_adopted", "tlink_engine_migrations_adopted_total",
      "staged migrations adopted into a slot (destination side)"),
+    # speculative decoding (docs/SERVING.md "Speculative decoding"):
+    # draft tokens packed as extra ragged rows and verified in-program
+    ("spec_drafted", "tlink_engine_spec_drafted_total",
+     "draft tokens packed for in-program verification"),
+    ("spec_accepted", "tlink_engine_spec_accepted_total",
+     "draft tokens accepted by in-program verification"),
+    ("spec_verify_passes", "tlink_engine_spec_verify_passes_total",
+     "verify passes executed (one per speculating slot per step)"),
+    ("spec_killed", "tlink_engine_spec_killed_total",
+     "requests whose acceptance-rate kill switch fired"),
 )
 
 
@@ -250,6 +261,15 @@ class ContinuousRequest:
     # the engine skips every span-recording call for this request)
     trace_id: str = ""
     prefill_done_t: float = 0.0  # when the slot left the prefilling set
+    # -- speculative decoding (engine/spec.py, docs/SERVING.md) ----------
+    # the request opted in ({"speculative": true}); only effective on an
+    # engine with MLConfig.spec_decode enabled
+    speculative: bool = False
+    # per-request drafting state machine (created lazily at the first
+    # decode pack; survives preemption/requeue so the permanent kill
+    # switch never re-probes; NOT shipped by migration — a migrated
+    # stream re-probes fresh at the destination)
+    spec_state: object = None
 
 
 class ContinuousEngine:
@@ -271,6 +291,9 @@ class ContinuousEngine:
         prefix_cache: bool = True,
         kv_quant: str = "none",
         prefill_budget: int = 0,
+        spec_decode: bool = False,
+        spec_draft: int = 8,
+        spec_budget: int = 0,
         sched_queue_cap: int = 64,
         sched_aging_ticks: int = 32,
         sched_preemption: bool = True,
@@ -327,6 +350,22 @@ class ContinuousEngine:
         # ragged (follows n_valid), trading admission latency for an even
         # tighter inter-token bound
         self.prefill_budget = int(prefill_budget)
+        # -- speculative decoding (docs/SERVING.md) ----------------------
+        # spec_width is the step program's STATIC verify-row count: ONE
+        # compiled ragged_step per engine whether speculation is on or
+        # off (per-slot draft lengths are data — spec/non-spec request
+        # mixes never recompile). Draft rows ride the packed block's
+        # columns, so the width caps at the chunk row (prefill_chunk).
+        self.spec_decode = bool(spec_decode)
+        self.spec_draft = max(0, min(int(spec_draft), self.prefill_chunk - 1))
+        self.spec_width = 1 + (self.spec_draft if self.spec_decode else 0)
+        # optional TOTAL draft tokens per step shared across speculating
+        # slots (0 = each gets a full draft): bounds the extra verify
+        # compute like prefill_budget bounds prefill compute — and since
+        # draft rows live in DECODE slots' rows, drafting can never eat
+        # a co-resident prefill's grant either way
+        self.spec_budget = int(spec_budget)
+        self._spec_phase = 0  # round-robin origin for a draft budget
         self._prefilling: dict[int, ContinuousRequest] = {}
         # -- live slot migration (docs/FAILURE_MODEL.md) -----------------
         # slots frozen for export: excluded from stepping, their pages
@@ -379,6 +418,14 @@ class ContinuousEngine:
             "tlink_engine_pages_in_transit",
             "pages held by in-flight migrations (either side)",
             fn=lambda: self._pages_in_transit(),
+        )
+        # throughput-mode discovery for operators/routers: which modes a
+        # replica actually runs rides /metrics (and /healthz) alongside
+        # kv_quant — see ml/validator.py::health_snapshot
+        self.metrics.gauge(
+            "tlink_engine_spec_decode",
+            "1 when speculative decoding is enabled on this engine",
+            fn=lambda: int(self.spec_decode),
         )
         self.sched = RequestScheduler(  #: guarded by self._lock
             max_slots=self.max_slots,
@@ -444,6 +491,7 @@ class ContinuousEngine:
         on_finish: Callable[[ContinuousRequest], None] | None = None,
         adopt: str | None = None,
         trace_id: str | None = None,
+        speculative: bool = False,
     ) -> ContinuousRequest:
         """Queue a request; the scheduler decides when (and at whose
         expense) it joins the slot batch. ``start_step`` > 0 resumes a
@@ -455,7 +503,10 @@ class ContinuousEngine:
         forever — the API layer's 429 backstop. ``adopt`` names a staged
         migration ticket (:meth:`stage_migration`): admission binds the
         shipped KV pages instead of prefilling, falling back to the
-        normal (re-)prefill path when the ticket is missing or stale."""
+        normal (re-)prefill path when the ticket is missing or stale.
+        ``speculative`` opts the request into draft/verify decoding when
+        the engine runs with ``spec_decode`` on (a pure speed hint: the
+        emitted stream is bit-identical either way)."""
         req = ContinuousRequest(
             rid=next(self._rid),
             prompt=[int(t) for t in prompt],
@@ -471,6 +522,7 @@ class ContinuousEngine:
             on_finish=on_finish,
             adopt=adopt,
             trace_id=str(trace_id or ""),
+            speculative=bool(speculative) and self.spec_decode,
         )
         req.submit_t = time.monotonic()
         overload: SchedulerOverloaded | None = None
@@ -870,6 +922,17 @@ class ContinuousEngine:
                 dur_s=(time.monotonic() - base) if base else None,
                 tokens=len(req.tokens),
             )
+            st = req.spec_state
+            if st is not None and st.verify_passes:
+                # verify-pass amortization, attributed per request: how
+                # much the draft/verify path multiplied this stream's
+                # decode (tokens_per_pass 1.0 = speculation never paid)
+                self._trace(
+                    req, "spec", drafted=st.drafted, accepted=st.accepted,
+                    passes=st.verify_passes,
+                    tokens_per_pass=round(st.tokens_per_pass or 0.0, 3),
+                    killed=st.dead,
+                )
             if req.admit_t:
                 # under the lock like every other scheduler touch: the
                 # service EWMA this updates is read concurrently by
@@ -1370,11 +1433,19 @@ class ContinuousEngine:
         page_bytes = (c.k.nbytes + c.v.nbytes) // c.n_pages
         if c.quantized:
             page_bytes += (c.k_scale.nbytes + c.v_scale.nbytes) // c.n_pages
+        # speculative decoding: enablement + the aggregate amortization
+        # (tokens emitted per verify pass across every speculating slot;
+        # 0.0 until the first verify pass ran)
+        passes = out.get("spec_verify_passes", 0)
         out.update({
             "kv_quant": self.kv_quant,
             "kv_pages_total": c.n_pages - 1,
             "kv_pages_free": self.alloc.n_free,
             "kv_page_bytes": int(page_bytes),
+            "spec_decode": self.spec_decode,
+            "spec_tokens_per_pass": round(
+                (out.get("spec_accepted", 0) + passes) / passes, 3
+            ) if passes else 0.0,
             # live migration telemetry (migrations_* counters ride
             # self.stats above): drain fence state + pages currently held
             # by an in-flight migration on either side
@@ -1536,7 +1607,71 @@ class ContinuousEngine:
                 remaining[s] = req.budget - len(req.tokens)
                 ids = sorted(req.eos)[: self._EOS_WIDTH]
                 eos_arr[s, : len(ids)] = ids
-        return blk, starts, n_valid, emit, remaining, eos_arr, completing, grants
+        n_spec = self._pack_drafts(blk, n_valid, remaining)
+        return (blk, starts, n_valid, n_spec, emit, remaining, eos_arr,
+                completing, grants)
+
+    # tlint: hot-path
+    def _pack_drafts(self, blk, n_valid, remaining):
+        """Draft-budget packing, the speculative half of the packed
+        block: each opted-in DECODING slot proposes a prompt-lookup draft
+        (engine/spec.py — host-side, zero model cost) and packs it as
+        extra valid rows after its current token; the unified step
+        verifies all of them in-program. Grants ride the same
+        round-robin fairness helper as prefill budgets
+        (:func:`pack_prefill_budgets` under ``spec_budget``) — and
+        because draft rows live in decode slots' OWN rows, speculation
+        never shrinks a co-resident prefill's grant regardless of
+        budget. Returns the per-slot draft counts ``n_spec`` (mutating
+        ``blk``/``n_valid`` in place for granted drafts)."""
+        S = self.max_slots
+        n_spec = np.zeros(S, np.int32)
+        if self.spec_width <= 1:
+            return n_spec
+        cands: list[tuple[int, list[int]]] = []
+        for s in range(S):
+            req = self._slots[s]
+            if req is None or not self._active[s] or not req.speculative:
+                continue
+            if req.spec_state is None:
+                # lazy arming: prescan the history once (prompt + any
+                # recovered/pre-preempt tokens); the controller then
+                # lives with the REQUEST, so preemption/requeue keeps
+                # the permanent kill switch — it never re-probes
+                req.spec_state = SpecController(self.spec_draft, rearm=True)
+                req.spec_state.prescan(req.prompt + req.tokens)
+            ctl = req.spec_state
+            if not ctl.active:
+                continue
+            # cap: the draft must fit the block row, the budget (at most
+            # remaining tokens can emit this pass, k drafts + 1 bonus),
+            # and the slot's allocated pages (budget implies allocation)
+            cap = min(self.spec_draft, int(remaining[s]) - 1)
+            if cap < 1:
+                continue
+            draft = ctl.draft(req.prompt + req.tokens, cap=cap)
+            if draft:
+                cands.append((s, draft))
+        if not cands:
+            return n_spec
+        grants = pack_prefill_budgets(
+            [len(d) for _, d in cands], self.spec_draft,
+            self.spec_budget if self.spec_budget > 0 else None,
+            phase=self._spec_phase,
+        )
+        self._spec_phase += 1
+        for (s, draft), g in zip(cands, grants):
+            if g <= 0:
+                continue
+            d = draft[:g]
+            blk[s, 1 : 1 + len(d)] = d
+            n_valid[s] = 1 + len(d)
+            n_spec[s] = len(d)
+            # credit the GRANTED length, not the proposal — the trace
+            # span's per-request drafted count must match what the
+            # engine's spec_drafted counter saw under a draft budget
+            self._slots[s].spec_state.drafted += len(d)
+        return n_spec
 
     # tlint: hot-path
     def step_chunk(self, *, admit_only: bool = False) -> bool:
@@ -1561,23 +1696,26 @@ class ContinuousEngine:
         pack = self._pack_ragged()
         if pack is None:
             return self.has_work()
-        blk, starts, n_valid, emit, remaining, eos_arr, completing, \
-            grants = pack
+        blk, starts, n_valid, n_spec, emit, remaining, eos_arr, \
+            completing, grants = pack
         t_chunk = time.monotonic()
-        tokens, n_exec, self.cache, _done, _steps_dev, self._counts, \
-            _rem = paged_ragged_step(
+        tokens, n_tok, spec_m, n_exec, self.cache, _done, _steps_dev, \
+            self._counts, _rem = paged_ragged_step(
                 self.engine.params, jnp.asarray(blk), self.cache,
                 jnp.asarray(starts), jnp.asarray(n_valid),
-                jnp.asarray(emit),
+                jnp.asarray(n_spec), jnp.asarray(emit),
                 jnp.asarray(self._seeds), jnp.asarray(self._steps),
                 jnp.asarray(self._temp), jnp.asarray(self._topk),
                 jnp.asarray(self._topp), jnp.asarray(self._pres),
                 jnp.asarray(self._freq), self._counts,
                 jnp.asarray(remaining), jnp.asarray(eos_arr),
-                self.cfg, self.chunk_steps, self.use_kernel,
+                self.cfg, self.chunk_steps, self.spec_width,
+                self.use_kernel,
             )
         n_exec = int(n_exec)
-        toks_host = np.asarray(tokens)[:, :n_exec]
+        toks_host = np.asarray(tokens)
+        n_tok_host = np.asarray(n_tok)
+        spec_m_host = np.asarray(spec_m)
         # the chunk's host-visible wall time — measured at the ONE
         # existing boundary sync (the asarray drain above), so span
         # recording adds no device round trips of its own
@@ -1615,10 +1753,28 @@ class ContinuousEngine:
             if not deliver[s]:
                 continue
             req = self._slots[s]
+            if n_spec[s] > 0 and req.spec_state is not None:
+                # verify-pass accounting feeds the per-request kill
+                # switch (engine/spec.py): spec_m is the pass's emitted
+                # count — accepted drafts + the one bonus/correction
+                m = int(spec_m_host[s])
+                self._count("spec_drafted", int(n_spec[s]))
+                self._count("spec_accepted", max(m - 1, 0))
+                self._count("spec_verify_passes")
+                if req.spec_state.note_verify(m):
+                    self._count("spec_killed")
             finished = False
             emitted = 0
-            for i in range(n_exec):
+            for i in range(int(n_tok_host[s])):
                 tok = int(toks_host[s, i])
+                if req.spec_state is not None:
+                    # keep the re-arm pair set current (a stream whose
+                    # text turns repetitive re-arms on the first
+                    # recurring pair — unless the kill switch fired)
+                    prev = req.tokens[-1] if req.tokens else (
+                        req.prompt[-1] if req.prompt else tok
+                    )
+                    req.spec_state.note_pair(prev, tok)
                 self._tok[s] = tok
                 emitted += 1
                 if self._emit(req, tok):
@@ -1640,6 +1796,7 @@ class ContinuousEngine:
             prefilling=len(self._prefilling),
             decode_steps=n_exec if bool(emit.any()) else 0,
             prefill_granted=int(sum(grants.values())),
+            spec_drafted=int(n_spec.sum()),
             tokens_emitted=delivered_total,
             pages_free=self.alloc.n_free,
             pages_in_transit=self._pages_in_transit(),

@@ -1019,42 +1019,30 @@ class GenerationEngine:
         return self.beam_finish(st)
 
     # -- speculative decode (prompt-lookup) -------------------------------
+    # The drafting + acceptance policy lives in engine/spec.py — ONE
+    # implementation shared with the continuous engine's ragged verify
+    # slots, so the two paths cannot drift. These staticmethods remain
+    # the engine-level override points (tests patch them).
     @staticmethod
     def _lookup_draft(
         history: list[int], n_draft: int, ngram: int = 8, min_ngram: int = 2,
     ) -> list[int]:
-        """Prompt-lookup drafting: if the trailing n-gram occurred earlier
-        in the token history, propose the tokens that followed it. Free —
-        no draft model; strong on repetitive/extractive text.
+        """Prompt-lookup drafting (see engine/spec.py::lookup_draft):
+        if the trailing n-gram occurred earlier in the token history,
+        propose the tokens that followed it — free, no draft model."""
+        from .spec import lookup_draft
 
-        Longest suffix first: an 8-gram match predicts the continuation
-        far better than a 1-gram, and on the fixed-shape verify pass a
-        longer draft costs nothing extra — so precision is the only lever.
-        ``min_ngram=2`` refuses single-token matches outright: "the
-        occurred before" is noise, and every wrong draft still consumes a
-        (padded) verify pass where a plain decode step would have done."""
-        lim = 4096  # bound the backward scan on very long histories
-        lo = max(0, len(history) - lim)
-        for n in range(min(ngram, len(history) - 1), min_ngram - 1, -1):
-            tail = history[-n:]
-            # most recent earlier occurrence
-            for start in range(len(history) - n - 1, lo - 1, -1):
-                if history[start : start + n] == tail:
-                    nxt = history[start + n : start + n + n_draft]
-                    if nxt:
-                        return nxt
-                    break
-        return []
+        return lookup_draft(history, n_draft, ngram=ngram, min_ngram=min_ngram)
 
     @staticmethod
     def _spec_worthwhile(tokens_per_pass: float, t_verify: float,
                          t_decode: float) -> bool:
         """Speculation continues only while its measured throughput beats
-        vanilla: tokens_per_pass/t_verify vs 1/t_decode. Pure so the
-        break-even rule is unit-testable without wall-clock flakiness."""
-        if t_verify <= 0 or t_decode <= 0:
-            return True  # no signal yet
-        return tokens_per_pass / t_verify >= 1.0 / t_decode
+        vanilla (engine/spec.py::spec_worthwhile). Pure so the break-even
+        rule is unit-testable without wall-clock flakiness."""
+        from .spec import spec_worthwhile
+
+        return spec_worthwhile(tokens_per_pass, t_verify, t_decode)
 
     def generate_lookahead(
         self,
@@ -1083,6 +1071,8 @@ class GenerationEngine:
         only) the fully-compiled ``_decode_loop``, so a losing speculation
         costs a few early passes and then decodes at the engine's best
         rate."""
+        from .spec import SpecController
+
         prompts = [list(p) for p in prompts]
         if len(prompts) != 1:
             raise ValueError("lookahead decode is B=1 (serving conversations)")
@@ -1094,7 +1084,6 @@ class GenerationEngine:
         n_passes = 1  # the prefill pass produced the first token
         n_verify = 0
         n_decode = 0
-        accepted_total = 0
         eos_set = set(int(e) for e in eos_ids)
         history = list(prompts[0])
         tok = int(np.asarray(logits)[0].argmax())
@@ -1109,68 +1098,33 @@ class GenerationEngine:
         # device); None until the program kind has a post-compile sample
         ema_tv: float | None = None
         ema_td: float | None = None
-        # acceptance is EMA'd like the timings it is compared against — a
-        # cumulative average would let an early high-acceptance phase mask
-        # a later losing one past any budget
-        ema_acc: float | None = None
         seen_tv = seen_td = 0
-        spec_on = True
-        # measured-loss disables are PERMANENT for the request: the
-        # pair-recurrence re-arm below only answers "is there anything to
-        # draft from", not "is drafting paying off" — re-arming after the
-        # break-even rule said no would reinstate the slowdown it stopped
-        spec_dead = False
-        _EMA = 0.5
-        # acceptance-rate kill switch (VERDICT r5: the bench measured a
-        # lookahead-enabled run at 0.56x plain throughput while the README
-        # claimed "never a slowdown"): the timing rule above needs several
-        # post-compile samples of BOTH program kinds before it can arm —
-        # on a request whose drafts keep hitting but not matching, that
-        # can take long enough to lose real wall clock. A verify pass that
-        # emits fewer than _MIN_TOKENS_PER_PASS tokens on average cannot
-        # beat plain decode even if the padded pass were free, so after
-        # _ACC_PROBE verify passes a measured acceptance that low disables
-        # speculation permanently — no timing signal required.
-        _ACC_PROBE = 4
-        _MIN_TOKENS_PER_PASS = 1.5
-        # a long run of draft MISSES never produces a verify sample for the
-        # timing rule, yet means the text isn't repetitive — stop looking
-        # (and, non-stream, hand the remainder to the compiled loop)
-        miss_run = 0
-        _MISS_OFF = 8
-        # prompt prescan: prompt-lookup can only ever draft from a
-        # RECURRING n-gram, so a prompt with zero repeated adjacent pairs
-        # starts with speculation off — a non-stream request then rides the
-        # compiled loop from its first token instead of paying _MISS_OFF
-        # host steps to learn what the prompt already told us. The pair set
-        # keeps growing as tokens emit: a STREAM request whose generated
-        # text turns repetitive re-arms speculation on the first recurring
-        # pair (non-stream never needs to — its compiled tail is already
-        # the fastest remainder).
-        pairs: set[tuple[int, int]] = set()
-        rep_pair = False
-        for a, b in zip(history, history[1:]):
-            if (a, b) in pairs:
-                rep_pair = True
-            else:
-                pairs.add((a, b))
-        if not rep_pair:
-            spec_on = False
+        # the shared drafting/acceptance policy (engine/spec.py): prompt
+        # prescan (a prompt with zero recurring adjacent pairs starts with
+        # speculation off — a non-stream request then rides the compiled
+        # tail from its first token), miss-run disarm, pair-recurrence
+        # re-arm (STREAM requests only: a non-stream request's compiled
+        # tail is already the fastest remainder), and the acceptance-rate
+        # kill switch (VERDICT r5: a verify pass emitting < 1.5 tokens on
+        # average cannot beat plain decode even if the padded pass were
+        # free — after the probe window that measured acceptance disables
+        # speculation PERMANENTLY, no timing signal required; the timing
+        # break-even rule below also kills permanently, since re-arming
+        # after a measured loss would reinstate the slowdown it stopped).
+        # draft_fn = the engine staticmethod, the test-patchable override.
+        ctrl = SpecController(
+            n_draft=n_draft, rearm=stream_cb is not None,
+            draft_fn=self._lookup_draft,
+        )
+        ctrl.prescan(history)
 
         def note_pair() -> None:
-            nonlocal spec_on, miss_run
-            pr = (history[-2], history[-1])
-            if pr in pairs:
-                if not spec_on and not spec_dead and stream_cb is not None:
-                    spec_on = True  # generated text became repetitive
-                    miss_run = 0
-            else:
-                pairs.add(pr)
+            ctrl.note_pair(history[-2], history[-1])
 
         compiled_tail = 0
         while len(seq) < limit and tok not in eos_set:
             remaining = limit - len(seq)
-            if not spec_on and compiled_fallback and stream_cb is None:
+            if not ctrl.on and compiled_fallback and stream_cb is None:
                 # speculation measured itself out — decode the remainder in
                 # ONE on-device while_loop (the same program the serving
                 # warmup compiles) instead of a host round-trip per token
@@ -1202,15 +1156,14 @@ class GenerationEngine:
                         break
                 break
             k = min(n_draft, remaining - 1, self.max_seq_len - lens[0] - len(seq))
-            draft = (
-                self._lookup_draft(history, k) if (spec_on and k > 0) else []
-            )
+            was_on = ctrl.active
+            draft = ctrl.draft(history, cap=k) if k > 0 else []
+            ctrl.drafted += len(draft)  # no budget here: granted = proposed
             if not draft:
-                if spec_on:
-                    miss_run += 1
-                    if miss_run >= _MISS_OFF:
-                        spec_on = False
-                        continue  # non-stream: compiled tail picks it up
+                if was_on and not ctrl.on:
+                    # the miss-run disarm just fired (engine/spec.py):
+                    # non-stream hands the remainder to the compiled tail
+                    continue
                 # no hit (or speculation disabled): one plain decode step —
                 # cheaper than a padded verify pass, and its timing seeds
                 # the vanilla side of the break-even rule
@@ -1223,7 +1176,7 @@ class GenerationEngine:
                 seen_td += 1
                 if seen_td > 1:  # first call includes the XLA compile
                     ema_td = dt if ema_td is None else (
-                        _EMA * dt + (1 - _EMA) * ema_td
+                        0.5 * dt + 0.5 * ema_td
                     )
                 n_passes += 1
                 n_decode += 1
@@ -1246,7 +1199,6 @@ class GenerationEngine:
             toks = np.zeros((B, 1 + pad_to), np.int32)
             toks[0, 0] = tok
             toks[0, 1 : 1 + len(draft)] = draft
-            miss_run = 0
             t0 = _time.perf_counter()
             targets, cache = _verify_step(
                 self.params, jnp.asarray(toks), cache, self.cfg
@@ -1260,28 +1212,21 @@ class GenerationEngine:
                 if draft[accepted] in eos_set:
                     break
                 accepted += 1
-            accepted_total += accepted
             emitted = list(draft[:accepted]) + [int(t_host[accepted])]
-            per_pass = accepted + 1
-            ema_acc = per_pass if ema_acc is None else (
-                _EMA * per_pass + (1 - _EMA) * ema_acc
-            )
+            # shared acceptance accounting + the permanent kill switch
+            # (engine/spec.py — same rule, same constants as the ragged
+            # path, so the two implementations cannot drift)
+            ctrl.note_verify(accepted + 1)
             seen_tv += 1
             if seen_tv > 1:  # first call includes the XLA compile
                 ema_tv = dt if ema_tv is None else (
-                    _EMA * dt + (1 - _EMA) * ema_tv
+                    0.5 * dt + 0.5 * ema_tv
                 )
-                if ema_td is not None and seen_tv > 3 and not spec_dead:
-                    spec_on = self._spec_worthwhile(ema_acc, ema_tv, ema_td)
-                    if not spec_on:
-                        spec_dead = True
-            if (
-                not spec_dead and seen_tv >= _ACC_PROBE
-                and ema_acc < _MIN_TOKENS_PER_PASS
-            ):
-                # measured acceptance alone says drafting is a loss
-                spec_on = False
-                spec_dead = True
+                if ema_td is not None and seen_tv > 3 and not ctrl.dead:
+                    # the measured break-even rule: a losing speculation
+                    # kills permanently, like the acceptance rule
+                    if not self._spec_worthwhile(ctrl.ema_acc, ema_tv, ema_td):
+                        ctrl.kill()
             # roll back rejected cache positions by resetting length only
             new_len = base_len + 1 + accepted
             cache = KVCache(
@@ -1313,10 +1258,9 @@ class GenerationEngine:
             "verify_passes": n_verify,
             "decode_steps": n_decode,
             "tokens_per_pass": round(len(seq) / max(n_passes, 1), 3),
-            "tokens_per_verify_pass": round(
-                (accepted_total + n_verify) / n_verify, 3
-            ) if n_verify else None,
-            "spec_disabled": not spec_on,
+            "tokens_per_verify_pass": round(ctrl.tokens_per_pass, 3)
+            if n_verify else None,
+            "spec_disabled": not ctrl.on,
             "compiled_tail": compiled_tail,
         }
         fin = bool(seq and seq[-1] in eos_set)

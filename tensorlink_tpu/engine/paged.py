@@ -600,13 +600,19 @@ def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
     its length stops advancing, it re-feeds its own token, and its
     per-slot key index stops — so the emitted stream is BIT-IDENTICAL to
     stepping one token at a time, which is what keeps the
-    solo/co-batched/recovery parity contract intact."""
+    solo/co-batched/recovery parity contract intact. Tokens land at each
+    slot's OWN column cursor (``col``): a speculating slot's verify pass
+    may have emitted several tokens in the ragged block, so the
+    continuation appends after them instead of at a shared step index
+    (frozen slots re-write their token at a column the host never reads —
+    delivery stops at the per-slot token count)."""
     from .continuous import _row_keys, _sample_rows
 
     S = seeds.shape[0]
+    rows = jnp.arange(S)
 
     def body(st):
-        i, tok, cache, done, steps, counts, remaining, tokens = st
+        i, tok, cache, done, steps, counts, remaining, col, tokens = st
         logits, cache = paged_decode_step(
             params, tok, cache, ~done, cfg, kernel
         )
@@ -616,16 +622,90 @@ def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
         )
         nxt = jnp.where(done, tok, nxt)  # frozen slots re-feed their token
         live = (~done).astype(jnp.int32)
-        counts = counts.at[jnp.arange(S), nxt].add(live)
+        counts = counts.at[rows, nxt].add(live)
         steps = steps + live
         remaining = remaining - live
         done = done | (nxt[:, None] == eos).any(-1) | (remaining <= 0)
+        tokens = tokens.at[
+            rows, jnp.minimum(col, tokens.shape[1] - 1)
+        ].set(nxt)
         return (
             i + 1, nxt, cache, done, steps, counts, remaining,
-            tokens.at[:, i].set(nxt),
+            col + live, tokens,
         )
 
     return body
+
+
+# tlint: hot-path
+def _verify_emit(blk, logits_v, base, n_spec, emit, seeds, steps, temp,
+                 top_k, top_p, pres, freq, counts, remaining, eos):
+    """The unified step's sampling epilogue, generalized to speculative
+    verification — the in-program acceptance walk over each slot's
+    gathered verification rows ``[S, W]``.
+
+    Row ``j`` of a speculating slot holds the logits of block row
+    ``base + j`` (absolute position ``start + base + j``) — the model's
+    view AFTER the draft tokens up to that row were scattered — so the
+    draw at row ``j`` with key ``fold_in(seed, steps + j)`` is EXACTLY
+    the draw sequential decode would make at that step, provided every
+    earlier draft matched its draw. The walk therefore accepts the
+    longest prefix of drafts whose tokens equal their own-row draws and
+    emits ONE extra token (the correction on a reject, the bonus draw
+    when every draft matched), updating the penalty histogram, key index
+    and budget per accepted token so the RNG/penalty state after the
+    pass equals the sequential state bit-for-bit. A non-speculating slot
+    (``n_spec == 0``) walks exactly one row — its last valid row — which
+    reduces to the plain single-draw epilogue, token for token.
+
+    Returns ``(tokens [S, W], last, m, ended, counts, steps, remaining)``
+    where ``m`` is each slot's emitted count this pass (the verify-pass
+    amortization the kill switch measures) and ``ended`` marks slots
+    that hit EOS or their budget INSIDE the pass."""
+    S, W, _V = logits_v.shape
+    rows = jnp.arange(S)
+    # the draft token draw j must match to be accepted: the NEXT packed
+    # block row's token (clamped gather; masked by j < n_spec)
+    j_idx = jnp.arange(W)[None, :]
+    nxt_rows = jnp.clip(base[:, None] + j_idx + 1, 0, blk.shape[1] - 1)
+    draft_next = jnp.take_along_axis(blk, nxt_rows, axis=1)  # [S, W]
+    has_draft = j_idx < n_spec[:, None]  # [S, W]
+
+    from .continuous import _row_keys, _sample_rows
+
+    def vstep(carry, xs):
+        counts, steps, remaining, stopped, ended, last, m = carry
+        lg, dnext, hd = xs
+        keys = _row_keys(seeds, steps)
+        t = _sample_rows(lg, keys, temp, top_k, top_p, pres, freq, counts)
+        live = emit & ~stopped
+        liv32 = live.astype(jnp.int32)
+        t = jnp.where(live, t, 0)
+        counts = counts.at[rows, t].add(liv32)
+        steps = steps + liv32
+        remaining = remaining - liv32
+        end_now = live & ((t[:, None] == eos).any(-1) | (remaining <= 0))
+        # accept: this row's draw reproduced the next draft token, so the
+        # already-scattered KV at that position is the TRUE token's KV
+        # and the walk may trust the next row's logits
+        accept = live & hd & (dnext == t) & ~end_now
+        last = jnp.where(live, t, last)
+        m = m + liv32
+        ended = ended | end_now
+        stopped = stopped | (live & ~accept)
+        return (counts, steps, remaining, stopped, ended, last, m), t
+
+    init = (
+        counts, steps, remaining, ~emit, jnp.zeros_like(emit),
+        jnp.zeros(S, jnp.int32), jnp.zeros(S, jnp.int32),
+    )
+    (counts, steps, remaining, _stopped, ended, last, m), toks = (
+        jax.lax.scan(
+            vstep, init,
+            (logits_v.transpose(1, 0, 2), draft_next.T, has_draft.T),
+        )
+    )
+    return toks.T, last, m, ended, counts, steps, remaining
 
 
 def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
@@ -662,7 +742,7 @@ def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
 # tlint: hot-path
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "kernel"),
+    static_argnames=("cfg", "n_steps", "spec_width", "kernel"),
     donate_argnames=("cache", "counts"),
 )
 def paged_ragged_step(
@@ -671,6 +751,7 @@ def paged_ragged_step(
     cache: PagedKVCache,
     starts: jax.Array,  # int32 [S] — absolute position of blk[s, 0]
     n_valid: jax.Array,  # int32 [S] — valid tokens per slot (0 = idle)
+    n_spec: jax.Array,  # int32 [S] — draft tokens per slot (rows 1..n_spec)
     emit: jax.Array,  # bool [S] — slot samples from its last valid row
     seeds: jax.Array,  # int32 [S] — per-slot RNG seeds
     steps: jax.Array,  # int32 [S] — per-slot next draw index
@@ -684,6 +765,7 @@ def paged_ragged_step(
     eos: jax.Array,  # int32 [S, E] per-slot EOS ids (pad with -1)
     cfg: ModelConfig,
     n_steps: int,
+    spec_width: int = 1,
     kernel: bool = False,
 ):
     """THE serving hot loop's single compiled program: one ragged
@@ -707,9 +789,30 @@ def paged_ragged_step(
     cache the same program stores int8 pages: the scatter quantizes,
     the kernels dequantize at the fetch.
 
-    Returns ``(tokens [S, n_steps], n_exec, cache, done, steps, counts,
-    remaining)``, with column 0 holding the ragged block's draws
-    (meaningful where ``emit``)."""
+    **Speculative slots** (``spec_width > 1``, docs/SERVING.md
+    "Speculative decoding"): a decoding slot may pack up to
+    ``spec_width - 1`` host-drafted tokens as EXTRA valid rows after its
+    current token (``n_spec[s]`` of them, DATA like everything else —
+    spec/non-spec mixes never recompile). The ragged forward then
+    verifies all rows in-program (draft row ``j`` attends ``<= start +
+    j`` — the kernel's existing causal ``q_pos`` masking, pinned bitwise
+    against sequential decode in tests/test_ops.py), and the
+    :func:`_verify_emit` walk accepts the longest draft prefix matching
+    the slot's own fold_in draw chain plus one bonus/correction token —
+    so speculative streams are bit-identical to plain decode. Rejected
+    draft positions hold garbage KV that the length truncation below
+    unwinds: ``lengths`` advances only past ACCEPTED tokens (write-then-
+    truncate at the one ``_scatter_kv`` write seam — the int8
+    payload+scales pairing and page conservation hold mid-rejection
+    because the slot already owns every page it wrote), and the next
+    pass overwrites the garbage before any mask can reach it.
+
+    Returns ``(tokens [S, n_steps + spec_width - 1], n_tok [S], spec_m
+    [S], n_exec, cache, done, steps, counts, remaining)``: per-slot
+    token counts ``n_tok`` replace the old shared column convention
+    (column 0..n_tok[s]-1 hold slot ``s``'s draws), and ``spec_m`` is
+    the ragged pass's emitted count (the tokens-per-verify-pass signal
+    the engine's kill switch consumes)."""
     S, C = blk.shape
     page = cache.page_size
     n_pp = cache.pages_per_slot
@@ -738,28 +841,43 @@ def paged_ragged_step(
         scan_fn, x, (params["layers"], *_cache_kv(cache))
     )
     x = _norm(x, params["final_norm"], cfg)
-    # per-slot last valid row → vocab head over [S] rows only (idle slots
-    # read row 0 — garbage, masked out of sampling by `emit`)
-    h_last = x[jnp.arange(S), jnp.maximum(n_valid - 1, 0)]  # [S, d]
-    logits = _logits(params, h_last[:, None], cfg)[:, 0]  # [S, V]
+    # verification rows: the last spec_width rows of each slot's valid
+    # span — base = n_valid - 1 - n_spec, so a non-speculating slot
+    # (n_spec 0: plain decode, completing prefill, idle) gathers exactly
+    # its last valid row at walk index 0 and the epilogue reduces to the
+    # plain single draw. The vocab head runs over [S, W] rows only —
+    # never the whole [S, C] block (idle slots read row 0: garbage,
+    # masked out of sampling by `emit`).
+    W = int(spec_width)
+    base = jnp.maximum(n_valid - 1 - n_spec, 0)
+    gather = jnp.minimum(
+        base[:, None] + jnp.arange(W)[None, :],
+        jnp.maximum(n_valid - 1, 0)[:, None],
+    )  # [S, W]
+    h_v = x[jnp.arange(S)[:, None], gather]  # [S, W, d]
+    logits_v = _logits(params, h_v, cfg)  # [S, W, V]
 
-    from .continuous import _row_keys, _sample_rows
-
-    keys = _row_keys(seeds, steps)
-    nxt = _sample_rows(logits, keys, temp, top_k, top_p, pres, freq, counts)
-    nxt = jnp.where(emit, nxt, 0)
-    live = emit.astype(jnp.int32)
-    counts = counts.at[jnp.arange(S), nxt].add(live)
-    steps = steps + live
-    remaining = remaining - live
-    done = ~emit | (nxt[:, None] == eos).any(-1) | (remaining <= 0)
+    toks0, nxt, spec_m, ended, counts, steps, remaining = _verify_emit(
+        blk, logits_v, base, n_spec, emit, seeds, steps, temp, top_k,
+        top_p, pres, freq, counts, remaining, eos,
+    )
+    done = ~emit | ended
+    # KV unwind at the write seam: a speculating slot's length advances
+    # only past its ACCEPTED tokens (spec_m includes the final
+    # bonus/correction draw, which — like a plain decode's draw — is not
+    # yet written); everything else keeps the full-block advance
+    adv = jnp.where((n_spec > 0) & emit, spec_m, n_valid)
     cache = _with_kv(
         cache, kv_new,
-        lengths=jnp.where(n_valid > 0, starts + n_valid, cache.lengths),
+        lengths=jnp.where(n_valid > 0, starts + adv, cache.lengths),
     )
-    tokens = jnp.zeros((S, n_steps), jnp.int32).at[:, 0].set(nxt)
+    tokens = (
+        jnp.zeros((S, n_steps + W - 1), jnp.int32).at[:, :W].set(toks0)
+    )
 
-    # decode continuation, starting past the ragged block's step
+    # decode continuation, starting past the ragged block's step, each
+    # slot appending at its own column cursor (the verify pass emitted
+    # spec_m tokens there)
     body = _decode_loop_body(
         params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel
     )
@@ -767,11 +885,17 @@ def paged_ragged_step(
     def cond(st):
         return (st[0] < n_steps) & ~st[3].all()
 
-    init = (jnp.int32(1), nxt, cache, done, steps, counts, remaining, tokens)
-    n_exec, _tok, cache, done, steps, counts, remaining, tokens = (
+    init = (
+        jnp.int32(1), nxt, cache, done, steps, counts, remaining,
+        spec_m, tokens,
+    )
+    n_exec, _tok, cache, done, steps, counts, remaining, n_tok, tokens = (
         jax.lax.while_loop(cond, body, init)
     )
-    return tokens, n_exec, cache, done, steps, counts, remaining
+    return (
+        tokens, n_tok, spec_m, n_exec, cache, done, steps, counts,
+        remaining,
+    )
 
 
 # tlint: hot-path

@@ -57,6 +57,10 @@ class _Pending:
     # dispatches ALONE; in a co-batch it decodes vanilla — the emitted
     # tokens are identical either way, so this is purely a speed hint
     lookahead: bool = False
+    # continuous speculative decoding (engine/continuous.py): the request
+    # opts into draft/verify ragged slots on a spec_decode engine — also
+    # a pure speed hint (streams bit-identical either way)
+    speculative: bool = False
     done: threading.Event = field(default_factory=threading.Event)
     stream_cb: Callable[[list[int]], None] | None = None
     result: list[int] | None = None
@@ -127,6 +131,7 @@ class GenBatcher:
         stream_cb: Callable[[list[int]], None] | None = None,
         timeout: float = 600.0,
         lookahead: bool = False,
+        speculative: bool = False,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         priority: str | None = None,
@@ -134,9 +139,12 @@ class GenBatcher:
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode.
-        ``priority`` is accepted for API symmetry with the continuous
-        scheduler; the windowed batcher itself stays FCFS. ``trace_id``
-        (core/trace.py) records the window-wait + batched-decode span."""
+        ``priority`` and ``speculative`` are accepted for API symmetry
+        with the continuous scheduler; the windowed batcher itself stays
+        FCFS and decodes vanilla (speculation is a paged-engine feature —
+        both knobs are pure hints, streams identical either way).
+        ``trace_id`` (core/trace.py) records the window-wait +
+        batched-decode span."""
         req = _Pending(
             ids=list(ids), max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), top_k=int(top_k),
@@ -703,6 +711,9 @@ class ContinuousBatcher:
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
         kv_quant: str = "none",
+        spec_decode: bool = False,
+        spec_draft: int = 8,
+        spec_budget: int = 0,
         seed: int = 0,
         default_priority: str = DEFAULT_PRIORITY,
         sched_queue_cap: int = 64,
@@ -747,6 +758,8 @@ class ContinuousBatcher:
                     engine, max_slots=max_slots, page_size=page_size,
                     chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
                     prefix_cache=prefix_cache, kv_quant=kv_quant,
+                    spec_decode=spec_decode, spec_draft=spec_draft,
+                    spec_budget=spec_budget,
                     default_priority=self.default_priority,
                     sched_queue_cap=sched_queue_cap,
                     sched_aging_ticks=sched_aging_ticks,
@@ -763,6 +776,12 @@ class ContinuousBatcher:
             self._sess = PipelinedSlotSession(model, max_slots=max_slots)
             self.mode = "pipelined"
         self.trace_site = trace_site or "batcher"
+        # configured throughput modes, surfaced by serving_modes() when
+        # the engine lives in another process (remote/pipelined)
+        self._modes = {
+            "kv_quant": str(kv_quant or "none"),
+            "spec_decode": bool(spec_decode),
+        }
         if self.mode in ("local", "pipelined"):
             self._thread = threading.Thread(
                 target=self._drive, name="cont-batcher", daemon=True
@@ -776,6 +795,20 @@ class ContinuousBatcher:
         serving snapshot instead (snapshot_gauges)."""
         return self._cont.metrics if self._cont is not None else None
 
+    def serving_modes(self) -> dict:
+        """Throughput-mode summary for /healthz (cheap attribute reads —
+        no engine round trip): which KV storage and decode modes this
+        hosted model actually runs, so an operator/router can see a
+        replica's throughput shape before sending traffic. Local mode
+        reads the live engine; remote/pipelined report the configured
+        knobs (the worker engine is built from the same MLConfig)."""
+        if self._cont is not None:
+            return {
+                "kv_quant": self._cont.kv_quant,
+                "spec_decode": bool(self._cont.spec_decode),
+            }
+        return dict(self._modes)
+
     # -- client side -----------------------------------------------------
     def generate(
         self,
@@ -788,6 +821,7 @@ class ContinuousBatcher:
         stream_cb: Callable[[list[int]], None] | None = None,
         timeout: float = 600.0,
         lookahead: bool = False,
+        speculative: bool = False,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         priority: str | None = None,
@@ -813,6 +847,7 @@ class ContinuousBatcher:
                     ids, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     stream_cb=stream_cb, lookahead=lookahead,
+                    speculative=speculative,
                     presence_penalty=presence_penalty,
                     frequency_penalty=frequency_penalty, seed=req_seed,
                     priority=priority, trace_id=trace_id,
@@ -865,6 +900,7 @@ class ContinuousBatcher:
             top_p=float(top_p), stream_cb=stream_cb,
             presence_penalty=float(presence_penalty),
             frequency_penalty=float(frequency_penalty),
+            speculative=bool(speculative),
             priority=priority,
             trace_id=trace_id,
         )
@@ -886,7 +922,7 @@ class ContinuousBatcher:
     def _generate_remote(
         self, ids, *, max_new_tokens, temperature, top_k, top_p, stream_cb,
         lookahead, presence_penalty, frequency_penalty, seed,
-        priority=None, trace_id="",
+        speculative=False, priority=None, trace_id="",
     ) -> list[int]:
         """Single-stage pass-through: the worker's slot engine is the
         scheduler, so each request ships immediately — concurrency comes
@@ -907,12 +943,15 @@ class ContinuousBatcher:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), eos_ids=self.eos_ids, seed=int(seed),
             stream_cb=cb, lookahead=spec,
+            # continuous speculation rides the slot batch itself — the
+            # worker's engine packs draft rows when ITS spec_decode is on
+            speculative=bool(speculative),
             presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty,
             priority=priority,
             trace_id=trace_id,
-            # speculation runs the solo engine path; everything else joins
-            # the worker's slot batch
+            # legacy lookahead runs the solo engine path; everything else
+            # joins the worker's slot batch
             continuous=not spec,
         )
         self._note_served()
@@ -1054,6 +1093,7 @@ class ContinuousBatcher:
             priority=req.priority,
             stream_cb=tok_cb, on_finish=on_finish,
             trace_id=req.trace_id,
+            speculative=req.speculative,
         )
 
     def stats(self) -> dict | None:

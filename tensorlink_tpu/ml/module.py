@@ -754,6 +754,7 @@ class DistributedModel:
         continuous: bool = False,
         priority: str | None = None,
         trace_id: str | None = None,
+        speculative: bool = False,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -801,6 +802,7 @@ class DistributedModel:
                     frequency_penalty=float(frequency_penalty or 0.0),
                     priority=priority,
                     trace_id=str(trace_id or ""),
+                    speculative=bool(speculative),
                 )
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -1080,6 +1082,7 @@ class DistributedModel:
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
         presence_penalty: float, frequency_penalty: float,
         priority: str | None = None, trace_id: str = "",
+        speculative: bool = False,
     ) -> list[list[int]]:
         """One request through the worker's continuous slot engine
         (B=1 per RPC; the worker co-batches concurrent requests into its
@@ -1127,6 +1130,11 @@ class DistributedModel:
                 # the worker's scheduler reads the class off the wire; an
                 # old worker simply ignores the extra key (FCFS for it)
                 body["priority"] = str(priority)
+            if speculative:
+                # draft/verify opt-in: the worker's engine packs draft
+                # rows when its spec_decode is on; streams bit-identical
+                # either way, so an ignoring worker changes nothing
+                body["speculative"] = True
             if trace_id:
                 # the trace id rides the GENERATE frame: the worker's
                 # engine records its spans under it and ships them back on

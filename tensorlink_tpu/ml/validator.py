@@ -332,6 +332,9 @@ class DistributedValidator:
                 max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
                 chunk_steps=ml_cfg.cont_chunk_steps,
                 kv_quant=ml_cfg.kv_quant,
+                spec_decode=bool(getattr(ml_cfg, "spec_decode", False)),
+                spec_draft=int(getattr(ml_cfg, "spec_draft", 8)),
+                spec_budget=int(getattr(ml_cfg, "spec_budget", 0)),
                 default_priority=ml_cfg.default_priority,
                 sched_queue_cap=ml_cfg.sched_queue_cap,
                 sched_aging_ticks=ml_cfg.sched_aging_ticks,
@@ -369,10 +372,23 @@ class DistributedValidator:
         the cluster router (ROADMAP item 3) can probe at high frequency
         without touching the serving path."""
         with self._host_lock:
-            names = list(self.hosted)
+            jobs = {name: j.batcher for name, j in self.hosted.items()}
+        modes = {}
+        for name, batcher in jobs.items():
+            get_modes = getattr(batcher, "serving_modes", None)
+            if callable(get_modes):
+                modes[name] = get_modes()
+            else:
+                # windowed batcher (or no batcher yet): vanilla decode
+                modes[name] = {"kv_quant": "none", "spec_decode": False}
         return {
             "status": "ok",
-            "hosted_models": names,
+            "hosted_models": list(jobs),
+            # per-model throughput modes (kv_quant, spec_decode): which
+            # decode shape a replica actually runs — a router must see
+            # this before placing traffic (cheap attribute reads, the
+            # same no-ML-round-trip contract as the rest of the body)
+            "serving_modes": modes,
             "draining": bool(self.draining),
         }
 
@@ -577,9 +593,12 @@ class DistributedValidator:
         # engine path carries counts in its compiled loop, the pipelined
         # path keeps them session-resident on the head-holding worker
         # (ml/worker.py::_sample_from_logits) — the r4 400 is gone.
-        # speculative decode is greedy-only; the emitted tokens are identical
-        # to vanilla greedy, so the flag is a pure speed hint
+        # legacy lookahead is greedy-only; the emitted tokens are identical
+        # to vanilla greedy, so the flag is a pure speed hint. Continuous
+        # speculation ({"speculative": true}) rides the slot batch instead
+        # and works under any sampling — also a pure hint.
         spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0
+        spec_cont = bool(getattr(req, "speculative", False))
         beams_used = None
         if n_beams > 1:
             # deterministic beam decode: bypass the batcher (beams cannot
@@ -614,6 +633,7 @@ class DistributedValidator:
                 frequency_penalty=args["frequency_penalty"],
                 stream_cb=stream_cb if use_cb else None,
                 lookahead=spec,
+                speculative=spec_cont,
                 priority=getattr(req, "priority", None) or None,
                 trace_id=trace_id,
             )

@@ -1706,6 +1706,9 @@ class DistributedWorker:
             # re-prefilling (engine falls back when the ticket is stale)
             adopt=p.get("adopt") or None,
             trace_id=tid,
+            # draft/verify opt-in (no-op unless this engine's spec_decode
+            # is on; streams bit-identical either way)
+            speculative=bool(p.get("speculative", False)),
         )
         # transport context for live migration: a drain must redirect this
         # stream mid-flight, which needs the original peer/rid/stream —
@@ -1742,6 +1745,9 @@ class DistributedWorker:
                 # `or` before str(): a null kv_quant in an operator
                 # config must read as "none", not the string "None"
                 kv_quant=str(ml.kv_quant or "none"),
+                spec_decode=bool(getattr(ml, "spec_decode", False)),
+                spec_draft=int(getattr(ml, "spec_draft", 8)),
+                spec_budget=int(getattr(ml, "spec_budget", 0)),
                 default_priority=str(ml.default_priority),
                 sched_queue_cap=int(ml.sched_queue_cap),
                 sched_aging_ticks=int(ml.sched_aging_ticks),

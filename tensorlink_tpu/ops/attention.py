@@ -551,7 +551,16 @@ def ragged_paged_attention_ref(
     ``paged_prefill_attention_ref`` (and, for a 1-valid-token slot,
     ``paged_attention_ref`` at length ``start + 1``) — the composition
     the parity tests pin. Rows at or past ``n_valid`` zero out instead
-    of carrying garbage."""
+    of carrying garbage.
+
+    **Verify mode** (speculative decoding, engine/paged.py): a
+    speculating slot is just ``k + 1`` valid query rows at its current
+    ``start`` — its token plus ``k`` draft tokens — and needs NO new
+    masking: the causal ``q_pos`` rule above already makes draft row
+    ``j`` attend exactly ``<= start + j``, which is bitwise the context
+    ``k`` sequential decode steps would each see (pinned against the
+    sequential ``paged_attention_ref`` oracle in tests/test_ops.py::
+    test_ragged_verify_rows_match_sequential_decode_bitwise)."""
     S, C, Hq, hd = q.shape
     P, Hkv, page, _ = k_pages.shape
     n_pp = block_tables.shape[1]
@@ -688,7 +697,10 @@ def ragged_paged_attention(
     online softmax carries ``[C·G, 1]`` running max/denominator. ONE
     compiled program serves every (prefill/decode mix, offset, length,
     page assignment) — slot roles are data, not shape, which is what
-    deletes the separate-prefill-then-decode dispatch seam."""
+    deletes the separate-prefill-then-decode dispatch seam. Speculative
+    verify slots (k+1 valid rows at a decode slot's current start) ride
+    the same causal ``q_pos`` masking — see the reference's "Verify
+    mode" note."""
     S, C, Hq, hd = q.shape
     P, Hkv, page, _ = k_pages.shape
     n_pp = block_tables.shape[1]

@@ -1133,6 +1133,303 @@ def test_drain_fence_sheds_queue_and_refuses_new_work(tiny_engine):
     ce.close()
 
 
+# ---------------------------------------------------------------------------
+# speculative decoding (draft/verify as ragged slots, docs/SERVING.md)
+# ---------------------------------------------------------------------------
+# a repetitive prompt: prompt-lookup can draft from it, so the spec path
+# really exercises multi-token acceptance (the bit-identity contract
+# holds for ANY prompt; this one makes the accepted>=1 asserts real)
+# tlint: disable=TL006(read-only repetitive-prompt fixture data)
+REP = [5, 9, 5, 9, 5, 9, 5, 9]
+
+
+def _spec_cont(eng, **kw):
+    kw.setdefault("spec_decode", True)
+    kw.setdefault("spec_draft", 4)
+    return _cont(eng, **kw)
+
+
+def test_spec_controller_kill_switch_units():
+    """The shared policy machine (engine/spec.py) in isolation — zero
+    compiles: prescan arms only on repetitive history, a miss run
+    disarms, a recurring pair re-arms, and the acceptance-rate kill
+    switch fires after the probe window and NEVER re-probes (note_pair
+    cannot resurrect a dead controller)."""
+    from tensorlink_tpu.engine.spec import (
+        ACC_PROBE, MISS_OFF, SpecController, lookup_draft,
+    )
+
+    # prescan: zero recurring adjacent pairs -> off; repetition -> on
+    assert not SpecController().prescan([1, 2, 3, 4])
+    assert SpecController().prescan([1, 2, 1, 2])
+    # draft misses disarm after MISS_OFF consecutive misses
+    c = SpecController(n_draft=4)
+    c.prescan([1, 2, 1, 2])
+    for _ in range(MISS_OFF):
+        assert c.draft([1, 2, 3, 4, 5, 6, 7, 8]) == []  # no recurrence
+    assert not c.on and not c.dead
+    # a recurring pair re-arms a disarmed (but not killed) controller
+    c.note_pair(7, 8)
+    c.note_pair(7, 8)
+    assert c.on
+    # real drafting delegates to lookup_draft (one implementation)
+    hist = [3, 4, 5, 3, 4]
+    assert c.draft(hist, cap=2) == lookup_draft(hist, 2)
+    # acceptance kill: ACC_PROBE passes at 1 token/pass -> dead, and the
+    # accounting matches (accepted = per_pass - 1 each pass)
+    c2 = SpecController()
+    c2.prescan([1, 2, 1, 2])
+    fired = [c2.note_verify(1) for _ in range(ACC_PROBE)]
+    assert fired == [False] * (ACC_PROBE - 1) + [True]
+    assert c2.dead and not c2.active
+    assert c2.tokens_per_pass == 1.0
+    # dead is PERMANENT: recurring pairs never re-arm it
+    c2.note_pair(1, 2)
+    c2.note_pair(1, 2)
+    assert c2.dead and not c2.on
+    assert c2.draft([1, 2, 1, 2, 1, 2]) == []
+    # a high-acceptance controller survives the probe window
+    c3 = SpecController()
+    c3.prescan([1, 2, 1, 2])
+    for _ in range(ACC_PROBE + 2):
+        assert not c3.note_verify(5)
+    assert c3.active and c3.tokens_per_pass == 5.0
+
+
+def test_spec_engine_knobs_zero_compile(tiny_engine):
+    """Construction-level contracts, no chunk ever runs: spec_width is
+    1 + spec_draft capped by the block row; the per-request flag is
+    gated on the ENGINE knob (a speculative submit on a plain engine
+    decodes vanilla); the snapshot carries the enablement + amortization
+    keys the /metrics//healthz surfaces read."""
+    ce = _cont(tiny_engine)  # spec off (default)
+    assert ce.spec_width == 1 and ce.spec_decode is False
+    r = ce.submit(REP, max_new_tokens=2, speculative=True)
+    assert r.speculative is False  # gated: engine knob off
+    snap = ce.serving_snapshot()
+    assert snap["spec_decode"] is False
+    assert snap["spec_tokens_per_pass"] == 0.0
+    for k in ("spec_drafted", "spec_accepted", "spec_verify_passes",
+              "spec_killed"):
+        assert snap[k] == 0, k
+    on = _spec_cont(tiny_engine)
+    assert on.spec_width == 5 and on.spec_decode is True
+    assert on.submit(REP, max_new_tokens=2, speculative=True).speculative
+    # a non-opted request on a spec engine stays vanilla
+    assert not on.submit(REP, max_new_tokens=2).speculative
+    # the block row caps the draft width (drafts are extra columns)
+    capped = _cont(tiny_engine, spec_decode=True, spec_draft=64,
+                   prefill_chunk=8)
+    assert capped.spec_width == 8  # 1 + (prefill_chunk - 1)
+
+
+@pytest.mark.slow  # compiles the spec-width step program shape — tier-1
+# wall-time; CI's engine job runs this file unfiltered on every push
+def test_spec_streams_bit_identical_across_lifecycle(tiny_engine):
+    """THE speculative acceptance pin: with spec_decode on, every stream
+    — greedy and sampled, solo, co-batched with plain neighbors,
+    admitted mid-flight, preempted + resumed, and crash-recovery
+    resumed — is BIT-IDENTICAL to the plain engine's (acceptance folds
+    into the same fold_in(seed, step) chain; rejected draft KV is
+    unwound by length truncation before any mask can see it). Real
+    multi-token acceptance is asserted, not assumed."""
+    eng = tiny_engine
+    mixes = [
+        (REP + [21], 14, SamplingParams.make(), 1),
+        (REP, 16, SamplingParams.make(temperature=0.9, top_k=5), 2),
+        ([4, 5], 8, SamplingParams.make(temperature=0.7, top_p=0.9), 3),
+    ]
+    plain = [
+        _solo(eng, p, n, sampling=sp, seed=s) for p, n, sp, s in mixes
+    ]
+    # co-batched + mid-flight admission, every request opted in
+    ce = _spec_cont(eng)
+    reqs = []
+    for prompt, n, sp, seed in mixes:
+        reqs.append(ce.submit(prompt, max_new_tokens=n, sampling=sp,
+                              seed=seed, speculative=True))
+        ce.step_chunk()  # later requests join mid-flight
+    ce.run_until_idle()
+    snap = ce.serving_snapshot()
+    for req, ref in zip(reqs, plain):
+        assert req.finished and req.tokens == ref
+    assert snap["spec_verify_passes"] >= 1
+    assert snap["spec_accepted"] >= 1  # speculation actually accepted
+    ce.check_page_conservation()
+    ce.close()
+    # solo spec == solo plain (and speculating alone compiles nothing new
+    # beyond the engine's own step program — guarded in the compile test)
+    for (prompt, n, sp, seed), ref in zip(mixes, plain):
+        ce2 = _spec_cont(eng)
+        r = ce2.submit(prompt, max_new_tokens=n, sampling=sp, seed=seed,
+                       speculative=True)
+        ce2.run_until_idle()
+        assert r.tokens == ref
+        ce2.close()
+    # preemption: a speculating victim resumes bit-identically (the
+    # controller — including any kill — survives the requeue)
+    ce3 = _spec_cont(eng, max_slots=1, sched_aging_ticks=1000)
+    victim = ce3.submit(REP, max_new_tokens=12, seed=2,
+                        sampling=SamplingParams.make(temperature=0.9,
+                                                     top_k=5),
+                        speculative=True, priority="best_effort")
+    ce3.step_chunk()
+    hi = ce3.submit([8, 8], max_new_tokens=2, seed=9,
+                    priority="interactive")
+    ce3.run_until_idle()
+    assert ce3.stats["preemptions"] >= 1
+    assert victim.finished and hi.finished
+    assert victim.tokens == plain[1][:12]
+    ce3.close()
+    # crash-recovery resume: prompt + delivered with start_step continues
+    # the SPECULATIVE stream bit-identically
+    cut = 5
+    ce4 = _spec_cont(eng)
+    resumed = ce4.submit(
+        REP + plain[1][:cut], max_new_tokens=16 - cut,
+        sampling=SamplingParams.make(temperature=0.9, top_k=5),
+        seed=2, start_step=cut, speculative=True,
+    )
+    ce4.run_until_idle()
+    assert plain[1][:cut] + resumed.tokens == plain[1]
+    ce4.close()
+
+
+@pytest.mark.slow  # drives two engines through the migration protocol —
+# tier-1 wall-time; CI's engine job runs this file unfiltered
+def test_spec_stream_migrated_bit_identical(tiny_engine):
+    """A SPECULATING stream migrated mid-decode is bit-identical to the
+    uninterrupted plain stream: the shipped KV never contains rejected
+    draft rows (export bounds itself by the slot's truncated length),
+    and the drafting state deliberately does NOT migrate — the
+    destination re-probes fresh (documented in docs/SERVING.md), which
+    can only change speed, never tokens."""
+    eng = tiny_engine
+    prompt = REP + [40]
+    base = _solo(eng, prompt, 14, seed=7)
+    src = _spec_cont(eng)
+    dst = _spec_cont(eng)
+    r = src.submit(prompt, max_new_tokens=14, seed=7, speculative=True)
+    _drive_until(src, r, 5)
+    slot = r.slot
+    src.freeze_slot(slot)
+    src.check_page_conservation()
+    chain, limit = src.migration_chain(slot)
+    blob = src.export_slot(slot, n_skip=dst.resident_prefix_pages(chain,
+                                                                  limit))
+    assert dst.stage_migration("sm", blob)
+    moved = src.commit_migration(slot)
+    r2 = dst.submit(
+        moved.prompt + moved.tokens,
+        max_new_tokens=moved.budget - len(moved.tokens),
+        seed=7, start_step=len(moved.tokens), adopt="sm",
+        speculative=True,  # the destination speculates afresh
+    )
+    dst.run_until_idle()
+    assert moved.tokens + r2.tokens == base
+    assert r2.spec_state is not moved.spec_state  # re-probed, not shipped
+    src.check_page_conservation()
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # engine-level kill-switch trace — tier-1 wall-time;
+# CI's engine job runs this file unfiltered on every push
+def test_spec_kill_switch_fires_and_never_reprobes(tiny_engine, monkeypatch):
+    """Adversarial drafts (hit every pass, never match the model) must
+    trip the acceptance-rate kill switch after the probe window, fall
+    the request back to 1-token decode PERMANENTLY, and still emit the
+    bit-identical stream. After the kill no further drafts pack — and a
+    preemption + resume does not re-probe (the controller rides the
+    request through the requeue)."""
+    import tensorlink_tpu.engine.spec as spec_mod
+    from tensorlink_tpu.engine.spec import ACC_PROBE
+
+    eng = tiny_engine
+    plain = _solo(eng, REP, 24, seed=4,
+                  sampling=SamplingParams.make(temperature=0.9, top_k=5))
+
+    def bad_draft(history, n_draft, **kw):
+        # always-hitting, never-matching drafts: token 1 is never what
+        # the sampled stream emits for this seed (asserted below)
+        return [1] * int(n_draft)
+
+    monkeypatch.setattr(spec_mod, "lookup_draft", bad_draft)
+    ce = _spec_cont(eng, max_slots=1, chunk_steps=1,
+                    sched_aging_ticks=1000)
+    r = ce.submit(REP, max_new_tokens=24, seed=4,
+                  sampling=SamplingParams.make(temperature=0.9, top_k=5),
+                  speculative=True, priority="best_effort")
+    # drive until the kill fires, then preempt the victim mid-stream
+    while ce.stats["spec_killed"] == 0 and not r.finished:
+        ce.step_chunk()
+    assert ce.stats["spec_killed"] == 1
+    assert r.spec_state is not None and r.spec_state.dead
+    assert ce.stats["spec_verify_passes"] == ACC_PROBE
+    drafted_at_kill = ce.stats["spec_drafted"]
+    assert not r.finished, "budget too small to observe the post-kill tail"
+    hi = ce.submit([8, 8], max_new_tokens=2, seed=9,
+                   priority="interactive")
+    ce.run_until_idle()
+    assert ce.stats["preemptions"] >= 1 and hi.finished
+    assert r.finished
+    # never re-probes: the resumed request packed ZERO further drafts
+    assert ce.stats["spec_drafted"] == drafted_at_kill
+    assert ce.stats["spec_verify_passes"] == ACC_PROBE
+    # and the stream never moved a token (1 was indeed never emitted —
+    # the premise of "never matching" held)
+    assert r.tokens == plain
+    assert 1 not in plain
+    ce.close()
+
+
+@pytest.mark.slow  # drives the spec-width program through churn — in
+# CI's compile-count-guard step; tier-1 wall-time protected
+def test_spec_decode_is_one_program(tiny_engine):
+    """The compile-set bar extends to speculation: a spec_decode engine
+    is ONE ragged_step program of its own (spec_width is a trace-time
+    constant; per-slot draft lengths are DATA) — spec/non-spec mixed
+    churn, draft hits and misses, acceptance and rejection, preemption
+    and recovery-shaped resume add ZERO compiles. Deltas, not absolutes
+    (process-global jit caches — the TL006 order-dependence note)."""
+    eng = tiny_engine
+    ce = _spec_cont(eng, sched_aging_ticks=1000)
+    pre = ce.jit_cache_sizes()
+    w = ce.submit(REP, max_new_tokens=6, seed=1, speculative=True)
+    ce.run_until_idle()
+    assert w.finished
+    # warm the COW program too: REP's page is resident now, so a
+    # mid-page divergence fires copy_page once (its one allowed compile)
+    ce.submit(REP[:4] + [2, 2, 2, 2], max_new_tokens=2, seed=90)
+    ce.run_until_idle()
+    base = ce.jit_cache_sizes()
+    assert 0 <= base["ragged_step"] - pre["ragged_step"] <= 1
+    assert 0 <= base["copy_page"] - pre["copy_page"] <= 1
+    # churn: spec and non-spec co-batched, different knobs/lengths,
+    # mid-flight admission, preemption, recovery-shaped resume
+    reqs = [
+        ce.submit(REP + [20 + i], max_new_tokens=6 + i, seed=i,
+                  speculative=bool(i % 2),
+                  priority="batch" if i else "best_effort")
+        for i in range(3)
+    ]
+    ce.step_chunk()
+    vip = ce.submit([7] * 9, max_new_tokens=4, seed=99,
+                    priority="interactive")
+    ce.run_until_idle()
+    assert vip.finished and all(x.finished for x in reqs)
+    full = ce.submit(REP, max_new_tokens=10, seed=5, speculative=True)
+    ce.run_until_idle()
+    resumed = ce.submit(REP + full.tokens[:4], max_new_tokens=6, seed=5,
+                        start_step=4, speculative=True)
+    ce.run_until_idle()
+    assert full.tokens[:4] + resumed.tokens == full.tokens
+    assert ce.jit_cache_sizes() == base, (base, ce.jit_cache_sizes())
+    ce.check_page_conservation()
+    ce.close()
+
+
 def test_continuous_refuses_unsupported_cache_modes(tiny_engine):
     """Sliding windows stay on the static batcher: the engine refuses
     loudly (the worker catches this and falls back). int8 KV is NOT

@@ -210,6 +210,8 @@ LEGACY_ENGINE_KEYS = (
     "prefill_tokens", "prefill_tokens_skipped",
     "migrations_started", "migrations_completed", "migrations_failed",
     "migrations_fell_back", "migrations_adopted",
+    # speculative decoding (spec_decode): the draft/verify families
+    "spec_drafted", "spec_accepted", "spec_verify_passes", "spec_killed",
 )
 
 

@@ -381,6 +381,40 @@ def test_ragged_ref_matches_decode_and_prefill_refs_bitwise():
         assert np.array_equal(ref[s, : nv[s]], np.asarray(pf)[: nv[s]]), s
 
 
+def test_ragged_verify_rows_match_sequential_decode_bitwise():
+    """THE speculative-verification pin (docs/SERVING.md "Speculative
+    decoding"): a verifying slot — k+1 valid query rows at its current
+    start — produces, at every row j, BITWISE the attention output of a
+    sequential decode step at length ``start + j + 1`` with the same
+    query. The ragged reference's causal ``q_pos`` masking already
+    encodes verify mode; no new kernel logic exists to drift. (Row 0 is
+    the existing decode-composition pin; rows 1..k are what speculation
+    adds.) The Pallas kernel is held to the reference on the same
+    verify-shaped block."""
+    rng = np.random.default_rng(11)
+    S, C, Hq, Hkv, hd, page, n_pp = 2, 8, 4, 2, 16, 8, 4
+    start, k = 13, 4  # a decode slot at length 13 verifying 4 drafts
+    q, kp, vp, bt, st, nvj = _ragged_case(
+        rng, S, C, Hq, Hkv, hd, page, n_pp, [start, 0], [1 + k, 0]
+    )
+    scale = hd**-0.5
+    ref = np.asarray(
+        ragged_paged_attention_ref(q, kp, vp, bt, st, nvj, scale=scale)
+    )
+    # oracle: k+1 sequential decode _ref steps — step j sees exactly the
+    # keys <= start + j (the block's KV is pre-scattered, like the step)
+    for j in range(1 + k):
+        dec = paged_attention_ref(
+            q[0:1, j], kp, vp, bt[0:1],
+            jnp.asarray([start + j + 1], jnp.int32), scale=scale,
+        )
+        assert np.array_equal(ref[0, j], np.asarray(dec)[0]), j
+    got = ragged_paged_attention(
+        q, kp, vp, bt, st, nvj, scale=scale, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.slow  # compiles dedicated ragged shapes — CI engine job runs
 # it unfiltered on every push (tier-1 wall-time)
 def test_ragged_packing_framing_is_bitwise_invariant():
@@ -438,13 +472,14 @@ def test_ragged_packing_framing_is_bitwise_invariant():
             starts[1], nv[1] = step_i, 1
             done_prefill = pos + g >= T
             emit[0] = done_prefill  # final chunk: greedy first draw
-            tokens, n_exec, cache, _d, _s, counts, _r = paged_ragged_step(
-                params, jnp.asarray(blk), cache, jnp.asarray(starts),
-                jnp.asarray(nv), jnp.asarray(emit),
-                zeros_i, zeros_i, zeros_f, zeros_i,
-                jnp.ones(S, jnp.float32), zeros_f, zeros_f, counts,
-                jnp.ones(S, jnp.int32), eos, cfg, 1, False,
-            )
+            tokens, _nt, _m, n_exec, cache, _d, _s, counts, _r = \
+                paged_ragged_step(
+                    params, jnp.asarray(blk), cache, jnp.asarray(starts),
+                    jnp.asarray(nv), zeros_i, jnp.asarray(emit),
+                    zeros_i, zeros_i, zeros_f, zeros_i,
+                    jnp.ones(S, jnp.float32), zeros_f, zeros_f, counts,
+                    jnp.ones(S, jnp.int32), eos, cfg, 1, 1, False,
+                )
             if done_prefill:
                 first_draw = int(np.asarray(tokens)[0, 0])
             pos += g
