@@ -271,12 +271,20 @@ class JobRequest:
     seq_len: int = 2048
     training: bool = False
     config: dict | None = None
+    # weight-only-quantized serving ("int8" halves parameter HBM traffic;
+    # "int8+kv" also stores KV quantized — docs/SERVING.md "Quantized KV")
+    quant: str | None = None
 
     @classmethod
     def parse(cls, d: dict) -> "JobRequest":
         _require(isinstance(d.get("hf_name"), str) and d["hf_name"], "hf_name required")
         cfg = d.get("config")
         _require(cfg is None or isinstance(cfg, dict), "config must be an object")
+        quant = d.get("quant")
+        _require(
+            quant in (None, "int8", "int8+kv"),
+            "quant must be \"int8\" or \"int8+kv\"",
+        )
         try:
             req = cls(
                 hf_name=d["hf_name"],
@@ -284,6 +292,7 @@ class JobRequest:
                 seq_len=int(d.get("seq_len", 2048)),
                 training=bool(d.get("training", False)),
                 config=cfg,
+                quant=quant,
             )
         except ValidationError:
             raise

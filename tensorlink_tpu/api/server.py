@@ -428,7 +428,7 @@ class TensorlinkAPI:
             job = await self._ml(
                 lambda: self.executor.host_model(
                     jr.hf_name, batch=jr.batch, seq_len=jr.seq_len,
-                    config=jr.config,
+                    config=jr.config, quant=jr.quant,
                 )
             )
             status = 200 if job.status == "ready" else 503
@@ -439,6 +439,7 @@ class TensorlinkAPI:
         self._pool.submit(
             self.executor.host_model, jr.hf_name,
             batch=jr.batch, seq_len=jr.seq_len, config=jr.config,
+            quant=jr.quant,
         )
         await self._send_json(
             writer, 200, {"model": jr.hf_name, "status": "loading"}

@@ -107,11 +107,25 @@ class MLConfig:
     # position, head) symmetric scales, quantized at the one page-write
     # path and dequantized in-kernel at the page fetch — KV bytes halve,
     # so ~2x serving slots and ~2x prefix-cache residency at fixed HBM.
-    # Streams stay bit-identical to each other across every lifecycle
-    # path (solo/co-batched/recovered/preempted, cache on/off); only the
-    # fp-vs-int8 comparison differs, bounded in tests. Default off for
-    # one release. Models served with quant="int8+kv" force int8 pages.
-    kv_quant: str = "none"  # "none" | "int8"
+    # "int4" packs two values per byte at the same scale granularity:
+    # ~4x at a byte-matched budget (vs bf16), with a looser but still
+    # context-length-independent divergence bound. Streams stay
+    # bit-identical to each other across every lifecycle path
+    # (solo/co-batched/recovered/preempted, cache on/off); only the
+    # fp-vs-quantized comparison differs, bounded in tests. Default
+    # int8 (the PR 7 one-release opt-in window has elapsed); "none" is
+    # the explicit opt-out. Models served with quant="int8+kv" force
+    # quantized pages.
+    kv_quant: str = "int8"  # "none" | "int8" | "int4"
+    # -- multi-tenant co-hosting (docs/SERVING.md "Co-hosting multiple
+    # models"): one physical KV page pool shared by every co-hosted
+    # model with matching page geometry (the many-small-fine-tunes
+    # shape), under per-model page quotas with cross-model preemption
+    # by scheduler rank. 0 keeps today's private pool per engine.
+    cont_pool_pages: int = 0  # TOTAL shared pool pages (0 = private pools)
+    # default per-model page quota on the shared pool (0 = uncapped —
+    # bounded by the pool alone); a model spec's "page_quota" overrides
+    cont_pool_quota: int = 0
     # EQuARX-style quantized collectives (parallel/ring.py): ring-attention
     # K/V hops move int8 chunks + scales over ICI with a deterministic f32
     # reduction — ~half the hop bytes at a bounded, test-pinned divergence.

@@ -28,10 +28,21 @@ near-free while the prefix stays resident.
 
 ``kv_quant="int8"`` stores the KV pages int8 with per-(page, position,
 head) scales (engine/paged.py): ~2× slots and ~2× prefix-cache residency
-per HBM byte. Quantized streams keep every determinism contract below
-among themselves (a quantized page + scales IS the cache value, moved
-byte-exactly by COW/promotion/eviction/recovery); only the fp-vs-int8
-comparison differs, bounded in tests/test_ops.py.
+per HBM byte. ``kv_quant="int4"`` packs two values per byte at the same
+scale granularity: ~4× at a byte-matched budget. Quantized streams keep
+every determinism contract below among themselves (a quantized page +
+scales IS the cache value, moved byte-exactly by
+COW/promotion/eviction/recovery); only the fp-vs-quantized comparison
+differs, bounded in tests/test_ops.py.
+
+**Co-hosting** (docs/SERVING.md "Co-hosting multiple models"): several
+engines — one per tenant model — may share ONE physical page pool
+(engine/paged.py::SharedPagePool) under per-tenant page quotas. Each
+tenant keeps its own slots, scheduler, and prefix cache; the shared
+free list is the contended resource, reclaimed cross-tenant first from
+cold resident prefixes and then by preempting strictly-lower-ranked
+neighbors (the PR 4 rank rules applied across models). Page
+conservation extends per-tenant and is checked globally.
 
 Determinism contract (the parity tests' anchor): each slot samples with
 its OWN stateless key chain — token n of a request draws from
@@ -65,6 +76,7 @@ from .paged import (
     PageAllocator,
     PagedKVCache,
     PrefixCache,
+    SharedPagePool,
     bind_slot,
     clear_slot,
     copy_page,
@@ -210,6 +222,11 @@ _ENGINE_COUNTERS = (
      "verify passes executed (one per speculating slot per step)"),
     ("spec_killed", "tlink_engine_spec_killed_total",
      "requests whose acceptance-rate kill switch fired"),
+    # multi-tenant co-hosting (docs/SERVING.md "Co-hosting multiple
+    # models"): this engine's slots torn down for ANOTHER tenant's
+    # higher-ranked candidate on the shared page pool
+    ("preempted_cross_tenant", "tlink_engine_preempted_cross_tenant_total",
+     "slots preempted for another tenant's higher-ranked candidate"),
 )
 
 
@@ -304,6 +321,9 @@ class ContinuousEngine:
         trace_site: str = "",
         metrics: MetricsRegistry | None = None,
         flight_capacity: int = 256,
+        pool: SharedPagePool | None = None,
+        model_id: str = "",
+        page_quota: int = 0,
     ):
         if engine.cfg.sliding_window is not None:
             raise ValueError(
@@ -321,7 +341,7 @@ class ContinuousEngine:
             # paged engine serves it natively as int8 pages — this is what
             # used to (wrongly) route such models to the dense engine
             kv_quant = "int8"
-        if kv_quant not in ("none", "int8"):
+        if kv_quant not in ("none", "int8", "int4"):
             raise ValueError(f"unknown kv_quant mode {kv_quant!r}")
         self.kv_quant = kv_quant
         self.engine = engine
@@ -333,12 +353,31 @@ class ContinuousEngine:
         # the Pallas kernel needs a real TPU; CPU (tests, fallback serving)
         # runs the pure-jnp reference path — same math, one compiled program
         self.use_kernel = jax.default_backend() == "tpu"
-        self.cache = PagedKVCache.init(
-            self.cfg, self.max_slots, page_size=self.page_size,
-            max_len=self.max_seq_len, dtype=engine.cache_dtype,
-            quantized=kv_quant == "int8",
-        )
-        self.alloc = PageAllocator(self.cache.n_pages)
+        # -- co-hosting (docs/SERVING.md "Co-hosting multiple models") ---
+        # with a shared pool the physical page arrays live in the pool
+        # (one set for every tenant); this engine keeps only its OWN
+        # block tables + lengths, and `self.cache` is a property view
+        # stitching the two — every `self.cache = step(...)` writes the
+        # donated arrays back so the next tenant's step reads them
+        self.pool = pool
+        self.model_id = str(model_id or "default")
+        if pool is not None:
+            n_pp = pages_needed(self.max_seq_len, self.page_size)
+            self._bt = jnp.zeros((self.max_slots, n_pp), jnp.int32)
+            self._lengths = jnp.zeros((self.max_slots,), jnp.int32)
+            # the actual pool.attach is the LAST statement of __init__:
+            # attaching here and then failing later (device OOM on the
+            # per-slot buffers, a bad knob) would wedge the tenant id on
+            # the pool — every rebuild for the job would refuse with
+            # "already attached" and the empty pool could never GC
+            self.alloc = None
+        else:
+            self.cache = PagedKVCache.init(
+                self.cfg, self.max_slots, page_size=self.page_size,
+                max_len=self.max_seq_len, dtype=engine.cache_dtype,
+                kv_quant=kv_quant,
+            )
+            self.alloc = PageAllocator(self.cache.n_pages)
         # chunked prefill: the prompt suffix beyond any cache hit prefills
         # in fixed-shape grants of the packed [slots, chunk] block, so a
         # long admission never stalls running slots at all
@@ -427,6 +466,25 @@ class ContinuousEngine:
             "1 when speculative decoding is enabled on this engine",
             fn=lambda: int(self.spec_decode),
         )
+        if pool is not None:
+            # per-tenant pool occupancy: these render under the model's
+            # label at /metrics (the registry-per-model grouping), which
+            # is what makes quota pressure visible PER TENANT
+            self.metrics.gauge(
+                "tlink_engine_pool_quota",
+                "this tenant's page quota on the shared pool",
+                fn=lambda: self.alloc.quota,
+            )
+            self.metrics.gauge(
+                "tlink_engine_pool_pages_used",
+                "pages this tenant holds (slots + cached + in transit)",
+                fn=lambda: self.alloc.used,
+            )
+            self.metrics.gauge(
+                "tlink_engine_pool_pages_free",
+                "free pages on the shared pool (all tenants)",
+                fn=lambda: self.pool.alloc.n_free,
+            )
         self.sched = RequestScheduler(  #: guarded by self._lock
             max_slots=self.max_slots,
             queue_cap=sched_queue_cap,
@@ -452,6 +510,42 @@ class ContinuousEngine:
         self._counts = jnp.zeros(
             (self.max_slots, self.cfg.vocab_size), jnp.int32
         )
+        if pool is not None:
+            # nothing fallible may follow: a registered-but-dead tenant
+            # is unrecoverable without a worker restart (see above)
+            self.alloc = pool.attach(
+                self.model_id, self, quota=int(page_quota)
+            )
+
+    @property
+    def cache(self) -> PagedKVCache:
+        """This tenant's paged-cache view. Solo engines own the whole
+        cache; a pool tenant stitches the SHARED physical page arrays
+        (engine/paged.py::SharedPagePool.kv) to its own block tables and
+        lengths — so N co-hosted engines read and write ONE page pool,
+        and a step's donated arrays flow back through the setter for the
+        next tenant's step to pick up (single driver thread across
+        tenants, the pool's contract)."""
+        if self.pool is None:
+            return self._cache
+        kv = self.pool.kv
+        ks, vs = (kv[2], kv[3]) if len(kv) == 4 else (None, None)
+        return PagedKVCache(
+            k=kv[0], v=kv[1], block_tables=self._bt,
+            lengths=self._lengths, k_scale=ks, v_scale=vs,
+        )
+
+    @cache.setter
+    def cache(self, value: PagedKVCache) -> None:
+        if self.pool is None:
+            self._cache = value
+            return
+        self.pool.kv = (
+            (value.k, value.v) if value.k_scale is None
+            else (value.k, value.v, value.k_scale, value.v_scale)
+        )
+        self._bt = value.block_tables
+        self._lengths = value.lengths
 
     @property
     def stats(self) -> dict:
@@ -675,12 +769,23 @@ class ContinuousEngine:
         LRU-leaf-first — but ONLY when eviction can actually cover the
         deficit. A request too big to fit even after a full cache wipe
         stays queued WITHOUT destroying the resident prefixes the other
-        requests keep hitting."""
+        requests keep hitting. On a shared pool a further rung follows:
+        OTHER tenants' cold resident prefixes reclaim to the shared
+        free list (pool.reclaim_cache) — but only when this tenant's
+        QUOTA has room, because a quota-dry tenant must pay with its
+        own pages, never a neighbor's."""
         pages = self.alloc.alloc(n)
         if pages is None and self.prefix is not None:
             deficit = n - self.alloc.n_free
-            if self.prefix.n_evictable() >= deficit:
+            if deficit > 0 and self.prefix.n_evictable() >= deficit:
                 self.alloc.free(self.prefix.evict(deficit))
+                pages = self.alloc.alloc(n)
+        if pages is None and self.pool is not None:
+            quota_room = self.alloc.quota - self.alloc.used
+            deficit = n - self.pool.alloc.n_free
+            if n <= quota_room and 0 < deficit <= self.pool.reclaim_cache(
+                deficit, self
+            ):
                 pages = self.alloc.alloc(n)
         return pages
 
@@ -1096,7 +1201,10 @@ class ContinuousEngine:
                 payload["ks"].append(np.asarray(got[2]))
                 payload["vs"].append(np.asarray(got[3]))
         blob = {
-            "v": 1,
+            # wire-format version. NOT "v" — that key is the V-pages
+            # payload below (the old "v": 1 entry was silently clobbered
+            # by it, so blobs never actually carried a version)
+            "blob_v": 2,
             "chain": np.asarray(chain, np.int32),
             "length": int(length),
             "last_tok": int(self._tok[slot]),
@@ -1104,6 +1212,11 @@ class ContinuousEngine:
             "n_skip": int(n_skip),
             "page_size": int(self.page_size),
             "kv_quant": self.kv_quant,
+            # the storage-mode triple the importer must match exactly —
+            # int4 and int8 pools share a numpy dtype (int8 bytes), so
+            # dtype alone can NOT tell them apart; kv_quant in the triple
+            # is what makes an int4<->int8 drain refuse loudly
+            "dtype": str(np.dtype(self.cache.k.dtype)),
             "k": np.stack(payload["k"]) if ship else np.zeros(0, np.int8),
             "v": np.stack(payload["v"]) if ship else np.zeros(0, np.int8),
         }
@@ -1225,6 +1338,17 @@ class ContinuousEngine:
         return out
 
     # -- live slot migration (import side) -------------------------------
+    def migration_mode(self) -> tuple[str, int, str]:
+        """The (kv_quant, page_size, cache dtype) storage-mode triple a
+        shipped page blob is portable within — ALL THREE must match for
+        staged bytes to be meaningful on this engine (int4 and int8
+        pools share the int8 byte dtype; page layouts differ per
+        page_size; payload bytes differ per dtype)."""
+        return (
+            self.kv_quant, self.page_size,
+            str(np.dtype(self.cache.k.dtype)),
+        )
+
     def resident_prefix_pages(self, chain, limit: int) -> int:
         """The probe: how many leading FULL pages of ``chain`` are
         resident in this engine's prefix cache — pages the exporter may
@@ -1248,9 +1372,27 @@ class ContinuousEngine:
         if self.drain_state != "serving":
             return False  # a draining engine must not adopt new streams
         t_stage = time.monotonic()
-        if str(blob.get("kv_quant", "none")) != self.kv_quant:
-            return False
-        if int(blob["page_size"]) != self.page_size:
+        ours = self.migration_mode()
+        theirs = (
+            str(blob.get("kv_quant", "none")),
+            int(blob["page_size"]),
+            # legacy blobs carry no dtype field: fall back to ours so the
+            # per-array dtype check below stays the only dtype gate
+            str(blob.get("dtype") or ours[2]),
+        )
+        if theirs != ours:
+            # LOUD refusal on the full (kv_quant, page_size, dtype)
+            # triple — an int4<->int8 drain shares the int8 byte dtype,
+            # so a dtype-only check would silently adopt garbage pages;
+            # the source descends the re-prefill ladder instead
+            from ..core.logging import get_logger
+
+            get_logger("engine.migrate").warning(
+                "refusing inbound migration %s: storage mode "
+                "(kv_quant, page_size, dtype) %r does not match ours %r "
+                "— source takes the re-prefill rung",
+                mig_id, theirs, ours,
+            )
             return False
         chain = [int(t) for t in np.asarray(blob["chain"]).reshape(-1)]
         length = int(blob["length"])
@@ -1378,7 +1520,14 @@ class ContinuousEngine:
         on violation — asserted at engine teardown (close) and by the
         engine/chaos tests after recovery AND mid-migration (the
         in-transit term is what keeps the invariant checkable while a
-        migration is in flight on either side)."""
+        migration is in flight on either side). On a shared pool the
+        invariant is GLOBAL — this delegates to the pool's per-tenant
+        check (free + Σ tenants' (slots + cached + in-transit) ==
+        total, pairwise disjoint ACROSS tenants, quota counters
+        honest)."""
+        if self.pool is not None:
+            self.pool.check_page_conservation()
+            return
         acc = self.page_accounting()
         free, cached = acc["free"], acc["cached"]
         slots, transit = acc["slots"], acc["in_transit"]
@@ -1439,6 +1588,10 @@ class ContinuousEngine:
         passes = out.get("spec_verify_passes", 0)
         out.update({
             "kv_quant": self.kv_quant,
+            # weight storage mode of the wrapped engine ("int8"/"int8+kv"
+            # = weight-only-quantized serving; operators size HBM with
+            # kv_quant AND this)
+            "weight_quant": getattr(self.engine, "quant", None) or "none",
             "kv_pages_total": c.n_pages - 1,
             "kv_pages_free": self.alloc.n_free,
             "kv_page_bytes": int(page_bytes),
@@ -1452,6 +1605,12 @@ class ContinuousEngine:
             "drain_state": self.drain_state,
             "pages_in_transit": self._pages_in_transit(),
         })
+        if self.pool is not None:
+            # co-hosting: the shared pool's occupancy plus THIS tenant's
+            # quota view (docs/SERVING.md "Co-hosting multiple models")
+            out.update(self.pool.snapshot())
+            out["pool_quota"] = self.alloc.quota
+            out["pool_pages_used"] = self.alloc.used
         with self._lock:
             out.update(self.sched.snapshot())
         if self.prefix is not None:
@@ -1511,9 +1670,31 @@ class ContinuousEngine:
                 # waits head-of-line like before
                 with self._lock:
                     victim = self.sched.victim(self._preemptable(), req)
-                if victim is None:
-                    return  # head-of-line waits for pages
-                self._preempt(victim.slot)
+                    cand_rank = self.sched.effective_rank(req)
+                if victim is not None:
+                    self._preempt(victim.slot)
+                    continue
+                if self.pool is not None and (
+                    self.alloc.quota - self.alloc.used
+                    >= pages_needed(
+                        min(len(req.prompt) + req.budget, self.max_seq_len),
+                        self.page_size,
+                    )
+                ):
+                    # cross-tenant rung (docs/SERVING.md "Co-hosting"):
+                    # no same-model victim, but the SHARED pool may hold a
+                    # strictly-lower-ranked slot of another tenant — tear
+                    # it down through ITS engine's normal preemption path
+                    # (promotion + requeue + bit-identical resume all
+                    # intact). Quota must have room: a quota-dry tenant
+                    # never preempts a neighbor.
+                    cross = self.pool.cross_model_victim(cand_rank, self)
+                    if cross is not None:
+                        owner, vreq = cross
+                        owner._preempt(vreq.slot)
+                        owner._count("preempted_cross_tenant")
+                        continue
+                return  # head-of-line waits for pages
             with self._lock:
                 self.sched.remove(req)
                 if req.slot >= 0:
@@ -1841,11 +2022,24 @@ class ContinuousEngine:
         # staged adoptions whose resume never arrived die with the engine
         for mig_id in list(self._migrations):
             self.drop_staged_migration(mig_id)
+        if self.pool is not None and self.prefix is not None:
+            # a pool tenant's resident prefixes die with its engine (the
+            # trie's pages belong to the shared pool — leaving them
+            # parked would leak them past this tenant's detach)
+            self.alloc.free(self.prefix.drop_all())
         # teardown invariant: with every slot evicted and every staged
         # migration released, the free-list plus the cache-resident set
         # must account for every usable page — a violation here means a
         # leak or a double-ownership upstream
         self.check_page_conservation()
+        if self.pool is not None:
+            # detach so the pool stops walking this tenant (and the model
+            # id frees up for a rebuilt engine); keep a frozen cache view
+            # so post-close telemetry reads don't dangle
+            frozen = self.cache
+            self.pool.detach(self.model_id)
+            self.pool = None
+            self._cache = frozen
 
 
 __all__ = [

@@ -26,18 +26,24 @@ scalar-prefetched block table) with
 and in parity tests; the decode continuation inside the step runs the
 :func:`~tensorlink_tpu.ops.attention.paged_attention` kernel per token.
 
-**Quantized pages** (``quantized=True`` / ``MLConfig.kv_quant="int8"``):
-the page pool stores KV int8 with per-(page, position, head) symmetric
-f32 scales carried page-granular alongside the payload. Quantization
-happens at THE one page-write path (``_ragged_write_indices`` feeds every
-program), one position at a time — a position's (int8 bytes, scale) pair
-depends only on its own KV row, so the bitwise cache contract survives by
-construction: a quantized page + its scale rows IS the cache value, and
-COW ``copy_page``, trie promotion, LRU eviction, crash-recovery
-re-prefill and preemption resume all move it byte-exactly. The kernels
-dequantize at the page fetch (scale multiply fused into the HBM read),
-so KV bytes halve while the MXU math stays in the model dtype — ~2×
-serving slots and ~2× prefix-cache residency at fixed HBM.
+**Quantized pages** (``MLConfig.kv_quant="int8"`` / ``"int4"``): the page
+pool stores KV int8 — or PACKED int4, two values per byte over a
+split-half nibble layout (models/quant.py::quantize_kv4) — with
+per-(page, position, head) symmetric f32 scales carried page-granular
+alongside the payload. Quantization happens at THE one page-write path
+(``_ragged_write_indices`` feeds every program), one position at a time —
+a position's (quantized bytes, scale) pair depends only on its own KV
+row, so the bitwise cache contract survives by construction: a quantized
+page + its scale rows IS the cache value, and COW ``copy_page``, trie
+promotion, LRU eviction, crash-recovery re-prefill and preemption resume
+all move it byte-exactly. The kernels dequantize at the page fetch
+(nibble unpack + scale multiply fused into the HBM read), so KV bytes
+halve (int8) or quarter (int4) while the MXU math stays in the model
+dtype — ~2×/~4× serving slots and prefix-cache residency at fixed HBM.
+
+**Multi-tenant pool** (:class:`SharedPagePool`): co-hosted models with
+matching page geometry share ONE physical pool under per-tenant quotas —
+the reclaimed HBM spent on scenario diversity instead of headroom.
 """
 
 from __future__ import annotations
@@ -62,6 +68,7 @@ from ..models.transformer import (
 )
 from ..models.quant import matmul as _mm
 from ..models.quant import quantize_kv as _quant_kv
+from ..models.quant import quantize_kv4 as _quant_kv4
 from ..ops.attention import (
     paged_attention,
     paged_attention_ref,
@@ -103,12 +110,29 @@ class PagedKVCache:
         max_len: int | None = None,
         dtype=None,
         quantized: bool = False,
+        kv_quant: str | None = None,
+        n_pages: int | None = None,
     ) -> "PagedKVCache":
+        """``kv_quant`` ("none"/"int8"/"int4") supersedes the legacy
+        ``quantized`` bool (kept as an "int8" alias). ``n_pages``
+        overrides the slots×capacity pool sizing — how a shared
+        multi-tenant pool decouples its page budget from any one
+        tenant's slot count (:class:`SharedPagePool`)."""
+        mode = kv_quant or ("int8" if quantized else "none")
         S_max = max_len or cfg.max_seq_len
         n_pp = -(-S_max // page_size)  # pages per slot (ceil)
-        P = 1 + max_slots * n_pp  # page 0 = scratch, never allocated
-        shape = (cfg.n_layers, P, cfg.n_kv_heads, page_size, cfg.head_dim)
-        if quantized:
+        # page 0 = scratch, never allocated
+        P = n_pages if n_pages is not None else 1 + max_slots * n_pp
+        hd = cfg.head_dim
+        if mode == "int4":
+            if hd % 2:
+                raise ValueError(
+                    f"kv_quant='int4' packs two values per byte — "
+                    f"head_dim {hd} must be even"
+                )
+            hd //= 2  # packed: two int4 values per stored byte
+        shape = (cfg.n_layers, P, cfg.n_kv_heads, page_size, hd)
+        if mode in ("int8", "int4"):
             return cls(
                 k=jnp.zeros(shape, jnp.int8),
                 v=jnp.zeros(shape, jnp.int8),
@@ -117,6 +141,8 @@ class PagedKVCache:
                 k_scale=jnp.zeros(shape[:-1], jnp.float32),
                 v_scale=jnp.zeros(shape[:-1], jnp.float32),
             )
+        if mode != "none":
+            raise ValueError(f"unknown kv_quant mode {mode!r}")
         dt = dtype or cfg.dtype
         return cls(
             k=jnp.zeros(shape, dt),
@@ -170,6 +196,278 @@ class PageAllocator:
         for p in pages:
             if p > 0:
                 self._free.append(p)
+
+
+# ---------------------------------------------------------------------------
+# Shared multi-tenant page pool (co-hosted models, docs/SERVING.md
+# "Co-hosting multiple models")
+# ---------------------------------------------------------------------------
+
+
+class PoolTenant:
+    """One co-hosted model's quota-bounded allocator façade over a
+    :class:`SharedPagePool` — the ``PageAllocator`` interface a
+    ``ContinuousEngine`` consumes (``n_free``/``alloc``/``free``), with
+    two extra constraints: an allocation must fit BOTH the shared pool's
+    free list and this tenant's page quota, and every page this tenant
+    holds (slot-owned, prefix-cache-resident, or in transit) counts
+    against ``used`` until it returns through :meth:`free` — which is
+    what makes the per-tenant conservation term checkable."""
+
+    def __init__(self, pool: "SharedPagePool", model_id: str, quota: int):
+        self.pool = pool
+        self.model_id = str(model_id)
+        # 0 = uncapped (bounded by the pool alone)
+        self.quota = int(quota) if quota else pool.n_pages - 1
+        self.used = 0
+        self.engine = None  # bound by SharedPagePool.attach
+
+    @property
+    def n_free(self) -> int:
+        return min(self.pool.alloc.n_free, self.quota - self.used)
+
+    @property
+    def _free(self):
+        # page_accounting compatibility: the authoritative free list is
+        # the shared pool's
+        return self.pool.alloc._free
+
+    def alloc(self, n: int) -> list[int] | None:
+        if self.used + n > self.quota:
+            return None  # quota dry — the tenant's own eviction/preemption
+            # ladder must reclaim ITS pages; other tenants are unaffected
+        pages = self.pool.alloc.alloc(n)
+        if pages is not None:
+            self.used += len(pages)
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        n = sum(1 for p in pages if p > 0)
+        self.pool.alloc.free(pages)
+        self.used -= n
+        assert self.used >= 0, (
+            f"tenant {self.model_id!r} freed more pages than it held"
+        )
+
+
+class SharedPagePool:
+    """ONE physical KV page pool shared by several co-hosted tenant
+    engines — the multi-tenant density play: the HBM a quantized page
+    pool reclaims is spent on MORE MODELS resident per chip instead of
+    idle headroom. Tenants must share page geometry (layers, kv heads,
+    head_dim, page size, storage mode) — the many-small-fine-tunes
+    shape, where N adapters of one base model serve from one worker;
+    each keeps its OWN block tables, slots, scheduler, and prefix cache
+    (cache keys are per-model by construction — tries never mix), while
+    the physical pages and the free list are shared under per-tenant
+    quotas.
+
+    Threading contract: the pool extends the engines' single-driver
+    discipline ACROSS tenants — every attached engine must be stepped
+    from the same driver thread (the worker's run loop already is), so
+    cross-tenant reclaim and preemption can walk another tenant's
+    host-side state without racing its driver.
+
+    Cross-tenant policy (the PR 4 scheduler's rank rules, extended):
+    when a tenant's allocation fails on the SHARED free list (not its
+    quota), the admission ladder may (1) evict other tenants'
+    refcount-0 prefix-cache pages LRU-first (:meth:`reclaim_cache`),
+    then (2) preempt another tenant's strictly-lower-ranked running
+    slot (:meth:`cross_model_victim`) through that engine's normal
+    preemption path — so an interactive request of model A outranks a
+    best_effort slot of model B, but can never touch B's equal-or-
+    better-ranked work."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        n_pages: int,
+        *,
+        page_size: int = 16,
+        dtype=None,
+        kv_quant: str = "none",
+    ):
+        self.page_size = int(page_size)
+        self.kv_quant = str(kv_quant or "none")
+        proto = PagedKVCache.init(
+            cfg, 0, page_size=self.page_size, max_len=self.page_size,
+            dtype=dtype, kv_quant=self.kv_quant, n_pages=1 + int(n_pages),
+        )
+        # the canonical layer-stacked page arrays: tenant engines read
+        # them through their cache property and write them back after
+        # every donated step — one physical pool, N block-table views
+        self.kv: tuple = _cache_kv(proto)
+        self.alloc = PageAllocator(1 + int(n_pages))
+        self.tenants: dict[str, PoolTenant] = {}
+        self.geometry = (
+            cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, self.page_size,
+            self.kv_quant, str(proto.k.dtype),
+        )
+        self.cross_preemptions = 0
+        self.cache_reclaims = 0
+
+    @property
+    def n_pages(self) -> int:
+        return self.kv[0].shape[1]
+
+    @property
+    def n_free(self) -> int:
+        return self.alloc.n_free
+
+    def attach(self, model_id: str, engine, *, quota: int = 0) -> PoolTenant:
+        """Register a tenant engine. Geometry must match the pool's —
+        a mismatched model cannot share physical pages and must get its
+        own pool (loud, never a silent corruption)."""
+        t_dtype = (
+            "int8" if engine.kv_quant in ("int8", "int4")
+            else str(jnp.dtype(engine.engine.cache_dtype))
+        )
+        geo = (
+            engine.cfg.n_layers, engine.cfg.n_kv_heads,
+            engine.cfg.head_dim, engine.page_size, engine.kv_quant,
+            t_dtype,
+        )
+        if geo != self.geometry:
+            raise ValueError(
+                f"tenant {model_id!r} page geometry {geo} does not match "
+                f"the shared pool's {self.geometry} — co-hosted models "
+                "must share (layers, kv_heads, head_dim, page_size, "
+                "kv_quant, dtype)"
+            )
+        if model_id in self.tenants:
+            raise ValueError(f"tenant {model_id!r} already attached")
+        t = PoolTenant(self, model_id, quota)
+        t.engine = engine
+        self.tenants[model_id] = t
+        return t
+
+    def detach(self, model_id: str) -> None:
+        t = self.tenants.pop(model_id, None)
+        assert t is None or t.used == 0, (
+            f"tenant {model_id!r} detached holding {t.used} pages"
+        )
+
+    # -- cross-tenant reclaim / preemption (single driver thread) --------
+    def reclaim_cache(self, n: int, exclude) -> int:
+        """Evict up to ``n`` refcount-0 prefix-cache pages from OTHER
+        tenants (LRU within each trie) back to the shared free list.
+        Returns how many pages came back. The first rung of the
+        cross-tenant ladder: cold resident prefixes are the cheapest
+        HBM to take — no stream is disturbed."""
+        freed = 0
+        for t in self.tenants.values():
+            if t.engine is exclude or t.engine.prefix is None:
+                continue
+            need = n - freed
+            if need <= 0:
+                break
+            pages = t.engine.prefix.evict(need)
+            if pages:
+                t.engine.alloc.free(pages)
+                freed += len(pages)
+        self.cache_reclaims += freed
+        return freed
+
+    def cross_model_victim(self, cand_rank: int, exclude):
+        """The running request another tenant should preempt for a
+        candidate of effective rank ``cand_rank``, or None — the PR 4
+        victim rules applied across models: only slots whose
+        ADMISSION-TIME rank is strictly worse are eligible, worst rank
+        first (ties broken toward the tenant holding the most pages, so
+        one teardown frees the most HBM). Returns ``(engine, request)``;
+        the caller preempts through that engine's normal path, so the
+        victim's resume contract (promotion, requeue, bit-identical
+        stream) is untouched."""
+        best = None
+        for t in self.tenants.values():
+            eng = t.engine
+            if eng is exclude:
+                continue
+            with eng._lock:
+                v = eng.sched.victim_for_rank(eng._preemptable(), cand_rank)
+            if v is None:
+                continue
+            key = (v.admit_rank, t.used)
+            if best is None or key > best[0]:
+                best = (key, eng, v)
+        if best is None:
+            return None
+        self.cross_preemptions += 1
+        return best[1], best[2]
+
+    # -- conservation ----------------------------------------------------
+    def check_page_conservation(self) -> None:
+        """The multi-tenant free-list invariant: shared free + Σ per
+        tenant (slot-owned + cache-resident + in-transit) == total
+        usable pages, every set pairwise disjoint ACROSS tenants, each
+        tenant's ``used`` counter equal to what its engine actually
+        holds, scratch page 0 nowhere. Raises AssertionError on
+        violation — the per-tenant terms are what keep a quota
+        meaningful: a tenant can neither hide pages from its quota nor
+        leak them into a neighbor's."""
+        problems: list[str] = []
+        free = set(self.alloc._free)
+        if len(free) != len(self.alloc._free):
+            problems.append("shared free-list holds a duplicate page")
+        seen: dict[int, str] = {p: "free" for p in free}
+        total_held = 0
+        for mid, t in self.tenants.items():
+            acc = t.engine.page_accounting()
+            slots, cached = list(acc["slots"]), set(acc["cached"])
+            transit = list(acc["in_transit"])
+            if len(slots) != len(set(slots)):
+                problems.append(f"[{mid}] a page is owned by two slots")
+            if len(transit) != len(set(transit)):
+                problems.append(f"[{mid}] a page is in transit twice")
+            held = set(slots) | cached | set(transit)
+            if len(held) != len(slots) + len(cached) + len(transit):
+                problems.append(f"[{mid}] page in two ownership classes")
+            for p in held:
+                prev = seen.get(p)
+                if prev is not None:
+                    problems.append(
+                        f"page {p} held by both {prev} and {mid}"
+                    )
+                seen[p] = mid
+            n_held = len(slots) + len(cached) + len(transit)
+            total_held += n_held
+            if n_held != t.used:
+                problems.append(
+                    f"[{mid}] quota accounting drifted: engine holds "
+                    f"{n_held} pages, tenant.used={t.used}"
+                )
+            if t.used > t.quota:
+                problems.append(
+                    f"[{mid}] over quota: used={t.used} > {t.quota}"
+                )
+        if 0 in seen:
+            problems.append("scratch page 0 entered an ownership set")
+        total = self.n_pages - 1
+        if len(free) + total_held != total:
+            problems.append(
+                f"leak: free={len(free)} + held={total_held} != "
+                f"total={total}"
+            )
+        if problems:
+            raise AssertionError(
+                "pool page conservation violated: " + "; ".join(problems)
+            )
+
+    def snapshot(self) -> dict:
+        """Pool-level telemetry (each tenant's engine merges this into
+        its serving_snapshot; /metrics reads the same numbers through
+        per-engine callback gauges)."""
+        return {
+            "pool_pages_total": self.n_pages - 1,
+            "pool_pages_free": self.alloc.n_free,
+            "pool_tenants": len(self.tenants),
+            "pool_cross_preemptions": self.cross_preemptions,
+            "pool_cache_reclaims": self.cache_reclaims,
+            "pool_used": {
+                mid: {"used": t.used, "quota": t.quota}
+                for mid, t in self.tenants.items()
+            },
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -481,16 +779,19 @@ def _with_kv(cache: PagedKVCache, kv: tuple, **kw) -> PagedKVCache:
 # tlint: hot-path
 def _scatter_kv(cache_kv: tuple, write_pg, write_off, k, v) -> tuple:
     """THE one page-write path's scatter: land this block's KV rows at
-    their ``(page, offset)`` targets across every program. In int8 mode
-    this is the single quantize site — each position's row quantizes
+    their ``(page, offset)`` targets across every program. In quantized
+    mode this is the single quantize site — each position's row quantizes
     independently (per-(position, head) scale over ``head_dim``,
-    models/quant.py::quantize_kv), which is exactly what keeps chunk
-    framing, COW and promotion byte-exact under quantization. ``k``/``v``
-    are ``[..., Hkv, hd]`` with leading dims matching ``write_pg``."""
+    models/quant.py::quantize_kv — or ``quantize_kv4`` when the pages are
+    PACKED int4, detected by the page dim being half the row's), which is
+    exactly what keeps chunk framing, COW and promotion byte-exact under
+    quantization. ``k``/``v`` are ``[..., Hkv, hd]`` with leading dims
+    matching ``write_pg``."""
     if len(cache_kv) == 4:
         ck, cv, cks, cvs = cache_kv
-        k8, ks = _quant_kv(k)
-        v8, vs = _quant_kv(v)
+        quant = _quant_kv4 if ck.shape[-1] != k.shape[-1] else _quant_kv
+        k8, ks = quant(k)
+        v8, vs = quant(v)
         ck = ck.at[write_pg, :, write_off].set(k8)
         cv = cv.at[write_pg, :, write_off].set(v8)
         cks = cks.at[write_pg, :, write_off].set(ks)
@@ -1005,7 +1306,9 @@ def pages_needed(total_len: int, page_size: int) -> int:
 __all__ = [
     "PagedKVCache",
     "PageAllocator",
+    "PoolTenant",
     "PrefixCache",
+    "SharedPagePool",
     "paged_decode_step",
     "paged_ragged_step",
     "copy_page",

@@ -354,9 +354,19 @@ class RequestScheduler:
         order says nothing about that, an early arrival may have just
         re-admitted).
         """
-        if not self.preemption or candidate is None:
+        if candidate is None:
             return None
-        cand_rank = self.effective_rank(candidate)
+        return self.victim_for_rank(running, self.effective_rank(candidate))
+
+    # tlint: holds-lock(the engine lock)
+    def victim_for_rank(self, running: list, cand_rank: int) -> object | None:
+        """:meth:`victim` against an externally-computed candidate rank —
+        how a co-hosted pool (engine/paged.py::SharedPagePool) applies
+        THIS scheduler's admission-time-rank preemption shield to a
+        candidate queued on ANOTHER tenant's scheduler: the rank value is
+        the cross-model currency, the victim rules are unchanged."""
+        if not self.preemption:
+            return None
         eligible = [
             r for r in running
             if r is not None

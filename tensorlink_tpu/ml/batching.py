@@ -722,6 +722,9 @@ class ContinuousBatcher:
         sched_policy: str = "slo",
         sched_max_wait_s: float = 60.0,
         trace_site: str = "",
+        pool: Any = None,
+        model_id: str = "",
+        page_quota: int = 0,
     ):
         from collections import deque
 
@@ -767,6 +770,9 @@ class ContinuousBatcher:
                     sched_policy=sched_policy,
                     sched_max_wait_s=sched_max_wait_s,
                     trace_site=trace_site or "local",
+                    # multi-tenant co-hosting: share ONE page pool with
+                    # the other tenants under a per-model quota
+                    pool=pool, model_id=model_id, page_quota=page_quota,
                 )
             )
             self.mode = "local"
@@ -780,6 +786,10 @@ class ContinuousBatcher:
         # the engine lives in another process (remote/pipelined)
         self._modes = {
             "kv_quant": str(kv_quant or "none"),
+            "weight_quant": str(
+                (getattr(model, "model_spec", None) or {}).get("quant")
+                or "none"
+            ),
             "spec_decode": bool(spec_decode),
         }
         if self.mode in ("local", "pipelined"):
@@ -803,10 +813,22 @@ class ContinuousBatcher:
         reads the live engine; remote/pipelined report the configured
         knobs (the worker engine is built from the same MLConfig)."""
         if self._cont is not None:
-            return {
+            modes = {
                 "kv_quant": self._cont.kv_quant,
+                "weight_quant": (
+                    getattr(self._cont.engine, "quant", None) or "none"
+                ),
                 "spec_decode": bool(self._cont.spec_decode),
             }
+            if self._cont.pool is not None:
+                # co-hosting view: a router sizing placement needs the
+                # tenant's quota headroom, not just the mode strings
+                modes["pool"] = {
+                    "quota": self._cont.alloc.quota,
+                    "used": self._cont.alloc.used,
+                    "free": self._cont.pool.alloc.n_free,
+                }
+            return modes
         return dict(self._modes)
 
     # -- client side -----------------------------------------------------

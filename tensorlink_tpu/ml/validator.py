@@ -256,9 +256,13 @@ class DistributedValidator:
         seq_len: int | None = None,
         config: dict | None = None,
         seed: int = 0,
+        quant: str | None = None,
     ) -> HostedJob:
         """Plan, recruit, and attach a model for API serving. Synchronous and
-        thread-safe; callable from API handler threads."""
+        thread-safe; callable from API handler threads. ``quant`` ("int8" /
+        "int8+kv") serves the model weight-only-quantized on the paged
+        engine — weights and KV shrink together (docs/SERVING.md
+        "Quantized KV")."""
         with self._host_lock:
             job = self.hosted.get(name)
             if job is not None and job.status in ("loading", "ready"):
@@ -266,14 +270,19 @@ class DistributedValidator:
             job = HostedJob(name=name)
             self.hosted[name] = job
         try:
-            self._do_host(job, batch=batch, seq_len=seq_len, config=config, seed=seed)
+            self._do_host(
+                job, batch=batch, seq_len=seq_len, config=config, seed=seed,
+                quant=quant,
+            )
         except Exception as e:
             job.status = "failed"
             job.error = f"{type(e).__name__}: {e}"
             self.log.exception("hosting %s failed", name)
         return job
 
-    def _do_host(self, job: HostedJob, *, batch, seq_len, config, seed) -> None:
+    def _do_host(
+        self, job: HostedJob, *, batch, seq_len, config, seed, quant=None
+    ) -> None:
         from tensorlink_tpu.api.tokenizer import load_tokenizer
         from tensorlink_tpu.ml.module import DistributedModel
 
@@ -281,6 +290,13 @@ class DistributedValidator:
         model_spec: dict = {"name": name, "seed": seed}
         if config:
             model_spec["config"] = config
+        if quant:
+            # weight-only-quantized serving rides the job spec to the
+            # worker (ml/worker.py::load_stage quantizes the stage params;
+            # the paged engine dequantizes through quant.matmul on the fly)
+            if quant not in ("int8", "int8+kv"):
+                raise ValueError(f"unknown quant mode {quant!r}")
+            model_spec["quant"] = quant
         if "/" in name or name.startswith("."):
             model_spec.setdefault("ckpt", name)
         cfg = self._resolve_config(model_spec)
@@ -380,7 +396,10 @@ class DistributedValidator:
                 modes[name] = get_modes()
             else:
                 # windowed batcher (or no batcher yet): vanilla decode
-                modes[name] = {"kv_quant": "none", "spec_decode": False}
+                modes[name] = {
+                    "kv_quant": "none", "weight_quant": "none",
+                    "spec_decode": False,
+                }
         return {
             "status": "ok",
             "hosted_models": list(jobs),

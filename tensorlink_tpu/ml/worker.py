@@ -129,6 +129,13 @@ class DistributedWorker:
         # redirected there instead of admitted, and the recruiting
         # capacity is zeroed. None = serving normally.
         self.draining: dict | None = None
+        # shared multi-tenant KV page pools (engine/paged.py::
+        # SharedPagePool), keyed by page GEOMETRY so only models that can
+        # physically share pages do — created lazily at the first
+        # continuous engine when MLConfig.cont_pool_pages > 0. Touched
+        # only from the serial run loop (the pool's single-driver
+        # contract holds because every job's engine steps there too).
+        self._kv_pools: dict = {}
         # per-node fault plan (core/faults.py) — an INSTANCE, not the module
         # global, so several worker nodes living in one test process never
         # share fault counters; None (the default) keeps the hot paths free
@@ -292,6 +299,9 @@ class DistributedWorker:
                 # than letting their clients wait out the RPC timeout
                 rt.cont.close(RuntimeError("job shut down"))
                 rt.cont = None
+                # close() detached the tenant: a now-empty shared pool
+                # must release its page arrays, not pin HBM forever
+                self._gc_kv_pools()
         elif kind == "token":
             pass  # token relays are user/validator side
         else:
@@ -419,9 +429,13 @@ class DistributedWorker:
                 max_seq_len=min(cfg.max_seq_len, ml_cfg.max_seq_len),
                 seq_buckets=ml_cfg.seq_buckets,
                 batch_buckets=ml_cfg.batch_buckets,
-                # params are pre-quantized above (idempotent); this sets
-                # the engine's cache mode for "+kv"
-                quant=quant if cache_quant else None,
+                # params are pre-quantized above (quantize_params is
+                # idempotent, so the engine's own pass is a no-op); this
+                # sets the engine's cache mode for "+kv" AND records the
+                # weight mode the serving snapshot / serving_modes report
+                # (weights-only "int8" used to pass None here, so the
+                # paged engine couldn't tell operators it was quantized)
+                quant=quant if not training else None,
             )
         with self._lock:
             old = self.jobs.get(job_id)
@@ -1731,9 +1745,22 @@ class DistributedWorker:
         from tensorlink_tpu.engine.continuous import ContinuousEngine
 
         ml = self.node.config.ml
+        pool = None
+        quota = 0
+        if int(getattr(ml, "cont_pool_pages", 0)) > 0:
+            pool = self._shared_kv_pool(rt, ml)
+            quota = int(
+                (rt.model_spec or {}).get("page_quota")
+                or getattr(ml, "cont_pool_quota", 0)
+            )
         try:
             rt.cont = cont = ContinuousEngine(
                 rt.engine,
+                # co-hosting (docs/SERVING.md): every job whose page
+                # geometry matches shares ONE physical pool under a
+                # per-model quota; job_id keys the tenant (unique even
+                # when one model hosts twice)
+                pool=pool, model_id=rt.job_id, page_quota=quota,
                 # spans this engine records carry the worker's identity —
                 # the cross-worker stitch /trace serves depends on it
                 trace_site=str(self.node.node_id or ""),
@@ -1763,6 +1790,58 @@ class DistributedWorker:
             return None
         return cont
 
+    def _shared_kv_pool(self, rt: "StageRuntime", ml):
+        """Get-or-create the shared multi-tenant page pool this job's
+        engine should draw from (MLConfig.cont_pool_pages > 0). Pools are
+        keyed by page GEOMETRY — (layers, kv heads, head_dim, page size,
+        kv_quant, dtype) — so models that cannot physically share pages
+        transparently get separate pools instead of a loud attach error
+        at hosting time."""
+        import jax.numpy as jnp
+
+        from tensorlink_tpu.engine.paged import SharedPagePool
+
+        cfg = rt.cfg
+        kvq = str(ml.kv_quant or "none")
+        if rt.cache_quant and kvq == "none":
+            kvq = "int8"  # mirror of the engine's cache_quant forcing
+        page_size = int(ml.cont_page_size)
+        dtype_str = (
+            "int8" if kvq in ("int8", "int4")
+            else str(jnp.dtype(rt.engine.cache_dtype))
+        )
+        key = (
+            cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, page_size, kvq,
+            dtype_str,
+        )
+        self._gc_kv_pools(keep=key)
+        pool = self._kv_pools.get(key)
+        if pool is None:
+            pool = SharedPagePool(
+                cfg, int(ml.cont_pool_pages), page_size=page_size,
+                dtype=rt.engine.cache_dtype, kv_quant=kvq,
+            )
+            self._kv_pools[key] = pool
+            self.log.info(
+                "created shared KV page pool %s (%d pages, kv_quant=%s)",
+                key, int(ml.cont_pool_pages), kvq,
+            )
+        return pool
+
+    def _gc_kv_pools(self, keep=None) -> None:
+        """Drop shared pools whose LAST tenant detached (their page
+        arrays would otherwise pin HBM for the life of the process —
+        a worker cycling through hosted geometries would accumulate one
+        dead full-size pool per geometry key). ``keep`` spares the key
+        about to be (re)used so an empty-but-wanted pool is reused, not
+        rebuilt. Called from the serial run loop only."""
+        for k in [
+            k for k, p in self._kv_pools.items()
+            if not p.tenants and k != keep
+        ]:
+            del self._kv_pools[k]
+            self.log.info("released empty shared KV page pool %s", k)
+
     def _schedule_cont(self, rt: "StageRuntime") -> None:
         if not rt.cont_scheduled:
             rt.cont_scheduled = True
@@ -1790,6 +1869,7 @@ class DistributedWorker:
             self.log.exception("continuous decode chunk failed")
             rt.cont.close(e)  # responds the error on every live rid
             rt.cont = None
+            self._gc_kv_pools()  # release a now-tenantless shared pool
             return
         if more:
             self._schedule_cont(rt)

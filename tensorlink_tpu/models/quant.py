@@ -90,6 +90,62 @@ def dequantize_kv(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
+# -- packed int4 KV (engine/paged.py kv_quant="int4") -----------------------
+# Layout: SPLIT-HALF nibble packing over head_dim — byte ``j`` of a packed
+# row holds element ``j`` in its low nibble and element ``j + hd/2`` in its
+# high nibble, so unpacking is one concatenate on the last axis (TPU-friendly;
+# a stride-2 interleave would fight the lane layout). Values are symmetric
+# int4 in [-7, 7] with the SAME per-(position, head) scale granularity as
+# int8 — which is what carries the paged cache's bitwise contract over: a
+# position's (packed bytes, scale) pair still depends only on its own KV row.
+
+
+# tlint: hot-path
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int values in [-7, 7] ``[..., hd]`` (hd even) into nibbles
+    ``[..., hd // 2]`` int8 — split-half layout (see above)."""
+    h = q.shape[-1] // 2
+    b = q.astype(jnp.int32)
+    return ((b[..., :h] & 0xF) | ((b[..., h:] & 0xF) << 4)).astype(jnp.int8)
+
+
+# tlint: hot-path
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: nibbles ``[..., hd // 2]`` int8 back
+    to sign-extended int values ``[..., hd]`` int32 in [-8, 7]. Pure
+    bit-ops (and/shift/xor/sub) so the same expression runs inside the
+    Pallas kernels' VMEM dequant and in the pure-jnp references."""
+    b = packed.astype(jnp.int32) & 0xFF
+    lo = ((b & 0xF) ^ 8) - 8
+    hi = (((b >> 4) & 0xF) ^ 8) - 8
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+# tlint: hot-path
+def quantize_kv4(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int4 over ``head_dim`` for KV rows headed into a packed
+    page pool: ``[..., hd] -> (int8 [..., hd // 2], f32 scale [...])`` —
+    two values per byte at :func:`quantize_kv`'s per-(position, head)
+    scale granularity, so every bitwise-cache argument that held for int8
+    (chunk-framing invariance, COW, promotion, re-prefill) holds for int4
+    by the same construction. 15 levels instead of 255: the divergence
+    bound is looser (tests/test_ops.py pins it) but still independent of
+    context length — attention outputs are convex combinations of V rows."""
+    tf = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(tf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(tf / scale[..., None]), -7, 7)
+    return pack_int4(q), scale
+
+
+# tlint: hot-path
+def dequantize_kv4(packed: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv4`; unpack + scale fuse into the read."""
+    return (
+        unpack_int4(packed).astype(jnp.float32) * scale[..., None]
+    ).astype(dtype)
+
+
 # Parameter-tree paths quantized for serving: the large matmul weights.
 # Norm scales, biases, and qk-norm vectors stay exact (tiny, and precision
 # there is cheap insurance).
@@ -141,6 +197,7 @@ def quantized_bytes(params: dict) -> int:
 
 
 __all__ = [
-    "QTensor", "dequantize", "dequantize_kv", "matmul", "quantize_kv",
-    "quantize_params", "quantize_tensor", "quantized_bytes",
+    "QTensor", "dequantize", "dequantize_kv", "dequantize_kv4", "matmul",
+    "pack_int4", "quantize_kv", "quantize_kv4", "quantize_params",
+    "quantize_tensor", "quantized_bytes", "unpack_int4",
 ]

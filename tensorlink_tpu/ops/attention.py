@@ -34,13 +34,15 @@ kernel's batch axis runs over (B, Hkv, G) while the k/v block specs index
 ``b // G`` — repeated KV heads are never materialized, matching the einsum
 path's memory behavior.
 
-Quantized paged KV (``MLConfig.kv_quant="int8"``): every paged entry point
-accepts optional ``k_scale``/``v_scale`` arrays ``[P, Hkv, page]`` marking
-the pages int8 — the kernels fetch half the KV bytes per page and fuse the
-per-(position, head) dequant multiply into the VMEM read (the
-models/quant.py weight pattern), so the MXU arithmetic is unchanged. The
-``_ref`` twins dequantize at the same gather, pinned against the kernels
-in tests/test_ops.py.
+Quantized paged KV (``MLConfig.kv_quant="int8"`` / ``"int4"``): every paged
+entry point accepts optional ``k_scale``/``v_scale`` arrays ``[P, Hkv,
+page]`` marking the pages quantized — the kernels fetch the quantized KV
+bytes per page (half for int8; a page whose trailing dim is ``hd // 2``
+is PACKED int4, two values per byte — a quarter) and fuse the
+per-(position, head) dequant multiply (plus the int4 nibble unpack) into
+the VMEM read (the models/quant.py weight pattern), so the MXU arithmetic
+is unchanged. The ``_ref`` twins dequantize at the same gather, pinned
+against the kernels in tests/test_ops.py.
 """
 
 from __future__ import annotations
@@ -228,12 +230,29 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
+def _unpack4(x):
+    """In-kernel/inline int4 dequant prologue: packed nibbles ``[.., h]``
+    int8 → f32 ``[.., 2h]``. Delegates to models/quant.py::unpack_int4 —
+    ONE implementation of the split-half layout, so the kernels' VMEM
+    unpack and the write-side packing can never drift (the bit-ops are
+    plain jnp and trace fine inside pallas)."""
+    from ..models.quant import unpack_int4
+
+    return unpack_int4(x).astype(jnp.float32).astype(jnp.float32)
+
+
 def _gather_pages(pages, scales, block_tables, shape):
-    """Contiguous f32 per-slot KV view over a (possibly int8) page pool:
-    gathers each block table's pages, dequantizing with the per-(page,
-    position, head) scales when present — the scale multiply rides the
-    gather read, exactly the models/quant.py weight pattern."""
-    x = pages[block_tables].astype(jnp.float32)
+    """Contiguous f32 per-slot KV view over a (possibly quantized) page
+    pool: gathers each block table's pages, dequantizing with the
+    per-(page, position, head) scales when present — the scale multiply
+    rides the gather read, exactly the models/quant.py weight pattern.
+    Packed int4 pages (two values per byte: the page's trailing dim is
+    half the target head_dim) unpack before the scale multiply."""
+    x = pages[block_tables]
+    if scales is not None and x.shape[-1] * 2 == shape[-1]:
+        x = _unpack4(x)  # packed int4 pages → f32 [.., hd]
+    else:
+        x = x.astype(jnp.float32)
     if scales is not None:
         x = x * scales[block_tables].astype(jnp.float32)[..., None]
     # [.., n_pp, Hkv, page, hd] -> [.., n_pp, page, Hkv, hd] -> [.., K, ..]
@@ -353,6 +372,7 @@ def _paged_prefill_kernel(
     n_pp: int,
     G: int,
     quantized: bool,
+    packed: bool = False,
 ):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
@@ -378,12 +398,19 @@ def _paged_prefill_kernel(
     @pl.when(i * page <= start + C - 1)
     def _compute():
         q = q_ref[0].astype(jnp.float32)  # [C·G, hd]
-        k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
-        v = v_ref[0, 0].astype(jnp.float32)
+        if packed:
+            # int4 pages (two values per byte): the nibble unpack joins
+            # the dequant in the VMEM read — the HBM fetch carried a
+            # QUARTER of the fp16 bytes
+            k = _unpack4(k_ref[0, 0])  # [page, hd]
+            v = _unpack4(v_ref[0, 0])
+        else:
+            k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+            v = v_ref[0, 0].astype(jnp.float32)
         if quantized:
-            # int8 pages: the per-(position, head) scale multiply fuses
-            # into the VMEM read — arithmetic stays f32 on the MXU while
-            # the HBM page fetch carried half the bytes
+            # int8/int4 pages: the per-(position, head) scale multiply
+            # fuses into the VMEM read — arithmetic stays f32 on the MXU
+            # while the HBM page fetch carried the quantized bytes
             k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
             v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
         sc = jax.lax.dot_general(
@@ -445,7 +472,7 @@ def paged_prefill_attention(
     One compiled program serves every (offset, page assignment) — the
     block table and start are data, not shape."""
     C, Hq, hd = q.shape
-    P, Hkv, page, _ = k_pages.shape
+    P, Hkv, page, hdk = k_pages.shape  # hdk = hd // 2 for packed int4
     n_pp = bt_row.shape[0]
     G = Hq // Hkv
     # [C, Hq, hd] -> [Hkv, C·G, hd]: kv-head-major so one grid row's
@@ -456,9 +483,10 @@ def paged_prefill_attention(
         .reshape(Hkv, C * G, hd)
     )
     quantized = k_scale is not None
+    packed = quantized and hdk * 2 == hd
     kernel = functools.partial(
         _paged_prefill_kernel, scale=scale, page=page, n_pp=n_pp, G=G,
-        quantized=quantized,
+        quantized=quantized, packed=packed,
     )
     # pages wholly past the last visible position clamp their fetch to
     # scratch page 0: the pipeline skips copies when the mapped block
@@ -472,8 +500,8 @@ def paged_prefill_attention(
 
     in_specs = [
         pl.BlockSpec((1, C * G, hd), lambda h, i, bt, st: (h, 0, 0)),
-        pl.BlockSpec((1, 1, page, hd), page_idx),
-        pl.BlockSpec((1, 1, page, hd), page_idx),
+        pl.BlockSpec((1, 1, page, hdk), page_idx),
+        pl.BlockSpec((1, 1, page, hdk), page_idx),
     ]
     args = [qg, k_pages, v_pages]
     if quantized:
@@ -603,6 +631,7 @@ def _ragged_kernel(
     n_pp: int,
     G: int,
     quantized: bool,
+    packed: bool = False,
 ):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
@@ -631,11 +660,17 @@ def _ragged_kernel(
     @pl.when((nv > 0) & (i * page <= start + nv - 1))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # [C·G, hd]
-        k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
-        v = v_ref[0, 0].astype(jnp.float32)
+        if packed:
+            # int4 pages: nibble unpack + dequant fused into the VMEM
+            # read — the HBM fetch carried a quarter of the fp16 bytes
+            k = _unpack4(k_ref[0, 0])  # [page, hd]
+            v = _unpack4(v_ref[0, 0])
+        else:
+            k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+            v = v_ref[0, 0].astype(jnp.float32)
         if quantized:
-            # int8 pages: dequant fused into the VMEM read — the HBM
-            # fetch carried half the bytes, the MXU math stays f32
+            # int8/int4 pages: dequant fused into the VMEM read — the
+            # HBM fetch carried the quantized bytes, the MXU math stays f32
             k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
             v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
         sc = jax.lax.dot_general(
@@ -702,7 +737,7 @@ def ragged_paged_attention(
     the same causal ``q_pos`` masking — see the reference's "Verify
     mode" note."""
     S, C, Hq, hd = q.shape
-    P, Hkv, page, _ = k_pages.shape
+    P, Hkv, page, hdk = k_pages.shape  # hdk = hd // 2 for packed int4
     n_pp = block_tables.shape[1]
     G = Hq // Hkv
     # [S, C, Hq, hd] -> [S, Hkv, C·G, hd]: kv-head-major so one grid
@@ -713,9 +748,10 @@ def ragged_paged_attention(
         .reshape(S, Hkv, C * G, hd)
     )
     quantized = k_scale is not None
+    packed = quantized and hdk * 2 == hd
     kernel = functools.partial(
         _ragged_kernel, scale=scale, page=page, n_pp=n_pp, G=G,
-        quantized=quantized,
+        quantized=quantized, packed=packed,
     )
     # pages wholly past the slot's live span clamp their fetch to scratch
     # page 0 (repeated block indexes are not re-copied by the pipeline):
@@ -740,8 +776,8 @@ def ragged_paged_attention(
         pl.BlockSpec(
             (1, 1, C * G, hd), lambda s, h, i, bt, st, nv: (s, h, 0, 0)
         ),
-        pl.BlockSpec((1, 1, page, hd), page_idx),
-        pl.BlockSpec((1, 1, page, hd), page_idx),
+        pl.BlockSpec((1, 1, page, hdk), page_idx),
+        pl.BlockSpec((1, 1, page, hdk), page_idx),
     ]
     args = [qg, k_pages, v_pages]
     if quantized:
@@ -797,6 +833,7 @@ def _paged_kernel(
     page: int,
     n_pp: int,
     quantized: bool,
+    packed: bool = False,
 ):
     if quantized:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
@@ -818,11 +855,17 @@ def _paged_kernel(
     @pl.when(i * page < length)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
-        k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
-        v = v_ref[0, 0].astype(jnp.float32)
+        if packed:
+            # int4 pages: nibble unpack + dequant fused into the VMEM
+            # read — the HBM fetch carried a quarter of the fp16 bytes
+            k = _unpack4(k_ref[0, 0])  # [page, hd]
+            v = _unpack4(v_ref[0, 0])
+        else:
+            k = k_ref[0, 0].astype(jnp.float32)  # [page, hd]
+            v = v_ref[0, 0].astype(jnp.float32)
         if quantized:
-            # int8 pages: dequant fused into the VMEM read — the HBM
-            # fetch carried half the bytes, the MXU math stays f32
+            # int8/int4 pages: dequant fused into the VMEM read — the
+            # HBM fetch carried the quantized bytes, the MXU math stays f32
             k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
             v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
         sc = jax.lax.dot_general(
@@ -876,23 +919,24 @@ def paged_attention(
     compiled program serves every (length mix, page assignment) — the
     block table and lengths are data, not shape."""
     S, Hq, hd = q.shape
-    P, Hkv, page, _ = k_pages.shape
+    P, Hkv, page, hdk = k_pages.shape  # hdk = hd // 2 for packed int4
     n_pp = block_tables.shape[1]
     G = Hq // Hkv
     qg = q.reshape(S, Hkv, G, hd)
     quantized = k_scale is not None
+    packed = quantized and hdk * 2 == hd
     kernel = functools.partial(
         _paged_kernel, scale=scale, page=page, n_pp=n_pp,
-        quantized=quantized,
+        quantized=quantized, packed=packed,
     )
     in_specs = [
         pl.BlockSpec((1, 1, G, hd), lambda s, h, i, bt, ln: (s, h, 0, 0)),
         pl.BlockSpec(
-            (1, 1, page, hd),
+            (1, 1, page, hdk),
             lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
         ),
         pl.BlockSpec(
-            (1, 1, page, hd),
+            (1, 1, page, hdk),
             lambda s, h, i, bt, ln: (bt[s, i], h, 0, 0),
         ),
     ]

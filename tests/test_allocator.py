@@ -365,3 +365,148 @@ def test_batch_bucket_smallest_fit(bucket_engine, n, want):
 def test_batch_bucket_overflow_raises(bucket_engine):
     with pytest.raises(ValueError):
         bucket_engine.batch_bucket(9)
+
+
+# ---------------------------------------------------------------------------
+# shared multi-tenant page pool (SharedPagePool / PoolTenant) — quota
+# accounting + per-tenant conservation, driven BY HAND (no compiles)
+# ---------------------------------------------------------------------------
+def _pool_engines(n_pages=20, quotas=(8, 8), kv_quant="none"):
+    from tensorlink_tpu.engine.continuous import ContinuousEngine
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.engine.paged import SharedPagePool
+    from tensorlink_tpu.models import init_params
+
+    eng = GenerationEngine(
+        TINY, init_params(TINY, jax.random.PRNGKey(0)),
+        seq_buckets=(8,), batch_buckets=(1,), max_seq_len=32,
+    )
+    pool = SharedPagePool(TINY, n_pages, page_size=8, kv_quant=kv_quant)
+    ces = [
+        ContinuousEngine(
+            eng, max_slots=2, page_size=8, chunk_steps=2,
+            kv_quant=kv_quant, pool=pool, model_id=f"m{i}", page_quota=q,
+        )
+        for i, q in enumerate(quotas)
+    ]
+    return pool, ces
+
+
+def test_pool_tenant_quota_bounds_allocation():
+    pool, (a, b) = _pool_engines(n_pages=20, quotas=(3, 0))
+    assert a.alloc.n_free == 3  # min(pool free, quota room)
+    assert b.alloc.n_free == 20  # uncapped: bounded by the pool alone
+    got = a.alloc.alloc(3)
+    assert got is not None and a.alloc.used == 3
+    assert a.alloc.alloc(1) is None  # quota dry, pool is not
+    assert pool.alloc.n_free == 17
+    a.alloc.free(got)
+    assert a.alloc.used == 0 and pool.alloc.n_free == 20
+
+
+def test_pool_attach_refuses_geometry_mismatch():
+    from tensorlink_tpu.engine.continuous import ContinuousEngine
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.engine.paged import SharedPagePool
+    from tensorlink_tpu.models import init_params
+
+    pool = SharedPagePool(TINY, 16, page_size=8, kv_quant="int8")
+    eng = GenerationEngine(
+        TINY, init_params(TINY, jax.random.PRNGKey(0)),
+        seq_buckets=(8,), batch_buckets=(1,), max_seq_len=32,
+    )
+    # kv_quant mismatch: an int4 tenant cannot draw on an int8 pool
+    with pytest.raises(ValueError, match="geometry"):
+        ContinuousEngine(
+            eng, max_slots=2, page_size=8, chunk_steps=2, kv_quant="int4",
+            pool=pool, model_id="bad",
+        )
+    # page-size mismatch refuses too
+    with pytest.raises(ValueError, match="geometry"):
+        ContinuousEngine(
+            eng, max_slots=2, page_size=16, chunk_steps=2, kv_quant="int8",
+            pool=pool, model_id="bad2",
+        )
+    # duplicate tenant ids refuse (a rebuilt engine must detach first)
+    ContinuousEngine(
+        eng, max_slots=2, page_size=8, chunk_steps=2, kv_quant="int8",
+        pool=pool, model_id="ok",
+    )
+    with pytest.raises(ValueError, match="already attached"):
+        ContinuousEngine(
+            eng, max_slots=2, page_size=8, chunk_steps=2, kv_quant="int8",
+            pool=pool, model_id="ok",
+        )
+
+
+def test_pool_conservation_sums_across_tenants():
+    from tensorlink_tpu.engine.continuous import ContinuousRequest
+    from tensorlink_tpu.engine.sampling import SamplingParams
+
+    pool, (a, b) = _pool_engines(n_pages=20, quotas=(10, 10))
+    pool.check_page_conservation()
+    pa = a.alloc.alloc(3)
+    pb = b.alloc.alloc(2)
+    # allocated-but-unowned pages are a leak until an owner claims them
+    with pytest.raises(AssertionError, match="leak"):
+        pool.check_page_conservation()
+    ra = ContinuousRequest(
+        rid=1, prompt=[1], budget=1, sampling=SamplingParams.make(),
+        eos=frozenset(), seed=0,
+    )
+    ra.pages = list(pa)
+    a._slots[0] = ra
+    b._migrations["m1"] = {"pages": pb, "nodes": [], "t": 0.0}
+    pool.check_page_conservation()  # slots(a) + in_transit(b) + free == total
+    # a page held by BOTH tenants is caught with both names in the report
+    rb = ContinuousRequest(
+        rid=2, prompt=[2], budget=1, sampling=SamplingParams.make(),
+        eos=frozenset(), seed=0,
+    )
+    rb.pages = [pa[0]]
+    b._slots[0] = rb
+    with pytest.raises(AssertionError, match="held by both"):
+        pool.check_page_conservation()
+    b._slots[0] = None
+    # quota counter drift (pages held != tenant.used) is caught per-tenant
+    a.alloc.used += 1
+    with pytest.raises(AssertionError, match="quota accounting"):
+        pool.check_page_conservation()
+    a.alloc.used -= 1
+    # cleanup restores the invariant
+    a._slots[0] = None
+    b._migrations.clear()
+    a.alloc.free(pa)
+    b.alloc.free(pb)
+    pool.check_page_conservation()
+    assert pool.alloc.n_free == 20
+
+
+def test_pool_cache_reclaim_takes_cold_neighbors_only():
+    pool, (a, b) = _pool_engines(n_pages=6, quotas=(6, 6))
+    # tenant b parks 4 cold pages in its prefix cache
+    pages = b.alloc.alloc(4)
+    node = None
+    for i, p in enumerate(pages):
+        node, adopted = b.prefix.insert(node, tuple(range(8 * i, 8 * i + 8)), p)
+        assert adopted
+    pool.check_page_conservation()
+    assert pool.alloc.n_free == 2
+    # a needs 5: its own trie is empty, b's cold pages reclaim to the pool
+    got = a._alloc_pages(5)
+    assert got is not None and len(got) == 5
+    assert pool.cache_reclaims >= 3 and b.alloc.used <= 1
+    a.alloc.free(got)
+    pool.check_page_conservation()
+
+
+def test_pool_snapshot_rides_serving_snapshot():
+    pool, (a, b) = _pool_engines(n_pages=20, quotas=(12, 6))
+    snap = a.serving_snapshot()
+    assert snap["pool_pages_total"] == 20
+    assert snap["pool_quota"] == 12 and snap["pool_pages_used"] == 0
+    assert snap["pool_tenants"] == 2
+    assert snap["pool_used"]["m1"]["quota"] == 6
+    # per-tenant gauges render under the registry (the /metrics view)
+    text = a.metrics.render({"model": "m0"})
+    assert 'tlink_engine_pool_quota{model="m0"} 12' in text

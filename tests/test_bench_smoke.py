@@ -64,6 +64,12 @@ def test_bench_all_legs_cpu():
                 "ragged_during_prefill_itl_ms",
                 "kv_slots_ratio", "kv_residency_ratio",
                 "kv_int8_slots", "kv_int8_resident_pages",
+                # packed int4 pages (byte-matched vs int8) + the
+                # two-models-one-pool co-tenancy leg
+                "kv_int4_slots", "kv_int4_slots_ratio",
+                "kv_int4_residency_ratio",
+                "cotenancy_served", "cotenancy_cross_preemptions",
+                "cotenancy_conservation_ok",
                 "migration_resume_ms", "migration_reprefill_resume_ms",
                 "migration_resume_speedup",
                 # trace-derived TTFT decompositions (core/trace.py) on the
@@ -116,6 +122,21 @@ def test_bench_all_legs_cpu():
     # per position-head = 1.94x at hd=128)
     assert extra["kv_slots_ratio"] >= 1.8, extra["kv_slots_ratio"]
     assert extra["kv_residency_ratio"] >= 1.8, extra["kv_residency_ratio"]
+    # the int4 density step: at a byte-matched budget the PACKED pool
+    # must admit >=1.8x the slots of the INT8 pool (page bytes hd/2+4 vs
+    # hd+4 — 1.89x at the bench's hd=64, 1.94x at hd=128, and the ratio
+    # is dtype-independent so it transfers to bf16 unchanged). Same
+    # structural, conservation-checked protocol as the int8 leg.
+    assert extra["kv_int4_slots_ratio"] >= 1.8, extra["kv_int4_slots_ratio"]
+    assert extra["kv_int4_residency_ratio"] >= 1.8, (
+        extra["kv_int4_residency_ratio"]
+    )
+    # co-tenancy (two models, ONE page pool, per-model quotas): every
+    # request of both tenants served, per-tenant page conservation held
+    # at every chunk boundary (checked in-leg — a cross-tenant leak
+    # fails the bench run itself), quotas never exceeded
+    assert extra["cotenancy_conservation_ok"] is True
+    assert extra["cotenancy_served"] == 12, extra["cotenancy_served"]
     # the migration leg's robustness bar: draining a worker mid-stream
     # drops ZERO streams (every resume bit-identical — deterministic on
     # CPU), and both resume latencies are real numbers. The latency

@@ -1442,5 +1442,260 @@ def test_continuous_refuses_unsupported_cache_modes(tiny_engine):
     )
     with pytest.raises(ValueError, match="sliding-window"):
         ContinuousEngine(eng)
+    # int4 joined int8 as a native page mode; only unknown strings refuse
     with pytest.raises(ValueError, match="kv_quant"):
-        ContinuousEngine(tiny_engine, kv_quant="int4")
+        ContinuousEngine(tiny_engine, kv_quant="nf4")
+
+
+# ---------------------------------------------------------------------------
+# packed int4 KV pages (kv_quant="int4"): lifecycle + compile-set pins
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # compiles the int4 step-program shape — tier-1
+# wall-time; CI's engine job runs this file unfiltered on every push
+def test_int4_streams_bit_identical_across_lifecycle(tiny_engine):
+    """THE int4 acceptance pin (the int8 lifecycle contract at double
+    density): with ``kv_quant="int4"`` every stream-identity contract
+    holds AMONG int4 streams — solo == co-batched == mid-flight-admitted
+    == recovery-resumed == preempted == MIGRATED, cache on or off. (int4
+    streams may differ from fp/int8 streams; that divergence is bounded
+    in tests/test_ops.py.)"""
+    eng = tiny_engine
+
+    def solo4(prompt, n, sp, seed, prefix_cache=True):
+        ce = _cont(eng, kv_quant="int4", prefix_cache=prefix_cache)
+        req = ce.submit(prompt, max_new_tokens=n, sampling=sp, seed=seed)
+        ce.run_until_idle()
+        assert req.finished
+        ce.check_page_conservation()
+        return req.tokens
+
+    mixes = [
+        (SYS + [21], 8, SamplingParams.make(temperature=0.9, top_k=5), 1),
+        ([4, 5], 6, SamplingParams.make(), 2),
+        (SYS + [22, 23], 8,
+         SamplingParams.make(temperature=0.7, top_p=0.9), 3),
+    ]
+    # co-batched + mid-flight admission, cache on == solo == cache off
+    ce = _cont(eng, kv_quant="int4")
+    reqs = []
+    for prompt, n, sp, seed in mixes:
+        reqs.append(ce.submit(prompt, max_new_tokens=n, sampling=sp,
+                              seed=seed))
+        ce.step_chunk()  # later requests join mid-flight
+    ce.run_until_idle()
+    assert all(r.finished for r in reqs)
+    ce.check_page_conservation()
+    for req, (prompt, n, sp, seed) in zip(reqs, mixes):
+        assert req.tokens == solo4(prompt, n, sp, seed), (prompt, seed)
+        assert req.tokens == solo4(prompt, n, sp, seed, prefix_cache=False)
+    ce.close()
+    # recovery resume: the crash-recovery re-prefill shape continues the
+    # int4 stream bit-identically
+    sp = SamplingParams.make(temperature=1.0, top_p=0.9)
+    full = solo4([5, 6, 7], 10, sp, 9)
+    cut = 4
+    ce2 = _cont(eng, kv_quant="int4")
+    resumed = ce2.submit(
+        [5, 6, 7] + full[:cut], max_new_tokens=10 - cut, sampling=sp,
+        seed=9, start_step=cut,
+    )
+    ce2.run_until_idle()
+    assert full[:cut] + resumed.tokens == full
+    ce2.close()
+    # preemption: the int4 victim resumes bit-identically
+    ce3 = _cont(eng, kv_quant="int4", max_slots=1, sched_aging_ticks=1000)
+    victim = ce3.submit([3, 1, 4], max_new_tokens=8, seed=7,
+                        priority="best_effort")
+    ce3.step_chunk()
+    pre = ce3.submit([8, 8], max_new_tokens=2, seed=9,
+                     priority="interactive")
+    ce3.run_until_idle()
+    assert ce3.stats["preemptions"] >= 1
+    assert victim.finished and pre.finished
+    assert victim.tokens == solo4([3, 1, 4], 8, None, 7)
+    ce3.close()
+    # migration: int4 pages ship byte-exact between two int4 engines and
+    # the migrated stream equals the uninterrupted one
+    base = solo4([5, 6, 7], 14,
+                 SamplingParams.make(temperature=0.9, top_k=5), 9)
+    src = _cont(eng, kv_quant="int4")
+    dst = _cont(eng, kv_quant="int4")
+    r = src.submit([5, 6, 7], max_new_tokens=14,
+                   sampling=SamplingParams.make(temperature=0.9, top_k=5),
+                   seed=9)
+    _drive_until(src, r, 5)
+    r2, moved = _migrate(src, dst, r, "mig4")
+    src.run_until_idle()
+    dst.run_until_idle()
+    assert r2.finished and moved.tokens + r2.tokens == base
+    src.check_page_conservation()
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # drives the int4 step-program shape through churn —
+# tier-1 wall-time; CI's compile-count-guard step runs it on every push
+def test_int4_is_one_program(tiny_engine):
+    """The compile-set bar per kv_quant mode, int4 edition: the packed
+    engine is ONE ragged_step program (+ copy_page) of its own — the
+    nibble packing is a trace-time constant, and admission, mixed churn,
+    hits, COW and eviction add ZERO compiles beyond it."""
+    eng = tiny_engine
+    ce = _cont(eng, kv_quant="int4")
+    pre = ce.jit_cache_sizes()
+    long = [5, 9] * 12
+    ce.submit(long, max_new_tokens=3, seed=7)  # miss -> promoted
+    ce.run_until_idle()
+    ce.submit(long[:20] + [2, 2, 2, 2], max_new_tokens=3, seed=8)  # COW
+    ce.run_until_idle()
+    base = ce.jit_cache_sizes()
+    assert 0 <= base["ragged_step"] - pre["ragged_step"] <= 1
+    assert 0 <= base["copy_page"] - pre["copy_page"] <= 1
+    reqs = [
+        ce.submit([3 + i] * (2 + i), max_new_tokens=3 + i, seed=i)
+        for i in range(4)
+    ]
+    ce.step_chunk()
+    late = ce.submit(long + [3], max_new_tokens=3, seed=30)  # cache hit
+    ce.submit([6] * 31, max_new_tokens=2, seed=31)  # different miss
+    ce.run_until_idle()
+    assert all(r.finished for r in [*reqs, late])
+    assert ce.jit_cache_sizes() == base, (base, ce.jit_cache_sizes())
+    ce.check_page_conservation()
+    ce.close()
+
+
+def test_migration_refuses_kv_mode_triple_mismatch(tiny_engine):
+    """The storage-mode gate is the FULL (kv_quant, page_size, dtype)
+    triple: int4 and int8 pools share the int8 byte dtype, so an
+    int4<->int8 drain must refuse on kv_quant — loudly — and a page-size
+    mismatch refuses the same way (regression for the two-dtype
+    assumption the old check baked in). Zero-compile: the refusal fires
+    before any device work."""
+    ce8 = _cont(tiny_engine, kv_quant="int8")
+    ce4 = _cont(tiny_engine, kv_quant="int4")
+    assert ce8.migration_mode() == ("int8", 8, "int8")
+    assert ce4.migration_mode() == ("int4", 8, "int8")  # same byte dtype!
+    blob = {
+        "blob_v": 2, "chain": np.asarray([1, 2, 3], np.int32), "length": 2,
+        "last_tok": 3, "prefill_target": 3, "n_skip": 0,
+        "page_size": 8, "kv_quant": "int8", "dtype": "int8",
+        "k": np.zeros(0, np.int8), "v": np.zeros(0, np.int8),
+    }
+    # int8 blob into an int4 engine: kv_quant differs, dtype alone would
+    # NOT have caught it
+    assert not ce4.stage_migration("m1", blob)
+    assert "m1" not in ce4._migrations
+    # page-size mismatch refuses through the same triple
+    blob2 = dict(blob, page_size=16)
+    assert not ce8.stage_migration("m2", blob2)
+    # the matching triple passes the mode gate (fails later on page-count
+    # sanity instead of silently staging: length 2 needs 1 page, 0 shipped)
+    assert not ce8.stage_migration("m3", blob)
+    ce4.close()
+    ce8.close()
+
+
+@pytest.mark.slow  # two engines + full decode traces — CI engine job
+def test_int4_to_int8_drain_falls_back_to_re_prefill(tiny_engine):
+    """An int4 source draining onto an int8 destination cannot page-ship
+    (mode triple mismatch, refused loudly at staging) — the stream takes
+    the re-prefill rung instead: resumed at the destination from prompt +
+    emitted, exactly-once, with the failure counted and conservation
+    holding on both sides."""
+    eng = tiny_engine
+    src = _cont(eng, kv_quant="int4")
+    dst = _cont(eng, kv_quant="int8")
+    r = src.submit([5, 6, 7], max_new_tokens=12, seed=9)
+    _drive_until(src, r, 5)
+    slot = r.slot
+    src.freeze_slot(slot)
+    chain, limit = src.migration_chain(slot)
+    blob = src.export_slot(slot, n_skip=0)
+    assert not dst.stage_migration("x1", blob)  # refused: int4 != int8
+    dst.check_page_conservation()  # nothing staged, nothing leaked
+    moved = src.commit_migration(slot, fell_back=True)
+    assert src.stats["migrations_fell_back"] == 1
+    src.check_page_conservation()
+    # the re-prefill rung: resume WITHOUT a ticket — adopt never set
+    r2 = dst.submit(
+        moved.prompt + moved.tokens,
+        max_new_tokens=moved.budget - len(moved.tokens),
+        seed=9, start_step=len(moved.tokens),
+    )
+    dst.run_until_idle()
+    assert r2.finished and len(moved.tokens) + len(r2.tokens) == 12
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant co-hosting: one page pool, per-model quotas, cross-model
+# preemption (engine/paged.py::SharedPagePool)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # two tenant engines churning on one pool — tier-1
+# wall-time; CI's engine job runs this file unfiltered on every push
+def test_shared_pool_cross_tenant_preemption_and_conservation(tiny_engine):
+    """Two models on ONE page pool: streams bit-identical to private-pool
+    runs, per-tenant conservation holding mid-churn, and an interactive
+    candidate of tenant A preempting tenant B's best_effort slot when the
+    SHARED free list runs dry (the PR 4 rank rules applied across
+    models) — with B's victim resuming bit-identically afterwards."""
+    from tensorlink_tpu.engine.paged import SharedPagePool
+
+    eng = tiny_engine
+
+    def solo4(prompt, n, seed, priority="interactive"):
+        ce = _cont(eng, kv_quant="int4")
+        req = ce.submit(prompt, max_new_tokens=n, seed=seed,
+                        priority=priority)
+        ce.run_until_idle()
+        ce.close()
+        return req.tokens
+
+    pool = SharedPagePool(eng.cfg, 10, page_size=8, kv_quant="int4")
+    a = _cont(eng, kv_quant="int4", pool=pool, model_id="a", page_quota=10)
+    b = _cont(eng, kv_quant="int4", pool=pool, model_id="b", page_quota=10)
+
+    # B decodes a best_effort stream holding 3 of the 10 shared pages
+    rb = b.submit([3, 1, 4], max_new_tokens=20, seed=7,
+                  priority="best_effort")
+    _drive_until(b, rb, 3)
+    pool.check_page_conservation()
+    held_b = b.alloc.used
+    assert held_b >= 3
+
+    # A's interactive request needs 8 pages — more than the pool has
+    # free — so admission preempts B's strictly-lower-ranked slot
+    # THROUGH B's engine (teardown + requeue + bit-identical resume)
+    ra = a.submit([40] * 44, max_new_tokens=16, seed=5,
+                  priority="interactive")
+    a.step_chunk(admit_only=True)
+    assert ra.slot >= 0, "candidate should have preempted cross-tenant"
+    assert pool.cross_preemptions >= 1
+    assert b.stats["preempted_cross_tenant"] >= 1
+    pool.check_page_conservation()
+
+    # drive both tenants to quiescence from ONE thread (the pool's
+    # single-driver contract), conservation checked every boundary
+    while a.step_chunk() | b.step_chunk():
+        pool.check_page_conservation()
+        assert a.alloc.used <= a.alloc.quota
+        assert b.alloc.used <= b.alloc.quota
+    assert ra.finished and rb.finished
+
+    # pooled streams == private-pool streams, preempted victim included
+    assert ra.tokens == solo4([40] * 44, 16, 5)
+    assert rb.tokens == solo4([3, 1, 4], 20, 7, priority="best_effort")
+
+    # per-model telemetry: each tenant's snapshot carries its own quota
+    # view and the shared pool totals
+    snap_a, snap_b = a.serving_snapshot(), b.serving_snapshot()
+    assert snap_a["pool_pages_total"] == snap_b["pool_pages_total"] == 10
+    assert snap_b["preempted_cross_tenant"] >= 1
+    assert snap_a["preempted_cross_tenant"] == 0
+    a.close()
+    b.close()
+    assert pool.alloc.n_free == 10  # everything returned at teardown

@@ -212,6 +212,9 @@ LEGACY_ENGINE_KEYS = (
     "migrations_fell_back", "migrations_adopted",
     # speculative decoding (spec_decode): the draft/verify families
     "spec_drafted", "spec_accepted", "spec_verify_passes", "spec_killed",
+    # multi-tenant co-hosting: slots torn down for another tenant's
+    # higher-ranked candidate on a shared page pool
+    "preempted_cross_tenant",
 )
 
 
