@@ -55,6 +55,14 @@ class GenerationRequest:
     # the hosting replica runs MLConfig.spec_decode (see /healthz
     # serving_modes). A pure speed hint, like lookahead.
     speculative: bool = False
+    # opt-OUT of the disaggregated prefill→decode handoff (docs/SERVING.md
+    # "Disaggregated prefill/decode"): on a pool with prefill/decode
+    # worker roles a continuous request prefills on a prefill-pool worker
+    # and is handed to a decode-pool worker at the prefill boundary —
+    # bit-identical either way, so the default is opted in; false pins
+    # the stream to the admission worker (debugging, latency-probing a
+    # specific replica). A no-op on single-pool deployments.
+    handoff: bool = True
     # beam search width (the reference forwards num_beams to HF generate,
     # ml/formatter.py:88-92; here engine/generate.py::generate_beam on
     # whole-model jobs and ml/module.py::_generate_beam_pipelined on
@@ -129,6 +137,7 @@ class GenerationRequest:
                 enable_thinking=bool(d.get("enable_thinking", False)),
                 lookahead=bool(d.get("lookahead", False)),
                 speculative=bool(d.get("speculative", False)),
+                handoff=bool(d.get("handoff", True)),
                 num_beams=int(d.get("num_beams", 1)),
                 stop=cls._parse_stop(d.get("stop")),
                 priority=cls._parse_priority(d.get("priority")),
@@ -185,6 +194,8 @@ class ChatCompletionRequest:
     lookahead: bool = False  # speculative decode hint (greedy only)
     # continuous draft/verify hint (see GenerationRequest.speculative)
     speculative: bool = False
+    # prefill→decode handoff opt-out (see GenerationRequest.handoff)
+    handoff: bool = True
     stop: list[str] = field(default_factory=list)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
@@ -214,6 +225,7 @@ class ChatCompletionRequest:
                 stream=bool(d.get("stream", False)),
                 lookahead=bool(d.get("lookahead", False)),
                 speculative=bool(d.get("speculative", False)),
+                handoff=bool(d.get("handoff", True)),
                 stop=GenerationRequest._parse_stop(d.get("stop")),
                 presence_penalty=float(d.get("presence_penalty", 0.0)),
                 frequency_penalty=float(d.get("frequency_penalty", 0.0)),
@@ -253,6 +265,7 @@ class ChatCompletionRequest:
             output_format="openai",
             lookahead=self.lookahead,
             speculative=self.speculative,
+            handoff=self.handoff,
             stop=self.stop,
             presence_penalty=self.presence_penalty,
             frequency_penalty=self.frequency_penalty,

@@ -135,6 +135,20 @@ class MLConfig:
     # unaffected; ring.quantized_psum/quantized_all_gather are the
     # building blocks for explicit shard_map paths.
     collective_quant: bool = False
+    # -- disaggregated prefill/decode pools (docs/SERVING.md
+    # "Disaggregated prefill/decode"): the serving role this worker
+    # advertises. "prefill" workers take new continuous admissions, fill
+    # their pages through the normal ragged grants, then freeze each
+    # slot at the prefill→decode boundary and ship it to a decode-pool
+    # worker through the migration export/stage/adopt path — so an
+    # interactive stream's inter-token latency never shares a step with
+    # a neighbor's long prompt. "decode" workers are excluded from
+    # placement and serve as handoff destinations. "mixed" (default)
+    # keeps the single-pool behavior. Placement and the decode-pool push
+    # are the validator's job (ml/validator.py); a prefill worker with
+    # no reachable decode pool degrades to mixed behavior per slot
+    # (abort_handoff — never a dropped or slower stream).
+    worker_role: str = "mixed"  # "prefill" | "decode" | "mixed"
     # speculative decoding inside the unified ragged step (engine/
     # continuous.py, docs/SERVING.md "Speculative decoding"): an opted-in
     # request ({"speculative": true}) packs a host-drafted prompt-lookup
@@ -142,9 +156,11 @@ class MLConfig:
     # step verifies all of them in-program — multi-token decode per pass
     # on repetitive/extractive text, bit-identical streams always, with
     # a per-request acceptance-rate kill switch so a bad draft mix can
-    # never make it a slowdown. Default off for one release (flip after
-    # the bench trajectory confirms the win on real hardware).
-    spec_decode: bool = False
+    # never make it a slowdown. Default ON (the PR 11 one-release
+    # opt-in window has elapsed, mirroring the kv_quant flip): the
+    # engine capability is armed everywhere, requests still opt in
+    # per-call; spec_decode=False is the explicit opt-out.
+    spec_decode: bool = True
     # max draft tokens per verify pass (extra ragged rows per
     # speculating slot; capped by prefill_chunk - 1)
     spec_draft: int = 8

@@ -33,6 +33,11 @@ Wired sites:
 - ``migrate.import``      — a MIGRATE staging arriving at the destination
   (ml/worker.py::_migrate_in); supports error / crash (the
   kill-the-destination-mid-migration case).
+- ``worker.handoff``      — per prefill-completed slot a prefill-pool
+  worker tries to ship to its decode pool (ml/worker.py::_run_handoffs);
+  supports error (the slot takes the re-prefill redirect rung) / crash
+  (a prefill worker dying at the prefill→decode boundary). The wire
+  transfer itself shares ``migrate.wire`` with the drain path.
 
 Site names are REGISTERED (:data:`SITES`): a rule naming an unknown site
 fails loudly at plan construction instead of silently never firing — a
@@ -78,6 +83,7 @@ SITES = (
     "migrate.export",
     "migrate.wire",
     "migrate.import",
+    "worker.handoff",
 )
 
 

@@ -212,6 +212,17 @@ _ENGINE_COUNTERS = (
      "streams redirected down the re-prefill rung"),
     ("migrations_adopted", "tlink_engine_migrations_adopted_total",
      "staged migrations adopted into a slot (destination side)"),
+    # disaggregated prefill/decode pools (docs/SERVING.md "Disaggregated
+    # prefill/decode"): prefill-pool slots frozen at the prefill→decode
+    # boundary and shipped to a decode-pool worker at admission time —
+    # migration promoted from a maintenance action to the steady-state
+    # data path (started == completed + fell_back over any quiet window)
+    ("handoffs_started", "tlink_engine_handoffs_started_total",
+     "prefill-completed slots frozen for prefill→decode handoff"),
+    ("handoffs_completed", "tlink_engine_handoffs_completed_total",
+     "handoffs whose pages shipped and committed (source side)"),
+    ("handoffs_fell_back", "tlink_engine_handoffs_fell_back_total",
+     "handoffs that fell back (re-prefill redirect or local resume)"),
     # speculative decoding (docs/SERVING.md "Speculative decoding"):
     # draft tokens packed as extra ragged rows and verified in-program
     ("spec_drafted", "tlink_engine_spec_drafted_total",
@@ -261,6 +272,16 @@ class ContinuousRequest:
     # staged-adoption ticket id: admission binds the shipped KV pages
     # instead of prefilling (engine._migrations); cleared on fallback
     adopt: str | None = None
+    # -- disaggregated prefill/decode (docs/SERVING.md) ------------------
+    # on a handoff-armed (prefill-pool) engine: this request's prefill
+    # stops ONE token short of its prompt and the slot freezes for
+    # shipment to a decode-pool worker instead of drawing its first
+    # token here — the export then carries (chain=prompt, length=T-1,
+    # last_tok=prompt[-1]), exactly the staged-adoption ticket shape, so
+    # the DESTINATION makes the first draw: fold_in(seed, 0) over
+    # position T-1's logits, bitwise the single-pool run's first token
+    # by the ragged framing-invariance contract (tests/test_ops.py)
+    handoff: bool = False
     # opaque client/transport context (peer, rid, stream id) the worker
     # layer attaches so a drain can redirect the stream mid-flight
     client_meta: dict | None = None
@@ -318,6 +339,8 @@ class ContinuousEngine:
         sched_max_wait_s: float = 60.0,
         default_priority: str = DEFAULT_PRIORITY,
         migration_ttl_s: float = 120.0,
+        handoff_after_prefill: bool = False,
+        worker_role: str = "mixed",
         trace_site: str = "",
         metrics: MetricsRegistry | None = None,
         flight_capacity: int = 256,
@@ -420,6 +443,17 @@ class ContinuousEngine:
         # can't leak; close() frees the rest before the conservation check
         self.migration_ttl_s = float(migration_ttl_s)
         self.drain_state = "serving"  # "serving" | "draining"
+        # -- disaggregated prefill/decode (docs/SERVING.md) --------------
+        # the drain fence GENERALIZED into steady-state handoff: a
+        # handoff-armed (prefill-pool) engine is always "draining" its
+        # completed prefills — each opted-in slot freezes at the
+        # prefill→decode boundary and lands in _handoff_ready for the
+        # driver to ship — but, unlike begin_drain, the admission path
+        # stays OPEN the whole time: new requests keep admitting and
+        # prefilling while earlier slots are frozen in transit
+        self.handoff_after_prefill = bool(handoff_after_prefill)
+        self.worker_role = str(worker_role or "mixed")
+        self._handoff_ready: list[int] = []
         # rotates the budgeted packing's round-robin origin so a
         # prefill_budget smaller than the number of concurrent
         # admissions never starves the tail slots
@@ -586,6 +620,7 @@ class ContinuousEngine:
         adopt: str | None = None,
         trace_id: str | None = None,
         speculative: bool = False,
+        handoff: bool = False,
     ) -> ContinuousRequest:
         """Queue a request; the scheduler decides when (and at whose
         expense) it joins the slot batch. ``start_step`` > 0 resumes a
@@ -600,7 +635,14 @@ class ContinuousEngine:
         normal (re-)prefill path when the ticket is missing or stale.
         ``speculative`` opts the request into draft/verify decoding when
         the engine runs with ``spec_decode`` on (a pure speed hint: the
-        emitted stream is bit-identical either way)."""
+        emitted stream is bit-identical either way). ``handoff`` marks
+        the request for prefill→decode handoff on a handoff-armed
+        engine: its prefill stops one token short, the slot freezes at
+        the boundary, and the driver ships it to a decode-pool worker
+        (no effect unless ``handoff_after_prefill`` is set; 1-token
+        prompts are exempt — there is nothing to prefill ahead of the
+        first draw, so shipping zero pages would cost more than it
+        saves)."""
         req = ContinuousRequest(
             rid=next(self._rid),
             prompt=[int(t) for t in prompt],
@@ -617,6 +659,10 @@ class ContinuousEngine:
             adopt=adopt,
             trace_id=str(trace_id or ""),
             speculative=bool(speculative) and self.spec_decode,
+            handoff=(
+                bool(handoff) and self.handoff_after_prefill
+                and len(prompt) > 1
+            ),
         )
         req.submit_t = time.monotonic()
         overload: SchedulerOverloaded | None = None
@@ -1337,6 +1383,75 @@ class ContinuousEngine:
             out.append((kind, s, req))
         return out
 
+    # -- disaggregated prefill/decode handoff (source side) --------------
+    # The steady-state generalization of the drain: on a handoff-armed
+    # engine every opted-in slot freezes at its prefill→decode boundary
+    # (step_chunk, handoff_done) and waits here for the driver to ship it
+    # through the SAME export/stage/adopt path a drain uses — while
+    # admission stays open and co-resident slots keep stepping. Fallback
+    # ladder per slot: page-ship → re-prefill redirect at the destination
+    # (commit_handoff(fell_back=True)) → resume locally (abort_handoff,
+    # the final prompt token simply prefills here and the slot decodes as
+    # on a mixed worker) — never a dropped stream.
+
+    def handoff_manifest(self) -> list[tuple[int, ContinuousRequest]]:
+        """Pop the slots frozen at their prefill→decode boundary since
+        the last call: (slot, request) pairs the driver must now ship,
+        redirect, or abort back to local decoding. Driver-thread only."""
+        ready, self._handoff_ready = self._handoff_ready, []
+        return [
+            (s, self._slots[s]) for s in ready
+            if s in self._frozen and self._slots[s] is not None
+        ]
+
+    def commit_handoff(
+        self, slot: int, *, fell_back: bool = False
+    ) -> ContinuousRequest | None:
+        """The handed-off stream now lives on the decode-pool worker
+        (pages shipped and staged, or — ``fell_back`` — redirected for a
+        fresh prefill there): tear the slot down through the normal
+        release path without finishing the request, exactly like a
+        drain's commit. Prefill-region pages promote into the trie, so a
+        sibling request's admission (or this stream's own fallback
+        re-prefill, should it bounce back) walks them for free."""
+        if slot not in self._frozen:
+            raise ValueError(f"slot {slot} is not frozen for handoff")
+        req = self._slots[slot]
+        dur = (
+            time.monotonic() - req.prefill_done_t
+            if req is not None and req.prefill_done_t else None
+        )
+        out = self._teardown_slot(slot)
+        if fell_back:
+            self._count("handoffs_fell_back")
+            self._trace(out, "handoff_fallback", slot=slot)
+        else:
+            self._count("handoffs_completed")
+            # the TTFT decomposition's handoff leg: prefill completed →
+            # pages committed at the destination (contiguous with the
+            # prefill span; the destination's first_token span covers
+            # resubmit → first draw, closing the sum)
+            self._trace(out, "handoff", dur_s=dur, slot=slot)
+        return out
+
+    def abort_handoff(self, slot: int) -> None:
+        """No usable destination (pool empty, every probe refused, the
+        worker is itself draining): un-freeze and finish the prefill
+        HERE — the request drops its handoff mark, the next packed block
+        grants its final prompt token, and the first draw happens
+        in-program like any mixed-worker admission. The stream stays
+        bit-identical (nothing was shipped; the grant schedule merely
+        paused) and is never worse off than without disaggregation."""
+        if slot not in self._frozen:
+            raise ValueError(f"slot {slot} is not frozen for handoff")
+        self._frozen.discard(slot)
+        self._count("handoffs_fell_back")
+        req = self._slots[slot]
+        if req is not None:
+            req.handoff = False
+            self._prefilling[slot] = req
+            self._trace(req, "handoff_fallback", slot=slot, local=True)
+
     # -- live slot migration (import side) -------------------------------
     def migration_mode(self) -> tuple[str, int, str]:
         """The (kv_quant, page_size, cache dtype) storage-mode triple a
@@ -1604,6 +1719,17 @@ class ContinuousEngine:
             # by an in-flight migration on either side
             "drain_state": self.drain_state,
             "pages_in_transit": self._pages_in_transit(),
+            # disaggregated prefill/decode (docs/SERVING.md): the pool
+            # role this engine serves under (rides /stats → /metrics →
+            # /healthz so a router can see the fleet's pool shape), and
+            # the slot-owned page count — free + cached + slots +
+            # in-transit == total is the conservation equation remote
+            # observers (chaos e2e, operators) can audit per snapshot
+            "worker_role": self.worker_role,
+            "kv_pages_slots": sum(
+                len(r.pages) for s, r in enumerate(self._slots)
+                if r is not None and s not in self._frozen
+            ),
         })
         if self.pool is not None:
             # co-hosting: the shared pool's occupancy plus THIS tenant's
@@ -1747,11 +1873,19 @@ class ContinuousEngine:
         remaining = np.zeros(S, np.int32)
         eos_arr = np.full((S, self._EOS_WIDTH), -1, np.int32)
         completing: list[int] = []
+        handoff_done: list[int] = []
         grants: dict[int, int] = {}
         pf_slots = sorted(self._prefilling)
+        # a handoff-marked slot prefills only to T-1: the final prompt
+        # token is deliberately NOT granted here — the DESTINATION feeds
+        # it as its first decode row, recomputing position T-1's KV
+        # bitwise (framing invariance) and making the first draw, so the
+        # shipped state matches the staged-adoption ticket contract with
+        # zero tokens emitted on this (prefill-pool) side
         pf_rem = [
             len(self._prefilling[s].prefill_tokens)
             - self._prefilling[s].prefill_pos
+            - (1 if self._prefilling[s].handoff else 0)
             for s in pf_slots
         ]
         budgets = pack_prefill_budgets(
@@ -1760,17 +1894,26 @@ class ContinuousEngine:
             phase=self._pack_phase,
         )
         self._pack_phase += 1
-        for s, g in zip(pf_slots, budgets):
+        for s, g, rem in zip(pf_slots, budgets, pf_rem):
+            req = self._prefilling[s]
+            if req.handoff and rem <= 0:
+                # already at T-1 (a prefix-cache hit covered everything
+                # shippable at admission): freeze at this boundary with
+                # no grant at all — the maximal prefix short-circuit
+                handoff_done.append(s)
+                continue
             if g <= 0:
                 continue  # budget exhausted: the slot idles this step
-            req = self._prefilling[s]
             blk[s, :g] = req.prefill_tokens[
                 req.prefill_pos : req.prefill_pos + g
             ]
             starts[s] = req.prefill_pos
             n_valid[s] = g
             grants[s] = g
-            if req.prefill_pos + g >= len(req.prefill_tokens):
+            if req.handoff:
+                if req.prefill_pos + g >= len(req.prefill_tokens) - 1:
+                    handoff_done.append(s)  # freeze — no first draw here
+            elif req.prefill_pos + g >= len(req.prefill_tokens):
                 completing.append(s)
                 emit[s] = True
         for s in range(S):
@@ -1790,7 +1933,7 @@ class ContinuousEngine:
                 eos_arr[s, : len(ids)] = ids
         n_spec = self._pack_drafts(blk, n_valid, remaining)
         return (blk, starts, n_valid, n_spec, emit, remaining, eos_arr,
-                completing, grants)
+                completing, handoff_done, grants)
 
     # tlint: hot-path
     def _pack_drafts(self, blk, n_valid, remaining):
@@ -1878,7 +2021,7 @@ class ContinuousEngine:
         if pack is None:
             return self.has_work()
         blk, starts, n_valid, n_spec, emit, remaining, eos_arr, \
-            completing, grants = pack
+            completing, handoff_done, grants = pack
         t_chunk = time.monotonic()
         tokens, n_tok, spec_m, n_exec, self.cache, _done, _steps_dev, \
             self._counts, _rem = paged_ragged_step(
@@ -1916,14 +2059,41 @@ class ContinuousEngine:
         now = time.monotonic()
         for s in completing:
             req = self._prefilling[s]
+            # a locally-resumed handoff (abort_handoff) already recorded
+            # its prefill span at the freeze — completing the final
+            # token must not emit a second one (the TTFT decomposition
+            # would double-count the prefill leg)
+            already_traced = bool(req.prefill_done_t)
+            req.prefill_done_t = now
+            if not already_traced:
+                self._trace(
+                    req, "prefill",
+                    dur_s=(now - req.admit_t) if req.admit_t else None,
+                    tokens=req.prefill_pos,
+                )
+            del self._prefilling[s]
+            self._active[s] = True
+        for s in handoff_done:
+            # the prefill→decode boundary, frozen WITHOUT a first draw
+            # (grants stopped at T-1): the slot leaves the prefilling set
+            # straight into the frozen (in-transit) state — _tok carries
+            # the final prompt token so the export's last_tok is exactly
+            # what the destination's first decode row must feed. Unlike
+            # begin_drain, nothing fences admission: co-resident slots
+            # keep stepping and new requests keep admitting while this
+            # one waits for the driver to ship it.
+            req = self._prefilling.pop(s)
             req.prefill_done_t = now
             self._trace(
                 req, "prefill",
                 dur_s=(now - req.admit_t) if req.admit_t else None,
                 tokens=req.prefill_pos,
             )
-            del self._prefilling[s]
-            self._active[s] = True
+            self._tok[s] = int(req.prefill_tokens[-1])
+            self._frozen.add(s)
+            self._handoff_ready.append(s)
+            self._count("handoffs_started")
+            self._trace(req, "freeze", slot=s, tokens=0)
         if emit.any():
             # prefill-only steps decode nothing — don't count them
             self._count("decode_steps", n_exec)

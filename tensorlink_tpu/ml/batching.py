@@ -136,13 +136,15 @@ class GenBatcher:
         frequency_penalty: float = 0.0,
         priority: str | None = None,
         trace_id: str | None = None,
+        handoff: bool = True,
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode.
-        ``priority`` and ``speculative`` are accepted for API symmetry
-        with the continuous scheduler; the windowed batcher itself stays
-        FCFS and decodes vanilla (speculation is a paged-engine feature —
-        both knobs are pure hints, streams identical either way).
+        ``priority``, ``speculative``, and ``handoff`` are accepted for
+        API symmetry with the continuous scheduler; the windowed batcher
+        itself stays FCFS and decodes vanilla (speculation and the
+        prefill→decode handoff are paged-engine features — all three are
+        pure hints, streams identical either way).
         ``trace_id`` (core/trace.py) records the window-wait +
         batched-decode span."""
         req = _Pending(
@@ -725,6 +727,7 @@ class ContinuousBatcher:
         pool: Any = None,
         model_id: str = "",
         page_quota: int = 0,
+        worker_role: str = "mixed",
     ):
         from collections import deque
 
@@ -791,6 +794,10 @@ class ContinuousBatcher:
                 or "none"
             ),
             "spec_decode": bool(spec_decode),
+            # the ENTRY worker's advertised pool role (the validator read
+            # it off the placement stats) — what serving_modes reports
+            # for a remote engine before any traffic produces a snapshot
+            "worker_role": str(worker_role or "mixed"),
         }
         if self.mode in ("local", "pipelined"):
             self._thread = threading.Thread(
@@ -819,6 +826,12 @@ class ContinuousBatcher:
                     getattr(self._cont.engine, "quant", None) or "none"
                 ),
                 "spec_decode": bool(self._cont.spec_decode),
+                # disaggregated prefill/decode: which pool the serving
+                # engine runs in — a fleet router reads the pool shape
+                # off /healthz before placing traffic (docs/SERVING.md)
+                "worker_role": str(
+                    getattr(self._cont, "worker_role", "mixed")
+                ),
             }
             if self._cont.pool is not None:
                 # co-hosting view: a router sizing placement needs the
@@ -829,6 +842,13 @@ class ContinuousBatcher:
                     "free": self._cont.pool.alloc.n_free,
                 }
             return modes
+        # remote engines report the PLACEMENT-TIME role of the entry
+        # worker (the admission point a router places traffic on). The
+        # last serving snapshot is deliberately NOT consulted: after a
+        # handoff it comes from whichever pool answered last (usually
+        # the decode worker), and a prefill entry replica flapping to
+        # "decode" on /healthz is exactly the misclassification the
+        # role plumbing exists to prevent.
         return dict(self._modes)
 
     # -- client side -----------------------------------------------------
@@ -848,6 +868,7 @@ class ContinuousBatcher:
         frequency_penalty: float = 0.0,
         priority: str | None = None,
         trace_id: str | None = None,
+        handoff: bool = True,
     ) -> list[int]:
         with self._submit_lock:
             if self._closed:
@@ -873,6 +894,7 @@ class ContinuousBatcher:
                     presence_penalty=presence_penalty,
                     frequency_penalty=frequency_penalty, seed=req_seed,
                     priority=priority, trace_id=trace_id,
+                    handoff=handoff,
                 )
             finally:
                 with self._idle:
@@ -944,7 +966,7 @@ class ContinuousBatcher:
     def _generate_remote(
         self, ids, *, max_new_tokens, temperature, top_k, top_p, stream_cb,
         lookahead, presence_penalty, frequency_penalty, seed,
-        speculative=False, priority=None, trace_id="",
+        speculative=False, priority=None, trace_id="", handoff=True,
     ) -> list[int]:
         """Single-stage pass-through: the worker's slot engine is the
         scheduler, so each request ships immediately — concurrency comes
@@ -972,6 +994,9 @@ class ContinuousBatcher:
             frequency_penalty=frequency_penalty,
             priority=priority,
             trace_id=trace_id,
+            # per-request opt-out of the prefill→decode handoff on a
+            # disaggregated pool (docs/SERVING.md)
+            handoff=handoff,
             # legacy lookahead runs the solo engine path; everything else
             # joins the worker's slot batch
             continuous=not spec,

@@ -755,6 +755,7 @@ class DistributedModel:
         priority: str | None = None,
         trace_id: str | None = None,
         speculative: bool = False,
+        handoff: bool = True,
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -803,6 +804,7 @@ class DistributedModel:
                     priority=priority,
                     trace_id=str(trace_id or ""),
                     speculative=bool(speculative),
+                    handoff=bool(handoff),
                 )
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -1044,45 +1046,97 @@ class DistributedModel:
             )
         return redirects + 1
 
-    def _attach_migrated(self, old_wid: str, mig: dict) -> str | None:
+    def _attach_migrated(
+        self, old_wid: str, mig: dict, *, rewrite_plan: bool = True
+    ) -> str | None:
         """Re-point this job at a migration redirect's destination worker
         (connect, rewrite the plan stage, record the repair mapping so
         concurrent requests chase to it too). Returns the staged-adoption
         ticket id (None = plain re-prefill resume). An unreachable
         destination raises :class:`WorkerLost` — the caller's recovery
-        path then pulls a validator replacement, the ladder's last rung."""
+        path then pulls a validator replacement, the ladder's last rung.
+
+        ``rewrite_plan=False`` is the steady-state prefill→decode handoff
+        shape (the redirect carries ``handoff: true``): only THIS request
+        follows to the destination — the plan keeps naming the prefill
+        worker, which stays the admission point for every later request."""
         dest_id = str(mig.get("worker") or "")
         addr = list(mig.get("addr") or [])
         if not dest_id or len(addr) != 2:
             raise WorkerLost(
                 old_wid, RuntimeError("malformed migration redirect")
             )
+        # ALWAYS (re)dial: the net layer dedupes live connections by
+        # address, and a stale cached peer id (the destination restarted,
+        # a dropped link) would otherwise make every future redirect to
+        # it fail with "no connection" forever — the steady-state handoff
+        # path hits the same destination on every request, so a dead
+        # cache entry must heal here. The dial happens OUTSIDE the
+        # repair lock (dedupe makes concurrent dials safe): holding the
+        # model-wide lock across a cross-process round trip would
+        # serialize every concurrent request's redirect on a path that
+        # is now per-request, not per-drain.
+        try:
+            conn_id = self.node.connect_to(addr[0], int(addr[1]))
+        except Exception as e:
+            raise WorkerLost(old_wid, e) from e
         with self._repair_lock:
-            if dest_id not in self.workers:
-                try:
-                    conn_id = self.node.connect_to(addr[0], int(addr[1]))
-                except Exception as e:
-                    raise WorkerLost(old_wid, e) from e
-                self.workers[dest_id] = conn_id
-                self.worker_addrs[dest_id] = [addr[0], int(addr[1])]
-            for s in self.plan.stages:
-                if s.worker_id == old_wid:
-                    s.worker_id = dest_id
-            if old_wid != dest_id:
-                self._repaired[old_wid] = dest_id
+            self.workers[dest_id] = conn_id
+            self.worker_addrs[dest_id] = [addr[0], int(addr[1])]
+            if rewrite_plan:
+                for s in self.plan.stages:
+                    if s.worker_id == old_wid:
+                        s.worker_id = dest_id
+                if old_wid != dest_id:
+                    self._repaired[old_wid] = dest_id
         self.log.info(
-            "stream migrated %s -> %s (%s)",
+            "stream %s %s -> %s (%s)",
+            "handed off" if not rewrite_plan else "migrated",
             old_wid[:8], dest_id[:8],
             "page-shipped" if mig.get("mig") else "re-prefill resume",
         )
         return mig.get("mig") or None
+
+    def _follow_redirect(
+        self, wid: str, mig: dict, *, off_plan: bool = False
+    ) -> tuple[str | None, str | None, bool]:
+        """Follow a migration/handoff redirect. Returns ``(adopt,
+        wid_override, retry_at_source)``: ``wid_override`` names the
+        destination for a HANDOFF redirect (this request only — the plan
+        keeps naming the prefill worker, the admission point), and
+        ``retry_at_source=True`` means a handoff destination was
+        unreachable — the prefill source is alive, so the caller simply
+        resubmits there (fresh prefill; the worker retries or serves the
+        stream locally) instead of escalating to validator repair.
+
+        ``off_plan=True`` marks a redirect received while already
+        decoding OFF the plan (at an earlier handoff's destination) —
+        e.g. the decode worker itself draining. The plan rewrite finds
+        no stage naming it, so the ticket's new home must ride the
+        override: re-issuing at the plan's prefill worker would carry a
+        ticket staged somewhere else entirely (it could never adopt)."""
+        is_handoff = bool(mig.get("handoff"))
+        try:
+            adopt = self._attach_migrated(
+                wid, mig, rewrite_plan=not is_handoff
+            )
+        except WorkerLost:
+            if not is_handoff:
+                raise  # drain ladder: recovery pulls a validator repair
+            self.log.warning(
+                "handoff destination %s unreachable; resubmitting at the "
+                "prefill worker", str(mig.get("worker") or "")[:8],
+            )
+            return None, None, True
+        follow = is_handoff or off_plan
+        return adopt, (str(mig["worker"]) if follow else None), False
 
     def _generate_continuous_remote(
         self, prompt: list[int], *, max_new_tokens: int, temperature: float,
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
         presence_penalty: float, frequency_penalty: float,
         priority: str | None = None, trace_id: str = "",
-        speculative: bool = False,
+        speculative: bool = False, handoff: bool = True,
     ) -> list[list[int]]:
         """One request through the worker's continuous slot engine
         (B=1 per RPC; the worker co-batches concurrent requests into its
@@ -1105,13 +1159,17 @@ class DistributedModel:
         # drain cycle (A drained onto B, B later drained onto A before A
         # was stopped) must surface as an error, not an infinite bounce
         MAX_REDIRECTS = 8
+        # a prefill→decode HANDOFF redirect moves only THIS request: the
+        # override names the decode worker to re-issue at while the plan
+        # keeps naming the prefill worker (the admission point)
+        wid_override: str | None = None
         while True:
             # capture the id this attempt ISSUES to: a concurrent request's
             # repair may rewrite the plan mid-flight, and recovery must
             # repair the worker that actually failed us — _repair's chase
             # map then reuses the concurrent thread's replacement instead
             # of trying to "replace" the live one
-            wid = self.plan.stages[0].worker_id
+            wid = wid_override or self.plan.stages[0].worker_id
             budget = int(max_new_tokens) - len(delivered)
             if budget <= 0:
                 return [delivered]
@@ -1135,6 +1193,11 @@ class DistributedModel:
                 # rows when its spec_decode is on; streams bit-identical
                 # either way, so an ignoring worker changes nothing
                 body["speculative"] = True
+            if not handoff:
+                # per-request opt-out of the prefill→decode handoff on a
+                # disaggregated pool (the default is to follow the
+                # worker's role); absence of the key means opted in
+                body["handoff"] = False
             if trace_id:
                 # the trace id rides the GENERATE frame: the worker's
                 # engine records its spans under it and ships them back on
@@ -1153,16 +1216,29 @@ class DistributedModel:
                     self._note_serving(resp)
                     mig = resp.get("migrated")
                     if mig is not None:
-                        # the worker is draining: our slot moved (or was
-                        # redirected) — top up delivered from the
-                        # authoritative list, re-point at the
-                        # destination, and re-issue there
+                        # the worker is draining (or handing our freshly
+                        # prefilled slot to the decode pool): top up
+                        # delivered from the authoritative list, re-point
+                        # at the destination, and re-issue there
                         delivered = self._merge_migrated_tokens(
                             mig, delivered, delivered, None
                         )
                         redirects = self._count_redirect(redirects,
                                                          MAX_REDIRECTS)
-                        adopt = self._attach_migrated(wid, mig)
+                        adopt, wid_override, retry = \
+                            self._follow_redirect(
+                                wid, mig,
+                                off_plan=wid_override is not None,
+                            )
+                        if retry:
+                            # the destination is unreachable FROM US
+                            # (asymmetric routing) even though the
+                            # prefill worker can ship to it — opt the
+                            # resubmission out of handoff, or the worker
+                            # would bounce us to the same dead end until
+                            # the redirect cap drops the stream
+                            recoveries += 1
+                            handoff = False
                         continue
                     return [
                         delivered
@@ -1177,7 +1253,15 @@ class DistributedModel:
                     )
                     redirects = self._count_redirect(redirects,
                                                      MAX_REDIRECTS)
-                    adopt = self._attach_migrated(wid, mig)
+                    adopt, wid_override, retry = \
+                        self._follow_redirect(
+                            wid, mig, off_plan=wid_override is not None,
+                        )
+                    if retry:
+                        # see above: client-unreachable destination —
+                        # pin the resubmission to the prefill worker
+                        recoveries += 1
+                        handoff = False
                     continue
                 if finished:
                     return [out]
@@ -1195,6 +1279,30 @@ class DistributedModel:
                 if not recoverable or recoveries >= MAX_RECOVERIES:
                     raise
                 recoveries += 1
+                if wid_override is not None and all(
+                    s.worker_id != wid for s in self.plan.stages
+                ):
+                    # the handoff DESTINATION died mid-decode. The
+                    # admission point (the plan's prefill worker) is not
+                    # implicated — resubmit there with a dead ticket
+                    # dropped, instead of "repairing" a healthy worker
+                    # (which would re-recruit and re-ship its stage)
+                    self.log.warning(
+                        "handoff destination lost mid-decode (%s); "
+                        "resubmitting prompt + %d delivered tokens at "
+                        "the prefill worker (recovery %d/%d)",
+                        e, len(delivered), recoveries, MAX_RECOVERIES,
+                    )
+                    wid_override = None
+                    adopt = None
+                    # the decode pool just ate our stream once — decode
+                    # the resubmission at the admission point instead of
+                    # letting the worker's (possibly stale) readiness
+                    # cache bounce it toward the same dead destination
+                    handoff = False
+                    continue
+                wid_override = None
+                adopt = None
                 self.log.warning(
                     "continuous generate lost its worker (%s); re-prefilling "
                     "prompt + %d delivered tokens on a replacement "

@@ -171,25 +171,91 @@ class DistributedValidator:
         """Shared plan→recruit path for user jobs and hosted models: live
         worker capacities → plan_sharding → create_job on the net process.
         Returns the create_job result. Raises AssignmentError on no fit."""
-        from tensorlink_tpu.parallel.planner import WorkerCapacity, plan_sharding
+        from tensorlink_tpu.parallel.planner import (
+            AssignmentError,
+            WorkerCapacity,
+            plan_sharding,
+        )
 
         name = model_spec.get("name", "")
         stats = self.bridge.request("stats_workers", timeout=15.0)
-        workers = [
-            WorkerCapacity(
-                node_id=s["id"],
-                hbm_bytes=float(s.get("free_bytes", s.get("hbm_bytes", 0.0))),
-                n_devices=int(s.get("n_devices", 1)),
-                slice_id=str(s.get("slice_id", "") or ""),
-            )
+        # -- disaggregated prefill/decode placement (docs/SERVING.md) ----
+        # Workers advertise a serving_role with every stats sweep. When a
+        # SERVING job is planned against a pool that contains decode-role
+        # workers, those are reserved as handoff destinations: the job's
+        # stages (= the admission point new requests hit) land on
+        # prefill/mixed workers, and each prefill-role worker the plan
+        # touches gets the decode-pool membership pushed at recruit time
+        # (roles.py cmd_create_job → HANDOFF frames) so it can ship every
+        # completed prefill there. Training jobs, pools with no decode
+        # workers, and models that can never hand off (the paged slot
+        # engine refuses them, or continuous batching is off — either way
+        # they serve through the windowed batcher, which has no
+        # prefill→decode boundary) place exactly as before: reserving
+        # decode workers for them would only shrink the plannable pool.
+        from tensorlink_tpu.engine.continuous import paged_unsupported
+
+        roles = {
+            s.get("id"): str(s.get("serving_role") or "mixed")
             for s in stats
+        }
+        decode_pool = [
+            {"id": s["id"], "addr": list(s["addr"])}
+            for s in stats
+            if roles.get(s.get("id")) == "decode" and s.get("addr")
         ]
-        plan = plan_sharding(
-            cfg, workers, model_name=name, batch=batch,
-            seq_len=seq_len, training=training, n_micro=n_micro,
-            mesh_hints=mesh_hints,
-            merge_co_slice=self.node.config.ml.co_slice_planning,
-        )
+        if decode_pool and not (
+            self.node.config.ml.continuous_batching
+            and paged_unsupported(cfg) is None
+        ):
+            decode_pool = []
+        placement = stats
+        if not training and decode_pool:
+            non_decode = [
+                s for s in stats if roles.get(s.get("id")) != "decode"
+            ]
+            if non_decode:
+                placement = non_decode
+            else:
+                # every worker is decode-role: nothing to disaggregate
+                # against — serve single-pool rather than fail planning
+                decode_pool = []
+        def _plan(pool):
+            workers = [
+                WorkerCapacity(
+                    node_id=s["id"],
+                    hbm_bytes=float(
+                        s.get("free_bytes", s.get("hbm_bytes", 0.0))
+                    ),
+                    n_devices=int(s.get("n_devices", 1)),
+                    slice_id=str(s.get("slice_id", "") or ""),
+                )
+                for s in pool
+            ]
+            return plan_sharding(
+                cfg, workers, model_name=name, batch=batch,
+                seq_len=seq_len, training=training, n_micro=n_micro,
+                mesh_hints=mesh_hints,
+                merge_co_slice=self.node.config.ml.co_slice_planning,
+            )
+
+        try:
+            plan = _plan(placement)
+        except AssignmentError:
+            if placement is stats:
+                raise
+            # the prefill/mixed subset alone can't fit the model (the
+            # reserved decode workers hold the missing capacity): a
+            # single-pool placement over the FULL pool beats a failed
+            # host — disaggregation is a latency optimization, not worth
+            # declining a job the cluster can serve
+            self.log.warning(
+                "disaggregated placement for %s does not fit the "
+                "prefill/mixed subset; falling back to single-pool "
+                "placement over all %d workers", name, len(stats),
+            )
+            decode_pool = []
+            plan = _plan(stats)
         total_layers = max(cfg.n_layers, 1)
         job = {
             "job_id": uuid.uuid4().hex,
@@ -201,11 +267,31 @@ class DistributedValidator:
                 for s in plan.stages
             },
         }
+        if not training and decode_pool:
+            handoff_push = {
+                s.worker_id: decode_pool
+                for s in plan.stages
+                if roles.get(s.worker_id) == "prefill"
+            }
+            if handoff_push:
+                job["handoff_push"] = handoff_push
+                self.log.info(
+                    "disaggregated placement for %s: %d prefill worker(s) "
+                    "→ %d decode worker(s)",
+                    name, len(handoff_push), len(decode_pool),
+                )
         result = self.bridge.request(
             "create_job",
             {"req_id": req_id, "user_id": user_id, "job": job},
             timeout=30.0,
         )
+        # the planned workers' advertised pool roles, for consumers that
+        # must know the shape BEFORE any traffic produces a serving
+        # snapshot (/healthz serving_modes on a fresh replica)
+        result["serving_roles"] = {
+            s.worker_id: roles.get(s.worker_id, "mixed")
+            for s in plan.stages
+        }
         self.log.info(
             "job %s (%s): accepted=%s stages=%d",
             job["job_id"][:8], name, result.get("accepted"), plan.n_stages,
@@ -339,12 +425,22 @@ class DistributedValidator:
         from tensorlink_tpu.engine.continuous import paged_unsupported
 
         unpageable = paged_unsupported(cfg) is not None
+        # the ENTRY worker's advertised pool role (disaggregated serving):
+        # what /healthz serving_modes reports until live snapshots arrive
+        entry_role = "mixed"
+        if getattr(job.model, "plan", None) is not None:
+            entry_role = str(
+                (result.get("serving_roles") or {}).get(
+                    job.model.plan.stages[0].worker_id
+                ) or "mixed"
+            )
         if ml_cfg.continuous_batching and not merged and not unpageable:
             # continuous batching (docs/SERVING.md): no arrival window, no
             # drain barrier — requests join the model's running slot batch
             # at decode-chunk boundaries.
             job.batcher = ContinuousBatcher(
                 job.model, job.tokenizer.eos_ids,
+                worker_role=entry_role,
                 max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
                 chunk_steps=ml_cfg.cont_chunk_steps,
                 kv_quant=ml_cfg.kv_quant,
@@ -398,7 +494,7 @@ class DistributedValidator:
                 # windowed batcher (or no batcher yet): vanilla decode
                 modes[name] = {
                     "kv_quant": "none", "weight_quant": "none",
-                    "spec_decode": False,
+                    "spec_decode": False, "worker_role": "mixed",
                 }
         return {
             "status": "ok",
@@ -655,6 +751,9 @@ class DistributedValidator:
                 speculative=spec_cont,
                 priority=getattr(req, "priority", None) or None,
                 trace_id=trace_id,
+                # per-request opt-out of the disaggregated prefill→decode
+                # handoff ({"handoff": false}; default opted in)
+                handoff=bool(getattr(req, "handoff", True)),
             )
         else:
             with job.lock:  # serialize per-model generation

@@ -129,6 +129,28 @@ class DistributedWorker:
         # redirected there instead of admitted, and the recruiting
         # capacity is zeroed. None = serving normally.
         self.draining: dict | None = None
+        # disaggregated prefill/decode (docs/SERVING.md): the decode-pool
+        # memberships a prefill-role worker hands completed prefills to —
+        # pushed by the validator (HANDOFF frames). Keyed PER JOB (the
+        # recruit-time push names the job it was planned for — a job
+        # recruited before any decode worker existed must NOT start
+        # shipping its streams to another job's pool), with "" as the
+        # worker-wide fallback an operator's set_handoff_pool installs.
+        # Empty = no handoffs (mixed-style serving even under
+        # worker_role="prefill").
+        self._handoff_pools: dict[str, list[dict]] = {}
+        self._handoff_rr = 0  # round-robin cursor over the pool
+        # destinations already probed loaded/ready per job — skips the
+        # per-handoff MODULE-ship round trip on the steady-state path;
+        # invalidated on any ship failure so a restarted destination is
+        # re-prepared instead of redirected into blind
+        self._handoff_dest_ready: set[tuple[str, str]] = set()
+        # (job, dest) prepares currently in flight (the warm-up thread or
+        # the run loop): a second prepare for the same key must neither
+        # block nor double-ship — a duplicate MODULE load REPLACES the
+        # destination's runtime, killing any stream adopted in between
+        self._handoff_preparing: set[tuple[str, str]] = set()
+        self._handoff_prep_lock = threading.Lock()
         # shared multi-tenant KV page pools (engine/paged.py::
         # SharedPagePool), keyed by page GEOMETRY so only models that can
         # physically share pages do — created lazily at the first
@@ -187,6 +209,14 @@ class DistributedWorker:
             "n_devices": len(devs),
             "platform": probe.platform,
             "training": True,
+            # disaggregated prefill/decode: the pool this worker serves
+            # in ("prefill" | "decode" | "mixed") — the validator's
+            # placement reads it off every stats sweep (decode workers
+            # are reserved as handoff destinations, docs/SERVING.md)
+            "serving_role": str(
+                getattr(self.node.config.ml, "worker_role", "mixed")
+                or "mixed"
+            ),
         }
         # hosts of one TPU slice share an ICI domain: advertise the slice so
         # the planner can merge co-slice workers into one mesh
@@ -291,9 +321,21 @@ class DistributedWorker:
             self._drain(p)
         elif kind == proto.MIGRATE:
             self._migrate_in(p)
+        elif kind == proto.HANDOFF:
+            self._set_handoff_pool(p)
         elif kind == "shutdown_job":
+            jid = p.get("job_id", "")
             with self._lock:
-                rt = self.jobs.pop(p.get("job_id", ""), None)
+                rt = self.jobs.pop(jid, None)
+            # drop the job's handoff state with it: its decode-pool list
+            # and per-destination readiness would otherwise pin per dead
+            # job id for the process lifetime (same lifecycle gap the
+            # shared KV pools had)
+            self._handoff_pools.pop(jid, None)
+            with self._handoff_prep_lock:  # vs the warm thread's add
+                self._handoff_dest_ready = {
+                    k for k in self._handoff_dest_ready if k[0] != jid
+                }
             if rt is not None and rt.cont is not None:
                 # fail queued/in-flight continuous requests fast rather
                 # than letting their clients wait out the RPC timeout
@@ -1723,6 +1765,16 @@ class DistributedWorker:
             # draft/verify opt-in (no-op unless this engine's spec_decode
             # is on; streams bit-identical either way)
             speculative=bool(p.get("speculative", False)),
+            # disaggregated prefill/decode: on a prefill-pool worker with
+            # a live decode pool, this admission freezes at its
+            # prefill→decode boundary and _run_handoffs ships it —
+            # unless the request opted out ({"handoff": false}) or is
+            # itself a migration resume (adopt) bouncing through
+            handoff=bool(
+                self._handoff_pool_for(rt.job_id)
+                and p.get("handoff", True) is not False
+                and not p.get("adopt")
+            ),
         )
         # transport context for live migration: a drain must redirect this
         # stream mid-flight, which needs the original peer/rid/stream —
@@ -1753,9 +1805,15 @@ class DistributedWorker:
                 (rt.model_spec or {}).get("page_quota")
                 or getattr(ml, "cont_pool_quota", 0)
             )
+        role = str(getattr(ml, "worker_role", "mixed") or "mixed")
         try:
             rt.cont = cont = ContinuousEngine(
                 rt.engine,
+                # disaggregated prefill/decode: a prefill-role worker's
+                # engine freezes opted-in slots at the prefill→decode
+                # boundary for _run_handoffs to ship (docs/SERVING.md)
+                handoff_after_prefill=(role == "prefill"),
+                worker_role=role,
                 # co-hosting (docs/SERVING.md): every job whose page
                 # geometry matches shares ONE physical pool under a
                 # per-model quota; job_id keys the tenant (unique even
@@ -1871,7 +1929,15 @@ class DistributedWorker:
             rt.cont = None
             self._gc_kv_pools()  # release a now-tenantless shared pool
             return
-        if more:
+        # steady-state prefill→decode handoff: ship every slot the chunk
+        # froze at its prefill boundary BEFORE deciding whether to
+        # requeue — a frozen slot is invisible to step_chunk's has_work,
+        # so resolving the manifest here is what keeps the engine free of
+        # parked in-transit slots between work items. Re-check has_work
+        # after: an aborted handoff resumes the slot's prefill HERE, and
+        # that revived work must requeue even when the chunk saw none.
+        self._run_handoffs(rt)
+        if more or (rt.cont is not None and rt.cont.has_work()):
             self._schedule_cont(rt)
 
     # -- live slot migration + drain (docs/FAILURE_MODEL.md) -------------
@@ -1882,6 +1948,275 @@ class DistributedWorker:
     # requests, and any failed export/wire/import). The client learns via
     # a {"migrated": ...} GENERATE_RESP and re-issues at the destination;
     # a stream is never dropped, only redirected.
+
+    # -- disaggregated prefill/decode: steady-state handoff --------------
+    # (docs/SERVING.md "Disaggregated prefill/decode") A prefill-role
+    # worker is permanently "draining" its completed prefills: every
+    # opted-in admission freezes at the prefill→decode boundary and is
+    # shipped here to a decode-pool worker through the SAME
+    # export/stage/adopt path a drain uses — but with no admission
+    # fence, no capacity zeroing, and a per-slot fallback ladder
+    # (page-ship → re-prefill redirect at the destination → resume
+    # locally) instead of a worker-wide abort. The client follows the
+    # redirect exactly like a drain redirect, except the plan keeps
+    # pointing HERE — this worker stays the admission point.
+
+    def _set_handoff_pool(self, p: dict) -> None:
+        """A HANDOFF push from the validator: the decode-pool membership
+        this (prefill-role) worker ships completed prefills to — scoped
+        to the named job ("" = worker-wide operator push)."""
+        pool = [
+            dict(e) for e in (p.get("pool") or [])
+            if e.get("id") and e.get("id") != self.node.node_id
+            and e.get("addr")
+        ]
+        job_id = str(p.get("job_id") or "")
+        self._handoff_pools[job_id] = pool
+        # membership changed: stale readiness could point at a departed
+        # worker, and a fresh pool deserves fresh probes — but only for
+        # the job whose pool this push names (a new job's recruit must
+        # not cost every OTHER job an inline re-probe on the run loop);
+        # the worker-wide "" push refreshes everything
+        with self._handoff_prep_lock:
+            # the lock covers every mutation of _handoff_dest_ready: the
+            # warm thread adds concurrently, and an unguarded add during
+            # this comprehension's iteration would raise "set changed
+            # size during iteration" in the control-frame handler
+            if job_id:
+                self._handoff_dest_ready = {
+                    k for k in self._handoff_dest_ready if k[0] != job_id
+                }
+            else:
+                self._handoff_dest_ready.clear()
+        self.log.info(
+            "handoff pool set (%s): %d decode worker(s) %s",
+            job_id[:8] or "worker-wide", len(pool),
+            [str(e["id"])[:8] for e in pool],
+        )
+        if pool:
+            # pre-warm OFF the run loop: a cold destination's stage ship
+            # can take minutes (MODULE timeout 120s), and paying it
+            # inside _run_handoffs would stall every co-resident
+            # stream's decode between chunks. The push arrives at
+            # recruit time — usually before any traffic — so the warm
+            # thread normally has the readiness cache populated before
+            # the first prefill completes; a handoff that races it just
+            # pays the old synchronous prepare once.
+            threading.Thread(
+                target=self._warm_handoff_dests, args=(job_id,),
+                name="handoff-warm", daemon=True,
+            ).start()
+
+    def _warm_handoff_dests(self, job_id: str) -> None:
+        """Background half of the pool push: probe/ship the job's stage
+        to every decode-pool member so the run loop's _pick_handoff_dest
+        finds them ready instead of preparing them inline. Job-scoped
+        pushes wait briefly for the runtime (HANDOFF and MODULE race at
+        recruit time); failures are dropped — the synchronous path
+        re-probes on demand and the slot falls back locally at worst."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            with self._lock:
+                if job_id:
+                    rts = [self.jobs[job_id]] if job_id in self.jobs else []
+                else:
+                    rts = list(self.jobs.values())
+            if rts or time.monotonic() >= deadline:
+                break
+            time.sleep(0.25)
+        for rt in rts:
+            pool = self._handoff_pool_for(rt.job_id)
+            for dest in pool:
+                key = (rt.job_id, str(dest.get("id", "")))
+                with self._handoff_prep_lock:
+                    if key in self._handoff_dest_ready \
+                            or key in self._handoff_preparing:
+                        continue
+                    self._handoff_preparing.add(key)
+                try:
+                    ok = self._prepare_dest(rt, dest)
+                    # the job may have been shut down during the ship
+                    # (MODULE can take minutes): marking it ready now
+                    # would re-pin the dead job id shutdown_job just
+                    # purged
+                    with self._lock:
+                        alive = rt.job_id in self.jobs
+                    if ok and alive:
+                        with self._handoff_prep_lock:
+                            self._handoff_dest_ready.add(key)
+                # tlint: disable=TL005(best-effort warm-up — the handoff path re-probes on demand)
+                except Exception:
+                    pass
+                finally:
+                    with self._handoff_prep_lock:
+                        self._handoff_preparing.discard(key)
+
+    def _handoff_pool_for(self, job_id: str) -> list[dict]:
+        """The decode pool a job's completed prefills ship to: the
+        job-scoped push wins; the worker-wide operator push stands in
+        for jobs recruited without one."""
+        return (
+            self._handoff_pools.get(job_id)
+            or self._handoff_pools.get("")
+            or []
+        )
+
+    def _pick_handoff_dest(self, rt: "StageRuntime") -> dict | None:
+        """Round-robin over the job's decode pool, skipping members that
+        can't host this job right now (unreachable / refusing /
+        stage-load failure). Readiness is cached per (job, dest) so the
+        steady-state path pays one probe per handoff, not a MODULE round
+        trip."""
+        pool = self._handoff_pool_for(rt.job_id)
+        n = len(pool)
+        for j in range(n):
+            dest = pool[(self._handoff_rr + j) % n]
+            key = (rt.job_id, str(dest["id"]))
+            if key in self._handoff_dest_ready:
+                self._handoff_rr = (self._handoff_rr + j + 1) % n
+                return dest
+            with self._handoff_prep_lock:
+                if key in self._handoff_preparing:
+                    # the warm-up thread is mid-ship to this member:
+                    # waiting would stall the run loop and a second
+                    # MODULE ship would replace the destination runtime
+                    # — try the next member (or resume locally)
+                    continue
+                self._handoff_preparing.add(key)
+            try:
+                ok = self._prepare_dest(rt, dest)
+            finally:
+                with self._handoff_prep_lock:
+                    self._handoff_preparing.discard(key)
+            if ok:
+                with self._handoff_prep_lock:
+                    self._handoff_dest_ready.add(key)
+                self._handoff_rr = (self._handoff_rr + j + 1) % n
+                return dest
+        return None
+
+    def _run_handoffs(self, rt: "StageRuntime") -> None:
+        """Ship every slot the last chunk froze at its prefill→decode
+        boundary. Runs on the worker's serial run loop right after the
+        chunk, so every freeze-to-ship window is one work item — no
+        frozen slot ever parks across items."""
+        cont = rt.cont
+        if cont is None:
+            return
+        manifest = cont.handoff_manifest()
+        if not manifest:
+            return
+        for slot, req in manifest:
+            meta = req.client_meta
+            if meta is None or self.draining is not None \
+                    or not self._handoff_pool_for(rt.job_id):
+                # no transport context to redirect (in-process driver),
+                # or this worker is itself mid-drain (the drain ladder
+                # owns its slots): finish the prefill locally
+                cont.abort_handoff(slot)
+                continue
+            dest = self._pick_handoff_dest(rt)
+            if dest is None:
+                # no decode worker usable: degrade to mixed serving for
+                # this slot — one grant finishes the prompt and the
+                # stream decodes here, never dropped, never slower
+                self.log.warning(
+                    "handoff: no usable decode-pool destination; "
+                    "slot %d resumes locally", slot,
+                )
+                cont.abort_handoff(slot)
+                continue
+            committed = False
+            try:
+                if self.faults is not None:
+                    # fault site "worker.handoff": error sends the slot
+                    # down the re-prefill redirect rung; crash is the
+                    # prefill-worker-dies-mid-handoff chaos case
+                    self.faults.inject(
+                        "worker.handoff", str(meta.get("rid", ""))
+                    )
+                mig_id = self._ship_migration(rt, cont, slot, dest)
+                moved = cont.commit_handoff(slot)
+                committed = True
+                self._respond_migrated(
+                    cont, meta, dest, mig_id, moved.tokens, handoff=True
+                )
+            except FaultCrash:
+                raise  # the run loop takes the node down
+            except Exception as e:
+                # per-slot containment: ONE failed handoff must neither
+                # re-commit a torn-down slot nor abandon the rest of the
+                # manifest (the popped entries would freeze forever)
+                if committed:
+                    # the slot already committed — its pages are staged
+                    # at the destination and the redirect send was
+                    # already retried (_respond_migrated); landing here
+                    # means the client's relay is genuinely gone (peer
+                    # hung up), so there is no one left to redirect. The
+                    # staged ticket expires via the migration TTL;
+                    # nothing to roll back, but say so loudly.
+                    self.log.warning(
+                        "handoff redirect for slot %d failed post-commit "
+                        "(%s); staged ticket left to TTL expiry", slot, e,
+                    )
+                    continue
+                # drop the readiness cache so the NEXT handoff re-probes
+                # this member, and kick the warm thread so that re-probe
+                # (and a possible stage re-ship to a restarted worker)
+                # happens OFF the run loop instead of inline between a
+                # future chunk and its handoffs
+                with self._handoff_prep_lock:
+                    self._handoff_dest_ready.discard(
+                        (rt.job_id, str(dest["id"]))
+                    )
+                threading.Thread(
+                    target=self._warm_handoff_dests, args=(rt.job_id,),
+                    name="handoff-rewarm", daemon=True,
+                ).start()
+                try:
+                    self._dial_dest(dest)
+                except Exception:
+                    # the destination is UNREACHABLE, not merely refusing
+                    # the transfer: redirecting the client at it would
+                    # just bounce off a dead worker — resume locally (the
+                    # slot's prefilled state is intact; one grant
+                    # finishes the prompt)
+                    self.log.warning(
+                        "handoff of slot %d failed and destination %s is "
+                        "unreachable (%s); resuming locally",
+                        slot, str(dest.get("id", ""))[:8], e,
+                    )
+                    cont.abort_handoff(slot)
+                    continue
+                self.log.warning(
+                    "handoff of slot %d to %s failed (%s); redirecting "
+                    "for re-prefill at the destination",
+                    slot, str(dest.get("id", ""))[:8], e,
+                )
+                # the destination hosts the job and is reachable — only
+                # the transfer failed. Send this stream down the
+                # re-prefill rung: redirect FIRST, commit after — if the
+                # redirect send itself fails, the slot is still frozen
+                # and the local-resume rung below stays reachable (a
+                # commit-first ordering would tear the slot down and
+                # strand the stream against its RPC timeout). Its
+                # prefill-region pages promote into the trie at commit,
+                # so even a bounce-back re-admission here walks them for
+                # free.
+                try:
+                    self._respond_migrated(
+                        cont, meta, dest, None, req.tokens, handoff=True,
+                    )
+                    cont.commit_handoff(slot, fell_back=True)
+                except Exception as e2:
+                    # even the fallback redirect failed: keep the stream
+                    # serving HERE rather than stranding it frozen
+                    self.log.warning(
+                        "handoff fallback redirect for slot %d failed "
+                        "(%s); resuming locally", slot, e2,
+                    )
+                    if slot in cont.frozen_slots():
+                        cont.abort_handoff(slot)
 
     def _drain(self, p: dict) -> None:
         dest = dict(p.get("dest") or {})
@@ -2114,13 +2449,17 @@ class DistributedWorker:
         return mig_id
 
     def _respond_migrated(self, cont, meta: dict, dest: dict,
-                          mig_id: str | None, tokens) -> None:
+                          mig_id: str | None, tokens, *,
+                          handoff: bool = False) -> None:
         """Tell the waiting client its stream moved: where to re-issue,
         which staged ticket to adopt (None = plain re-prefill resume), and
         the authoritative emitted-so-far list (fire-and-forget stream
         frames may have dropped — the client tops up exactly-once from
         this). ``cont`` may be None (the admission-fence redirect fires
-        before any slot engine exists)."""
+        before any slot engine exists). ``handoff`` marks a steady-state
+        prefill→decode redirect: the client follows it for THIS request
+        only and keeps its plan pointed at this worker — the admission
+        point — instead of rewriting the plan like a drain redirect."""
         tid = str(meta.get("trace") or "")
         body = {
             "migrated": {
@@ -2128,6 +2467,7 @@ class DistributedWorker:
                 "addr": list(dest["addr"]),
                 "mig": mig_id,
                 "tokens_so_far": [int(t) for t in tokens],
+                "handoff": bool(handoff),
                 # the redirect carries the request's trace id (and, below,
                 # the source worker's spans): the client re-issues at the
                 # destination under the SAME id, so both halves stitch
@@ -2138,7 +2478,32 @@ class DistributedWorker:
             body["serving"] = cont.serving_snapshot()
             if tid:
                 body["trace"] = {"id": tid, "spans": cont.tracer.collect(tid)}
-        self._respond(meta["peer"], proto.GENERATE_RESP, meta["rid"], body)
+        # the redirect IS the stream at this point — on the handoff path
+        # the slot is already torn down and its pages staged at the
+        # destination, so a transiently failed send here would strand the
+        # client against its RPC timeout (not the recovery ladder, which
+        # only catches lost-worker shapes). Absorb transient relay
+        # hiccups with short retries — handoffs only: a drain's manifest
+        # may hold many slots with hung-up clients, and serializing
+        # blocking backoffs across it would stall the run loop for the
+        # healthy streams (the drain path keeps its fail-fast shape).
+        # The client matches by rid, so a duplicate delivery is dropped
+        # as stale.
+        attempts = 3 if handoff else 1
+        for attempt in range(attempts):
+            try:
+                self._respond(
+                    meta["peer"], proto.GENERATE_RESP, meta["rid"], body
+                )
+                break
+            except Exception as e:
+                if attempt == attempts - 1:
+                    raise
+                self.log.warning(
+                    "handoff redirect send failed (attempt %d/%d): %s",
+                    attempt + 1, attempts, e,
+                )
+                time.sleep(0.25 * (attempt + 1))
         if meta.get("stream"):
             try:
                 # close the relay so a streaming client's drain loop

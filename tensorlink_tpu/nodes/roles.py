@@ -278,8 +278,9 @@ class WorkerServer(RoleServer):
             proto.PARAMS_REQ, proto.OPTIMIZER, proto.TRAIN_MODE,
             proto.CHECKPOINT, proto.PROOF_REQ,
             # live slot migration: DRAIN from a validator, MIGRATE
-            # (probe / page transfer) worker-to-worker
-            proto.DRAIN, proto.MIGRATE,
+            # (probe / page transfer) worker-to-worker; HANDOFF pushes
+            # the decode-pool membership a prefill worker ships to
+            proto.DRAIN, proto.MIGRATE, proto.HANDOFF,
         ):
             self.register(tag, self._relay_to_ml)
 
@@ -948,12 +949,57 @@ class ValidatorServer(RoleServer):
             }
             await self.dht_store_global(f"job:{job_id}", _json_safe(self.jobs[job_id]))
 
+        if ok:
+            # disaggregated prefill/decode: the validator ML's plan named
+            # which recruited workers serve the prefill pool and which
+            # decode workers they should hand completed prefills to —
+            # push the membership now (fire-and-forget; a worker that
+            # never hears it simply serves mixed, never a failed job)
+            for wid, pool in (job.get("handoff_push") or {}).items():
+                if wid not in accepted:
+                    continue
+                try:
+                    await (await self._worker_conn(wid)).send_control(
+                        proto.HANDOFF, {"job_id": job_id, "pool": pool}
+                    )
+                # tlint: disable=TL005(best-effort pool push — an unreached prefill worker degrades to mixed serving)
+                except Exception as e:
+                    # truly fire-and-forget: a re-dial here can also raise
+                    # asyncio.TimeoutError / HandshakeError, and NONE of
+                    # them may abort cmd_create_job — the job is already
+                    # recruited and the JOB_ACCEPT below must still send
+                    self.log.warning(
+                        "job %s: handoff-pool push to %s failed: %s",
+                        job_id[:8], wid[:8], e,
+                    )
         req = self._job_requests.pop(p.get("req_id", ""), None)
         if req is not None:
             conn, body = req
             await self.respond(conn, proto.JOB_ACCEPT if ok else proto.JOB_DECLINE,
                                body, result)
         return result
+
+    async def cmd_set_handoff_pool(self, p) -> dict:
+        """Operator surface for disaggregated serving (docs/SERVING.md
+        "Disaggregated prefill/decode"): push a decode-pool membership to
+        ``worker`` (a prefill-pool worker). ``pool`` defaults to every
+        connected worker advertising ``serving_role == "decode"`` — the
+        refresh an operator runs after decode workers join or leave, the
+        same information recruit-time pushes carry automatically."""
+        wid = self._resolve_worker(str(p.get("worker", "")))
+        if wid is None:
+            return {"ok": False, "error": "unknown or ambiguous worker"}
+        pool = p.get("pool")
+        if pool is None:
+            stats = await self._own_worker_stats()
+            pool = [
+                {"id": s["id"], "addr": list(s["addr"])}
+                for s in stats
+                if str(s.get("serving_role") or "mixed") == "decode"
+                and s.get("addr") and s["id"] != wid
+            ]
+        await self._conn(wid).send_control(proto.HANDOFF, {"pool": pool})
+        return {"ok": True, "pool": [str(x.get("id", ""))[:16] for x in pool]}
 
     async def cmd_decline_job(self, p) -> bool:
         """Planning failed (no capacity / unknown model)."""

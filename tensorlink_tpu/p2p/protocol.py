@@ -94,6 +94,11 @@ MIGRATE = "mig"
 MIGRATE_RESP = "mig.resp"
 DRAIN = "drain"
 DRAIN_RESP = "drain.resp"
+# disaggregated prefill/decode pools (docs/SERVING.md "Disaggregated
+# prefill/decode"): validator → prefill-pool worker, fire-and-forget —
+# the decode-pool membership [{id, addr}, ...] the worker hands its
+# completed prefills to through the MIGRATE export/stage/adopt path
+HANDOFF = "handoff"
 PARAMS_REQ = "params.req"
 PARAMETERS = "params"
 OPTIMIZER = "opt"

@@ -32,6 +32,18 @@ from tensorlink_tpu.api.schemas import (
 def test_generation_request_parse_defaults():
     r = GenerationRequest.parse({"hf_name": "m", "message": "hi"})
     assert r.hf_name == "m" and r.max_new_tokens == 256 and not r.stream
+    # disaggregated serving: opted IN to the prefill→decode handoff by
+    # default; {"handoff": false} is the per-request opt-out and rides
+    # the chat-completions mapping too
+    assert r.handoff is True
+    assert GenerationRequest.parse(
+        {"hf_name": "m", "handoff": False}
+    ).handoff is False
+    chat = ChatCompletionRequest.parse({
+        "model": "m", "handoff": False,
+        "messages": [{"role": "user", "content": "hi"}],
+    })
+    assert chat.to_generation_request().handoff is False
 
 
 @pytest.mark.parametrize(

@@ -50,8 +50,11 @@ def tiny_cfg(**kw):
     return ModelConfig(**base)
 
 
-def _cluster(tmp_path, n_workers=3, worker_faults=None, user_faults=None):
-    """validator + n workers (+ optional per-worker fault plans) + user."""
+def _cluster(tmp_path, n_workers=3, worker_faults=None, user_faults=None,
+             worker_ml=None):
+    """validator + n workers (+ optional per-worker fault plans and
+    MLConfigs — ``worker_ml={i: MLConfig(...)}`` sets e.g. the
+    disaggregated-pool ``worker_role``) + user."""
     from tensorlink_tpu.nodes.runners import UserNode, ValidatorNode, WorkerNode
 
     common = dict(
@@ -68,9 +71,13 @@ def _cluster(tmp_path, n_workers=3, worker_faults=None, user_faults=None):
     workers = []
     for i in range(n_workers):
         fl = (worker_faults or {}).get(i, {})
+        kw = dict(common)
+        ml = (worker_ml or {}).get(i)
+        if ml is not None:
+            kw["ml"] = ml
         workers.append(WorkerNode(WorkerConfig(
             seed_validators=seeds, duplicate=str(i) if i else "",
-            faults=fl, **common,
+            faults=fl, **kw,
         )).start())
     user = UserNode(UserConfig(
         seed_validators=seeds, faults=user_faults or {}, **common
@@ -345,7 +352,7 @@ def test_worker_crash_mid_continuous_batch_recovers_all_sessions(tmp_path):
         # the faulted worker really died and was replaced
         assert model.plan.stages[0].worker_id != first_wid
         for i in (0, 1):
-            baseline = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            baseline = _cont_greedy(cfg, 11, prompts[i], n_toks)
             assert results[i] == baseline, (i, results[i], baseline)
             assert streams[i] == baseline, (i, streams[i], baseline)
         model.shutdown()
@@ -509,7 +516,7 @@ def test_drain_migrates_live_slots_zero_dropped_streams(tmp_path):
         assert summary["migrated"] >= 1, summary
         assert summary["migrated"] + summary["fell_back"] == 4, summary
         for i in range(4):
-            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            base = _cont_greedy(cfg, 11, prompts[i], n_toks)
             assert results[i] == base, (i, results[i], base)
             assert streams[i] == base, (i, streams[i], base)
         # the plan now points at the destination, and its snapshot (rode
@@ -653,7 +660,7 @@ def test_migrate_frames_duplicated_staging_is_idempotent(tmp_path):
         assert errors == [None, None], errors
         assert summary.get("ok") and summary["migrated"] >= 1, summary
         for i in range(2):
-            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            base = _cont_greedy(cfg, 11, prompts[i], n_toks)
             assert results[i] == base, (i, results[i], base)
             assert streams[i] == base, (i, streams[i], base)
         model.shutdown()
@@ -710,7 +717,7 @@ def test_kill_destination_mid_migration_falls_back_re_prefill(tmp_path):
         assert summary["migrated"] == 0, summary
         assert summary["fell_back"] >= 1, summary
         for i in range(2):
-            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            base = _cont_greedy(cfg, 11, prompts[i], n_toks)
             assert results[i] == base, (i, results[i], base)
             assert streams[i] == base, (i, streams[i], base)
         # the clients descended to validator repair — onto the spare, not
@@ -769,7 +776,7 @@ def test_kill_source_mid_migration_streams_recover(tmp_path):
         assert errors == [None, None], errors
         assert model.plan.stages[0].worker_id != first_wid
         for i in range(2):
-            base = _engine_greedy(cfg, 11, prompts[i], n_toks)
+            base = _cont_greedy(cfg, 11, prompts[i], n_toks)
             assert results[i] == base, (i, results[i], base)
             assert streams[i] == base, (i, streams[i], base)
         model.shutdown()
@@ -827,3 +834,188 @@ def test_stop_cancel_bounds_compiled_chunk_overrun(tmp_path):
         model.shutdown()
     finally:
         _stop_all([user, worker, validator])
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode pools (docs/SERVING.md): role-aware
+# placement, steady-state prefill→decode handoff, chaos at the boundary
+# ---------------------------------------------------------------------------
+def _cont_greedy(cfg, seed, prompt, n):
+    """Single-pool CONTINUOUS baseline with the worker's default engine
+    knobs (built from MLConfig so default flips keep parity automatic).
+    The disaggregation contract is bit-identity against the single-pool
+    SLOT engine — not the dense fp engine: with the int8 KV default the
+    fp-vs-quantized comparison is bounded, not bitwise, so an unlucky
+    prompt can diverge at an argmax tie against ``_engine_greedy`` while
+    the pool comparison stays exact."""
+    import jax
+
+    from tensorlink_tpu.engine.continuous import ContinuousEngine
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models.transformer import init_params
+
+    ml = MLConfig()
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    engine = GenerationEngine(cfg, params, max_seq_len=64)
+    ce = ContinuousEngine(
+        engine, max_slots=ml.cont_max_slots, page_size=ml.cont_page_size,
+        chunk_steps=ml.cont_chunk_steps, prefill_chunk=ml.prefill_chunk,
+        kv_quant=ml.kv_quant, spec_decode=ml.spec_decode,
+        spec_draft=ml.spec_draft,
+    )
+    req = ce.submit(list(prompt), max_new_tokens=n, seed=0)
+    ce.run_until_idle()
+    out = list(req.tokens)
+    ce.close()
+    return out
+
+
+def _spy_snapshots(model):
+    """Record every serving snapshot the client sees (they ride each
+    continuous GENERATE_RESP and every migration/handoff redirect) so the
+    test can audit BOTH pools' telemetry — the snapshots carry
+    worker_role, the handoff counters, and the page-conservation terms."""
+    snaps: list[dict] = []
+    orig = model._note_serving
+
+    def spy(resp):
+        s = resp.get("serving")
+        if isinstance(s, dict):
+            snaps.append(dict(s))
+        return orig(resp)
+
+    model._note_serving = spy
+    return snaps
+
+
+def _assert_snapshot_conservation(snaps):
+    """The remotely-auditable page-conservation equation, per snapshot:
+    free + cache-resident + slot-owned + in-transit == total usable —
+    including snapshots taken MID-handoff (pages_in_transit > 0)."""
+    assert snaps, "no serving snapshots observed"
+    for s in snaps:
+        assert (
+            s["kv_pages_free"] + s["prefix_resident_pages"]
+            + s["kv_pages_slots"] + s["pages_in_transit"]
+            == s["kv_pages_total"]
+        ), s
+
+
+@pytest.mark.slow  # full multi-process cluster — CI chaos job runs this
+# file unfiltered; excluded from the tier-1 'not slow' pass for wall-time
+def test_disagg_prefill_decode_pools_handoff_bit_identical(tmp_path):
+    """THE disaggregation e2e pin: workers advertise prefill/decode
+    roles, the validator places the job on the prefill worker and pushes
+    it the decode pool at recruit time, every continuous request
+    prefills there and is handed to the decode worker at its
+    prefill→decode boundary — streams bit-identical to the single-pool
+    run, the plan still naming the PREFILL worker afterwards (the
+    admission point; a handoff redirect moves one request, not the
+    job), and both pools' snapshots carrying the role + handoff
+    telemetry with page conservation holding in every one."""
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=2,
+        worker_ml={0: MLConfig(worker_role="prefill"),
+                   1: MLConfig(worker_role="decode")},
+    )
+    try:
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        # role-aware placement: the decode worker is reserved as a
+        # handoff destination — the stage lands on the prefill worker
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        snaps = _spy_snapshots(model)
+        prompts = [[7, 3, 200, 5, 9, 2, 8, 4], [9, 1, 2, 300, 7, 7]]
+        n_toks = 24
+        threads, streams, results, errors = _start_streams(
+            model, prompts, n_toks
+        )
+        for t in threads:
+            t.join(120)
+        assert errors == [None, None], errors
+        for i in range(2):
+            base = _cont_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == base, (i, results[i], base)
+            assert streams[i] == base, (i, streams[i], base)
+        # the plan STILL points at the prefill worker: handoff redirects
+        # move requests, never the admission point (unlike a drain)
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        pre = [s for s in snaps if s.get("worker_role") == "prefill"]
+        dec = [s for s in snaps if s.get("worker_role") == "decode"]
+        # the handoff really happened: source counted completions, the
+        # decode pool adopted, and the streams FINISHED there
+        assert any(s["handoffs_completed"] >= 1 for s in pre), snaps
+        assert any(s["migrations_adopted"] >= 1 for s in dec), snaps
+        _assert_snapshot_conservation(snaps)
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage
+def test_kill_prefill_worker_mid_handoff_streams_recover(tmp_path):
+    """Chaos at the prefill→decode boundary: the PREFILL worker dies on
+    its second page-ship (migrate.wire crash) — after one stream already
+    handed off cleanly. The handed-off stream keeps decoding on the
+    decode pool untouched; the stranded stream's client falls down the
+    ladder (dead connection → validator repair-recruit) and re-prefills
+    on a replacement. Both streams finish bit-identical — never a
+    dropped stream — and page conservation (including any in-transit
+    staged tickets) holds in every snapshot either survivor reported."""
+    validator, workers, user = _cluster(
+        tmp_path, n_workers=3,
+        worker_ml={0: MLConfig(worker_role="prefill"),
+                   1: MLConfig(worker_role="decode")},
+        worker_faults={0: {"seed": 5, "rules": [
+            {"site": "migrate.wire", "op": "crash", "nth": 2},
+        ]}},
+    )
+    try:
+        # stage lands on the (large) prefill worker; the spare starts too
+        # small to be planned, then grows so repair can recruit it
+        caps = [8e9, 4e9, 1_000_000.0]
+        for w, c in zip(workers, caps):
+            w.send_request("set_capacity", {"hbm_bytes": c, "n_devices": 1})
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        cfg = tiny_cfg()
+        model = DistributedModel(
+            cfg, node=user, seed=11, seq_len=64, batch=1,
+            request_timeout=30.0,
+        )
+        assert model.plan.stages[0].worker_id == workers[0].node_id
+        workers[2].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        snaps = _spy_snapshots(model)
+        prompts = [[7, 3, 200, 5, 9, 2, 8, 4], [9, 1, 2, 300, 7, 7]]
+        n_toks = 24
+        threads, streams, results, errors = _start_streams(
+            model, prompts, n_toks
+        )
+        for t in threads:
+            t.join(180)
+        assert errors == [None, None], errors
+        # the kill really happened: the monitor/repair replaced the dead
+        # prefill worker in the plan
+        assert model.plan.stages[0].worker_id != workers[0].node_id
+        for i in range(2):
+            base = _cont_greedy(cfg, 11, prompts[i], n_toks)
+            assert results[i] == base, (i, results[i], base)
+            assert streams[i] == base, (i, streams[i], base)
+        # the decode pool served at least one adopted stream, and every
+        # snapshot a survivor shipped satisfies the conservation equation
+        dec = [s for s in snaps if s.get("worker_role") == "decode"]
+        assert any(s["migrations_adopted"] >= 1 for s in dec), snaps
+        _assert_snapshot_conservation(snaps)
+        model.shutdown()
+    finally:
+        _stop_all([user, *workers, validator])

@@ -1699,3 +1699,391 @@ def test_shared_pool_cross_tenant_preemption_and_conservation(tiny_engine):
     a.close()
     b.close()
     assert pool.alloc.n_free == 10  # everything returned at teardown
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode pools: handoff at the prefill boundary
+# (docs/SERVING.md "Disaggregated prefill/decode")
+# ---------------------------------------------------------------------------
+def _prefill_cont(eng, **kw):
+    kw.setdefault("handoff_after_prefill", True)
+    kw.setdefault("worker_role", "prefill")
+    return _cont(eng, **kw)
+
+
+def _drive_to_handoff(src, max_chunks=50):
+    """Step the prefill-pool engine until at least one slot freezes at
+    its prefill→decode boundary; returns the popped manifest."""
+    for _ in range(max_chunks):
+        src.step_chunk()
+        manifest = src.handoff_manifest()
+        if manifest:
+            return manifest
+    raise AssertionError("no handoff produced")
+
+
+def _handoff(src, dst, slot, mig_id, *, probe=True):
+    """The full prefill→decode handoff: probe, export, stage, commit,
+    resume-with-adopt at the decode engine. The moved request has emitted
+    ZERO tokens (its prefill stopped one short of the prompt), so the
+    resume is a plain first submission whose first draw happens at the
+    destination."""
+    chain, limit = src.migration_chain(slot)
+    n_skip = dst.resident_prefix_pages(chain, limit) if probe else 0
+    blob = src.export_slot(slot, n_skip=n_skip)
+    assert dst.stage_migration(mig_id, blob)
+    moved = src.commit_handoff(slot)
+    assert moved is not None and moved.tokens == []
+    r2 = dst.submit(
+        moved.prompt,
+        max_new_tokens=moved.budget,
+        sampling=moved.sampling,
+        eos_ids=sorted(moved.eos),
+        seed=moved.seed,
+        start_step=moved.start_step,
+        priority=moved.priority,
+        adopt=mig_id,
+    )
+    return r2, moved
+
+
+def test_handoff_flags_and_snapshot_zero_compile(tiny_engine):
+    """Fast, zero-compile shape checks: the handoff mark needs BOTH the
+    armed engine and the per-request opt-in, 1-token prompts are exempt,
+    and the role + handoff counter families ride the serving snapshot
+    (→ /stats → /metrics → /healthz serving_modes)."""
+    eng = tiny_engine
+    ce = _prefill_cont(eng)
+    r = ce.submit([1, 2, 3], max_new_tokens=4, seed=1, handoff=True)
+    assert r.handoff is True
+    r1 = ce.submit([9], max_new_tokens=4, seed=1, handoff=True)
+    assert r1.handoff is False  # nothing to prefill ahead of the draw
+    r2 = ce.submit([1, 2, 3], max_new_tokens=4, seed=1)
+    assert r2.handoff is False  # per-request opt-in
+    snap = ce.serving_snapshot()
+    assert snap["worker_role"] == "prefill"
+    for key in ("handoffs_started", "handoffs_completed",
+                "handoffs_fell_back", "kv_pages_slots"):
+        assert key in snap, key
+    ce.close()
+    plain = _cont(eng)
+    r3 = plain.submit([1, 2, 3], max_new_tokens=4, seed=1, handoff=True)
+    assert r3.handoff is False  # unarmed engine never freezes prefills
+    assert plain.serving_snapshot()["worker_role"] == "mixed"
+    plain.close()
+
+
+def test_mlconfig_worker_role_and_spec_decode_defaults():
+    """Config pins: worker_role defaults to the single-pool "mixed", and
+    MLConfig.spec_decode's one-release opt-in window has elapsed — the
+    default is ON (requests still opt in per-call), with False kept as
+    the explicit opt-out."""
+    from tensorlink_tpu.core.config import MLConfig
+
+    assert MLConfig().worker_role == "mixed"
+    assert MLConfig().spec_decode is True
+    assert MLConfig(spec_decode=False).spec_decode is False
+
+
+def test_placement_reserves_decode_pool_only_for_pageable_models():
+    """Role-aware placement (ml/validator.py::_plan_and_create) reserves
+    decode-role workers as handoff destinations ONLY for jobs that can
+    actually hand off — a model the paged engine refuses (sliding-window
+    attention) serves through the windowed batcher, which has no
+    prefill→decode boundary, so excluding decode workers from its
+    placement would just shrink the plannable pool. Driven through the
+    real planner with a faked stats/create_job bridge."""
+    import logging
+    from types import SimpleNamespace
+
+    from tensorlink_tpu.core.config import MLConfig
+    from tensorlink_tpu.ml.validator import DistributedValidator
+
+    stats = [
+        {"id": "w-pre", "addr": ["127.0.0.1", 1], "serving_role": "prefill",
+         "free_bytes": 8e9, "n_devices": 1},
+        {"id": "w-dec", "addr": ["127.0.0.1", 2], "serving_role": "decode",
+         "free_bytes": 8e9, "n_devices": 1},
+    ]
+    created = {}
+
+    def _request(kind, payload=None, timeout=None):
+        if kind == "stats_workers":
+            return stats
+        assert kind == "create_job"
+        created["job"] = payload["job"]
+        return {"accepted": list(payload["job"]["stage_bytes"]),
+                "job_id": "j"}
+
+    fake = SimpleNamespace(
+        bridge=SimpleNamespace(request=_request),
+        node=SimpleNamespace(config=SimpleNamespace(ml=MLConfig())),
+        log=logging.getLogger("test-placement"),
+    )
+    tiny = dict(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+
+    # pageable model: decode worker reserved, stages land on the prefill
+    # worker, and the recruit push names its decode pool
+    DistributedValidator._plan_and_create(
+        fake, {"name": "m"}, ModelConfig(**tiny), seq_len=64,
+    )
+    job = created["job"]
+    assert set(job["stage_bytes"]) == {"w-pre"}, job["stage_bytes"]
+    assert job["handoff_push"] == {
+        "w-pre": [{"id": "w-dec", "addr": ["127.0.0.1", 2]}]
+    }
+
+    # unpageable model (sliding-window attention → windowed batcher, no
+    # handoff boundary): the decode worker stays plannable and no pool
+    # is pushed
+    DistributedValidator._plan_and_create(
+        fake, {"name": "m"}, ModelConfig(**tiny, sliding_window=16),
+        seq_len=64,
+    )
+    job = created["job"]
+    assert "handoff_push" not in job
+    # both workers offered to the planner (whichever it picked, the
+    # decode worker was not excluded)
+    assert set(job["stage_bytes"]) <= {"w-pre", "w-dec"}
+
+    # continuous batching off: same single-pool placement even for a
+    # pageable model
+    fake.node.config.ml = MLConfig(continuous_batching=False)
+    DistributedValidator._plan_and_create(
+        fake, {"name": "m"}, ModelConfig(**tiny), seq_len=64,
+    )
+    assert "handoff_push" not in created["job"]
+
+    # capacity fallback: when the prefill/mixed subset alone can't fit
+    # the model, placement retries single-pool over the FULL pool (the
+    # reserved decode worker's capacity is what makes the job fit) —
+    # disaggregation must never decline a job the cluster can serve
+    fake.node.config.ml = MLConfig()
+    stats[0]["free_bytes"] = 1e4  # prefill worker alone: far too small
+    DistributedValidator._plan_and_create(
+        fake, {"name": "m"}, ModelConfig(**tiny), seq_len=64,
+    )
+    job = created["job"]
+    assert "handoff_push" not in job
+    assert "w-dec" in job["stage_bytes"], job["stage_bytes"]
+    stats[0]["free_bytes"] = 8e9
+
+
+@pytest.mark.slow  # drives full decode traces on two engines — tier-1
+# wall-time; CI's engine job runs this file unfiltered on every push
+def test_handoff_stream_bit_identical_across_pools(tiny_engine):
+    """THE disaggregation acceptance pin: a stream admitted on a
+    prefill-pool engine and handed to a decode-pool engine at its
+    prefill→decode boundary is bit-identical to the single-pool run —
+    greedy and sampled, prefix-cache hit and miss on the destination,
+    and composed with preemption at the destination. The source emits
+    ZERO tokens: the destination recomputes position T-1 as its first
+    decode row (bitwise, by ragged framing invariance) and makes the
+    fold_in(seed, 0) first draw itself."""
+    eng = tiny_engine
+    mixes = [
+        (SYS + [40, 41], 12, SamplingParams.make(), 7),
+        ([5, 6, 7, 8, 9, 10, 11, 12, 13], 10,
+         SamplingParams.make(temperature=0.9, top_k=5), 9),
+    ]
+    solos = [
+        _solo(eng, p, n, sampling=sp, seed=s) for p, n, sp, s in mixes
+    ]
+    # -- miss: a cold decode engine adopts every shipped page ------------
+    src, dst = _prefill_cont(eng), _cont(eng)
+    reqs = [
+        src.submit(p, max_new_tokens=n, sampling=sp, seed=s, handoff=True)
+        for p, n, sp, s in mixes
+    ]
+    shipped = []
+    for _ in range(50):
+        src.step_chunk()
+        for i, (slot, req) in enumerate(src.handoff_manifest()):
+            dst.step_chunk()  # the decode pool keeps serving mid-handoff
+            mid = f"h{len(shipped)}"
+            shipped.append((req, *_handoff(src, dst, slot, mid)))
+        if len(shipped) == len(mixes):
+            break
+    assert len(shipped) == len(mixes)
+    dst.run_until_idle()
+    by_req = {id(req): r2 for req, r2, _ in shipped}
+    for req, solo in zip(reqs, solos):
+        r2 = by_req[id(req)]
+        assert r2.finished and req.tokens == []
+        assert r2.tokens == solo, (r2.tokens, solo)
+    assert src.stats["handoffs_started"] == 2
+    assert src.stats["handoffs_completed"] == 2
+    assert src.serving_snapshot()["pages_in_transit"] == 0
+    assert dst.stats["migrations_adopted"] == 2
+    src.close()
+    dst.close()
+
+    # -- hit: destination-resident prefix short-circuits the ship --------
+    src, dst = _prefill_cont(eng), _cont(eng)
+    warm = dst.submit(SYS + [40, 41], max_new_tokens=2, seed=1)
+    dst.run_until_idle()
+    assert warm.finished  # prompt pages promoted into dst's trie
+    r = src.submit(SYS + [40, 41], max_new_tokens=12, seed=7, handoff=True)
+    (slot, _req), = _drive_to_handoff(src)
+    chain, limit = src.migration_chain(slot)
+    assert chain == SYS + [40, 41] and limit == len(chain) - 1
+    n_skip = dst.resident_prefix_pages(chain, limit)
+    assert n_skip >= 2  # the warmed prompt really is resident
+    full_pages = src.export_slot(slot, n_skip=0)["k"].shape[0]
+    r2, _moved = _handoff(src, dst, slot, "hh")
+    dst.run_until_idle()
+    assert r2.tokens == solos[0]
+    # fewer pages crossed the "wire" than the slot holds
+    assert full_pages > full_pages - n_skip >= 0
+    src.close()
+    dst.close()
+
+    # -- composed with preemption at the destination ---------------------
+    src = _prefill_cont(eng)
+    dst = _cont(eng, max_slots=1)  # one slot: the flood must preempt
+    r = src.submit(
+        SYS + [40, 41], max_new_tokens=12, seed=7,
+        priority="best_effort", handoff=True,
+    )
+    (slot, _req), = _drive_to_handoff(src)
+    r2, _moved = _handoff(src, dst, slot, "hp")
+    dst.step_chunk()  # adopted + decoding on the destination
+    assert len(r2.tokens) > 0 and not r2.finished
+    hi = dst.submit([1, 1], max_new_tokens=3, seed=1, priority="interactive")
+    dst.run_until_idle()
+    assert hi.finished and r2.finished
+    assert dst.stats["preemptions"] >= 1  # the adopted slot was preempted
+    assert r2.tokens == solos[0]
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # see above — CI engine job coverage
+def test_handoff_fallback_ladder_re_prefill_and_local_resume(tiny_engine):
+    """The handoff fallback ladder, both rungs below page-ship: a failed
+    transfer redirects the stream for a fresh prefill at the destination
+    (commit_handoff(fell_back=True) — the never-staged ticket quietly
+    takes the re-prefill rung at admission), and with no destination at
+    all the slot resumes locally (abort_handoff): the final prompt token
+    simply prefills here and the stream decodes as on a mixed worker.
+    Both rungs bit-identical; started == completed + fell_back."""
+    eng = tiny_engine
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    base = _solo(eng, prompt, 12, seed=5)
+
+    # rung: re-prefill redirect at the destination
+    src, dst = _prefill_cont(eng), _cont(eng)
+    r = src.submit(prompt, max_new_tokens=12, seed=5, handoff=True)
+    (slot, _req), = _drive_to_handoff(src)
+    src.export_slot(slot)  # gathered, then the wire "fails"
+    moved = src.commit_handoff(slot, fell_back=True)
+    src.check_page_conservation()
+    r2 = dst.submit(
+        moved.prompt, max_new_tokens=moved.budget, seed=5,
+        start_step=0, adopt="never-staged",
+    )
+    dst.run_until_idle()
+    assert r2.finished and r2.tokens == base
+    assert dst.stats["migrations_adopted"] == 0  # re-prefill rung
+    assert src.stats["handoffs_started"] == 1
+    assert src.stats["handoffs_fell_back"] == 1
+    assert src.stats["handoffs_completed"] == 0
+    src.close()
+    dst.close()
+
+    # rung: resume locally (no usable destination)
+    ce = _prefill_cont(eng)
+    r = ce.submit(prompt, max_new_tokens=12, seed=5, handoff=True)
+    (slot, req), = _drive_to_handoff(ce)
+    ce.abort_handoff(slot)
+    assert req.handoff is False  # degraded to mixed serving for good
+    ce.run_until_idle()
+    assert r.finished and r.tokens == base
+    s = ce.stats
+    assert s["handoffs_started"] == s["handoffs_completed"] \
+        + s["handoffs_fell_back"] == 1
+    ce.close()
+
+
+@pytest.mark.slow  # see above — CI engine job coverage
+def test_handoff_freeze_does_not_fence_admissions(tiny_engine):
+    """The drain fence generalized into steady-state handoff: while a
+    slot sits frozen at its prefill→decode boundary, the engine keeps
+    ADMITTING and SERVING — submit succeeds (no SchedulerOverloaded, no
+    draining rejection), the new admission prefills and decodes to
+    completion, and page conservation (frozen pages in transit) holds on
+    both engines mid-flight throughout."""
+    eng = tiny_engine
+    prompt = SYS + [40, 41]
+    src, dst = _prefill_cont(eng), _cont(eng)
+    r = src.submit(prompt, max_new_tokens=12, seed=7, handoff=True)
+    (slot, _req), = _drive_to_handoff(src)
+    # slot is frozen, nothing resolved yet: the fence must NOT exist
+    assert src.drain_state == "serving"
+    assert src.admission_check() is None
+    nb = src.submit([8, 8, 2], max_new_tokens=6, seed=42)
+    assert nb.error is None
+    while not nb.finished:
+        src.step_chunk()
+        src.check_page_conservation()  # frozen slot counted in transit
+    assert nb.tokens == _solo(eng, [8, 8, 2], 6, seed=42)
+    # now resolve the parked handoff; the stream is unharmed
+    chain, limit = src.migration_chain(slot)
+    blob = src.export_slot(slot, n_skip=0)
+    assert dst.stage_migration("hf", blob)
+    dst.check_page_conservation()  # staged ticket counted in transit
+    moved = src.commit_handoff(slot)
+    r2 = dst.submit(
+        moved.prompt, max_new_tokens=moved.budget, seed=7, adopt="hf",
+    )
+    dst.run_until_idle()
+    assert r2.tokens == _solo(eng, prompt, 12, seed=7)
+    src.close()
+    dst.close()
+
+
+@pytest.mark.slow  # exercises the handoff device paths' compile keys —
+# referenced by CI's compile-count-guard step
+def test_handoff_adds_zero_new_programs(tiny_engine):
+    """Compile-set guard over the steady-state data path: a full
+    prefill→decode handoff (freeze at the boundary / export / stage /
+    adopt / first draw at the destination) adds ZERO compiled programs
+    beyond the gather/scatter page movers migration already registered —
+    the serving step set (ragged_step, copy_page) stays exactly where
+    it was on BOTH sides."""
+    eng = tiny_engine
+    src, dst = _prefill_cont(eng), _cont(eng)
+    # warm every program class once (incl. the page movers)
+    w = src.submit([4, 2, 4, 2, 1, 1, 3], max_new_tokens=4, seed=2,
+                   handoff=True)
+    (slot, _req), = _drive_to_handoff(src)
+    r2, _ = _handoff(src, dst, slot, "w")
+    dst.run_until_idle()
+    assert r2.finished and w.tokens == []
+    base = src.jit_cache_sizes()
+    # steady state: more handoffs, mixed with live decode on both sides
+    nb = dst.submit([9, 9, 1], max_new_tokens=16, seed=41)
+    reqs = [
+        src.submit([4, 2, 4, 2, 1, 1, 3 + i], max_new_tokens=6, seed=2 + i,
+                   handoff=True)
+        for i in range(2)
+    ]
+    done = []
+    for _ in range(50):
+        src.step_chunk()
+        dst.step_chunk()
+        for slot, _req in src.handoff_manifest():
+            done.append(_handoff(src, dst, slot, f"z{len(done)}")[0])
+        if len(done) == len(reqs):
+            break
+    dst.run_until_idle()
+    assert len(done) == len(reqs) and all(r.finished for r in done)
+    assert nb.finished
+    after = src.jit_cache_sizes()
+    assert after == base, (base, after)
+    src.close()
+    dst.close()

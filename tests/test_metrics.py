@@ -210,6 +210,9 @@ LEGACY_ENGINE_KEYS = (
     "prefill_tokens", "prefill_tokens_skipped",
     "migrations_started", "migrations_completed", "migrations_failed",
     "migrations_fell_back", "migrations_adopted",
+    # disaggregated prefill/decode: prefill-pool slots frozen at the
+    # prefill boundary and shipped to decode-pool workers at admission
+    "handoffs_started", "handoffs_completed", "handoffs_fell_back",
     # speculative decoding (spec_decode): the draft/verify families
     "spec_drafted", "spec_accepted", "spec_verify_passes", "spec_killed",
     # multi-tenant co-hosting: slots torn down for another tenant's
