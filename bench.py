@@ -2028,6 +2028,291 @@ def run_bench() -> None:
         except Exception as e:
             disagg_extra = {"disagg_error": str(e)[:500]}
 
+    # ---- fleet serving (ROADMAP item 2, the "millions of users" step) -----
+    # 1 vs N engine replicas behind the cache-/SLO-aware FleetRouter under
+    # a many-session flood: Zipf-distributed shared prefixes, mixed
+    # priority classes, and mid-flood churn on the N-replica leg — a
+    # replica JOINS, one rolling-DEPLOYS (drain → rebuild → rejoin, via
+    # the autopilot), and one is KILLED (dispatches fail over). The bars:
+    # zero dropped streams, every stream bit-identical to its solo run
+    # (greedy — placement is not part of the determinism contract),
+    # interactive TTFT p95 no worse than the queue-bound single replica.
+    # Aggregate-throughput linearity is a TPU-rounds claim (N replicas on
+    # ONE CPU share the core; see fleet_note).
+    fleet_extra = {}
+    if on_tpu and _budget_left() < 300:
+        fleet_extra = {"fleet_skipped": "low time budget"}
+    else:
+        try:
+            import threading as _fth
+
+            from tensorlink_tpu.engine.continuous import (
+                ContinuousEngine as _FCE,
+            )
+            from tensorlink_tpu.fleet.autopilot import (
+                EngineFleetActions,
+                FleetAutopilot,
+            )
+            from tensorlink_tpu.fleet.router import FleetRouter
+            from tensorlink_tpu.ml.batching import ContinuousBatcher as _FCB
+
+            fl_page, fl_chunk, fl_pc, fl_slots = 16, 4, 32, 6
+            fl_max = 128
+            eng_fl = GenerationEngine(
+                cfg, params, seq_buckets=(32, fl_max), batch_buckets=(1,),
+                max_seq_len=fl_max,
+            )
+            flr = np.random.default_rng(47)
+            N_REPL, N_SESS = 3, 30
+            n_groups, prefix_len, tail_len, fl_budget = 6, 32, 8, 6
+            shared = [
+                flr.integers(1, cfg.vocab_size, prefix_len).tolist()
+                for _ in range(n_groups)
+            ]
+            zipf = 1.0 / np.arange(1, n_groups + 1, dtype=np.float64)
+            zipf /= zipf.sum()
+            sess_group = flr.choice(n_groups, size=N_SESS, p=zipf)
+            sess_cls = [
+                ("interactive", "batch", "best_effort")[i % 3]
+                for i in range(N_SESS)
+            ]
+            sess_prompts = [
+                shared[g] + flr.integers(
+                    1, cfg.vocab_size, tail_len
+                ).tolist()
+                for g in sess_group
+            ]
+
+            def fl_engine():
+                return _FCE(
+                    eng_fl, max_slots=fl_slots, page_size=fl_page,
+                    chunk_steps=fl_chunk, prefill_chunk=fl_pc,
+                )
+
+            def fl_batcher():
+                return _FCB(engine=fl_engine(), eos_ids=[])
+
+            def fl_solo(p):
+                ce = fl_engine()
+                r = ce.submit(p, max_new_tokens=fl_budget, seed=0)
+                ce.run_until_idle()
+                out = list(r.tokens)
+                ce.close()
+                return out
+
+            fl_solos = [fl_solo(p) for p in sess_prompts]
+
+            def run_fleet(n_repl, *, churn=False):
+                batchers = {f"f{i}": fl_batcher() for i in range(n_repl)}
+                router = FleetRouter(refresh_s=0.05)
+                for rid, b in batchers.items():
+                    router.register(rid, b)
+                actions = EngineFleetActions(
+                    lambda rid: router.batcher(rid)._cont,
+                    exec_on=lambda rid, fn: router.batcher(
+                        rid
+                    ).run_on_driver(fn),
+                    rebuild=lambda rid: fl_batcher(),
+                )
+                ap = FleetAutopilot(
+                    router, actions, action_cooldown_s=0.0,
+                    max_moves_per_tick=4,
+                )
+                # warm every program either path runs (incl. the page
+                # movers, via a live rebalance on a throwaway stream)
+                router.dispatch(sess_prompts[0], max_new_tokens=2)
+                if n_repl > 1:
+                    wdone: dict = {}
+
+                    def _warm():
+                        wdone["t"] = batchers["f0"].generate(
+                            sess_prompts[1], max_new_tokens=24,
+                        )
+
+                    wt = _fth.Thread(target=_warm)
+                    wt.start()
+                    wdl = time.monotonic() + 60
+                    while time.monotonic() < wdl:
+                        if actions.movable_streams("f0") >= 1:
+                            actions.rebalance("f0", "f1", 1)
+                            break
+                        time.sleep(0.005)
+                    wt.join(timeout=120)
+                results: dict = {}
+                t_sub: dict = {}
+                t_first: dict = {}
+
+                def one(i):
+                    def cb(_t, _i=i):
+                        if _i not in t_first:
+                            t_first[_i] = time.perf_counter()
+                        return False
+
+                    t_sub[i] = time.perf_counter()
+                    try:
+                        results[i] = router.dispatch(
+                            sess_prompts[i], max_new_tokens=fl_budget,
+                            priority=sess_cls[i], stream_cb=cb,
+                        )
+                    except Exception as e:  # dropped — counted below
+                        results[i] = e
+
+                t0 = time.perf_counter()
+                threads = [
+                    _fth.Thread(target=one, args=(i,))
+                    for i in range(N_SESS)
+                ]
+                for k, t in enumerate(threads):
+                    t.start()
+                    if churn and k == N_SESS // 3:
+                        jb = fl_batcher()  # a replica JOINS mid-flood
+                        batchers["join"] = jb
+                        router.register("join", jb)
+                    if churn and k == N_SESS // 2:
+                        # rolling deploy mid-flood: drain f1 onto a
+                        # sibling, rebuild it, rejoin — zero drops
+                        ap.request_deploy(["f1"])
+                    if churn and k == (2 * N_SESS) // 3:
+                        # KILL f2 mid-flood: its next chunk raises, the
+                        # router fails affected dispatches over
+                        def _arm(e):
+                            def boom(**kw):
+                                raise RuntimeError("fleet chaos kill")
+
+                            e.step_chunk = boom
+
+                        try:
+                            batchers["f2"].run_on_driver(_arm)
+                        # tlint: disable=TL005(the kill may race the driver's own death — either way the replica is dead, which is the point)
+                        except Exception:
+                            pass
+                    time.sleep(0.002)
+                deadline = time.monotonic() + 300
+                while any(t.is_alive() for t in threads) \
+                        and time.monotonic() < deadline:
+                    if churn:
+                        ap.tick()
+                    time.sleep(0.01)
+                for t in threads:
+                    t.join(timeout=60)
+                wall = time.perf_counter() - t0
+                deploys = sum(
+                    1 for h in ap.status()["history"]
+                    if h["kind"] == "deploy_done"
+                )
+                cache_routed = router.snapshot()["route_cache_tokens"]
+                ap.stop()
+                # a rolling deploy REPLACED a batcher inside the router
+                # (rebuild hook) — close the router's current set too,
+                # or the rebuilt replica's driver thread + engine would
+                # outlive the leg and skew every later measurement
+                to_close = {id(b): b for b in batchers.values()}
+                for rid in router.replica_ids():
+                    b = router.batcher(rid)
+                    if b is not None:
+                        to_close[id(b)] = b
+                for b in to_close.values():
+                    b.close(timeout=60.0)
+                ok = {
+                    i: v for i, v in results.items()
+                    if isinstance(v, list)
+                }
+                dropped = N_SESS - len(ok)
+                exact = all(
+                    ok.get(i) == fl_solos[i] for i in range(N_SESS)
+                )
+                ttfts = sorted(
+                    (t_first[i] - t_sub[i]) * 1e3
+                    for i in range(N_SESS)
+                    if sess_cls[i] == "interactive" and i in t_first
+                )
+                p95 = (
+                    ttfts[min(int(round(0.95 * (len(ttfts) - 1))),
+                              len(ttfts) - 1)]
+                    if ttfts else 0.0
+                )
+                toks = sum(len(v) for v in ok.values())
+                return {
+                    "wall": wall, "tokps": toks / max(wall, 1e-9),
+                    "dropped": dropped, "exact": exact,
+                    "ttft_p95": p95, "deploys": deploys,
+                    "cache_routed": cache_routed,
+                }
+
+            one_leg = run_fleet(1)
+            n_leg = run_fleet(N_REPL)  # clean: the TTFT/scaling numbers
+            churn_leg = run_fleet(N_REPL, churn=True)  # join/deploy/kill
+            del eng_fl
+            assert one_leg["dropped"] == 0 and n_leg["dropped"] == 0 \
+                and churn_leg["dropped"] == 0, (
+                    one_leg["dropped"], n_leg["dropped"],
+                    churn_leg["dropped"],
+                )
+            assert one_leg["exact"] and n_leg["exact"] \
+                and churn_leg["exact"]
+            assert churn_leg["deploys"] >= 1, "mid-flood deploy never landed"
+            scaling = n_leg["tokps"] / max(one_leg["tokps"], 1e-9)
+            if on_tpu:
+                # the linearity teeth, armed where replicas actually get
+                # their own compute (N chips): aggregate tok/s must scale
+                # to >= 60% of linear, and interactive TTFT p95 must stay
+                # flat (each replica's queue is 1/N as deep)
+                assert scaling >= 0.6 * N_REPL, (scaling, N_REPL)
+                assert n_leg["ttft_p95"] <= 2.0 * one_leg["ttft_p95"], (
+                    n_leg["ttft_p95"], one_leg["ttft_p95"],
+                )
+            fleet_extra = {
+                "fleet_replicas": N_REPL,
+                "fleet_sessions": N_SESS,
+                "fleet_prefix_groups": n_groups,
+                "fleet_tokps_1": round(one_leg["tokps"], 2),
+                "fleet_tokps_n": round(n_leg["tokps"], 2),
+                "fleet_scaling": round(scaling, 3),
+                "fleet_dropped": int(
+                    n_leg["dropped"] + churn_leg["dropped"]
+                ),
+                "fleet_streams_exact": bool(
+                    one_leg["exact"] and n_leg["exact"]
+                    and churn_leg["exact"]
+                ),
+                "fleet_ttft_p95_1_ms": round(one_leg["ttft_p95"], 2),
+                "fleet_ttft_p95_n_ms": round(n_leg["ttft_p95"], 2),
+                "fleet_churn_ttft_p95_ms": round(
+                    churn_leg["ttft_p95"], 2
+                ),
+                "fleet_deploys": int(churn_leg["deploys"]),
+                "fleet_route_cache_tokens": int(
+                    n_leg["cache_routed"] + churn_leg["cache_routed"]
+                ),
+                **(
+                    {}
+                    if on_tpu
+                    else {
+                        "fleet_note": (
+                            "CPU fallback: zero-dropped + bit-identical "
+                            "streams, the mid-flood join/deploy/kill "
+                            "churn, the landed rolling deploy, and the "
+                            "cache-affine routed-token count are "
+                            "deterministic and faithful here. The "
+                            "PERFORMANCE pair is not: N replicas share "
+                            "ONE CPU core, so aggregate tok/s cannot "
+                            "scale (fleet_scaling <= ~1) and the extra "
+                            "driver threads make every chunk slower — "
+                            "TTFT p95 reads WORSE with N here purely "
+                            "from core contention. Both in-leg bars "
+                            "(scaling >= 0.6*N, TTFT p95 flat within "
+                            "2x) arm on TPU rounds, where each replica "
+                            "owns its chip and the single replica's "
+                            "queue depth is the real bottleneck. "
+                            "tpu_escalation streak logic applies as "
+                            "for every CPU round."
+                        )
+                    }
+                ),
+            }
+        except Exception as e:
+            fleet_extra = {"fleet_error": str(e)[:500]}
+
     # ---- flash vs einsum prefill (the Pallas kernel's actual TPU win) -----
     flash_extra = {}
     if (on_tpu and _budget_left() > 1200) or force_all:
@@ -2394,6 +2679,7 @@ def run_bench() -> None:
         **cot_extra,
         **mig_extra,
         **disagg_extra,
+        **fleet_extra,
         **flash_extra,
         **spec_extra,
         **int8_extra,

@@ -352,6 +352,15 @@ class TensorlinkAPI:
                 # route operators already poll for node health
                 st["models"] = await self._ml(self.executor.hosted_snapshot)
                 return await self._send_json(writer, 200, st)
+            if path == "/fleet":
+                # per-model fleet state: router replica table + routed
+                # counts, autopilot status/history (docs/SERVING.md
+                # "Fleet serving"). Off the event loop — collection
+                # takes the executor's host lock.
+                return await self._send_json(
+                    writer, 200,
+                    {"fleet": await self._ml(self.executor.fleet_snapshot)},
+                )
             if path == "/node-info":
                 return await self._send_json(writer, 200, self._node_info())
             if path == "/network-history":
@@ -388,6 +397,24 @@ class TensorlinkAPI:
             return await self._generate_common(gen, writer, n=chat.n)
         if path == "/request-model":
             return await self._request_model(data, writer)
+        if path == "/fleet/deploy":
+            # operator trigger for a zero-dropped-token rolling deploy:
+            # {"model": name, "replicas": ["r0", ...]} (replicas
+            # optional = all). The autopilot drains each replica onto a
+            # sibling, rebuilds it, rejoins it — streams migrate through
+            # the export/stage/adopt path, bit-identical.
+            model = str(data.get("model", ""))
+            if not model:
+                raise HTTPError(400, "deploy needs {'model': name}")
+            reps = data.get("replicas")
+            if reps is not None and not isinstance(reps, list):
+                raise HTTPError(400, "'replicas' must be a list")
+            out = await self._ml(
+                lambda: self.executor.fleet_deploy(model, reps)
+            )
+            return await self._send_json(
+                writer, 200 if out.get("ok") else 404, out
+            )
         raise HTTPError(404, f"no route {path}")
 
     def _metrics_text(self) -> str:
@@ -470,7 +497,12 @@ class TensorlinkAPI:
                  "priority": priority or "interactive", "retry_after": 1},
                 headers={"Retry-After": "1"},
             )
-        check = getattr(getattr(job, "batcher", None), "admission_check", None)
+        # a fleet-hosted model's gate is the ROUTER's: admit when any
+        # non-draining replica would (docs/SERVING.md "Fleet serving")
+        gate = getattr(job, "router", None)
+        if gate is None:
+            gate = getattr(job, "batcher", None)
+        check = getattr(gate, "admission_check", None)
         rej = check(priority, n) if callable(check) else None
         if rej:
             retry = max(1, int(round(float(rej.get("retry_after", 1.0)))))

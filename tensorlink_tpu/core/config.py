@@ -195,6 +195,22 @@ class MLConfig:
     # the request's class exceeds this many seconds (0 disables the
     # wait check; the queue cap still applies)
     sched_max_wait_s: float = 60.0
+    # -- fleet serving (tensorlink_tpu/fleet, docs/SERVING.md "Fleet
+    # serving"): N replicas of each hosted model behind a cache- and
+    # SLO-aware router. host_model plans this many independent replica
+    # jobs (fewer when capacity runs out — the fleet degrades, the host
+    # never fails for lack of spares) and routes each request by
+    # prefix-cache affinity + per-class load; 1 keeps today's
+    # single-replica path byte-identical.
+    fleet_replicas: int = 1
+    # start the FleetAutopilot control loop per hosted fleet: rebalance
+    # hot replicas, scale the decode pool, run rolling deploys — every
+    # action through the drain/migration path (zero dropped tokens)
+    fleet_autopilot: bool = False
+    fleet_autopilot_interval_s: float = 2.0
+    # router telemetry refresh cadence (seconds between replica-view
+    # pulls; route() also refreshes lazily at this cadence)
+    fleet_refresh_s: float = 0.5
     # streamed requests: >0 runs the decode as fully-compiled on-device
     # chunks of this many steps (one host round trip per chunk instead of
     # per token — engine/generate.py::generate_chunked); 0 keeps the

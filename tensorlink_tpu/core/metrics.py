@@ -286,14 +286,20 @@ def snapshot_gauges(
     *,
     prefix: str = "tlink_snapshot_",
     help: str = "remote serving-snapshot value",
+    skip: tuple = ("prefix_digest",),
 ) -> None:
     """Flatten a remote engine's serving snapshot (the dict riding
     GENERATE_RESP) into gauges on ``registry`` — how /metrics exposes an
     engine whose registry lives in another process. Non-numeric leaves
-    are skipped; nested dicts flatten with ``_``-joined keys."""
+    are skipped; nested dicts flatten with ``_``-joined keys. ``skip``
+    names subtrees that must never become gauges — the prefix-cache
+    digest's keys are CONTENT HASHES, so flattening it would mint an
+    unbounded, never-collected metric family (one per chain ever seen)."""
 
     def walk(d: Mapping[str, object], path: str):
         for k, v in d.items():
+            if k in skip:
+                continue
             key = f"{path}{k}"
             if isinstance(v, Mapping):
                 walk(v, f"{key}_")

@@ -90,6 +90,7 @@ from .sampling import SamplingParams, sample
 from .spec import SpecController
 from .scheduler import (
     DEFAULT_PRIORITY,
+    PRIORITY_CLASSES,
     RequestScheduler,
     SchedulerOverloaded,
     normalize_priority,
@@ -406,6 +407,14 @@ class ContinuousEngine:
         # long admission never stalls running slots at all
         self.prefill_chunk = min(int(prefill_chunk), self.max_seq_len)
         self.prefix = PrefixCache(self.page_size) if prefix_cache else None
+        # fleet-router cache-affinity digest (docs/SERVING.md "Fleet
+        # serving"): a compact {chain_hash: covered_tokens} view of the
+        # resident trie, rebuilt by the DRIVER at chunk boundaries only
+        # when trie membership changed (PrefixCache.version) — readers
+        # (serving_snapshot, /stats, the GENERATE_RESP snapshot) see an
+        # atomically-swapped plain dict, never the live trie
+        self._prefix_digest: dict = {}
+        self._digest_version = -1
         # optional TOTAL prefill tokens per unified step shared across
         # mid-prefill slots (0 = each slot gets a full chunk row): bounds
         # the per-step prefill compute on TPU where the kernel's cost is
@@ -695,6 +704,27 @@ class ContinuousEngine:
             return self.sched.admission_check(
                 priority if priority else self.default_priority, n
             )
+
+    def router_snapshot(self) -> dict:
+        """Placement-scoring view for the fleet router (docs/SERVING.md
+        "Fleet serving"): headroom, per-class queue depth, service EWMA,
+        role/drain state, and the driver-refreshed prefix digest. Cheap
+        by contract — attribute reads plus one pass over the host queue
+        under the engine lock; NO device work, NO trie walk."""
+        with self._lock:
+            depth = {c: self.sched.depth(c) for c in PRIORITY_CLASSES}
+            ewma = self.sched._service_ewma
+        return {
+            "draining": self.drain_state != "serving",
+            "worker_role": self.worker_role,
+            "max_slots": self.max_slots,
+            "slots_free": sum(1 for r in self._slots if r is None),
+            "kv_pages_free": self.alloc.n_free,
+            "kv_pages_total": self.cache.n_pages - 1,
+            "service_ewma_s": float(ewma),
+            "queue_depth": depth,
+            "prefix_digest": self._prefix_digest,
+        }
 
     def has_work(self) -> bool:
         with self._lock:
@@ -1730,6 +1760,11 @@ class ContinuousEngine:
                 len(r.pages) for s, r in enumerate(self._slots)
                 if r is not None and s not in self._frozen
             ),
+            # fleet-router headroom (docs/SERVING.md "Fleet serving"):
+            # slots no request holds — with kv_pages_free and the
+            # per-class sched_classes depths below, the placement inputs
+            # a router/LB needs without a second probe
+            "slots_free": sum(1 for r in self._slots if r is None),
         })
         if self.pool is not None:
             # co-hosting: the shared pool's occupancy plus THIS tenant's
@@ -1749,6 +1784,9 @@ class ContinuousEngine:
                 "prefix_evictions": ps["evictions"],
                 "prefix_inserts": ps["inserts"],
                 "prefix_resident_pages": self.prefix.n_resident,
+                # compact resident-chain digest for fleet cache-affinity
+                # scoring: the driver-refreshed swap copy, never the trie
+                "prefix_digest": self._prefix_digest,
             })
         return out
 
@@ -2154,7 +2192,17 @@ class ContinuousEngine:
             preemptions=int(self._stat["preemptions"].value),
             chunk_ms=round(chunk_dur * 1e3, 3),
         )
+        self._refresh_prefix_digest()
         return self.has_work()
+
+    def _refresh_prefix_digest(self) -> None:
+        """Rebuild the fleet digest when trie membership changed since
+        the last chunk. Driver-thread only (the trie is driver state);
+        the swap is atomic so snapshot readers never see a torn dict."""
+        if self.prefix is None or self.prefix.version == self._digest_version:
+            return
+        self._digest_version = self.prefix.version
+        self._prefix_digest = self.prefix.digest()
 
     def run_until_idle(self) -> None:
         """Drive the loop to quiescence (tests, bench, local serving)."""

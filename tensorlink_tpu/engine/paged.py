@@ -48,6 +48,7 @@ the reclaimed HBM spent on scenario diversity instead of headroom.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 from dataclasses import dataclass, replace
 from functools import partial
@@ -475,11 +476,44 @@ class SharedPagePool:
 # ---------------------------------------------------------------------------
 
 
+def chain_hash(parent_hash: str, block) -> str:
+    """16-hex-char rolling hash of a trie chain: the previous prefix's
+    hash folded with one page-size token block. Structural trie equality
+    stays the CACHE key (no collision can ever map a wrong page); these
+    hashes exist only so a chain can be NAMED compactly off-box — the
+    fleet router scores a replica's cache affinity against a digest of
+    them (docs/SERVING.md "Fleet serving") without shipping the trie. A
+    collision merely misguides placement by one request, never
+    correctness."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent_hash.encode("ascii"))
+    h.update(",".join(str(int(t)) for t in block).encode("ascii"))
+    return h.hexdigest()
+
+
+def prompt_chain_hashes(tokens, page_size: int, max_pages: int) -> list[str]:
+    """The rolling chain hashes of ``tokens``' leading full page blocks
+    (up to ``max_pages``) — what the router matches against a replica's
+    :meth:`PrefixCache.digest`. Index i covers ``(i + 1) * page_size``
+    tokens. Host-only, no trie required."""
+    out: list[str] = []
+    prev = ""
+    p = int(page_size)
+    limit = min((len(tokens) // p), int(max_pages))
+    for i in range(limit):
+        prev = chain_hash(prev, tokens[i * p : (i + 1) * p])
+        out.append(prev)
+    return out
+
+
 class _TrieNode:
     """One cached FULL page: the KV of ``block`` (page_size token ids) at
     the absolute positions its chain depth implies."""
 
-    __slots__ = ("block", "page", "parent", "children", "refs", "tick")
+    __slots__ = (
+        "block", "page", "parent", "children", "refs", "tick",
+        "depth", "key_hash",
+    )
 
     def __init__(self, block: tuple, page: int, parent: "_TrieNode | None"):
         self.block = block
@@ -488,6 +522,14 @@ class _TrieNode:
         self.children: dict[tuple, _TrieNode] = {}
         self.refs = 0  # slots currently mapping this page
         self.tick = 0  # LRU recency (monotonic engine counter)
+        # chain identity for the fleet digest: pages-from-root count and
+        # the rolling chain hash (root carries depth 0 / hash "")
+        if parent is None:
+            self.depth = 0
+            self.key_hash = ""
+        else:
+            self.depth = parent.depth + 1
+            self.key_hash = chain_hash(parent.key_hash, block)
 
 
 class PrefixCache:
@@ -513,6 +555,9 @@ class PrefixCache:
         self.root = _TrieNode((), 0, None)
         self._by_page: dict[int, _TrieNode] = {}
         self._tick = 0
+        # bumped on every membership change (insert/evict) so the engine
+        # can skip rebuilding the fleet digest when nothing moved
+        self.version = 0
         self.stats = {
             "lookups": 0,
             "hits": 0,
@@ -530,6 +575,27 @@ class PrefixCache:
     @property
     def n_resident(self) -> int:
         return len(self._by_page)
+
+    def digest(self, max_chains: int = 32) -> dict:
+        """Compact export of the resident chains for off-box cache-
+        affinity scoring (docs/SERVING.md "Fleet serving"): the
+        ``max_chains`` most-recently-used nodes as ``{chain_hash:
+        covered_tokens}``. Interior prefixes of a hot chain are touched
+        by every hit, so recency order naturally exports them too — a
+        prompt matching only part of a resident chain still scores.
+        Bounded bytes by construction (~26 B/entry serialized), JSON-
+        safe, and NEVER authoritative: admission re-walks the real trie,
+        so a stale or colliding digest can only misplace a request, not
+        corrupt a stream."""
+        nodes = sorted(
+            self._by_page.values(), key=lambda n: n.tick, reverse=True,
+        )[: max(int(max_chains), 0)]
+        return {
+            "page_size": self.page_size,
+            "chains": {
+                n.key_hash: n.depth * self.page_size for n in nodes
+            },
+        }
 
     def _touch(self, node: _TrieNode) -> None:
         self._tick += 1
@@ -615,6 +681,7 @@ class PrefixCache:
         self._by_page[int(page)] = node
         self._touch(node)
         self.stats["inserts"] += 1
+        self.version += 1
         return node, True
 
     def n_evictable(self) -> int:
@@ -650,6 +717,7 @@ class PrefixCache:
             del victim.parent.children[victim.block]
             del self._by_page[victim.page]
             self.stats["evictions"] += 1
+            self.version += 1
             freed.append(victim.page)
             parent = victim.parent
             if (

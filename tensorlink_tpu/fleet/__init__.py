@@ -1,0 +1,24 @@
+"""Fleet serving: policy over N engine replicas of one model.
+
+:mod:`tensorlink_tpu.fleet.router` — per-request placement scored on
+prefix-cache affinity (the compact trie digest each replica exports),
+per-class queue depth / service EWMA, and replica role/drain state.
+
+:mod:`tensorlink_tpu.fleet.autopilot` — the drain-driven control loop:
+rebalance live streams off hot replicas, scale the decode pool, and run
+zero-dropped-token rolling deploys, every action through the existing
+migration export/stage/adopt path (docs/SERVING.md "Fleet serving").
+"""
+
+from tensorlink_tpu.fleet.autopilot import (
+    EngineFleetActions,
+    FleetAutopilot,
+)
+from tensorlink_tpu.fleet.router import FleetRouter, NoReplicaAvailable
+
+__all__ = [
+    "EngineFleetActions",
+    "FleetAutopilot",
+    "FleetRouter",
+    "NoReplicaAvailable",
+]

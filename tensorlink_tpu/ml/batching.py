@@ -76,6 +76,16 @@ class _Pending:
     submit_t: float = 0.0
 
 
+def _headroom_from(snap: dict) -> dict:
+    """The /healthz per-replica headroom fields, projected from a
+    router_snapshot — ONE definition of the field set so the two batcher
+    kinds can never diverge (docs/SERVING.md "Fleet serving")."""
+    return {
+        k: snap[k]
+        for k in ("slots_free", "kv_pages_free", "queue_depth", "draining")
+    }
+
+
 class GenBatcher:
     """One per hosted model; owns the model's generation serialization."""
 
@@ -195,6 +205,29 @@ class GenBatcher:
                 "retry_after": max(1.0, min(depth * 0.5, 600.0)),
             }
         return None
+
+    def router_snapshot(self) -> dict:
+        """Fleet-router scoring view (docs/SERVING.md "Fleet serving").
+        The windowed batcher has no paged engine behind it: no digest,
+        no per-class queues — the flat dispatch depth stands in for
+        every class so a fleet mixing batcher kinds still balances."""
+        depth = self._q.qsize()
+        return {
+            "draining": False,
+            "worker_role": "mixed",
+            "max_slots": self.max_batch,
+            "slots_free": max(self.max_batch - depth, 0),
+            "kv_pages_free": 0,
+            "kv_pages_total": 0,
+            "service_ewma_s": 0.0,
+            "queue_depth": {c: depth for c in PRIORITY_RANK},
+            "prefix_digest": {},
+        }
+
+    def headroom(self) -> dict:
+        """The /healthz per-replica headroom fields — cheap, no ML
+        round trip (the same contract as health_snapshot)."""
+        return _headroom_from(self.router_snapshot())
 
     def close(self, timeout: float = 600.0) -> None:
         """Serve everything already queued, then stop. Blocks until the
@@ -752,6 +785,10 @@ class ContinuousBatcher:
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
+        # driver-confined control work (fleet autopilot migration verbs):
+        # (fn, box) pairs the dispatcher executes against the local
+        # engine between chunks — deque append/popleft are atomic
+        self._ctl: deque = deque()
         self._cont = None
         self._sess = None
         if engine is not None:
@@ -850,6 +887,115 @@ class ContinuousBatcher:
         # "decode" on /healthz is exactly the misclassification the
         # role plumbing exists to prevent.
         return dict(self._modes)
+
+    def router_snapshot(self) -> dict:
+        """Fleet-router scoring view (docs/SERVING.md "Fleet serving"):
+        headroom + per-class depth + service EWMA + the prefix digest.
+        Local mode reads the live engine; remote mode reads the last
+        serving snapshot riding GENERATE_RESP (the existing stats
+        sweep refreshes it) floored by the validator-side in-flight
+        counts; pipelined reads the session queue. Cheap by contract —
+        no device work, no worker round trip."""
+        if self._cont is not None:
+            return self._cont.router_snapshot()
+        if self.mode == "local":
+            # the driver closed the engine (error path): the replica is
+            # dead — say so, so the router marks the view unhealthy
+            # instead of scoring a ghost
+            raise RuntimeError("local engine is closed")
+        with self._idle:
+            inflight = dict(self._inflight_cls)
+        if self.mode == "remote":
+            snap = getattr(self.model, "cont_serving_stats", None)
+            snap = snap if isinstance(snap, dict) else {}
+            classes = snap.get("sched_classes") or {}
+            depth = {
+                c: max(
+                    int((classes.get(c) or {}).get("queue_depth", 0)),
+                    inflight.get(c, 0),
+                )
+                for c in PRIORITY_RANK
+            }
+            live = sum(inflight.values())
+            return {
+                "draining": snap.get("drain_state") == "draining",
+                "worker_role": self._modes.get("worker_role", "mixed"),
+                "max_slots": int(snap.get("max_slots") or self.max_slots),
+                "slots_free": int(
+                    snap.get("slots_free", max(self.max_slots - live, 0))
+                ),
+                "kv_pages_free": int(snap.get("kv_pages_free") or 0),
+                "kv_pages_total": int(snap.get("kv_pages_total") or 0),
+                "service_ewma_s": float(
+                    snap.get("sched_service_ewma_s") or 0.0
+                ),
+                "queue_depth": depth,
+                "prefix_digest": snap.get("prefix_digest") or {},
+            }
+        sess = self._sess
+        queued = len(sess.queue) if sess is not None else 0
+        free = len(sess.free_slots) if sess is not None else 0
+        return {
+            "draining": False,
+            "worker_role": "mixed",
+            "max_slots": self.max_slots,
+            "slots_free": free,
+            "kv_pages_free": 0,
+            "kv_pages_total": 0,
+            "service_ewma_s": 0.0,
+            "queue_depth": {c: queued for c in PRIORITY_RANK},
+            "prefix_digest": {},
+        }
+
+    def headroom(self) -> dict:
+        """The /healthz per-replica headroom fields — cheap, no ML
+        round trip (the same contract as health_snapshot)."""
+        return _headroom_from(self.router_snapshot())
+
+    def run_on_driver(self, fn, timeout: float = 60.0):
+        """Execute ``fn(engine)`` on the dispatcher thread between
+        chunks (local mode only) — the fleet autopilot's entry to the
+        engine's driver-thread-only migration verbs (freeze/export/
+        stage/adopt) without violating single-driver discipline."""
+        if self._cont is None or self._thread is None:
+            raise RuntimeError("run_on_driver requires a local engine")
+        box: dict = {"done": threading.Event()}
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("model is being unhosted")
+            self._ctl.append((fn, box))
+            self._wake.set()
+        if not box["done"].wait(timeout):
+            # CANCEL, don't just abandon: an unpicked fn must never run
+            # later with no waiter (a stale freeze/export would wedge
+            # slots nobody will commit or abort). A fn the driver is
+            # ALREADY executing when the timeout fires still completes —
+            # the flag only stops un-started work.
+            box["abandoned"] = True
+            raise TimeoutError("driver did not pick up control work")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _run_ctl(self, cont) -> None:
+        """Drain the control queue on the driver (or fail it when the
+        engine is gone)."""
+        while self._ctl:
+            try:
+                fn, box = self._ctl.popleft()
+            except IndexError:
+                return
+            if box.get("abandoned"):
+                box["done"].set()  # waiter already raised; nothing runs
+                continue
+            try:
+                if cont is None:
+                    raise RuntimeError("engine is closed")
+                box["result"] = fn(cont)
+            except BaseException as e:  # noqa: BLE001 — hand to the waiter
+                box["error"] = e
+            finally:
+                box["done"].set()
 
     # -- client side -----------------------------------------------------
     def generate(
@@ -1067,6 +1213,7 @@ class ContinuousBatcher:
         while True:
             try:
                 if cont is not None:
+                    self._run_ctl(cont)  # autopilot verbs, driver-confined
                     for req in self._drain_queue(1 << 30):
                         self._submit_local(req)
                     busy = cont.has_work()
@@ -1094,6 +1241,7 @@ class ContinuousBatcher:
                         self._closed = True
                     cont.close(e)
                     self._cont = cont = None
+                    self._run_ctl(None)  # fail waiters, don't hang them
                     while True:
                         try:
                             req = self._q.get_nowait()
@@ -1107,6 +1255,7 @@ class ContinuousBatcher:
             with self._submit_lock:
                 closed = self._closed
             if closed and not busy and self._q.empty():
+                self._run_ctl(None)  # nothing races a finished driver
                 return
             if not busy:
                 self._wake.wait(timeout=0.05)
@@ -1216,6 +1365,7 @@ class ContinuousBatcher:
             if req is not None:
                 req.error = RuntimeError("model is being unhosted")
                 req.done.set()
+        self._run_ctl(None)  # control waiters must not hang on a close
 
 
 __all__ = ["GenBatcher", "ContinuousBatcher", "PipelinedSlotSession"]

@@ -38,6 +38,14 @@ class HostedJob:
     # dynamic request batching (ml/batching.py): concurrent API requests
     # coalesce into one batched decode instead of queueing on the lock
     batcher: Any = None
+    # -- fleet serving (tensorlink_tpu/fleet, docs/SERVING.md "Fleet
+    # serving"): N replicas of this model behind a cache-/SLO-aware
+    # router. ``replicas`` holds [{rid, model, batcher, job_id}];
+    # ``model``/``batcher`` above stay replica 0 (the single-replica
+    # path is byte-identical when the fleet knobs are off).
+    replicas: list = field(default_factory=list)
+    router: Any = None  # FleetRouter when > 1 replica hosted
+    autopilot: Any = None  # FleetAutopilot when enabled
 
 
 class DistributedValidator:
@@ -366,11 +374,93 @@ class DistributedValidator:
             self.log.exception("hosting %s failed", name)
         return job
 
+    def _build_replica(
+        self, job: HostedJob, model_spec: dict, cfg, *, batch, seed,
+    ) -> tuple:
+        """Plan, recruit, attach, and wrap ONE serving replica of
+        ``job``'s model: (model, batcher, job_id). Raises on failure
+        after releasing whatever recruiting reserved."""
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        result = self._plan_and_create(
+            model_spec, cfg, batch=batch, seq_len=job.seq_len, training=False,
+        )
+        if not result.get("accepted"):
+            raise RuntimeError(f"recruiting failed: {result.get('declined')}")
+        try:
+            model = DistributedModel.from_job(
+                self.node, result, seq_len=job.seq_len, seed=seed,
+            )
+        except Exception:
+            # release what recruiting reserved — workers that accepted would
+            # otherwise keep the reservation forever (same leak the recruit
+            # decline path guards against, roles.py cmd_create_job)
+            try:
+                self.bridge.request(
+                    "shutdown_job", {"job_id": result["job_id"]}, timeout=15.0
+                )
+            except Exception:
+                self.log.warning("rollback of job %s failed", result["job_id"][:8])
+            raise
+        from tensorlink_tpu.ml.batching import ContinuousBatcher, GenBatcher
+
+        ml_cfg = self.node.config.ml
+        merged = any(s.coworkers for s in model.plan.stages)
+        # models the paged slot engine refuses must get the WINDOWED
+        # batcher here — routing them continuous would degrade each
+        # request to a serialized solo generate on the worker's fallback.
+        # The predicate lives with the engine (paged_unsupported) so this
+        # routing can never drift from what the engine actually accepts:
+        # int8-KV models ("int8+kv") serve CONTINUOUS now — the paged
+        # cache stores int8 pages natively (kv_quant, docs/SERVING.md)
+        from tensorlink_tpu.engine.continuous import paged_unsupported
+
+        unpageable = paged_unsupported(cfg) is not None
+        # the ENTRY worker's advertised pool role (disaggregated serving):
+        # what /healthz serving_modes reports until live snapshots arrive
+        entry_role = "mixed"
+        if getattr(model, "plan", None) is not None:
+            entry_role = str(
+                (result.get("serving_roles") or {}).get(
+                    model.plan.stages[0].worker_id
+                ) or "mixed"
+            )
+        if ml_cfg.continuous_batching and not merged and not unpageable:
+            # continuous batching (docs/SERVING.md): no arrival window, no
+            # drain barrier — requests join the model's running slot batch
+            # at decode-chunk boundaries.
+            batcher = ContinuousBatcher(
+                model, job.tokenizer.eos_ids,
+                worker_role=entry_role,
+                max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
+                chunk_steps=ml_cfg.cont_chunk_steps,
+                kv_quant=ml_cfg.kv_quant,
+                spec_decode=bool(getattr(ml_cfg, "spec_decode", False)),
+                spec_draft=int(getattr(ml_cfg, "spec_draft", 8)),
+                spec_budget=int(getattr(ml_cfg, "spec_budget", 0)),
+                default_priority=ml_cfg.default_priority,
+                sched_queue_cap=ml_cfg.sched_queue_cap,
+                sched_aging_ticks=ml_cfg.sched_aging_ticks,
+                sched_preemption=ml_cfg.sched_preemption,
+                sched_policy=ml_cfg.sched_policy,
+                sched_max_wait_s=ml_cfg.sched_max_wait_s,
+            )
+        else:
+            batcher = GenBatcher(
+                model, job.tokenizer.eos_ids,
+                # a batch never exceeds what the engine's buckets compile for
+                max_batch=min(ml_cfg.max_serve_batch, ml_cfg.batch_buckets[-1]),
+            )
+        self.log.info(
+            "replica of %s ready (%d stages, job %s)",
+            job.name, len(result["plan"]["stages"]), result["job_id"][:8],
+        )
+        return model, batcher, result["job_id"]
+
     def _do_host(
         self, job: HostedJob, *, batch, seq_len, config, seed, quant=None
     ) -> None:
         from tensorlink_tpu.api.tokenizer import load_tokenizer
-        from tensorlink_tpu.ml.module import DistributedModel
 
         name = job.name
         model_spec: dict = {"name": name, "seed": seed}
@@ -389,79 +479,118 @@ class DistributedValidator:
         model_spec["config"] = cfg.to_json()
         job.cfg = cfg
         job.seq_len = min(seq_len or cfg.max_seq_len, cfg.max_seq_len)
-
-        result = self._plan_and_create(
-            model_spec, cfg, batch=batch, seq_len=job.seq_len, training=False,
-        )
-        if not result.get("accepted"):
-            raise RuntimeError(f"recruiting failed: {result.get('declined')}")
-        try:
-            job.model = DistributedModel.from_job(
-                self.node, result, seq_len=job.seq_len, seed=seed,
-            )
-        except Exception:
-            # release what recruiting reserved — workers that accepted would
-            # otherwise keep the reservation forever (same leak the recruit
-            # decline path guards against, roles.py cmd_create_job)
-            try:
-                self.bridge.request(
-                    "shutdown_job", {"job_id": result["job_id"]}, timeout=15.0
-                )
-            except Exception:
-                self.log.warning("rollback of job %s failed", result["job_id"][:8])
-            raise
         job.tokenizer = load_tokenizer(model_spec)
-        from tensorlink_tpu.ml.batching import ContinuousBatcher, GenBatcher
+
+        job.model, job.batcher, jid = self._build_replica(
+            job, model_spec, cfg, batch=batch, seed=seed,
+        )
+        job.replicas = [{
+            "rid": "r0", "model": job.model, "batcher": job.batcher,
+            "job_id": jid, "spec": dict(model_spec), "batch": batch,
+            "seed": seed,
+        }]
+        ml_cfg = self.node.config.ml
+        n_replicas = max(int(getattr(ml_cfg, "fleet_replicas", 1)), 1)
+        if n_replicas > 1:
+            self._grow_fleet(job, model_spec, cfg, n_replicas,
+                             batch=batch, seed=seed)
+        job.status = "ready"
+        self.log.info(
+            "hosting %s ready (%d replica(s))", name, len(job.replicas)
+        )
+
+    def _grow_fleet(
+        self, job: HostedJob, model_spec: dict, cfg, n_replicas: int,
+        *, batch, seed,
+    ) -> None:
+        """Host replicas 1..N-1 behind a FleetRouter (docs/SERVING.md
+        "Fleet serving"). A replica that fails to plan/recruit degrades
+        the fleet instead of failing the host — a model served by fewer
+        replicas beats a model not served at all."""
+        from tensorlink_tpu.fleet.router import FleetRouter
 
         ml_cfg = self.node.config.ml
-        merged = any(s.coworkers for s in job.model.plan.stages)
-        # models the paged slot engine refuses must get the WINDOWED
-        # batcher here — routing them continuous would degrade each
-        # request to a serialized solo generate on the worker's fallback.
-        # The predicate lives with the engine (paged_unsupported) so this
-        # routing can never drift from what the engine actually accepts:
-        # int8-KV models ("int8+kv") serve CONTINUOUS now — the paged
-        # cache stores int8 pages natively (kv_quant, docs/SERVING.md)
-        from tensorlink_tpu.engine.continuous import paged_unsupported
+        router = FleetRouter(
+            refresh_s=float(getattr(ml_cfg, "fleet_refresh_s", 0.5)),
+        )
+        router.register("r0", job.batcher)
+        for i in range(1, n_replicas):
+            try:
+                model, batcher, jid = self._build_replica(
+                    job, model_spec, cfg, batch=batch, seed=seed,
+                )
+            except Exception as e:
+                self.log.warning(
+                    "fleet replica %d of %s failed to host (%s: %s) — "
+                    "serving with %d replica(s)",
+                    i, job.name, type(e).__name__, e, len(job.replicas),
+                )
+                break
+            job.replicas.append({
+                "rid": f"r{i}", "model": model, "batcher": batcher,
+                "job_id": jid, "spec": dict(model_spec), "batch": batch,
+                "seed": seed,
+            })
+            router.register(f"r{i}", batcher)
+        if len(job.replicas) < 2:
+            return  # no fleet materialized: the single-replica path stands
+        job.router = router
+        self._push_replica_sets(job)
+        if bool(getattr(ml_cfg, "fleet_autopilot", False)):
+            self._start_autopilot(job)
 
-        unpageable = paged_unsupported(cfg) is not None
-        # the ENTRY worker's advertised pool role (disaggregated serving):
-        # what /healthz serving_modes reports until live snapshots arrive
-        entry_role = "mixed"
-        if getattr(job.model, "plan", None) is not None:
-            entry_role = str(
-                (result.get("serving_roles") or {}).get(
-                    job.model.plan.stages[0].worker_id
-                ) or "mixed"
-            )
-        if ml_cfg.continuous_batching and not merged and not unpageable:
-            # continuous batching (docs/SERVING.md): no arrival window, no
-            # drain barrier — requests join the model's running slot batch
-            # at decode-chunk boundaries.
-            job.batcher = ContinuousBatcher(
-                job.model, job.tokenizer.eos_ids,
-                worker_role=entry_role,
-                max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
-                chunk_steps=ml_cfg.cont_chunk_steps,
-                kv_quant=ml_cfg.kv_quant,
-                spec_decode=bool(getattr(ml_cfg, "spec_decode", False)),
-                spec_draft=int(getattr(ml_cfg, "spec_draft", 8)),
-                spec_budget=int(getattr(ml_cfg, "spec_budget", 0)),
-                default_priority=ml_cfg.default_priority,
-                sched_queue_cap=ml_cfg.sched_queue_cap,
-                sched_aging_ticks=ml_cfg.sched_aging_ticks,
-                sched_preemption=ml_cfg.sched_preemption,
-                sched_policy=ml_cfg.sched_policy,
-                sched_max_wait_s=ml_cfg.sched_max_wait_s,
-            )
-        else:
-            job.batcher = GenBatcher(
-                job.model, job.tokenizer.eos_ids,
-                # a batch never exceeds what the engine's buckets compile for
-                max_batch=min(ml_cfg.max_serve_batch, ml_cfg.batch_buckets[-1]),
-            )
-        job.status = "ready"
-        self.log.info("hosting %s ready (%d stages)", name, len(result["plan"]["stages"]))
+    def _start_autopilot(self, job: HostedJob) -> None:
+        """ONE construction site for a fleet's control loop — host-time
+        (fleet_autopilot=True) and the on-demand /fleet/deploy path must
+        build it identically or silently drift."""
+        from tensorlink_tpu.fleet.autopilot import FleetAutopilot
+
+        ml_cfg = self.node.config.ml
+        job.autopilot = FleetAutopilot(
+            job.router,
+            ValidatorFleetActions(self, job),
+            interval_s=float(
+                getattr(ml_cfg, "fleet_autopilot_interval_s", 2.0)
+            ),
+        ).start()
+
+    def _replica_entry_worker(self, rep: dict) -> str:
+        model = rep.get("model")
+        plan = getattr(model, "plan", None)
+        if plan is None or not plan.stages:
+            return ""
+        return str(plan.stages[0].worker_id)
+
+    def _push_replica_sets(self, job: HostedJob) -> None:
+        """Mirror of the PR 13 HANDOFF push at fleet granularity: tell
+        each replica's entry worker who its sibling replicas are
+        (REPLICA_SET frames), so a destination-less DRAIN — the
+        autopilot's rolling deploy — lands on a sibling that already
+        serves the same model. Best-effort: an unreached worker just
+        keeps the validator-chosen drain destination."""
+        entries = [
+            (rep, self._replica_entry_worker(rep)) for rep in job.replicas
+        ]
+        for rep, wid in entries:
+            if not wid:
+                continue
+            peers = [
+                {"id": w2, "job_id": r2["job_id"]}
+                for r2, w2 in entries
+                if r2 is not rep and w2
+            ]
+            if not peers:
+                continue
+            try:
+                self.bridge.request(
+                    "set_replica_set",
+                    {"worker": wid, "job_id": rep["job_id"], "peers": peers},
+                    timeout=10.0,
+                )
+            except Exception as e:
+                self.log.warning(
+                    "replica-set push to %s failed: %s", wid[:8], e
+                )
 
     def unhost_model(self, name: str) -> bool:
         """Drop a hosted model and release its workers (reference
@@ -470,6 +599,27 @@ class DistributedValidator:
             job = self.hosted.pop(name, None)
         if job is None:
             return False
+        if job.autopilot is not None:
+            job.autopilot.stop()  # no control actions during teardown
+        # fleet replicas beyond r0 (r0 IS job.model/job.batcher below)
+        for rep in job.replicas[1:]:
+            if job.router is not None:
+                job.router.deregister(rep["rid"])
+            try:
+                rep["batcher"].close()
+            except Exception:
+                self.log.exception(
+                    "replica %s of %s batcher close failed", rep["rid"],
+                    name,
+                )
+            try:
+                # shutdown ALWAYS runs — a wedged batcher close must not
+                # leave this replica's recruited workers reserved forever
+                rep["model"].shutdown()
+            except Exception:
+                self.log.exception(
+                    "replica %s of %s failed to unhost", rep["rid"], name
+                )
         if job.batcher is not None:
             job.batcher.close()  # drain the dispatcher first
         if job.model is not None:
@@ -484,9 +634,13 @@ class DistributedValidator:
         the cluster router (ROADMAP item 3) can probe at high frequency
         without touching the serving path."""
         with self._host_lock:
-            jobs = {name: j.batcher for name, j in self.hosted.items()}
+            jobs = {
+                name: (j.batcher, list(j.replicas))
+                for name, j in self.hosted.items()
+            }
         modes = {}
-        for name, batcher in jobs.items():
+        headroom: dict = {}
+        for name, (batcher, replicas) in jobs.items():
             get_modes = getattr(batcher, "serving_modes", None)
             if callable(get_modes):
                 modes[name] = get_modes()
@@ -496,6 +650,30 @@ class DistributedValidator:
                     "kv_quant": "none", "weight_quant": "none",
                     "spec_decode": False, "worker_role": "mixed",
                 }
+            # per-replica headroom (kv_pages_free, slots_free, per-class
+            # queue depth): enough for an EXTERNAL load balancer to
+            # route without scraping /metrics — same cheap contract
+            reps = replicas or (
+                [{"rid": "r0", "batcher": batcher}] if batcher is not None
+                else []
+            )
+            hr = {}
+            for rep in reps:
+                get_hr = getattr(rep.get("batcher"), "headroom", None)
+                if not callable(get_hr):
+                    continue
+                try:
+                    hr[rep["rid"]] = get_hr()
+                except Exception:
+                    # one dead replica must not 500 the whole node's
+                    # probe — report it unroutable, keep the siblings
+                    hr[rep["rid"]] = {
+                        "slots_free": 0, "kv_pages_free": 0,
+                        "queue_depth": {}, "draining": True,
+                        "dead": True,
+                    }
+            if hr:
+                headroom[name] = hr
         return {
             "status": "ok",
             "hosted_models": list(jobs),
@@ -504,6 +682,9 @@ class DistributedValidator:
             # this before placing traffic (cheap attribute reads, the
             # same no-ML-round-trip contract as the rest of the body)
             "serving_modes": modes,
+            # per-model, per-replica headroom (docs/SERVING.md "Fleet
+            # serving" — the external-LB routing fields)
+            "headroom": headroom,
             "draining": bool(self.draining),
         }
 
@@ -521,21 +702,38 @@ class DistributedValidator:
         with self._host_lock:
             jobs = list(self.hosted.values())
         for j in jobs:
-            labels = {"model": j.name}
-            batcher = j.batcher
-            reg = None
-            if batcher is not None:
-                get_reg = getattr(batcher, "metrics_registry", None)
-                reg = get_reg() if callable(get_reg) else None
-                if reg is None:
-                    reg = getattr(batcher, "metrics", None)
-            if reg is not None:
-                groups.append((labels, reg))
-            snap = getattr(j.model, "cont_serving_stats", None)
-            if isinstance(snap, dict) and snap:
-                sreg = MetricsRegistry()
-                snapshot_gauges(sreg, snap, prefix="tlink_engine_")
-                groups.append((labels, sreg))
+            # one label group per replica (single-replica models keep
+            # the unlabeled-model shape — byte-compatible with pre-fleet
+            # scrapes); the router/autopilot registries render under the
+            # model label alone
+            fleet = j.router is not None
+            replicas = j.replicas or [
+                {"rid": "r0", "model": j.model, "batcher": j.batcher}
+            ]
+            for rep in replicas:
+                labels = {"model": j.name}
+                if fleet:
+                    labels["replica"] = rep["rid"]
+                batcher = rep.get("batcher")
+                reg = None
+                if batcher is not None:
+                    get_reg = getattr(batcher, "metrics_registry", None)
+                    reg = get_reg() if callable(get_reg) else None
+                    if reg is None:
+                        reg = getattr(batcher, "metrics", None)
+                if reg is not None:
+                    groups.append((labels, reg))
+                snap = getattr(
+                    rep.get("model"), "cont_serving_stats", None
+                )
+                if isinstance(snap, dict) and snap:
+                    sreg = MetricsRegistry()
+                    snapshot_gauges(sreg, snap, prefix="tlink_engine_")
+                    groups.append((labels, sreg))
+            if fleet:
+                groups.append(({"model": j.name}, j.router.metrics))
+            if j.autopilot is not None:
+                groups.append(({"model": j.name}, j.autopilot.metrics))
         return groups
 
     def hosted_snapshot(self) -> list[dict]:
@@ -554,6 +752,16 @@ class DistributedValidator:
                     cf = getattr(model, "chain_forwards", 0)
                     if cf:  # worker-to-worker chained calls completed
                         entry["chain_forwards"] = cf
+                if j.router is not None:
+                    # fleet view: per-replica routed counts + health,
+                    # and each replica's own serving stats under its rid
+                    entry["replicas"] = len(j.replicas)
+                    entry["fleet"] = j.router.snapshot()
+                    entry["replica_serving"] = {
+                        rep["rid"]: rep["batcher"].stats()
+                        for rep in j.replicas[1:]
+                        if rep.get("batcher") is not None
+                    }
                 out.append(entry)
             return out
 
@@ -737,8 +945,17 @@ class DistributedValidator:
             out_ids = seqs[0]
         elif job.batcher is not None:
             # concurrent requests coalesce into one batched decode
-            # (ml/batching.py); the batcher demuxes this request's tokens
-            out_ids = job.batcher.generate(
+            # (ml/batching.py); the batcher demuxes this request's tokens.
+            # A fleet-hosted model routes through the FleetRouter first:
+            # same generate contract, placement scored per request
+            # (prefix-cache affinity + per-class load), replica failure
+            # failing over before the first token (docs/SERVING.md
+            # "Fleet serving")
+            gen = (
+                job.router.dispatch if job.router is not None
+                else job.batcher.generate
+            )
+            out_ids = gen(
                 ids,
                 max_new_tokens=args["max_new_tokens"],
                 temperature=args["temperature"],
@@ -825,6 +1042,248 @@ class DistributedValidator:
         if beams_used is not None and beams_used != n_beams:
             out["num_beams_used"] = int(beams_used)  # worker clamped
         return out
+
+
+    # ------------------------------------------------------------------
+    # fleet serving (tensorlink_tpu/fleet, docs/SERVING.md "Fleet
+    # serving") — the /fleet route's view + the rolling-deploy verb
+    # ------------------------------------------------------------------
+    def fleet_snapshot(self) -> dict:
+        """Per-model fleet state for ``GET /fleet``: router telemetry
+        (per-replica routed counts, health, headroom) and the autopilot's
+        status/history when one runs."""
+        with self._host_lock:
+            jobs = list(self.hosted.values())
+        out = {}
+        for j in jobs:
+            if j.router is None:
+                continue
+            out[j.name] = {
+                "replicas": len(j.replicas),
+                "router": j.router.snapshot(),
+                "autopilot": (
+                    j.autopilot.status() if j.autopilot is not None else None
+                ),
+            }
+        return out
+
+    def fleet_deploy(self, model: str, replicas: list | None = None) -> dict:
+        """Operator trigger for a zero-dropped-token rolling deploy
+        (``POST /fleet/deploy``): each named replica (default all) in
+        turn drains onto a sibling, rebuilds, rejoins. Requires a fleet;
+        an autopilot is started on demand when none is running."""
+        # under the host lock: a deploy racing unhost_model must either
+        # see the job gone, or install the autopilot BEFORE unhost pops
+        # the job — so unhost's stop() always finds and kills it (no
+        # orphan control thread issuing verbs against released workers)
+        with self._host_lock:
+            job = self.hosted.get(model)
+            if job is None or job.router is None:
+                return {
+                    "ok": False, "error": f"no fleet hosted for {model!r}"
+                }
+            if job.autopilot is None:
+                self._start_autopilot(job)
+            autopilot = job.autopilot
+        queued = autopilot.request_deploy(replicas)
+        return {"ok": True, "queued": queued}
+
+
+class ValidatorFleetActions:
+    """FleetAutopilot actions over REMOTE replicas — every verb rides
+    the existing wire machinery, so moved streams stay bit-identical by
+    the PR 8 contract:
+
+    - ``drain``/``drain_step``: the validator's DRAIN verb sheds the
+      replica's entry worker (page-ship, re-prefill fallback, zero
+      dropped streams); in-flight client requests follow the migration
+      redirects transparently (ml/module.py).
+    - ``rehost``: the rolling deploy's upgrade — shut the replica's job
+      down, re-plan/recruit a fresh one (the drained worker sits fenced
+      until its operator restarts it, which IS the binary-upgrade
+      window), return the new batcher for the router to re-register.
+    - ``rebalance``: declined (returns 0). The wire moves streams at
+      WORKER granularity only — a per-stream rebalance would drain the
+      whole replica, which is the deploy verb's job, not a load tweak.
+      (The in-process :class:`~tensorlink_tpu.fleet.autopilot.
+      EngineFleetActions` does per-stream moves.)
+    - ``scale_decode``: re-push the handoff pool (PR 13) to every
+      replica's entry worker with one more / one fewer decode-role
+      worker.
+    """
+
+    def __init__(self, validator: DistributedValidator, job: HostedJob):
+        self.validator = validator
+        self.job = job
+        self.log = validator.log
+        self._decode_pool_n: int | None = None
+        # replicas whose wire DRAIN completed: the serving snapshot only
+        # refreshes on GENERATE_RESP traffic, and a drained (fenced)
+        # replica receives none — judging "drained" from the stale
+        # snapshot would loop the deploy forever
+        self._drained: set[str] = set()
+
+    def _job_live(self) -> bool:
+        """The job is still THE hosted job for its model. unhost_model's
+        autopilot.stop() only joins 10s while wire verbs run minutes —
+        an in-flight tick that outlives the unhost must not keep acting
+        (a post-unhost rehost would recruit workers nothing ever
+        releases)."""
+        return self.validator.hosted.get(self.job.name) is self.job
+
+    def _rep(self, rid: str) -> dict | None:
+        for rep in self.job.replicas:
+            if rep["rid"] == rid:
+                return rep
+        return None
+
+    def live_work(self, rid: str) -> int:
+        rep = self._rep(rid)
+        if rep is None:
+            return 0
+        snap = rep["batcher"].router_snapshot()
+        live = max(
+            int(snap.get("max_slots") or 0) - int(snap.get("slots_free") or 0),
+            0,
+        )
+        return live + sum(
+            int(v) for v in (snap.get("queue_depth") or {}).values()
+        )
+
+    def movable_streams(self, rid: str) -> int:
+        return self.live_work(rid)
+
+    def rebalance(self, src: str, dst: str, max_streams: int = 1) -> int:
+        self.log.debug(
+            "fleet rebalance %s→%s declined: remote replicas move at "
+            "worker granularity (use the deploy/drain verb)", src, dst,
+        )
+        return 0
+
+    def drain(self, rid: str) -> None:
+        rep = self._rep(rid)
+        if rep is None or not self._job_live():
+            return
+        wid = self.validator._replica_entry_worker(rep)
+        if not wid:
+            return
+        # primary path: drain onto a SIBLING replica's entry worker (it
+        # already hosts the model — no stage ship, prefix probes hit).
+        # When no sibling runs on a different worker the verb goes out
+        # dest-less: the net layer picks most-free, and the worker's own
+        # REPLICA_SET fallback backstops a validator with no candidates.
+        dest = next(
+            (
+                w for r2 in self.job.replicas
+                if r2 is not rep
+                and (w := self.validator._replica_entry_worker(r2))
+                and w != wid
+            ),
+            None,
+        )
+        req = {"worker": wid}
+        if dest:
+            req["dest"] = dest
+        summary = self.validator.bridge.request(
+            "drain_worker", req, timeout=600.0,
+        )
+        if isinstance(summary, dict) and summary.get("ok"):
+            self._drained.add(rid)
+        self.log.info(
+            "autopilot drain of replica %s (worker %s → %s): %s",
+            rid, wid[:8], (dest or "auto")[:8], summary,
+        )
+
+    def undrain(self, rid: str) -> None:
+        # the DRAIN verb is synchronous and terminal for the worker (it
+        # stays capacity-fenced for its upgrade); nothing to lower here
+        return
+
+    def drain_step(self, src: str, dst: str, max_streams: int = 4) -> int:
+        # a COMPLETED wire drain moved everything synchronously —
+        # in-flight client requests finish through their migration
+        # redirects regardless, and the stale snapshot must not gate the
+        # deploy (it stops refreshing the moment the replica is fenced)
+        if src in self._drained:
+            return 0
+        return self.live_work(src)
+
+    def rehost(self, rid: str):
+        """Rebuild the replica on current capacity; returns the new
+        batcher (the autopilot re-registers it under the same rid)."""
+        if not self._job_live():
+            raise RuntimeError(
+                f"{self.job.name} was unhosted mid-deploy — refusing to "
+                "recruit workers for a released job"
+            )
+        rep = self._rep(rid)
+        if rep is None:
+            return None
+        old_batcher, old_model = rep["batcher"], rep["model"]
+        model, batcher, jid = self.validator._build_replica(
+            self.job, dict(rep["spec"]), self.job.cfg,
+            batch=rep.get("batch", 1), seed=rep.get("seed", 0),
+        )
+        rep.update({"model": model, "batcher": batcher, "job_id": jid})
+        self._drained.discard(rid)  # the rebuilt replica serves again
+        if rep is self.job.replicas[0]:
+            self.job.model, self.job.batcher = model, batcher
+        try:
+            old_batcher.close()
+        except Exception:
+            self.log.exception("old replica %s batcher close failed", rid)
+        try:
+            # shutdown ALWAYS runs — a wedged batcher close must not
+            # leave the old replica's recruited workers reserved forever
+            # (the same invariant unhost_model keeps)
+            old_model.shutdown()
+        except Exception:
+            self.log.exception("old replica %s teardown failed", rid)
+        self.validator._push_replica_sets(self.job)
+        return batcher
+
+    def scale_decode(self, up: bool) -> bool:
+        if not self._job_live():
+            return False
+        stats = self.validator.bridge.request(
+            "stats_workers", timeout=15.0
+        )
+        decode = [
+            s for s in stats
+            if str(s.get("serving_role") or "mixed") == "decode"
+            and s.get("addr")
+        ]
+        if not decode:
+            return False
+        cur = (
+            self._decode_pool_n
+            if self._decode_pool_n is not None else len(decode)
+        )
+        target = max(1, min(len(decode), cur + (1 if up else -1)))
+        if target == cur and self._decode_pool_n is not None:
+            return False
+        pool = [
+            {"id": s["id"], "addr": list(s["addr"])}
+            for s in decode[:target]
+        ]
+        pushed = False
+        for rep in self.job.replicas:
+            wid = self.validator._replica_entry_worker(rep)
+            if not wid:
+                continue
+            try:
+                self.validator.bridge.request(
+                    "set_handoff_pool", {"worker": wid, "pool": pool},
+                    timeout=10.0,
+                )
+                pushed = True
+            except Exception as e:
+                self.log.warning(
+                    "handoff-pool push to %s failed: %s", wid[:8], e
+                )
+        if pushed:
+            self._decode_pool_n = target
+        return pushed
 
 
 class ModelNotReady(RuntimeError):

@@ -140,6 +140,12 @@ class DistributedWorker:
         # worker_role="prefill").
         self._handoff_pools: dict[str, list[dict]] = {}
         self._handoff_rr = 0  # round-robin cursor over the pool
+        # fleet serving (docs/SERVING.md "Fleet serving"): the sibling-
+        # replica memberships pushed by the validator (REPLICA_SET
+        # frames, keyed by this worker's job id) — the destination a
+        # DRAIN with no explicit dest falls back to, so a rolling-deploy
+        # drain lands on a replica that already serves the same model
+        self._replica_sets: dict[str, list[dict]] = {}
         # destinations already probed loaded/ready per job — skips the
         # per-handoff MODULE-ship round trip on the steady-state path;
         # invalidated on any ship failure so a restarted destination is
@@ -323,6 +329,8 @@ class DistributedWorker:
             self._migrate_in(p)
         elif kind == proto.HANDOFF:
             self._set_handoff_pool(p)
+        elif kind == proto.REPLICA_SET:
+            self._set_replica_set(p)
         elif kind == "shutdown_job":
             jid = p.get("job_id", "")
             with self._lock:
@@ -332,6 +340,7 @@ class DistributedWorker:
             # job id for the process lifetime (same lifecycle gap the
             # shared KV pools had)
             self._handoff_pools.pop(jid, None)
+            self._replica_sets.pop(jid, None)
             with self._handoff_prep_lock:  # vs the warm thread's add
                 self._handoff_dest_ready = {
                     k for k in self._handoff_dest_ready if k[0] != jid
@@ -1961,6 +1970,24 @@ class DistributedWorker:
     # redirect exactly like a drain redirect, except the plan keeps
     # pointing HERE — this worker stays the admission point.
 
+    def _set_replica_set(self, p: dict) -> None:
+        """A REPLICA_SET push from the validator (mirrors the HANDOFF
+        pool push): the other replicas of the fleet this worker's job
+        belongs to, as ``[{id, addr, job_id}, ...]``. Pure wire state —
+        consulted only when a DRAIN arrives with no destination."""
+        peers = [
+            dict(e) for e in (p.get("peers") or [])
+            if e.get("id") and e.get("id") != self.node.node_id
+            and e.get("addr")
+        ]
+        job_id = str(p.get("job_id") or "")
+        self._replica_sets[job_id] = peers
+        self.log.info(
+            "replica set (%s): %d sibling(s) %s",
+            job_id[:8] or "worker-wide", len(peers),
+            [str(e["id"])[:8] for e in peers],
+        )
+
     def _set_handoff_pool(self, p: dict) -> None:
         """A HANDOFF push from the validator: the decode-pool membership
         this (prefill-role) worker ships completed prefills to — scoped
@@ -2224,6 +2251,23 @@ class DistributedWorker:
             # fault site "worker.drain": a worker that dies the moment it
             # is asked to shed its slots (crash) or refuses (error)
             self.faults.inject("worker.drain", str(dest.get("id", "")))
+        if not dest.get("id") or not dest.get("addr"):
+            # fleet fallback (docs/SERVING.md "Fleet serving"): a DRAIN
+            # with no destination drains onto a sibling replica's entry
+            # worker from the REPLICA_SET push — but _drain ships EVERY
+            # job to the one destination, so the fallback applies only
+            # when the candidate is UNAMBIGUOUS: all pushed sets agree
+            # on one sibling (a worker co-hosting two fleets must not
+            # drain model A's streams onto model B's sibling)
+            candidates = {
+                e["id"]: dict(e)
+                for peers in self._replica_sets.values()
+                for e in peers
+                if e.get("id") and e.get("id") != self.node.node_id
+                and e.get("addr")
+            }
+            if len(candidates) == 1:
+                dest = next(iter(candidates.values()))
         if not dest.get("id") or not dest.get("addr"):
             self._respond(
                 p["peer"], proto.DRAIN_RESP, p["rid"],

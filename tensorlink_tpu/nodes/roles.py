@@ -279,8 +279,11 @@ class WorkerServer(RoleServer):
             proto.CHECKPOINT, proto.PROOF_REQ,
             # live slot migration: DRAIN from a validator, MIGRATE
             # (probe / page transfer) worker-to-worker; HANDOFF pushes
-            # the decode-pool membership a prefill worker ships to
-            proto.DRAIN, proto.MIGRATE, proto.HANDOFF,
+            # the decode-pool membership a prefill worker ships to;
+            # REPLICA_SET pushes the sibling-replica membership a fleet
+            # entry worker may drain onto (docs/SERVING.md "Fleet
+            # serving")
+            proto.DRAIN, proto.MIGRATE, proto.HANDOFF, proto.REPLICA_SET,
         ):
             self.register(tag, self._relay_to_ml)
 
@@ -807,6 +810,11 @@ class ValidatorServer(RoleServer):
         dest = None
         if p.get("dest"):
             dest = self._resolve_worker(str(p["dest"]))
+            if dest is None or dest == src or dest not in self.addresses:
+                # an EXPLICITLY named destination that doesn't resolve
+                # stays a loud error — silently draining onto a fallback
+                # the operator never chose is worse than refusing
+                return {"ok": False, "error": "no usable destination worker"}
         else:
             # destination choice: most free capacity among the OTHER
             # connected workers with a known listen address
@@ -819,11 +827,19 @@ class ValidatorServer(RoleServer):
                 ),
             )
             dest = ranked[0]["id"] if ranked else None
-        if dest is None or dest == src or dest not in self.addresses:
-            return {"ok": False, "error": "no usable destination worker"}
+        if dest is not None and (dest == src or dest not in self.addresses):
+            dest = None
+        if dest is None:
+            # no candidate from here — still send the DRAIN: a fleet
+            # entry worker holds a REPLICA_SET push and can drain onto
+            # its sibling replica itself (docs/SERVING.md "Fleet
+            # serving"); a worker with neither answers with the error
+            body = {}
+        else:
+            body = {"dest": {"id": dest, "addr": list(self.addresses[dest])}}
         reply = await self.request(
             self._conn(src), proto.DRAIN,
-            {"dest": {"id": dest, "addr": list(self.addresses[dest])}},
+            body,
             # generous default: a drain to a COLD destination ships the
             # whole stage (up to ~130s) before the per-slot transfers
             # (60s each) — a shorter operator timeout would report a
@@ -1000,6 +1016,39 @@ class ValidatorServer(RoleServer):
             ]
         await self._conn(wid).send_control(proto.HANDOFF, {"pool": pool})
         return {"ok": True, "pool": [str(x.get("id", ""))[:16] for x in pool]}
+
+    async def cmd_set_replica_set(self, p) -> dict:
+        """Fleet serving (docs/SERVING.md "Fleet serving"): push a
+        sibling-replica membership to ``worker`` — the entry worker of
+        one replica of a hosted fleet. Mirrors the HANDOFF pool push:
+        fire-and-forget wire state the worker uses when a DRAIN arrives
+        with no explicit destination (the autopilot's rolling deploy
+        drains a replica onto a sibling), scoped to the replica's own
+        ``job_id``. ``peers`` is ``[{id, addr, job_id}, ...]`` naming the
+        OTHER replicas' entry workers."""
+        wid = self._resolve_worker(str(p.get("worker", "")))
+        if wid is None:
+            return {"ok": False, "error": "unknown or ambiguous worker"}
+        peers = []
+        for e in p.get("peers") or []:
+            pid = self._resolve_worker(str(e.get("id", "")))
+            if pid is None:
+                continue
+            # the ML process knows worker IDS, not transports — fill each
+            # sibling's LISTEN address here, where the net process keeps
+            # them (the same table the DRAIN destination uses)
+            addr = list(e.get("addr") or self.addresses.get(pid) or [])
+            if not addr:
+                continue
+            peers.append({
+                "id": pid, "addr": addr,
+                "job_id": str(e.get("job_id", "")),
+            })
+        await self._conn(wid).send_control(
+            proto.REPLICA_SET,
+            {"job_id": str(p.get("job_id", "")), "peers": peers},
+        )
+        return {"ok": True, "peers": [e["id"][:16] for e in peers]}
 
     async def cmd_decline_job(self, p) -> bool:
         """Planning failed (no capacity / unknown model)."""

@@ -99,6 +99,11 @@ DRAIN_RESP = "drain.resp"
 # the decode-pool membership [{id, addr}, ...] the worker hands its
 # completed prefills to through the MIGRATE export/stage/adopt path
 HANDOFF = "handoff"
+# fleet serving (docs/SERVING.md "Fleet serving"): validator → replica
+# entry worker, fire-and-forget — the sibling-replica membership
+# [{id, addr, job_id}, ...] this worker may drain onto when a DRAIN
+# arrives with no explicit destination (the autopilot's rolling deploy)
+REPLICA_SET = "replica.set"
 PARAMS_REQ = "params.req"
 PARAMETERS = "params"
 OPTIMIZER = "opt"

@@ -319,6 +319,32 @@ def test_scheduler_rejection_becomes_429_with_retry_after():
     assert batcher.calls == [("batch", 1)]
 
 
+def test_fleet_router_gates_admission_over_batcher():
+    """A fleet-hosted model's 429 gate is the ROUTER's admission_check
+    (admit when any replica admits) — the entry batcher's own view must
+    not be consulted (its queue says nothing about the siblings')."""
+    from tensorlink_tpu.api.server import HTTPError
+
+    rej = {
+        "priority": "interactive", "queue_depth": 8, "cap": 8,
+        "retry_after": 3.0,
+    }
+    batcher = _RejectingBatcher(rej)  # replica 0 looks full...
+    router = _RejectingBatcher(None)  # ...but a sibling admits
+    job = _FakeJob(batcher)
+    job.router = router
+    api = _make_api(job)
+    gen = GenerationRequest.parse({"hf_name": "m"})
+    api._reject_if_overloaded(job, gen, 1)  # no raise
+    assert router.calls == [(None, 1)] and batcher.calls == []
+    # and a fleet-wide rejection still becomes the 429 contract
+    router.rej = rej
+    with pytest.raises(HTTPError) as ei:
+        api._reject_if_overloaded(job, gen, 1)
+    assert ei.value.status == 429
+    assert ei.value.headers.get("Retry-After") == "3"
+
+
 def test_admission_pass_through_when_not_overloaded():
     batcher = _RejectingBatcher(None)
     api = _make_api(_FakeJob(batcher))

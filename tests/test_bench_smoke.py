@@ -28,7 +28,7 @@ def test_bench_all_legs_cpu():
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disarm the TPU-tunnel hook
     p = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=1500, env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=1700, env=env, cwd=REPO,
     )
     assert p.returncode == 0, p.stderr[-2000:]
     lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
@@ -82,6 +82,14 @@ def test_bench_all_legs_cpu():
                 "disagg_queue_ms", "disagg_prefill_ms",
                 "disagg_handoff_ms", "disagg_first_decode_ms",
                 "disagg_ttft_trace_ms", "disagg_ttft_wall_ms",
+                # fleet serving: 1 vs N replicas behind the router under
+                # a Zipf-prefix mixed-class flood, with a churned leg
+                # (replica joins, rolling deploy, replica kill)
+                "fleet_replicas", "fleet_tokps_1", "fleet_tokps_n",
+                "fleet_scaling", "fleet_dropped", "fleet_streams_exact",
+                "fleet_ttft_p95_1_ms", "fleet_ttft_p95_n_ms",
+                "fleet_churn_ttft_p95_ms", "fleet_deploys",
+                "fleet_route_cache_tokens",
                 # trace-derived TTFT decompositions (core/trace.py) on the
                 # serving, sched, and migration legs + the tracing
                 # overhead bound
@@ -175,6 +183,20 @@ def test_bench_all_legs_cpu():
     assert abs(extra["disagg_ttft_trace_ms"] - wall) <= max(
         0.25 * wall, 20.0
     ), (extra["disagg_ttft_trace_ms"], wall)
+    # the fleet leg's bars (ROADMAP item 2): the DETERMINISTIC ones —
+    # zero dropped streams across the clean AND churned floods (the
+    # churned leg joins a replica, rolling-deploys one, and KILLS one
+    # mid-flood), every stream bit-identical to its solo run, at least
+    # one zero-drop rolling deploy landed, and the router really placed
+    # by prefix-cache affinity (digest-matched prompt tokens routed).
+    # The scaling/TTFT PAIR is wall-clock and CPU-meaningless (N
+    # replicas share one core — fleet_note documents it; the >=0.6*N
+    # scaling and flat-TTFT bars arm in-leg on TPU rounds only).
+    assert extra["fleet_dropped"] == 0, extra["fleet_dropped"]
+    assert extra["fleet_streams_exact"] is True
+    assert extra["fleet_deploys"] >= 1, extra["fleet_deploys"]
+    assert extra["fleet_route_cache_tokens"] > 0
+    assert extra["fleet_scaling"] > 0
     # the migration leg's robustness bar: draining a worker mid-stream
     # drops ZERO streams (every resume bit-identical — deterministic on
     # CPU), and both resume latencies are real numbers. The latency

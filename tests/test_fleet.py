@@ -1,0 +1,836 @@
+"""Fleet serving: the cache-/SLO-aware router + drain-driven autopilot
+(tensorlink_tpu/fleet, docs/SERVING.md "Fleet serving").
+
+Contracts under test:
+
+- the prefix-trie digest is compact, bounded, and names exactly the
+  chains the trie holds (a router can score affinity from it off-box);
+- the router places by cache affinity until load says otherwise, fences
+  draining replicas, fails over BEFORE the first token only, and admits
+  when any replica admits;
+- the autopilot's decisions (rebalance spread, rolling-deploy state
+  machine, decode-pool water marks) are deterministic given the views,
+  and its safety rails hold;
+- moved streams are BIT-IDENTICAL to unmoved ones (the migration resume
+  contract), a replica killed mid-flood drops zero streams while
+  survivors hold page conservation, and the whole fleet layer adds ZERO
+  compiled programs (pure host-side policy).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tensorlink_tpu.engine.continuous import ContinuousEngine
+from tensorlink_tpu.engine.generate import GenerationEngine
+from tensorlink_tpu.engine.paged import PrefixCache, prompt_chain_hashes
+from tensorlink_tpu.engine.scheduler import SchedulerOverloaded
+from tensorlink_tpu.fleet.autopilot import EngineFleetActions, FleetAutopilot
+from tensorlink_tpu.fleet.router import FleetRouter, NoReplicaAvailable
+from tensorlink_tpu.ml.batching import ContinuousBatcher
+from tensorlink_tpu.models import ModelConfig, init_params
+
+
+# ---------------------------------------------------------------------------
+# fakes (zero-compile units)
+# ---------------------------------------------------------------------------
+def _view(**kw):
+    base = {
+        "draining": False,
+        "worker_role": "mixed",
+        "max_slots": 4,
+        "slots_free": 4,
+        "kv_pages_free": 32,
+        "kv_pages_total": 32,
+        "service_ewma_s": 0.5,
+        "queue_depth": {"interactive": 0, "batch": 0, "best_effort": 0},
+        "prefix_digest": {},
+    }
+    base.update(kw)
+    return base
+
+
+class FakeBatcher:
+    """router_snapshot/admission_check/generate triple the router needs."""
+
+    def __init__(self, view=None, tokens=(1, 2, 3), fail=None, reject=None):
+        self.view = view or _view()
+        self.tokens = list(tokens)
+        self.fail = fail  # exception to raise from generate
+        self.reject = reject  # admission_check rejection record
+        self.calls = 0
+
+    def router_snapshot(self):
+        return dict(self.view)
+
+    def admission_check(self, priority=None, n=1):
+        return dict(self.reject) if self.reject else None
+
+    def generate(self, ids, *, max_new_tokens, stream_cb=None, **kw):
+        self.calls += 1
+        if self.fail is not None:
+            raise self.fail
+        if stream_cb is not None:
+            for t in self.tokens:
+                stream_cb([t])
+        return list(self.tokens)
+
+
+def _digest_for(tokens, page_size):
+    """A digest covering every full-page prefix of ``tokens``."""
+    hs = prompt_chain_hashes(tokens, page_size, 64)
+    return {
+        "page_size": page_size,
+        "chains": {h: (i + 1) * page_size for i, h in enumerate(hs)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# prefix digest
+# ---------------------------------------------------------------------------
+def test_prefix_digest_names_resident_chains_and_is_bounded():
+    pc = PrefixCache(4)
+    n1, _ = pc.insert(None, (1, 2, 3, 4), 10)
+    n2, _ = pc.insert(n1, (5, 6, 7, 8), 11)
+    pc.insert(None, (9, 9, 9, 9), 12)
+    d = pc.digest()
+    assert d["page_size"] == 4
+    # a prompt extending the cached chain matches its full depth
+    hs = prompt_chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 1, 1], 4, 64)
+    assert d["chains"][hs[0]] == 4
+    assert d["chains"][hs[1]] == 8
+    # a diverging prompt matches nothing past the divergence
+    miss = prompt_chain_hashes([1, 2, 3, 4, 7, 7, 7, 7], 4, 64)
+    assert miss[0] in d["chains"] and miss[1] not in d["chains"]
+    # bounded: max_chains caps the entry count (most-recent first)
+    for i in range(20):
+        pc.insert(None, (100 + i,) * 4, 20 + i)
+    assert len(pc.digest(max_chains=5)["chains"]) == 5
+    # membership changes bump the version (the engine's refresh key)
+    v = pc.version
+    pc.evict(1)
+    assert pc.version > v
+
+
+def test_prompt_chain_hashes_page_granular():
+    assert prompt_chain_hashes([1, 2, 3], 4, 64) == []  # no full page
+    hs = prompt_chain_hashes(list(range(12)), 4, 2)  # max_pages caps
+    assert len(hs) == 2
+    # prefix property: the first hash is shared with any same-start chain
+    assert prompt_chain_hashes(list(range(8)), 4, 64)[0] == hs[0]
+
+
+# ---------------------------------------------------------------------------
+# router: scoring + placement
+# ---------------------------------------------------------------------------
+def test_router_prefers_cache_affine_replica():
+    prompt = list(range(1, 17))
+    warm = FakeBatcher(_view(prefix_digest=_digest_for(prompt, 4)))
+    cold = FakeBatcher(_view())
+    r = FleetRouter(refresh_s=0.0)
+    r.register("warm", warm)
+    r.register("cold", cold)
+    assert r.route(prompt) == "warm"
+    # a prompt NEITHER has cached falls to the load tiebreak (equal here
+    # → deterministic id order), not the warm replica by default
+    assert r.cache_affinity(cold.view, prompt) == 0
+    assert r.cache_affinity(warm.view, prompt) == 16
+
+
+def test_router_load_overrides_cache_affinity():
+    prompt = list(range(1, 17))
+    warm = FakeBatcher(_view(
+        prefix_digest=_digest_for(prompt, 4),
+        queue_depth={"interactive": 40, "batch": 0, "best_effort": 0},
+        service_ewma_s=2.0, slots_free=0,
+    ))
+    idle = FakeBatcher(_view())
+    r = FleetRouter(refresh_s=0.0)
+    r.register("warm", warm)
+    r.register("idle", idle)
+    assert r.route(prompt, priority="interactive") == "idle"
+
+
+def test_router_fences_draining_and_decode_role():
+    r = FleetRouter(refresh_s=0.0)
+    r.register("a", FakeBatcher(_view(draining=True)))
+    r.register("b", FakeBatcher(_view(worker_role="decode")))
+    r.register("c", FakeBatcher(_view()))
+    # draining fenced, decode-role penalized → the mixed replica wins
+    assert r.route([1, 2, 3]) == "c"
+    # last resort: with every replica draining, the least-bad one still
+    # serves (its admission fence rejects cleanly if it must)
+    solo = FleetRouter(refresh_s=0.0)
+    solo.register("only", FakeBatcher(_view(draining=True)))
+    assert solo.route([1, 2, 3]) == "only"
+
+
+def test_router_failover_before_first_token_only():
+    r = FleetRouter(refresh_s=0.0, failure_cooldown_s=0.1)
+    bad = FakeBatcher(_view(), fail=RuntimeError("replica died"))
+    good = FakeBatcher(_view(worker_role="decode"))  # scored below bad
+    r.register("bad", bad)
+    r.register("good", good)
+    assert r.route([1]) == "bad"
+    # no tokens delivered → fails over and completes on the survivor
+    assert r.dispatch([1], max_new_tokens=4) == [1, 2, 3]
+    assert bad.calls == 1 and good.calls == 1
+    assert r.snapshot()["failovers"] == 1
+
+    # mid-stream failure, GREEDY: the survivor's replay has the
+    # identical prefix (greedy streams are placement-invariant), so the
+    # router suppresses the already-delivered tokens — the client sees
+    # ONE continuous exactly-once stream
+    class MidStream(FakeBatcher):
+        def generate(self, ids, *, max_new_tokens, stream_cb=None, **kw):
+            self.calls += 1
+            stream_cb([1])  # the survivor's replay starts 1, 2, 3...
+            raise RuntimeError("died mid-stream")
+
+    r2 = FleetRouter(refresh_s=0.0)
+    r2.register("mid", MidStream(_view()))
+    r2.register("other", FakeBatcher(_view(worker_role="decode")))
+    got: list = []
+    out = r2.dispatch(
+        [1], max_new_tokens=4, stream_cb=lambda t: got.append(t)
+    )
+    assert out == [1, 2, 3]
+    assert got == [[1], [2], [3]]  # token 1 delivered exactly once
+
+    # mid-stream failure, SAMPLED: a replay would draw a different
+    # stream — the error propagates (the model-level repair ladder owns
+    # resumption, not the router)
+    r3 = FleetRouter(refresh_s=0.0)
+    r3.register("mid", MidStream(_view()))
+    r3.register("other", FakeBatcher(_view(worker_role="decode")))
+    with pytest.raises(RuntimeError, match="mid-stream"):
+        r3.dispatch(
+            [1], max_new_tokens=4, temperature=0.7,
+            stream_cb=lambda t: got.append(t),
+        )
+
+
+def test_router_overflow_spills_to_sibling_and_admission_check():
+    rej = {"priority": "interactive", "queue_depth": 9, "cap": 8,
+           "retry_after": 5.0}
+    full = FakeBatcher(_view(), reject=rej)
+    full.fail = SchedulerOverloaded("interactive", 9, 8, 5.0)
+    open_ = FakeBatcher(_view(worker_role="decode"))
+    r = FleetRouter(refresh_s=0.0)
+    r.register("full", full)
+    r.register("open", open_)
+    # gate: ANY replica admitting admits the fleet
+    assert r.admission_check("interactive") is None
+    # dispatch: the full replica's engine-side rejection spills over
+    assert r.dispatch([1], max_new_tokens=4) == [1, 2, 3]
+    assert r.snapshot()["overflow_reroutes"] == 1
+    # every replica rejecting → the smallest retry-after wins
+    open_.reject = {**rej, "retry_after": 2.0}
+    out = r.admission_check("interactive")
+    assert out["retry_after"] == 2.0
+
+
+def test_router_empty_and_deregister():
+    r = FleetRouter(refresh_s=0.0)
+    assert r.route([1]) is None
+    with pytest.raises(NoReplicaAvailable):
+        r.dispatch([1], max_new_tokens=1)
+    b = FakeBatcher(_view())
+    r.register("a", b)
+    assert r.deregister("a") is b
+    assert r.route([1]) is None
+
+
+# ---------------------------------------------------------------------------
+# autopilot decisions (fake actions; real router over fake batchers)
+# ---------------------------------------------------------------------------
+class FakeActions:
+    def __init__(self, remaining=(0,)):
+        self.calls: list = []
+        self._remaining = list(remaining)
+        self.rehost_handle = FakeBatcher(_view())
+
+    def rebalance(self, src, dst, k):
+        self.calls.append(("rebalance", src, dst, k))
+        return k
+
+    def drain(self, rid):
+        self.calls.append(("drain", rid))
+
+    def undrain(self, rid):
+        self.calls.append(("undrain", rid))
+
+    def drain_step(self, src, dst, max_streams=4):
+        self.calls.append(("drain_step", src, dst))
+        return self._remaining.pop(0) if self._remaining else 0
+
+    def rehost(self, rid):
+        self.calls.append(("rehost", rid))
+        return self.rehost_handle
+
+    def scale_decode(self, up):
+        self.calls.append(("scale", up))
+        return True
+
+
+def _fleet(views: dict):
+    r = FleetRouter(refresh_s=0.0)
+    for rid, v in views.items():
+        r.register(rid, FakeBatcher(v))
+    return r
+
+
+def test_autopilot_rebalances_hot_to_cold():
+    r = _fleet({
+        "hot": _view(slots_free=0,
+                     queue_depth={"interactive": 6, "batch": 0,
+                                  "best_effort": 0}),
+        "cold": _view(),
+    })
+    acts = FakeActions()
+    ap = FleetAutopilot(r, acts, action_cooldown_s=0.0,
+                        rebalance_spread=0.5, max_moves_per_tick=2)
+    recs = ap.tick()
+    assert ("rebalance", "hot", "cold", 2) in acts.calls
+    assert recs and recs[0]["kind"] == "rebalance" and recs[0]["moved"] == 2
+    # rails: below the spread → no action
+    acts2 = FakeActions()
+    r2 = _fleet({"a": _view(), "b": _view()})
+    assert FleetAutopilot(r2, acts2, action_cooldown_s=0.0).tick() == []
+    assert acts2.calls == []
+    # rails: a single replica never rebalances no matter how hot
+    acts3 = FakeActions()
+    r3 = _fleet({"only": _view(slots_free=0)})
+    assert FleetAutopilot(r3, acts3, action_cooldown_s=0.0).tick() == []
+    assert acts3.calls == []
+
+
+def test_autopilot_cooldown_and_dry_run():
+    r = _fleet({"hot": _view(slots_free=0), "cold": _view()})
+    acts = FakeActions()
+    ap = FleetAutopilot(r, acts, action_cooldown_s=3600.0,
+                        rebalance_spread=0.5)
+    ap._last_action_t = time.monotonic()  # an action just happened
+    assert ap.tick() == []
+    dry = FleetAutopilot(r, acts, action_cooldown_s=0.0,
+                         rebalance_spread=0.5, dry_run=True)
+    recs = dry.tick()
+    assert recs[0]["dry_run"] is True and acts.calls == []
+
+
+def test_autopilot_rolling_deploy_state_machine():
+    r = _fleet({"a": _view(), "b": _view()})
+    acts = FakeActions(remaining=[2, 0])  # two drain rounds then empty
+    ap = FleetAutopilot(r, acts, action_cooldown_s=0.0)
+    ap.request_deploy(["a"])
+    recs = ap.tick()  # raise the fence
+    assert recs[0]["kind"] == "deploy_drain" and ("drain", "a") in acts.calls
+    recs = ap.tick()  # first drain round: still work left
+    assert recs[0]["kind"] == "deploy_draining"
+    recs = ap.tick()  # drained → rehost + rejoin
+    assert recs[0]["kind"] == "deploy_done"
+    assert ("rehost", "a") in acts.calls
+    # the rejoined replica is the rehost handle, generation bumped
+    assert r.batcher("a") is acts.rehost_handle
+    assert r.snapshot()["replicas"]["a"]["generation"] == 1
+    assert ap.status()["deploying"] is None
+
+
+def test_autopilot_deploy_skips_unknown_replica():
+    """Regression: an unknown/deregistered rid at the queue head must be
+    DROPPED, not left to wedge every later (valid) deploy forever."""
+    r = _fleet({"a": _view(), "b": _view()})
+    acts = FakeActions()
+    ap = FleetAutopilot(r, acts, action_cooldown_s=0.0)
+    ap.request_deploy(["typo", "a"])
+    recs = ap.tick()
+    assert recs and recs[0]["kind"] == "deploy_skipped", recs
+    recs = ap.tick()  # the valid deploy behind it proceeds
+    assert recs and recs[0]["kind"] == "deploy_drain" \
+        and recs[0]["rid"] == "a", recs
+
+
+def test_autopilot_deploy_refuses_last_replica():
+    r = _fleet({"only": _view()})
+    acts = FakeActions()
+    ap = FleetAutopilot(r, acts, action_cooldown_s=0.0)
+    ap.request_deploy(["only"])
+    assert ap.tick() == []  # rail: nothing to drain onto
+    assert acts.calls == []
+
+
+def test_autopilot_decode_pool_watermarks():
+    # saturated decode pool → scale up
+    r = _fleet({
+        "p": _view(worker_role="prefill"),
+        "d": _view(worker_role="decode", slots_free=0),
+    })
+    acts = FakeActions()
+    ap = FleetAutopilot(r, acts, action_cooldown_s=0.0,
+                        decode_low_water=0.25, decode_high_water=0.75)
+    recs = ap.tick()
+    assert ("scale", True) in acts.calls
+    assert any(x["kind"] == "scale_decode" and x["up"] for x in recs)
+    # idle decode pool → scale down
+    r2 = _fleet({
+        "p": _view(worker_role="prefill"),
+        "d": _view(worker_role="decode", slots_free=4),
+    })
+    acts2 = FakeActions()
+    FleetAutopilot(r2, acts2, action_cooldown_s=0.0).tick()
+    assert ("scale", False) in acts2.calls
+    # in-band free fraction → no action
+    r3 = _fleet({"d": _view(worker_role="decode", slots_free=2)})
+    acts3 = FakeActions()
+    FleetAutopilot(r3, acts3, action_cooldown_s=0.0).tick()
+    assert all(c[0] != "scale" for c in acts3.calls)
+
+
+# ---------------------------------------------------------------------------
+# integration over real engines (compile-bearing — CI runs unfiltered)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return GenerationEngine(
+        cfg, params, seq_buckets=(8, 32), batch_buckets=(1,), max_seq_len=64
+    )
+
+
+def _local_batcher(eng, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("chunk_steps", 4)
+    return ContinuousBatcher(
+        engine=ContinuousEngine(eng, **kw), eos_ids=[],
+    )
+
+
+def _solo(eng, prompt, n, seed=0):
+    ce = ContinuousEngine(eng, max_slots=4, page_size=8, chunk_steps=4)
+    req = ce.submit(prompt, max_new_tokens=n, seed=seed)
+    ce.run_until_idle()
+    out = list(req.tokens)
+    ce.close()
+    return out
+
+
+def _await_movable(actions, rid, deadline_s=60.0):
+    """Poll until ``rid`` holds a movable decode stream. Bounded: a
+    stream that finished before the poll observed it fails the test
+    loudly instead of spinning forever."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if actions.movable_streams(rid) >= 1:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"no movable stream ever appeared on {rid}")
+
+
+def _mk_fleet(eng, n=2):
+    batchers = {f"r{i}": _local_batcher(eng) for i in range(n)}
+    router = FleetRouter(refresh_s=0.0)
+    for rid, b in batchers.items():
+        router.register(rid, b)
+    actions = EngineFleetActions(
+        lambda rid: batchers[rid]._cont,
+        exec_on=lambda rid, fn: batchers[rid].run_on_driver(fn),
+    )
+    return batchers, router, actions
+
+
+@pytest.mark.slow
+def test_fleet_dispatch_streams_bit_identical(tiny_engine):
+    """Concurrent greedy dispatches through the router complete with
+    streams bit-identical to solo runs, spread across replicas."""
+    eng = tiny_engine
+    batchers, router, _ = _mk_fleet(eng, 2)
+    try:
+        prompts = [[1 + i, 2, 3, 4 + i] for i in range(6)]
+        solos = [_solo(eng, p, 8) for p in prompts]
+        results: dict = {}
+
+        def one(i):
+            # seed 0 matters only for sampled rows; these are greedy
+            results[i] = router.dispatch(prompts[i], max_new_tokens=8)
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert [results[i] for i in range(6)] == solos
+        snap = router.snapshot()
+        assert sum(
+            r["routed"] for r in snap["replicas"].values()
+        ) == len(prompts)
+    finally:
+        for b in batchers.values():
+            b.close()
+
+
+@pytest.mark.slow
+def test_router_live_cache_affinity_after_digest_refresh(tiny_engine):
+    """A replica that served a prompt exports its chains in the digest
+    at the next chunk boundary, and the router then places the
+    shared-prefix follower on it."""
+    eng = tiny_engine
+    batchers, router, _ = _mk_fleet(eng, 2)
+    try:
+        shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]  # 2 pages
+        # warm exactly one replica with the shared prefix
+        warm_rid = router.route(shared)
+        batchers[warm_rid].generate(
+            shared, max_new_tokens=4, temperature=0.0
+        )
+        router.refresh(force=True)
+        view = router.views()[warm_rid]
+        assert view["prefix_digest"]["chains"], "digest never exported"
+        assert router.cache_affinity(view, shared + [7, 7]) >= 8
+        # the follower (same prefix, divergent tail) lands on the warm one
+        assert router.route(shared + [7, 7, 7]) == warm_rid
+    finally:
+        for b in batchers.values():
+            b.close()
+
+
+@pytest.mark.slow
+def test_autopilot_rebalance_moves_live_stream_bit_identical(tiny_engine):
+    """The autopilot's rebalance page-ships a LIVE decode stream between
+    threaded replicas through run_on_driver; the client's blocking
+    generate returns the full, solo-identical stream."""
+    eng = tiny_engine
+    batchers, router, actions = _mk_fleet(eng, 2)
+    try:
+        prompt = [5, 4, 3, 2, 1, 1, 2, 3, 4]
+        budget = 48
+        solo = _solo(eng, prompt, budget)
+        out: dict = {}
+
+        def client():
+            out["tokens"] = batchers["r0"].generate(
+                prompt, max_new_tokens=budget, temperature=0.0
+            )
+
+        t = threading.Thread(target=client)
+        t.start()
+        # wait until the stream is steadily decoding on r0
+        _await_movable(actions, "r0")
+        moved = actions.rebalance("r0", "r1", 1)
+        assert moved == 1
+        t.join(timeout=120)
+        assert out["tokens"] == solo
+        # conservation holds on BOTH replicas after the move
+        for rid in ("r0", "r1"):
+            batchers[rid].run_on_driver(
+                lambda e: e.check_page_conservation()
+            )
+        assert batchers["r1"].run_on_driver(
+            lambda e: int(e.stats["migrations_adopted"])
+        ) == 1
+    finally:
+        for b in batchers.values():
+            b.close()
+
+
+@pytest.mark.slow
+def test_autopilot_rolling_deploy_zero_dropped_streams(tiny_engine):
+    """Drain → upgrade → rejoin on a live replica: its in-flight stream
+    migrates to the sibling and completes bit-identically; the rebuilt
+    replica rejoins and serves."""
+    eng = tiny_engine
+
+    def rebuild(rid, _eng=eng):
+        return _local_batcher(_eng)
+
+    batchers, router, _ = _mk_fleet(eng, 2)
+    actions = EngineFleetActions(
+        lambda rid: router.batcher(rid)._cont,
+        exec_on=lambda rid, fn: router.batcher(rid).run_on_driver(fn),
+        rebuild=rebuild,
+    )
+    ap = FleetAutopilot(router, actions, action_cooldown_s=0.0,
+                        max_moves_per_tick=4)
+    try:
+        prompt = [9, 8, 7, 6, 5]
+        budget = 48
+        solo = _solo(eng, prompt, budget)
+        out: dict = {}
+
+        def client():
+            out["tokens"] = batchers["r0"].generate(
+                prompt, max_new_tokens=budget, temperature=0.0
+            )
+
+        t = threading.Thread(target=client)
+        t.start()
+        _await_movable(actions, "r0")
+        ap.request_deploy(["r0"])
+        for _ in range(20):
+            recs = ap.tick()
+            if any(r["kind"] == "deploy_done" for r in recs):
+                break
+        else:
+            raise AssertionError(f"deploy never finished: {ap.status()}")
+        t.join(timeout=120)
+        assert out["tokens"] == solo  # zero dropped tokens, bit-identical
+        # the rejoined replica is fresh and serves
+        nb = router.batcher("r0")
+        assert nb is not batchers["r0"]
+        assert nb.generate([2, 2, 2], max_new_tokens=4) == _solo(
+            eng, [2, 2, 2], 4
+        )
+        nb.close()
+    finally:
+        ap.stop()
+        batchers["r1"].close()
+        batchers["r0"].close()  # the drained ORIGINAL r0 (nb replaced it)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_replica_kill_mid_flood(tiny_engine):
+    """Satellite 3: kill a replica mid-flood with the router live.
+    Affected dispatches descend the failover rung (resubmit-from-prompt,
+    the repair ladder's local analogue), survivors are untouched, every
+    stream completes bit-identically, and page conservation holds on
+    every survivor."""
+    eng = tiny_engine
+    batchers, router, _ = _mk_fleet(eng, 3)
+    try:
+        prompts = [[1 + (i % 5), 2, 3 + (i % 3), 4] for i in range(12)]
+        solos = [_solo(eng, p, 6) for p in prompts]
+        results: dict = {}
+        errors: dict = {}
+
+        def one(i):
+            try:
+                results[i] = router.dispatch(prompts[i], max_new_tokens=6)
+            except BaseException as e:  # noqa: BLE001 — recorded for assert
+                errors[i] = e
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads[:6]:
+            t.start()
+        # kill r1 mid-flood: its next driver chunk raises, the batcher
+        # closes the engine and fails its in-flight work — the router
+        # fails those dispatches over to the survivors
+        def arm_kill(e):
+            def boom(**kw):
+                raise RuntimeError("replica r1 killed (chaos)")
+            e.step_chunk = boom
+
+        try:
+            batchers["r1"].run_on_driver(arm_kill)
+        except RuntimeError:
+            pass  # driver died executing the kill — that's the point
+        for t in threads[6:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert [results[i] for i in range(len(prompts))] == solos
+        # survivors: page conservation + zero leaked in-transit pages
+        for rid in ("r0", "r2"):
+            batchers[rid].run_on_driver(
+                lambda e: e.check_page_conservation()
+            )
+            assert batchers[rid].run_on_driver(
+                lambda e: e.serving_snapshot()["pages_in_transit"]
+            ) == 0
+        assert router.snapshot()["failovers"] >= 1
+    finally:
+        for b in batchers.values():
+            b.close()
+
+
+@pytest.mark.slow
+def test_fleet_adds_zero_new_programs(tiny_engine):
+    """Compile-count guard (CI compile-guard step): routing, dispatch,
+    digest refresh, rebalance, and a full rolling deploy add ZERO
+    compiled programs — the fleet layer is pure host-side policy over
+    the existing serving/migration program set."""
+    eng = tiny_engine
+    batchers, router, actions = _mk_fleet(eng, 2)
+    try:
+        # warm every program class once, page movers included
+        router.dispatch([1, 2, 3, 4, 5], max_new_tokens=4)
+        done: dict = {}
+
+        def client():
+            done["t"] = batchers["r0"].generate(
+                [4, 4, 2, 1], max_new_tokens=48, temperature=0.0
+            )
+
+        t = threading.Thread(target=client)
+        t.start()
+        _await_movable(actions, "r0")
+        assert actions.rebalance("r0", "r1", 1) == 1
+        t.join(timeout=120)
+        base = batchers["r0"].run_on_driver(lambda e: e.jit_cache_sizes())
+        # churn: mixed dispatches + another live move, both directions
+        for i in range(4):
+            router.dispatch([1 + i, 2, 3], max_new_tokens=5)
+        t2 = threading.Thread(target=client)
+        t2.start()
+        _await_movable(actions, "r0")
+        actions.rebalance("r0", "r1", 1)
+        t2.join(timeout=120)
+        for rid in ("r0", "r1"):
+            after = batchers[rid].run_on_driver(
+                lambda e: e.jit_cache_sizes()
+            )
+            assert after == base, (rid, base, after)
+    finally:
+        for b in batchers.values():
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# validator surfaces: /healthz headroom, /stats fleet block, /fleet view
+# ---------------------------------------------------------------------------
+def _bare_validator():
+    from tensorlink_tpu.ml.validator import DistributedValidator
+
+    v = DistributedValidator.__new__(DistributedValidator)
+    v._host_lock = threading.Lock()
+    v.hosted = {}
+    v.draining = False
+    return v
+
+
+class _ModesBatcher(FakeBatcher):
+    def serving_modes(self):
+        return {"kv_quant": "int8", "weight_quant": "none",
+                "spec_decode": True, "worker_role": "mixed"}
+
+    def headroom(self):
+        snap = self.router_snapshot()
+        return {k: snap[k] for k in ("slots_free", "kv_pages_free",
+                                     "queue_depth", "draining")}
+
+
+def test_validator_healthz_per_replica_headroom_and_fleet_snapshot():
+    from tensorlink_tpu.ml.validator import HostedJob
+
+    v = _bare_validator()
+    job = HostedJob(name="m", status="ready")
+    b0 = _ModesBatcher(_view(slots_free=3, kv_pages_free=17))
+    b1 = _ModesBatcher(_view(slots_free=1, kv_pages_free=5))
+    job.batcher = b0
+    job.replicas = [
+        {"rid": "r0", "model": None, "batcher": b0, "job_id": "j0"},
+        {"rid": "r1", "model": None, "batcher": b1, "job_id": "j1"},
+    ]
+    job.router = FleetRouter(refresh_s=0.0)
+    job.router.register("r0", b0)
+    job.router.register("r1", b1)
+    v.hosted["m"] = job
+    hz = v.health_snapshot()
+    # the satellite's fields: per-replica kv_pages_free / slots_free /
+    # per-class queue_depth, cheap enough for an external LB
+    hr = hz["headroom"]["m"]
+    assert hr["r0"]["slots_free"] == 3 and hr["r0"]["kv_pages_free"] == 17
+    assert hr["r1"]["slots_free"] == 1 and hr["r1"]["kv_pages_free"] == 5
+    assert set(hr["r0"]["queue_depth"]) == {
+        "interactive", "batch", "best_effort"
+    }
+    assert hz["serving_modes"]["m"]["kv_quant"] == "int8"
+    # the /fleet view names both replicas with routed counts
+    fs = v.fleet_snapshot()
+    assert fs["m"]["replicas"] == 2
+    assert set(fs["m"]["router"]["replicas"]) == {"r0", "r1"}
+    # single-replica models keep the pre-fleet /healthz shape plus an
+    # r0 headroom entry (replicas list empty = legacy-hosted)
+    job2 = HostedJob(name="solo", status="ready")
+    job2.batcher = b0
+    v.hosted["solo"] = job2
+    hz2 = v.health_snapshot()
+    assert list(hz2["headroom"]["solo"]) == ["r0"]
+    assert "solo" not in v.fleet_snapshot()
+
+
+def test_validator_healthz_survives_dead_replica():
+    """Regression: one replica whose engine died (headroom raises) must
+    not 500 the whole node's probe — it reports unroutable, siblings
+    report normally."""
+    from tensorlink_tpu.ml.validator import HostedJob
+
+    class _DeadBatcher(_ModesBatcher):
+        def headroom(self):
+            raise RuntimeError("local engine is closed")
+
+    v = _bare_validator()
+    job = HostedJob(name="m", status="ready")
+    ok_b = _ModesBatcher(_view(slots_free=2))
+    job.batcher = ok_b
+    job.replicas = [
+        {"rid": "r0", "model": None, "batcher": ok_b, "job_id": "j0"},
+        {"rid": "r1", "model": None, "batcher": _DeadBatcher(_view()),
+         "job_id": "j1"},
+    ]
+    v.hosted["m"] = job
+    hz = v.health_snapshot()
+    assert hz["status"] == "ok"
+    hr = hz["headroom"]["m"]
+    assert hr["r0"]["slots_free"] == 2
+    assert hr["r1"]["dead"] is True and hr["r1"]["draining"] is True
+
+
+# ---------------------------------------------------------------------------
+# headroom (the /healthz satellite's batcher-level fields)
+# ---------------------------------------------------------------------------
+def test_headroom_fields_shape():
+    from tensorlink_tpu.ml.batching import GenBatcher
+
+    class _NoModel:
+        pass
+
+    gb = GenBatcher(_NoModel(), [], max_batch=4)
+    try:
+        hr = gb.headroom()
+        assert set(hr) == {
+            "slots_free", "kv_pages_free", "queue_depth", "draining"
+        }
+        assert hr["slots_free"] == 4 and hr["draining"] is False
+        assert set(hr["queue_depth"]) == {
+            "interactive", "batch", "best_effort"
+        }
+    finally:
+        gb.close(timeout=5.0)
+
+
+@pytest.mark.slow
+def test_engine_router_snapshot_headroom_live(tiny_engine):
+    """The engine-level view carries real headroom + digest and flips
+    the drain flag with the fence."""
+    ce = ContinuousEngine(tiny_engine, max_slots=4, page_size=8,
+                          chunk_steps=4)
+    try:
+        snap = ce.router_snapshot()
+        assert snap["slots_free"] == 4 and snap["kv_pages_free"] > 0
+        assert snap["draining"] is False
+        r = ce.submit([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new_tokens=4, seed=0)
+        ce.run_until_idle()
+        assert r.finished
+        snap2 = ce.router_snapshot()
+        assert snap2["slots_free"] == 4  # evicted at completion
+        assert snap2["prefix_digest"]["chains"]  # promoted + refreshed
+        ce.begin_drain()
+        assert ce.router_snapshot()["draining"] is True
+        ce.end_drain()
+    finally:
+        ce.close()
