@@ -91,6 +91,7 @@ from .spec import SpecController
 from .scheduler import (
     DEFAULT_PRIORITY,
     PRIORITY_CLASSES,
+    PRIORITY_RANK,
     RequestScheduler,
     SchedulerOverloaded,
     normalize_priority,
@@ -239,6 +240,13 @@ _ENGINE_COUNTERS = (
     # higher-ranked candidate on the shared page pool
     ("preempted_cross_tenant", "tlink_engine_preempted_cross_tenant_total",
      "slots preempted for another tenant's higher-ranked candidate"),
+    # serve-and-train (docs/TRAINING.md "Serve-and-train"): live weight
+    # publishes hot-swapped at the chunk boundary, and background train
+    # steps executed between this engine's serving chunks
+    ("weights_published", "tlink_engine_weights_published_total",
+     "weight versions hot-swapped into the serving engine"),
+    ("train_steps", "tlink_engine_train_steps_total",
+     "background train steps run between serving chunks"),
 )
 
 
@@ -300,6 +308,12 @@ class ContinuousRequest:
     # the engine skips every span-recording call for this request)
     trace_id: str = ""
     prefill_done_t: float = 0.0  # when the slot left the prefilling set
+    # -- live weight publish (docs/TRAINING.md "Serve-and-train") --------
+    # the engine weights version this request was ADMITTED under: its
+    # prefill-written pages may promote into the prefix cache only while
+    # this still equals the engine's version — KV computed under older
+    # weights must never become a cache hit for a post-publish admission
+    weights_version: int = 0
     # -- speculative decoding (engine/spec.py, docs/SERVING.md) ----------
     # the request opted in ({"speculative": true}); only effective on an
     # engine with MLConfig.spec_decode enabled
@@ -508,6 +522,29 @@ class ContinuousEngine:
             "tlink_engine_spec_decode",
             "1 when speculative decoding is enabled on this engine",
             fn=lambda: int(self.spec_decode),
+        )
+        # -- live weight publish / serve-and-train (docs/TRAINING.md) ----
+        # the model version this engine serves: starts at 1 (the loaded
+        # checkpoint) and bumps on every publish_weights — the fleet
+        # router reads it off /healthz//metrics to see which replicas
+        # have picked a new version up
+        self.weights_version = 1
+        self._train_step_ms = 0.0  # last background train step (gauge)
+        self._train_mfu = 0.0
+        self.metrics.gauge(
+            "tlink_engine_weights_version",
+            "model weights version this engine serves (bumps per publish)",
+            fn=lambda: self.weights_version,
+        )
+        self.metrics.gauge(
+            "tlink_engine_train_step_ms",
+            "last background train step wall time (ms)",
+            fn=lambda: self._train_step_ms,
+        )
+        self.metrics.gauge(
+            "tlink_engine_train_mfu",
+            "model FLOPs utilization of the last background train step",
+            fn=lambda: self._train_mfu,
         )
         if pool is not None:
             # per-tenant pool occupancy: these render under the model's
@@ -1027,6 +1064,12 @@ class ContinuousEngine:
         # across all prior submissions) and the context histogram covers
         # the WHOLE chain — exactly the uninterrupted run's state here
         self._arm_slot(req, slot, ctx=seq)
+        # the adopted KV was computed under the SOURCE's weights: stamp
+        # THAT version (overriding _arm_slot's local stamp) so the
+        # promotion gate refuses these pages unless the source version
+        # still equals this engine's at teardown — a mid-publish
+        # migration can never seed the trie with old-weights KV
+        req.weights_version = int(ticket.get("weights_version", 0))
         self._tok[slot] = int(ticket["last_tok"])
         self._active[slot] = True
         del self._migrations[req.adopt]
@@ -1064,6 +1107,11 @@ class ContinuousEngine:
         passes its full chain instead."""
         self._seeds[slot] = req.seed
         self._steps[slot] = req.start_step + len(req.tokens)
+        # stamp the weights version this admission prefills under: the
+        # promotion path refuses pages from any OLDER version (a publish
+        # between admission and eviction must not seed the trie with KV
+        # the current weights would not have computed)
+        req.weights_version = self.weights_version
         self._set_knob_mirrors(slot, req.sampling)
         if ctx is None:
             ctx = req.prefill_tokens or req.prompt
@@ -1192,14 +1240,21 @@ class ContinuousEngine:
         n_hit = len(req.shared_nodes)
         node = req.shared_nodes[-1] if req.shared_nodes else None
         free_list: list[int] = []
-        promoting = req.error is None
+        # version gate: KV prefilled under an older weights version must
+        # never enter the (version-fenced) trie — see publish_weights
+        promoting = (
+            req.error is None
+            and req.weights_version == self.weights_version
+        )
         for j, pid in enumerate(req.pages):
             hi = (n_hit + j + 1) * page
             if promoting and hi <= lim:
                 block = tuple(
                     int(t) for t in req.prefill_tokens[hi - page : hi]
                 )
-                node, adopted = self.prefix.insert(node, block, pid)
+                node, adopted = self.prefix.insert(
+                    node, block, pid, freed=free_list
+                )
                 if not adopted:
                     # an identical chain landed first (e.g. a co-batched
                     # twin finished earlier): keep theirs, free ours
@@ -1293,6 +1348,12 @@ class ContinuousEngine:
             # dtype alone can NOT tell them apart; kv_quant in the triple
             # is what makes an int4<->int8 drain refuse loudly
             "dtype": str(np.dtype(self.cache.k.dtype)),
+            # the model weights version this slot's KV was computed under
+            # (docs/TRAINING.md): the destination stamps the adopted
+            # request with IT, not with its own version, so mid-publish
+            # migrations can never promote old-weights KV into a
+            # newer-version trie
+            "weights_version": int(req.weights_version),
             "k": np.stack(payload["k"]) if ship else np.zeros(0, np.int8),
             "v": np.stack(payload["v"]) if ship else np.zeros(0, np.int8),
         }
@@ -1397,6 +1458,127 @@ class ContinuousEngine:
         self.drain_state = "serving"
         with self._lock:
             self.sched.set_draining(False)
+
+    # -- live weight publish / serve-and-train (docs/TRAINING.md) --------
+    def publish_weights(self, params, *, version: int | None = None) -> int:
+        """Hot-swap the serving weights at the chunk boundary. DRIVER-
+        THREAD ONLY (ContinuousBatcher.publish_weights routes here via
+        run_on_driver; a background trainer is already on the driver).
+
+        The published tree must match the serving tree leaf-for-leaf
+        (structure, shapes, dtypes): params are DATA to the compiled
+        ragged step, so a conforming publish adds ZERO compiled programs
+        to the serving hot path (test-pinned) — anything else is refused
+        loudly before the swap. Weight-only-quantized engines quantize
+        the published tree through the same path the original load took.
+
+        Contract (docs/TRAINING.md "Hot-swap contract"): live streams
+        continue without a dropped token — their already-written KV is
+        NOT recomputed, so tokens after the swap mix old-weight KV with
+        new-weight QKV (the standard live-fine-tune approximation);
+        admissions from here on prefill under the new weights. The
+        prefix cache is version-fenced: chains cached under older
+        versions stop matching immediately, their unreferenced pages are
+        evicted now, and in-flight requests admitted under an older
+        version never promote their pages (the bitwise cache contract
+        survives every publish). Returns the new version."""
+        new_version = (
+            int(version) if version is not None else self.weights_version + 1
+        )
+        if new_version <= self.weights_version:
+            raise ValueError(
+                f"weights version must grow: {new_version} <= "
+                f"{self.weights_version}"
+            )
+        eng = self.engine
+        params_in = params
+        if getattr(eng, "quant", None):
+            from ..models.quant import quantize_params
+
+            params_in = quantize_params(params_in)
+        old = eng.params
+        try:
+            match = jax.tree.all(jax.tree.map(
+                lambda a, b: tuple(jnp.shape(a)) == tuple(jnp.shape(b))
+                and getattr(a, "dtype", None) == getattr(b, "dtype", None),
+                old, params_in,
+            ))
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"published params tree does not match the serving tree: {e}"
+            ) from e
+        if not match:
+            raise ValueError(
+                "published params leaf shapes/dtypes do not match the "
+                "serving model — a publish must never recompile the step"
+            )
+
+        # Placement normalization — the other half of "zero new compiled
+        # programs": a leaf whose device COMMITMENT differs from the
+        # serving tree's changes the step's jit cache key (measured), so
+        # every entry point (batcher staging, the serve-train loop's
+        # driver-side publish, fleet actions on sibling replicas) funnels
+        # through this one fix-up. Committed serving leaves get the new
+        # leaf device_put onto their own sharding; uncommitted serving
+        # leaves keep the new leaf as-is unless IT arrived committed —
+        # then it bounces through the host once (rare: only explicitly
+        # device_put trees published into an uncommitted engine).
+        def _place(x, c):
+            c_committed = getattr(c, "_committed", False)
+            x_committed = getattr(x, "_committed", False)
+            if c_committed and getattr(c, "sharding", None) is not None:
+                if x_committed and x.sharding == c.sharding:
+                    return x
+                return jax.device_put(x, c.sharding)
+            if x_committed:
+                return jnp.asarray(np.asarray(x))
+            return x
+
+        try:
+            params_in = jax.tree.map(_place, params_in, old)
+        # tlint: disable=TL005(leaves that aren't arrays — exotic QTensor layouts — can't be re-placed; structure was validated above, so swapping the tree as given is the correct degradation)
+        except (ValueError, TypeError):
+            pass
+        eng.params = params_in
+        self.weights_version = new_version
+        if self.prefix is not None:
+            # version-fence the trie: future inserts tag the new version,
+            # stale chains stop matching, and whatever is unreferenced
+            # frees right now (referenced pages free as their slots do)
+            self.prefix.weights_version = new_version
+            self.alloc.free(self.prefix.drop_all())
+            self._refresh_prefix_digest()
+        self._count("weights_published")
+        return new_version
+
+    def note_train_step(self, step_ms: float, mfu: float = 0.0) -> None:
+        """Record one background train step's telemetry (driver-thread
+        only — the serve-and-train loop runs between this engine's
+        chunks): rides serving_snapshot → /stats and the registry gauges
+        → /metrics."""
+        self._train_step_ms = float(step_ms)
+        self._train_mfu = float(mfu)
+        self._count("train_steps")
+
+    def foreground_work(self, above: str = "best_effort") -> bool:
+        """True when any live or queued request outranks ``above``
+        (scheduler rank order: LOWER rank = higher class) — the
+        background trainer's yield gate: train steps run at chunk
+        granularity only while the engine serves nothing above the
+        best_effort class, so an interactive arrival waits at most ONE
+        train step (the chunk-boundary control the scheduler already
+        gives preemption). Thread-safe."""
+        bar = PRIORITY_RANK[normalize_priority(above)]
+        with self._lock:
+            if any(
+                PRIORITY_RANK.get(r.priority, bar) < bar
+                for r in self.sched.pending()
+            ):
+                return True
+        for req in self._slots:
+            if req is not None and PRIORITY_RANK.get(req.priority, bar) < bar:
+                return True
+        return False
 
     def frozen_slots(self) -> list[int]:
         return sorted(self._frozen)
@@ -1601,6 +1783,10 @@ class ContinuousEngine:
             "length": length,
             "last_tok": int(blob["last_tok"]),
             "prefill_target": int(blob["prefill_target"]),
+            # the SOURCE's weights version for the adopted request's
+            # promotion gate; legacy blobs carry none → 0, which never
+            # equals a live version, so their pages simply never promote
+            "weights_version": int(blob.get("weights_version", 0)),
             "t": time.monotonic(),
         }
         tid = str(blob.get("trace") or "")
@@ -1765,6 +1951,13 @@ class ContinuousEngine:
             # per-class sched_classes depths below, the placement inputs
             # a router/LB needs without a second probe
             "slots_free": sum(1 for r in self._slots if r is None),
+            # serve-and-train (docs/TRAINING.md): which model version
+            # this engine serves (bumps per weight publish — the fleet
+            # view of a rolling model update), plus the background
+            # trainer's last step telemetry (0.0 until one runs)
+            "weights_version": self.weights_version,
+            "train_step_ms": round(self._train_step_ms, 3),
+            "train_mfu": round(self._train_mfu, 5),
         })
         if self.pool is not None:
             # co-hosting: the shared pool's occupancy plus THIS tenant's

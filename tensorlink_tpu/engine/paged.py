@@ -512,7 +512,7 @@ class _TrieNode:
 
     __slots__ = (
         "block", "page", "parent", "children", "refs", "tick",
-        "depth", "key_hash",
+        "depth", "key_hash", "weights_version",
     )
 
     def __init__(self, block: tuple, page: int, parent: "_TrieNode | None"):
@@ -522,6 +522,10 @@ class _TrieNode:
         self.children: dict[tuple, _TrieNode] = {}
         self.refs = 0  # slots currently mapping this page
         self.tick = 0  # LRU recency (monotonic engine counter)
+        # the model weights version this page's KV was computed under
+        # (PrefixCache.insert stamps it): the match fence for live weight
+        # publishes — see ContinuousEngine.publish_weights
+        self.weights_version = 1
         # chain identity for the fleet digest: pages-from-root count and
         # the rolling chain hash (root carries depth 0 / hash "")
         if parent is None:
@@ -558,6 +562,14 @@ class PrefixCache:
         # bumped on every membership change (insert/evict) so the engine
         # can skip rebuilding the fleet digest when nothing moved
         self.version = 0
+        # the CURRENT model weights version (the engine bumps it on every
+        # live weight publish, docs/TRAINING.md): inserts stamp it onto
+        # their nodes, and match() refuses chains stamped with any other
+        # version — cached KV from older weights can never become a hit,
+        # which is what keeps the bitwise cache contract true across a
+        # hot-swap. Stale refcount-0 chains are evicted at publish time;
+        # still-referenced ones free as their slots do.
+        self.weights_version = 1
         self.stats = {
             "lookups": 0,
             "hits": 0,
@@ -588,7 +600,11 @@ class PrefixCache:
         so a stale or colliding digest can only misplace a request, not
         corrupt a stream."""
         nodes = sorted(
-            self._by_page.values(), key=lambda n: n.tick, reverse=True,
+            (
+                n for n in self._by_page.values()
+                if n.weights_version == self.weights_version
+            ),
+            key=lambda n: n.tick, reverse=True,
         )[: max(int(max_chains), 0)]
         return {
             "page_size": self.page_size,
@@ -618,7 +634,9 @@ class PrefixCache:
         out: list[_TrieNode] = []
         for block in self._blocks(tokens, limit):
             child = node.children.get(block)
-            if child is None:
+            if child is None or child.weights_version != self.weights_version:
+                # a version mismatch fences the WHOLE chain below: its KV
+                # was computed under different weights (publish_weights)
                 break
             out.append(child)
             self._touch(child)  # a hit IS a use: refresh LRU recency
@@ -641,6 +659,10 @@ class PrefixCache:
             return None
         best: tuple[_TrieNode, int] | None = None
         for block, child in parent.children.items():
+            if child.weights_version != self.weights_version:
+                # stale-version KV (live weight publish) must not seed a
+                # COW copy any more than it may full-page match
+                continue
             n = 0
             for a, b in zip(want, block):
                 if a != b:
@@ -664,19 +686,41 @@ class PrefixCache:
 
     # -- insert / evict --------------------------------------------------
     def insert(
-        self, parent: "_TrieNode | None", block: tuple, page: int
+        self, parent: "_TrieNode | None", block: tuple, page: int,
+        freed: "list[int] | None" = None,
     ) -> tuple[_TrieNode, bool]:
         """Adopt ``page`` as the cached KV of ``block`` under ``parent``
         (None = root). Returns ``(node, adopted)`` — ``adopted=False``
         means an identical chain is already resident: the caller keeps
         ownership of ``page`` (frees it) and continues the walk from the
-        existing node."""
+        existing node.
+
+        A STALE-version unreferenced leaf shadowing this block (its KV
+        predates a weight publish, so it can never match again) is
+        evicted in place and the fresh page adopted — its page id lands
+        in ``freed`` for the caller's allocator. A stale node that still
+        has refs or children stays (its readers are mid-stream); the
+        fresh page is declined and the chain re-caches once they drain."""
         parent = parent or self.root
         existing = parent.children.get(block)
+        if (
+            existing is not None
+            and existing.weights_version != self.weights_version
+            and existing.refs == 0
+            and not existing.children
+        ):
+            del parent.children[block]
+            del self._by_page[existing.page]
+            self.stats["evictions"] += 1
+            self.version += 1
+            if freed is not None:
+                freed.append(existing.page)
+            existing = None
         if existing is not None:
             self._touch(existing)
             return existing, False
         node = _TrieNode(block, int(page), parent)
+        node.weights_version = self.weights_version
         parent.children[block] = node
         self._by_page[int(page)] = node
         self._touch(node)

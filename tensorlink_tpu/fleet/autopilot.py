@@ -19,6 +19,11 @@ construction:
   the actions layer to grow/shrink the decode pool when decode-role
   headroom crosses the water marks (the validator's actions implement
   it with the PR 13 handoff-pool push; a harness may decline).
+- **fleet weight publish** (``request_publish``, docs/TRAINING.md): a
+  serve-and-train loop's new weight version propagates to sibling
+  replicas ONE per tick — each picks it up at its own chunk boundary
+  with zero dropped streams and zero new compiled programs; remote
+  actions decline (their replicas take the rolling-deploy path).
 
 Safety rails: the autopilot never acts with fewer than
 ``min_replicas_for_action`` healthy replicas, never deploys two replicas
@@ -365,6 +370,21 @@ class EngineFleetActions:
             )
         return self._rebuild(rid)
 
+    def publish_weights(self, rid: str, params, version: int) -> bool:
+        """Hot-swap ``params`` into one replica's live engine at its next
+        chunk boundary (docs/TRAINING.md "Serve-and-train") — the fleet
+        propagation leg of a live weight publish. Returns True on
+        success; already-at-version replicas are a no-op success (the
+        version check makes re-publishes idempotent)."""
+
+        def do(eng, _p=params, _v=int(version)):
+            if int(getattr(eng, "weights_version", 0)) >= _v:
+                return eng.weights_version  # already there — idempotent
+            return eng.publish_weights(_p, version=_v)
+
+        self._exec(rid, do)
+        return True
+
     def scale_decode(self, up: bool) -> bool:
         """Decode-pool scaling is a validator-level verb (the PR 13
         handoff-pool push); an engine-level harness has no pool to
@@ -407,7 +427,9 @@ class FleetAutopilot:
                 "tlink_autopilot_actions_total",
                 "autopilot actions executed", kind=kind,
             )
-            for kind in ("rebalance", "deploy", "scale_up", "scale_down")
+            for kind in (
+                "rebalance", "deploy", "scale_up", "scale_down", "publish",
+            )
         }
         self._m_moved = self.metrics.counter(
             "tlink_autopilot_streams_moved_total",
@@ -416,6 +438,9 @@ class FleetAutopilot:
         self._lock = threading.Lock()
         self._deploy_queue: deque[str] = deque()  #: guarded by self._lock
         self._deploying: dict | None = None  #: guarded by self._lock
+        # in-flight fleet-wide weight publish (docs/TRAINING.md):
+        # {"version", "params", "pending", "published", "failed", "ticks"}
+        self._publish: dict | None = None  #: guarded by self._lock
         self.history: deque[dict] = deque(maxlen=100)  #: guarded by self._lock
         self._last_action_t = 0.0
         self._thread: threading.Thread | None = None
@@ -461,14 +486,39 @@ class FleetAutopilot:
                     self._deploy_queue.append(r)
         return targets
 
+    def request_publish(self, params, version: int) -> list[str]:
+        """Queue a fleet-wide live weight publish: every replica picks
+        ``version`` up at its own chunk boundary, ONE replica per tick
+        (the deploy ladder's replica-by-replica temperament, though a
+        publish never drains anything — streams keep flowing on every
+        replica throughout). Draining/unhealthy replicas stay pending
+        until they return; a newer request_publish supersedes an
+        unfinished one (latest version wins). Typically wired as
+        ``ServeTrainLoop.on_publish``. Returns the target replica ids."""
+        targets = [str(r) for r in self.router.replica_ids()]
+        with self._lock:
+            self._publish = {
+                "version": int(version), "params": params,
+                "pending": list(targets), "published": [],
+                "failed": {}, "ticks": 0,
+            }
+        return targets
+
     def status(self) -> dict:
         with self._lock:
+            pub = self._publish
             return {
                 "running": self._thread is not None,
                 "dry_run": self.dry_run,
                 "deploy_queue": list(self._deploy_queue),
                 "deploying": (
                     dict(self._deploying) if self._deploying else None
+                ),
+                "publishing": (
+                    # params deliberately excluded — status is a wire/API
+                    # payload (/fleet), not a tensor transport
+                    {k: v for k, v in pub.items() if k != "params"}
+                    if pub else None
                 ),
                 "history": list(self.history),
                 "streams_moved": int(self._m_moved.value),
@@ -516,6 +566,12 @@ class FleetAutopilot:
                     error=f"{type(e).__name__}: {e}"[:200],
                 )
 
+        # weight publish first: non-structural (no drain, no rebuild —
+        # replicas keep serving through it), so it proceeds even while a
+        # deploy holds the one-structural-action rail
+        rec = safe(self._publish_step, views)
+        if rec:
+            out.append(rec)
         with self._lock:
             deploying = self._deploying
         if deploying is not None:
@@ -537,6 +593,77 @@ class FleetAutopilot:
         if rec:
             out.append(rec)
         return out
+
+    # a publish whose remaining replicas never become eligible (stuck
+    # draining, dead-but-registered) must finish with those marked
+    # failed instead of pinning the queue forever
+    MAX_PUBLISH_TICKS = 120
+
+    def _publish_step(self, views: dict) -> dict | None:
+        """Push the queued weight version to ONE eligible replica (see
+        request_publish). Never raises past safe(): a replica dying
+        under the publish lands in ``failed`` and the ladder moves on —
+        it can pick the version up on rejoin via a fresh request."""
+        finish: tuple | None = None
+        with self._lock:
+            pub = self._publish
+            if pub is None:
+                return None
+            # replicas that left the fleet have nothing to pick up
+            pub["pending"] = [r for r in pub["pending"] if r in views]
+            eligible = self._eligible(views)
+            target = next(
+                (r for r in pub["pending"] if r in eligible), None
+            )
+            if not pub["pending"]:
+                self._publish = None
+                finish = ("publish_done", pub)
+            elif target is None:
+                pub["ticks"] += 1
+                if pub["ticks"] <= self.MAX_PUBLISH_TICKS:
+                    return None  # all pending are draining/dead — retry
+                pub["failed"].update({
+                    r: "never became eligible" for r in pub["pending"]
+                })
+                self._publish = None
+                finish = ("publish_aborted", pub)
+            else:
+                version, params = pub["version"], pub["params"]
+        if finish is not None:
+            # recorded OUTSIDE the lock — _record takes it too
+            kind, pub = finish
+            return self._record(
+                kind, version=pub["version"],
+                published=list(pub["published"]),
+                failed=dict(pub["failed"]),
+            )
+        if self.dry_run:
+            with self._lock:
+                pub["pending"].remove(target)
+                pub["published"].append(target)
+            return self._record(
+                "publish", rid=target, version=version, dry_run=True,
+            )
+        err = None
+        try:
+            ok = self.actions.publish_weights(target, params, version)
+            if not ok:
+                err = "declined (remote replica — deploy path)"
+        except Exception as e:  # noqa: BLE001 — per-replica containment
+            err = f"{type(e).__name__}: {e}"[:200]
+        with self._lock:
+            if target in pub["pending"]:
+                pub["pending"].remove(target)
+            if err is None:
+                pub["published"].append(target)
+            else:
+                pub["failed"][target] = err
+        if err is None:
+            self._m_actions["publish"].inc()
+        return self._record(
+            "publish", rid=target, version=version,
+            **({"error": err} if err else {}),
+        )
 
     def _cooldown_open(self) -> bool:
         return (

@@ -789,6 +789,12 @@ class ContinuousBatcher:
         # (fn, box) pairs the dispatcher executes against the local
         # engine between chunks — deque append/popleft are atomic
         self._ctl: deque = deque()
+        # background work hook (docs/TRAINING.md "Serve-and-train"): a
+        # callable the DRIVER runs once per loop iteration, between
+        # serving chunks — returns True when it did work (keeps the loop
+        # hot). The serve-and-train loop attaches its train tick here;
+        # gating (yield to interactive/batch) lives in the tick itself.
+        self._bg: Callable[[], bool] | None = None
         self._cont = None
         self._sess = None
         if engine is not None:
@@ -869,6 +875,13 @@ class ContinuousBatcher:
                 "worker_role": str(
                     getattr(self._cont, "worker_role", "mixed")
                 ),
+                # serve-and-train (docs/TRAINING.md): the model version
+                # this replica serves — bumps on every live weight
+                # publish, so a router can see which replicas picked a
+                # rolling model update up
+                "weights_version": int(
+                    getattr(self._cont, "weights_version", 1)
+                ),
             }
             if self._cont.pool is not None:
                 # co-hosting view: a router sizing placement needs the
@@ -885,8 +898,16 @@ class ContinuousBatcher:
         # handoff it comes from whichever pool answered last (usually
         # the decode worker), and a prefill entry replica flapping to
         # "decode" on /healthz is exactly the misclassification the
-        # role plumbing exists to prevent.
-        return dict(self._modes)
+        # role plumbing exists to prevent. weights_version is the one
+        # genuinely DYNAMIC field: read it from the last snapshot (1
+        # until traffic produces one — remote publishes ride deploys).
+        modes = dict(self._modes)
+        snap = getattr(self.model, "cont_serving_stats", None)
+        modes["weights_version"] = int(
+            (snap or {}).get("weights_version", 1)
+            if isinstance(snap, dict) else 1
+        )
+        return modes
 
     def router_snapshot(self) -> dict:
         """Fleet-router scoring view (docs/SERVING.md "Fleet serving"):
@@ -951,6 +972,60 @@ class ContinuousBatcher:
         """The /healthz per-replica headroom fields — cheap, no ML
         round trip (the same contract as health_snapshot)."""
         return _headroom_from(self.router_snapshot())
+
+    def set_background(self, fn: "Callable[[], bool] | None") -> None:
+        """Attach (or clear) the driver's background hook — local mode
+        only. The hook runs on the DISPATCHER thread after each serving
+        chunk (and while idle), so anything it touches on the engine
+        honors single-driver discipline for free; an exception detaches
+        it loudly rather than killing the serving loop."""
+        if fn is not None and (self._cont is None or self._thread is None):
+            raise RuntimeError("background work requires a local engine")
+        self._bg = fn
+        self._wake.set()
+
+    def publish_weights(
+        self, params, *, version: int | None = None, timeout: float = 120.0,
+    ) -> int:
+        """Double-buffered live weight publish (docs/TRAINING.md): stage
+        the new tree on device HERE (old weights keep serving while the
+        transfer runs), then hot-swap it at a chunk boundary on the
+        driver thread. Local mode only — remote replicas pick new
+        weights up through the rolling-deploy path."""
+        if self._cont is None:
+            raise RuntimeError(
+                "weight publish requires a local engine — remote replicas "
+                "take the fleet rolling-deploy path (docs/SERVING.md)"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        cur = getattr(self._cont.engine, "params", None)
+        try:
+            # stage onto the serving tree's own placements — but ONLY
+            # where the current leaf is explicitly committed (sharded /
+            # multi-device engines): committing a tree the engine holds
+            # UNCOMMITTED would change the step's jit cache key and
+            # recompile it, exactly what a publish must never do
+            # (measured; _committed is the array's placement flag)
+            staged = jax.tree.map(
+                lambda x, c: jax.device_put(x, c.sharding)
+                if getattr(c, "_committed", False)
+                and getattr(c, "sharding", None) is not None
+                else jnp.asarray(x),
+                params, cur,
+            )
+        except (ValueError, TypeError):
+            # weight-quantized engines hold a QTensor tree — the engine
+            # quantizes the published raw tree itself; stage it plainly
+            staged = jax.tree.map(jnp.asarray, params)
+        jax.block_until_ready(staged)
+        if self._thread is None or not self._thread.is_alive():
+            raise RuntimeError("engine driver is not running")
+        return self.run_on_driver(
+            lambda e: e.publish_weights(staged, version=version),
+            timeout=timeout,
+        )
 
     def run_on_driver(self, fn, timeout: float = 60.0):
         """Execute ``fn(engine)`` on the dispatcher thread between
@@ -1221,6 +1296,23 @@ class ContinuousBatcher:
                         with self._stats_lock:
                             self.live_samples.append(cont.live_slots)
                         cont.step_chunk()
+                    bg = self._bg
+                    if bg is not None:
+                        # background work (serve-and-train ticks) runs at
+                        # chunk granularity on THIS thread — between
+                        # serving chunks, never under one. A tick that
+                        # raises detaches itself; serving never dies for
+                        # a training bug.
+                        try:
+                            if bg():
+                                busy = True
+                        except BaseException:  # noqa: BLE001 — detach loudly
+                            from tensorlink_tpu.core.logging import get_logger
+
+                            get_logger("ml.batching").exception(
+                                "background task failed — detaching it"
+                            )
+                            self._bg = None
                 else:
                     for req in self._drain_queue(1 << 30):
                         sess.submit(req)

@@ -649,6 +649,7 @@ class DistributedValidator:
                 modes[name] = {
                     "kv_quant": "none", "weight_quant": "none",
                     "spec_decode": False, "worker_role": "mixed",
+                    "weights_version": 1,
                 }
             # per-replica headroom (kv_pages_free, slots_free, per-class
             # queue depth): enough for an EXTERNAL load balancer to
@@ -1110,6 +1111,11 @@ class ValidatorFleetActions:
     - ``scale_decode``: re-push the handoff pool (PR 13) to every
       replica's entry worker with one more / one fewer decode-role
       worker.
+    - ``publish_weights``: declined (returns False). A live weight
+      hot-swap needs the engine in-process (docs/TRAINING.md); a remote
+      replica picks a new model version up through the rolling-deploy
+      path (rehost reloads the checkpoint), which the autopilot records
+      per replica so the operator sees exactly who is on what.
     """
 
     def __init__(self, validator: DistributedValidator, job: HostedJob):
@@ -1241,6 +1247,15 @@ class ValidatorFleetActions:
             self.log.exception("old replica %s teardown failed", rid)
         self.validator._push_replica_sets(self.job)
         return batcher
+
+    def publish_weights(self, rid: str, params, version: int) -> bool:
+        """Declined — see the class docstring: remote replicas take the
+        rolling-deploy path for model updates."""
+        self.log.info(
+            "fleet weight publish v%s declined for remote replica %s "
+            "(rolling-deploy path)", version, rid,
+        )
+        return False
 
     def scale_decode(self, up: bool) -> bool:
         if not self._job_live():

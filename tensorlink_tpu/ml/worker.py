@@ -1312,6 +1312,7 @@ class DistributedWorker:
             name = spec.pop("name", "adamw")
             rt.opt = make_optimizer(name, **spec)
             rt.opt_state = rt.opt.init(rt.params)
+            self._maybe_shard_opt_state(rt)
             body = {"ok": True, "op": op}
         elif op == "zero":
             rt.grad_accum = None
@@ -1348,6 +1349,14 @@ class DistributedWorker:
                 rt.grad_accum, rt.opt_state, rt.params
             )
             rt.params = optax.apply_updates(rt.params, updates)
+            if self._zero1_dp(rt) > 1:
+                # sharded updates make `p + u` inherit the data-sharded
+                # layout — put params back in their stage specs
+                # (replicated over data) so the forward programs' input
+                # layout never drifts across optimizer steps
+                rt.params = self._shard_params(
+                    rt.params, rt.cfg, rt.stage, rt.mesh
+                )
             if rt.engine is not None:
                 rt.engine.params = rt.params
             gnorm = float(self._to_host(rt, optax.global_norm(rt.grad_accum)))
@@ -1358,6 +1367,48 @@ class DistributedWorker:
         else:
             raise ValueError(f"unknown optimizer op {op!r}")
         self._respond(p["peer"], proto.OPTIMIZER_RESP, p["rid"], body)
+
+    def _zero1_dp(self, rt: StageRuntime) -> int:
+        """The stage's ZeRO-1 data-parallel degree: >1 only when the plan
+        gave this training stage a data axis (parallel/planner.py::
+        training_update_mode — the one predicate) and a real mesh backs
+        it. 0/1 means the unsharded optimizer layout."""
+        if rt.mesh is None or not rt.training:
+            return 0
+        from tensorlink_tpu.parallel.planner import training_update_mode
+
+        axes = rt.stage.get("mesh_axes") or {}
+        if training_update_mode(axes, rt.training) != "zero1":
+            return 0
+        return int(axes.get("data", 1))
+
+    def _maybe_shard_opt_state(self, rt: StageRuntime) -> None:
+        """ZeRO-1 on the RPC training path (docs/TRAINING.md): when the
+        stage mesh carries a data axis, the optimizer state is DECLARED
+        sharded 1/dp over it at init (params stay in their stage specs —
+        replicated over data), so the eager optax update runs sharded and
+        per-replica optimizer bytes drop to ~1/dp. Same locality the
+        compiled zero1 step gets, without new programs on this path."""
+        dp = self._zero1_dp(rt)
+        if dp <= 1:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+
+        from tensorlink_tpu.engine.training import optimizer_state_specs
+        from tensorlink_tpu.parallel.planner import (
+            StagePlan,
+            stage_param_specs,
+        )
+
+        pspecs = stage_param_specs(rt.cfg, StagePlan(**rt.stage))
+        sspecs = optimizer_state_specs(
+            rt.opt, rt.params, pspecs, dp_axis="data", dp_size=dp,
+        )
+        rt.opt_state = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(rt.mesh, s)),
+            rt.opt_state, sspecs,
+        )
 
     # -- proof of learning (platform/proofs.py; reference scaffolding
     # never wired, ml/proofs.py + job_monitor.py:193-207) -----------------

@@ -141,6 +141,20 @@ class StagePlan:
         return (self.layer_lo, self.layer_hi)
 
 
+def training_update_mode(axes: dict[str, int], training: bool) -> str:
+    """THE zero1 routing predicate (docs/TRAINING.md): a training mesh
+    with a data axis > 1 runs the ZeRO-1 train step — optimizer state
+    sharded 1/dp per replica, weight update sharded with it — and
+    anything else runs the unsharded step. One definition so the plan,
+    the worker's optimizer init, and the capacity model below can never
+    disagree about which layout a job gets."""
+    return (
+        "zero1"
+        if training and int((axes or {}).get("data", 1)) > 1
+        else "unsharded"
+    )
+
+
 @dataclass
 class ShardingPlan:
     model_name: str
@@ -150,6 +164,10 @@ class ShardingPlan:
     seq_len: int
     training: bool
     estimate: MemoryEstimate
+    # how the optimizer step runs on this plan: "zero1" (optimizer state
+    # + weight update sharded over the data axis, engine/training.py)
+    # whenever a training stage carries data > 1, else "unsharded"
+    update_mode: str = "unsharded"
 
     @property
     def n_stages(self) -> int:
@@ -174,6 +192,8 @@ class ShardingPlan:
             seq_len=d["seq_len"],
             training=d["training"],
             estimate=MemoryEstimate(**d["estimate"]),
+            # absent in pre-zero1 stored plans (DHT entries) — derive
+            update_mode=d.get("update_mode", "unsharded"),
         )
 
 
@@ -316,16 +336,21 @@ def _per_device_bytes(
     cfg: ModelConfig | None = None,
     batch: int = 1,
     exclude_model_bytes: float = 0.0,
+    training: bool = False,
 ) -> float:
     """Bytes each device must hold for (a ``frac`` layer-fraction of) the
-    estimate under ``axes``. Sharding geometry: params/grads/optimizer shard
-    over tensor×fsdp×expert×stage but REPLICATE over data (the r3 bug: a
+    estimate under ``axes``. Sharding geometry: params/grads shard over
+    tensor×fsdp×expert×stage but REPLICATE over data (the r3 bug: a
     4-device worker "fit" a model each chip could not hold — aggregate HBM
-    is only reachable for axes that actually shard the tensor). Activations
-    and KV shard over the data axis only when the batch divides it, and KV
-    over tensor only when the kv heads divide it — mirroring the worker's
-    runtime degrade rules (ml/worker.py::_cache_specs_for), which otherwise
-    REPLICATE those arrays per device."""
+    is only reachable for axes that actually shard the tensor); the
+    OPTIMIZER state additionally shards over data on zero1 training plans
+    (engine/training.py: ZeRO-1 stores it 1/dp per replica — the capacity
+    this buys is exactly why the planner picks zero1 whenever dp > 1).
+    Activations and KV shard over the data axis only when the batch
+    divides it, and KV over tensor only when the kv heads divide it —
+    mirroring the worker's runtime degrade rules
+    (ml/worker.py::_cache_specs_for), which otherwise REPLICATE those
+    arrays per device."""
 
     def ax(name: str) -> int:
         return max(int(axes.get(name, 1)), 1)
@@ -336,15 +361,19 @@ def _per_device_bytes(
     if cfg is not None and cfg.n_kv_heads % tp_kv:
         tp_kv = 1
     shard_model = ax("tensor") * ax("fsdp") * ax("expert") * ax("stage")
+    shard_opt = shard_model * (
+        dp if training_update_mode(axes, training) == "zero1" else 1
+    )
     shard_act = ax("fsdp") * dp_eff * ax("seq")
     shard_kv = dp_eff * tp_kv
-    model_bytes = max(
-        est.params + est.grads + est.optimizer - exclude_model_bytes, 0.0
+    pg_bytes = max(
+        est.params + est.grads - exclude_model_bytes, 0.0
     )
-    model = model_bytes * frac / shard_model
+    model = pg_bytes * frac / shard_model
+    opt = est.optimizer * frac / shard_opt
     act = est.activations * frac / shard_act
     kv = est.kv_cache * frac / shard_kv
-    return (model + act + kv) * 1.1
+    return (model + opt + act + kv) * 1.1
 
 
 def _merge_co_slice(
@@ -432,7 +461,9 @@ def plan_sharding(
             mesh_hints=mesh_hints,
         )
         per_dev_hbm = best.hbm_bytes / max(best.n_devices, 1)
-        if _per_device_bytes(est, axes, cfg=cfg, batch=batch) <= per_dev_hbm:
+        if _per_device_bytes(
+            est, axes, cfg=cfg, batch=batch, training=training
+        ) <= per_dev_hbm:
             stage = StagePlan(
                 worker_id=best.node_id,
                 layer_lo=0,
@@ -446,11 +477,17 @@ def plan_sharding(
             return ShardingPlan(
                 model_name=model_name,
                 stages=[stage],
-                n_micro=n_micro or 1,
+                # zero1 needs whole micro-batches per replica: default the
+                # micro count to the dp degree (1 micro per replica, the
+                # bitwise-pinned configuration — engine/training.py)
+                n_micro=n_micro or max(
+                    axes.get("data", 1) if training else 1, 1
+                ),
                 batch=batch,
                 seq_len=seq_len,
                 training=training,
                 estimate=est,
+                update_mode=training_update_mode(axes, training),
             )
 
     # 2) pipeline split: per-layer cost + embedding/head overheads
@@ -484,6 +521,7 @@ def plan_sharding(
         per_layer_dev = _per_device_bytes(
             est, axes, frac=1.0 / max(cfg.n_layers, 1), cfg=cfg, batch=batch,
             exclude_model_bytes=2 * cfg.vocab_size * cfg.d_model * pb,
+            training=training,
         )
         fit = min(int(budget // per_layer), int(dev_budget // per_layer_dev))
         if fit <= 0:
@@ -538,6 +576,14 @@ def plan_sharding(
         seq_len=seq_len,
         training=training,
         estimate=est,
+        update_mode=(
+            "zero1"
+            if any(
+                training_update_mode(s.mesh_axes, training) == "zero1"
+                for s in stages
+            )
+            else "unsharded"
+        ),
     )
 
 

@@ -101,7 +101,20 @@ def test_bench_all_legs_cpu():
                 "migration_queue_ms", "migration_prefill_ms",
                 "migration_first_decode_ms", "migration_ttft_trace_ms",
                 "train_mfu", "train_step_s",
-                "train_mfu_best_prior", "train_mfu_regressed"):
+                "train_mfu_best_prior", "train_mfu_regressed",
+                # ZeRO-1 sharded train step: unsharded vs zero1 at a
+                # matched global batch (bitwise pin + 1/dp opt bytes)
+                "zero1_dp", "zero1_bitwise_identical", "zero1_step_ms",
+                "zero1_unsharded_step_ms", "zero1_opt_state_ratio",
+                "zero1_opt_bytes_per_replica",
+                # serve-and-train: background train steps + live weight
+                # publishes against a serving engine
+                "serve_train_steps", "serve_train_publishes",
+                "serve_train_weights_version", "serve_train_dropped",
+                "serve_train_stream_exact_len", "serve_train_itl_ms",
+                "serve_train_baseline_itl_ms", "serve_train_itl_ratio",
+                "serve_train_bg_steps_during_itl",
+                "serve_train_publish_new_programs"):
         assert key in extra, (key, extra)
     # the TTFT decomposition contract: the engine records queue_wait,
     # prefill, and first_decode CONTIGUOUSLY, so the parts sum to the
@@ -209,6 +222,24 @@ def test_bench_all_legs_cpu():
     # stay within 2x of the best comparable prior round in BENCH_r*.json
     # — training perf can't silently rot while serving work lands
     assert not extra["train_mfu_regressed"], extra
+    # ZeRO-1: the deterministic bars — the sharded step is BITWISE the
+    # unsharded step at matched global batch, and each replica resides
+    # ~1/dp of the optimizer-state bytes (scalars replicate, hence the
+    # slack); step-time parity is expected on CPU (zero1_note)
+    assert extra["zero1_bitwise_identical"] is True
+    assert extra["zero1_opt_state_ratio"] <= 1.0 / extra["zero1_dp"] + 0.05
+    # serve-and-train: a best_effort stream spanning >=1 live weight
+    # publish drops ZERO tokens and the publish compiles NOTHING; the
+    # trainer yields to interactive at chunk granularity so armed-vs-off
+    # ITL stays within noise (generous wall-clock bound), while idle
+    # gaps really do run train steps
+    assert extra["serve_train_dropped"] == 0, extra
+    assert extra["serve_train_stream_exact_len"] is True
+    assert extra["serve_train_publishes"] >= 1
+    assert extra["serve_train_weights_version"] >= 2
+    assert extra["serve_train_publish_new_programs"] == 0, extra
+    assert extra["serve_train_bg_steps_during_itl"] >= 1, extra
+    assert extra["serve_train_itl_ratio"] <= 3.0, extra
     # the scheduling overload leg's deterministic pins: interactive
     # arrivals at 2x slot capacity really did preempt lower-class slots,
     # the best_effort overflow burst really was rejected fail-fast (the

@@ -600,6 +600,58 @@ def test_microbatch_bf16_train_step():
     assert jax.tree.leaves(p)[0].dtype == jnp.bfloat16
 
 
+def test_bf16_scan_carry_stays_fp32():
+    """The r02 bf16 scan-carry bug class, pinned STRUCTURALLY (the fix
+    used to exist only as a comment in engine/training.py): trace the
+    microbatched train step under bf16 params and assert the gradient-
+    accumulation scan's carry avals are fp32 — a bf16 accumulator (e.g.
+    ``zeros_like(p)`` without the dtype override) either fails to trace
+    or silently degrades the sum, and this test catches both without
+    compiling anything."""
+    cfg = TINY.with_(dtype=jnp.bfloat16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = make_optimizer("adamw", lr=1e-3)
+    ts = make_train_step(cfg, opt, n_micro=2, remat=True, donate=False)
+    state = jax.eval_shape(opt.init, params)
+    batch = {"tokens": jnp.zeros((4, 16), jnp.int32)}
+    closed = jax.make_jaxpr(ts.step_fn)(params, state, batch)
+
+    def find_scans(jaxpr):
+        out = []
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "scan":
+                out.append(eqn)
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (list, tuple)) else (v,)
+                for item in vs:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        out.extend(find_scans(inner))
+        return out
+
+    # the accumulation scan is the one whose CARRY holds a per-param
+    # gradient accumulator (one aval per param leaf, param-shaped) plus
+    # the nll/token scalars — the per-layer forward scan's carry is just
+    # activations, so the shape test uniquely identifies it
+    pshapes = sorted(tuple(x.shape) for x in jax.tree.leaves(params))
+    accum_scans = []
+    for eqn in find_scans(closed.jaxpr):
+        nc = eqn.params["num_consts"]
+        nk = eqn.params["num_carry"]
+        carry = eqn.params["jaxpr"].in_avals[nc : nc + nk]
+        cshapes = sorted(tuple(a.shape) for a in carry)
+        if all(s in cshapes for s in set(pshapes)):
+            accum_scans.append(carry)
+    assert accum_scans, "gradient-accumulation scan not found in the jaxpr"
+    for carry in accum_scans:
+        for aval in carry:
+            if jnp.issubdtype(aval.dtype, jnp.floating):
+                assert aval.dtype == jnp.float32, (
+                    f"scan carry aval {aval} is not fp32 — the bf16 "
+                    "accumulator bug (r02) is back"
+                )
+
+
 def test_loss_mask(tiny_model):
     cfg, params = tiny_model
     toks = jnp.asarray(np.arange(32, dtype=np.int32).reshape(2, 16) % 64)

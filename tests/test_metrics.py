@@ -218,6 +218,9 @@ LEGACY_ENGINE_KEYS = (
     # multi-tenant co-hosting: slots torn down for another tenant's
     # higher-ranked candidate on a shared page pool
     "preempted_cross_tenant",
+    # serve-and-train (docs/TRAINING.md): live weight publishes +
+    # background train steps between serving chunks
+    "weights_published", "train_steps",
 )
 
 

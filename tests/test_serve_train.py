@@ -1,0 +1,489 @@
+"""Serve-and-train on one mesh (docs/TRAINING.md): live weight hot-swap
+into a serving ContinuousEngine at the chunk boundary + the background
+train loop riding the serving driver as a best_effort-class tenant.
+
+Contracts under test:
+
+- a publish swaps params ONLY when the tree matches leaf-for-leaf
+  (refused loudly otherwise), bumps a monotonic version, and adds ZERO
+  compiled programs to the serving hot path;
+- a live stream SPANNING a publish completes with zero dropped tokens,
+  and the new version is visible at /stats, /metrics, and
+  serving_modes (the /healthz body);
+- the prefix cache is version-fenced: chains cached under older weights
+  stop matching (full-page and COW) the instant a publish lands —
+  the bitwise cache contract survives every hot-swap;
+- the background trainer yields to any work above best_effort at chunk
+  granularity, counts train_steps/train_step_ms/train_mfu into the
+  engine telemetry, and publishes on its cadence;
+- the fleet autopilot propagates a published version replica-by-replica
+  (one per tick), skipping ineligible replicas and recording declines.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.core.metrics import render_prometheus
+from tensorlink_tpu.engine.continuous import ContinuousEngine
+from tensorlink_tpu.engine.generate import GenerationEngine
+from tensorlink_tpu.engine.paged import PrefixCache
+from tensorlink_tpu.engine.serve_train import ServeTrainLoop
+from tensorlink_tpu.engine.training import make_optimizer, make_train_step
+from tensorlink_tpu.fleet.autopilot import EngineFleetActions, FleetAutopilot
+from tensorlink_tpu.fleet.router import FleetRouter
+from tensorlink_tpu.ml.batching import ContinuousBatcher
+from tensorlink_tpu.models import ModelConfig, init_params
+
+CFG = ModelConfig(
+    family="llama", vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+    n_kv_heads=2, head_dim=8, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    return CFG, params
+
+
+def _engine(params):
+    return GenerationEngine(
+        CFG, params, seq_buckets=(32,), batch_buckets=(1,), max_seq_len=64,
+    )
+
+
+def _cont(params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("chunk_steps", 2)
+    kw.setdefault("prefill_chunk", 8)
+    return ContinuousEngine(_engine(params), **kw)
+
+
+# ---------------------------------------------------------------------------
+# publish validation + telemetry (fast — engine build, no stepping)
+# ---------------------------------------------------------------------------
+def test_publish_validates_and_versions(tiny):
+    cfg, params = tiny
+    ce = _cont(params)
+    try:
+        assert ce.weights_version == 1
+        v = ce.publish_weights(jax.tree.map(lambda x: x * 0.5, params))
+        assert v == 2
+        # explicit versions must grow
+        with pytest.raises(ValueError, match="grow"):
+            ce.publish_weights(params, version=2)
+        v = ce.publish_weights(params, version=10)
+        assert v == 10 and ce.weights_version == 10
+        # a mismatched tree is refused BEFORE the swap
+        with pytest.raises(ValueError, match="match"):
+            ce.publish_weights(jax.tree.map(lambda x: x[..., :1], params))
+        with pytest.raises(ValueError):
+            ce.publish_weights({"nope": jnp.zeros((2,))})
+        assert ce.weights_version == 10  # refusals changed nothing
+        snap = ce.serving_snapshot()
+        assert snap["weights_version"] == 10
+        assert snap["weights_published"] == 2
+        assert snap["train_steps"] == 0
+        assert snap["train_step_ms"] == 0.0 and snap["train_mfu"] == 0.0
+    finally:
+        ce.close()
+
+
+def test_note_train_step_rides_snapshot_and_metrics(tiny):
+    cfg, params = tiny
+    ce = _cont(params)
+    try:
+        ce.note_train_step(12.5, mfu=0.031)
+        snap = ce.serving_snapshot()
+        assert snap["train_steps"] == 1
+        assert snap["train_step_ms"] == 12.5
+        assert snap["train_mfu"] == 0.031
+        text = render_prometheus([({"model": "m"}, ce.metrics)])
+        assert "tlink_engine_weights_version" in text
+        assert "tlink_engine_train_step_ms" in text
+        assert "tlink_engine_train_mfu" in text
+        assert "tlink_engine_train_steps_total" in text
+        assert "tlink_engine_weights_published_total" in text
+    finally:
+        ce.close()
+
+
+def test_foreground_work_gate(tiny):
+    cfg, params = tiny
+    ce = _cont(params)
+    try:
+        assert ce.foreground_work() is False
+        r_be = ce.submit([1, 2], max_new_tokens=4, priority="best_effort")
+        assert ce.foreground_work() is False  # best_effort never blocks
+        r_int = ce.submit([3, 4], max_new_tokens=4, priority="interactive")
+        assert ce.foreground_work() is True
+        r_b = ce.submit([5, 6], max_new_tokens=4, priority="batch")
+        assert ce.foreground_work("batch") is True  # interactive queued
+    finally:
+        ce.close()
+
+
+def test_prefix_cache_version_fence_units():
+    pc = PrefixCache(4)
+    n1, _ = pc.insert(None, (1, 2, 3, 4), 10)
+    pc.insert(n1, (5, 6, 7, 8), 11)
+    assert len(pc.match([1, 2, 3, 4, 5, 6, 7, 8], 8)) == 2
+    assert pc.digest()["chains"]
+    # the publish fence: version bump makes every existing chain inert
+    pc.weights_version = 2
+    assert pc.match([1, 2, 3, 4, 5, 6, 7, 8], 8) == []
+    assert pc.partial_match([], [1, 2, 9, 9], 4) is None
+    assert pc.digest()["chains"] == {}
+    # the engine's publish path evicts the stale (unreferenced) chains;
+    # fresh inserts then live under the new version and match again
+    assert sorted(pc.drop_all()) == [10, 11]
+    pc.insert(None, (1, 2, 3, 4), 12)
+    assert len(pc.match([1, 2, 3, 4], 4)) == 1
+
+    # a stale LEAF shadowing a fresh insert (it survived the publish
+    # because a slot still read it, then released) is evicted in place —
+    # the freed page id goes back to the caller's allocator
+    pc2 = PrefixCache(4)
+    pc2.insert(None, (1, 1, 1, 1), 20)
+    pc2.weights_version = 2
+    freed: list = []
+    node, adopted = pc2.insert(None, (1, 1, 1, 1), 21, freed=freed)
+    assert adopted and freed == [20]
+    assert len(pc2.match([1, 1, 1, 1], 4)) == 1
+    assert pc2.match([1, 1, 1, 1], 4)[0].page == 21
+
+
+def test_serve_train_loop_requires_local_engine(tiny):
+    cfg, params = tiny
+
+    class NotLocal:
+        _cont = None
+
+    opt = make_optimizer("adamw", lr=1e-3)
+    ts = make_train_step(cfg, opt, n_micro=1, donate=False)
+    with pytest.raises(ValueError, match="local"):
+        ServeTrainLoop(NotLocal(), ts, params, data_fn=lambda i: None)
+
+
+def test_serve_train_loop_gating_and_cadence():
+    """Tick mechanics against FAKES (zero jax work): yields while
+    foreground work exists, steps otherwise, publishes every
+    publish_every steps, stops at max_steps, detaches when done."""
+
+    class FakeCont:
+        def __init__(self):
+            self.fg = False
+            self.published = []
+            self.noted = []
+            self.weights_version = 1
+
+        def foreground_work(self, above="best_effort"):
+            return self.fg
+
+        def note_train_step(self, ms, mfu=0.0):
+            self.noted.append((ms, mfu))
+
+        def publish_weights(self, params, version=None):
+            self.weights_version += 1
+            self.published.append(self.weights_version)
+            return self.weights_version
+
+    class FakeBatcher:
+        def __init__(self):
+            self._cont = FakeCont()
+            self.bg = "unset"
+
+        def set_background(self, fn):
+            self.bg = fn
+
+    class FakeStep:
+        mode = "unsharded"
+
+        def init_state(self, params):
+            return {}
+
+        def step_fn(self, p, s, b):
+            return p, s, {"loss": jnp.float32(1.0)}
+
+    bat = FakeBatcher()
+    pubs = []
+    loop = ServeTrainLoop(
+        bat, FakeStep(), {"w": jnp.zeros((2,))},
+        data_fn=lambda i: {"tokens": jnp.zeros((2, 4), jnp.int32)},
+        publish_every=2, max_steps=5,
+        on_publish=lambda v, p: pubs.append(v),
+    ).attach()
+    assert callable(bat.bg) and bat.bg.__self__ is loop
+    bat._cont.fg = True
+    assert loop.tick() is False and loop.step == 0  # yielded
+    bat._cont.fg = False
+    for _ in range(10):
+        loop.tick()
+    assert loop.step == 5 and loop.done
+    assert bat._cont.published == [2, 3]  # steps 2 and 4
+    assert pubs == [2, 3]
+    assert len(bat._cont.noted) == 5
+    assert bat.bg is None  # detached at max_steps
+    assert loop.tick() is False  # done stays done
+
+
+def test_autopilot_fleet_publish_ladder():
+    """Replica-by-replica version propagation over fakes: one replica
+    per tick, draining replicas stay pending, remote-style declines land
+    in failed, and publish_done closes the queue."""
+
+    class View:
+        def __init__(self, draining=False):
+            self.draining = draining
+
+        def router_snapshot(self):
+            return {
+                "draining": self.draining, "worker_role": "mixed",
+                "max_slots": 4, "slots_free": 4, "kv_pages_free": 8,
+                "kv_pages_total": 8, "service_ewma_s": 0.1,
+                "queue_depth": {
+                    "interactive": 0, "batch": 0, "best_effort": 0,
+                },
+                "prefix_digest": {},
+            }
+
+        def admission_check(self, priority=None, n=1):
+            return None
+
+    class FakeEngine:
+        def __init__(self):
+            self.weights_version = 1
+
+        def publish_weights(self, params, version=None):
+            self.weights_version = int(version)
+            return self.weights_version
+
+    engines = {"a": FakeEngine(), "b": FakeEngine(), "c": FakeEngine()}
+    views = {"a": View(), "b": View(draining=True), "c": View()}
+    router = FleetRouter(refresh_s=0.0)
+    for rid, v in views.items():
+        router.register(rid, v)
+    actions = EngineFleetActions(lambda rid: engines[rid])
+    ap = FleetAutopilot(router, actions)
+    ap.request_publish({"w": 1}, version=5)
+    recs = []
+    for _ in range(4):
+        recs.extend(ap.tick())
+    kinds = [r["kind"] for r in recs]
+    # a and c published (one per tick); b is draining and stays pending
+    assert kinds.count("publish") == 2
+    assert engines["a"].weights_version == 5
+    assert engines["c"].weights_version == 5
+    assert engines["b"].weights_version == 1
+    assert ap.status()["publishing"]["pending"] == ["b"]
+    # b stops draining -> it picks the version up and the queue closes
+    views["b"].draining = False
+    recs = []
+    for _ in range(3):
+        recs.extend(ap.tick())
+    kinds = [r["kind"] for r in recs]
+    assert "publish_done" in kinds and engines["b"].weights_version == 5
+    assert ap.status()["publishing"] is None
+    # idempotent re-publish of the same version: engines no-op
+    ap.request_publish({"w": 1}, version=5)
+    for _ in range(5):
+        ap.tick()
+    assert engines["a"].weights_version == 5
+
+    # declined actions (the remote/validator shape) land in failed
+    class Declines:
+        def publish_weights(self, rid, params, version):
+            return False
+
+    ap2 = FleetAutopilot(router, Declines())
+    ap2.request_publish({"w": 1}, version=9)
+    recs = []
+    for _ in range(5):
+        recs.extend(ap2.tick())
+    done = [r for r in recs if r["kind"] == "publish_done"]
+    assert done and set(done[0]["failed"]) == {"a", "b", "c"}
+
+
+# ---------------------------------------------------------------------------
+# live-stream integration (slow — compiles the ragged step; CI engine
+# job runs these unfiltered)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_stream_spanning_publish_zero_dropped_and_zero_compiles(tiny):
+    """ISSUE 15 acceptance bar: a live serving stream spanning a weight
+    publish completes with zero dropped tokens, the new version is
+    visible at /stats + /metrics, and the publish added ZERO compiled
+    programs to the serving hot path."""
+    cfg, params = tiny
+    bat = ContinuousBatcher(
+        engine=_engine(params), eos_ids=[], max_slots=2, page_size=8,
+        chunk_steps=2, prefill_chunk=8, kv_quant="none",
+    )
+    try:
+        # warm: one stream end-to-end so every program is compiled
+        assert len(bat.generate([9, 8, 7], max_new_tokens=4, timeout=120)) == 4
+        sizes_before = bat._cont.jit_cache_sizes()
+        out: dict = {}
+
+        def run():
+            out["tokens"] = bat.generate(
+                [1, 2, 3], max_new_tokens=60, timeout=120,
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        # publish mid-stream from a foreign thread — the batcher stages
+        # on device and commits on the driver at a chunk boundary
+        deadline = time.monotonic() + 30
+        while bat._cont.live_slots == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        v = bat.publish_weights(jax.tree.map(lambda x: x * 0.9, params))
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert len(out["tokens"]) == 60  # zero dropped tokens
+        assert v == 2
+        assert bat._cont.jit_cache_sizes() == sizes_before
+        assert bat.stats()["engine"]["weights_version"] == 2
+        assert bat.serving_modes()["weights_version"] == 2
+        text = render_prometheus([({"model": "m"}, bat.metrics_registry())])
+        assert 'tlink_engine_weights_published_total' in text
+        bat._cont.check_page_conservation()
+    finally:
+        bat.close()
+
+
+@pytest.mark.slow
+def test_publish_fences_prefix_cache_end_to_end(tiny):
+    """Pages cached under v1 weights stop producing prefill skips the
+    instant v2 publishes — the bitwise cache contract across a swap."""
+    cfg, params = tiny
+    ce = _cont(params)
+    try:
+        prompt = list(range(1, 17))
+        ce.submit(prompt, max_new_tokens=4, seed=1)
+        ce.run_until_idle()
+        ce.submit(prompt, max_new_tokens=4, seed=1)
+        ce.run_until_idle()
+        hit = ce.serving_snapshot()["prefill_tokens_skipped"]
+        assert hit > 0
+        ce.publish_weights(jax.tree.map(lambda x: x * 1.1, params))
+        ce.submit(prompt, max_new_tokens=4, seed=1)
+        ce.run_until_idle()
+        assert ce.serving_snapshot()["prefill_tokens_skipped"] == hit
+        # and the re-prefilled pages re-enter under the NEW version:
+        ce.submit(prompt, max_new_tokens=4, seed=1)
+        ce.run_until_idle()
+        assert ce.serving_snapshot()["prefill_tokens_skipped"] > hit
+        ce.check_page_conservation()
+    finally:
+        ce.close()
+
+
+@pytest.mark.slow
+def test_migrated_stream_never_promotes_stale_weights_kv(tiny):
+    """Cross-replica version fence: during a replica-by-replica publish
+    the fleet is briefly mixed-version, and a rebalance can ship a
+    stream whose KV predates the destination's weights. The export blob
+    carries the SOURCE's weights_version and the adopted request is
+    stamped with it, so teardown never promotes old-weights KV into the
+    destination's (newer-version) trie — while same-version migrations
+    keep promoting exactly as before."""
+    cfg, params = tiny
+
+    def decode_to_freeze(src, prompt, seed):
+        r = src.submit(prompt, max_new_tokens=24, seed=seed)
+        for _ in range(20):
+            src.step_chunk()
+            if len(r.tokens) >= 2:
+                break
+        assert len(r.tokens) >= 2 and not r.finished
+        src.freeze_slot(r.slot)
+        return r
+
+    def adopt_and_finish(dst, src, r, mig_id):
+        blob = src.export_slot(r.slot)
+        assert dst.stage_migration(mig_id, blob)
+        moved = src.commit_migration(r.slot)
+        res = dst.submit(
+            moved.prompt + list(moved.tokens),
+            max_new_tokens=moved.budget - len(moved.tokens),
+            seed=moved.seed, start_step=len(moved.tokens), adopt=mig_id,
+        )
+        dst.run_until_idle()
+        assert res.finished
+        return res
+
+    prompt = list(range(1, 17))  # two full pages — promotable region
+    # mixed-version: destination published v2 while the source still
+    # serves v1 — the adopted pages must NOT enter the trie
+    src = _cont(params)
+    dst = _cont(params)
+    try:
+        r = decode_to_freeze(src, prompt, seed=3)
+        assert src._slots[r.slot].weights_version == 1
+        dst.publish_weights(jax.tree.map(lambda x: x * 0.9, params))
+        adopt_and_finish(dst, src, r, "mig-stale")
+        assert dst.serving_snapshot()["prefix_resident_pages"] == 0
+        dst.check_page_conservation()
+        src.check_page_conservation()
+    finally:
+        src.close()
+        dst.close()
+    # same-version control: promotion still happens
+    src = _cont(params)
+    dst = _cont(params)
+    try:
+        r = decode_to_freeze(src, prompt, seed=3)
+        adopt_and_finish(dst, src, r, "mig-same")
+        assert dst.serving_snapshot()["prefix_resident_pages"] > 0
+        dst.check_page_conservation()
+    finally:
+        src.close()
+        dst.close()
+
+
+@pytest.mark.slow
+def test_serve_and_train_loop_end_to_end(tiny):
+    """The background trainer trains + publishes while a best_effort
+    stream decodes: stream exact-length, >=1 publish, telemetry flows,
+    and the loop stops at max_steps."""
+    cfg, params = tiny
+    bat = ContinuousBatcher(
+        engine=_engine(params), eos_ids=[], max_slots=2, page_size=8,
+        chunk_steps=2, prefill_chunk=8, kv_quant="none",
+    )
+    try:
+        opt = make_optimizer("adamw", lr=1e-3)
+        ts = make_train_step(cfg, opt, n_micro=1, donate=False)
+        rng = np.random.default_rng(0)
+
+        def data_fn(step):
+            return {"tokens": jnp.asarray(
+                rng.integers(1, CFG.vocab_size, (2, 16)).astype(np.int32)
+            )}
+
+        loop = ServeTrainLoop(
+            bat, ts, params, data_fn=data_fn, publish_every=2,
+            max_steps=4, cfg=cfg,
+        ).attach()
+        out = bat.generate(
+            [1, 2, 3], max_new_tokens=30, priority="best_effort",
+            timeout=120,
+        )
+        deadline = time.monotonic() + 60
+        while not loop.done and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(out) == 30
+        assert loop.done and loop.step == 4 and loop.publishes == 2
+        st = bat.stats()["engine"]
+        assert st["train_steps"] == 4
+        assert st["weights_version"] == 3
+        assert st["train_step_ms"] > 0
+    finally:
+        bat.close()

@@ -1,0 +1,328 @@
+"""ZeRO-1 cross-replica sharding of optimizer state + weight update
+(engine/training.py ``make_train_step(zero1=True)``, docs/TRAINING.md).
+
+Contracts under test:
+
+- the zero1 step is BIT-IDENTICAL to the unsharded microbatched step at
+  ``n_micro == dp`` (fixed-gather-order reduction + shard-local update —
+  the quantized_psum determinism argument applied to training), with
+  per-replica optimizer-state bytes ~1/dp;
+- ``optimizer_state_specs`` derives dp-extended specs for optax states
+  whose sub-trees DON'T mirror the param tree (masked/chained/empty
+  nodes) — a moment buffer is never silently replicated;
+- the planner picks zero1 exactly when a training stage carries a data
+  axis > 1, and its capacity model shards optimizer bytes over it;
+- the compile set is bounded: cold-entry + steady-state programs, churn
+  adds ZERO.
+"""
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorlink_tpu.engine.training import (
+    ChainedOptimizer,
+    make_optimizer,
+    make_train_step,
+    optimizer_state_specs,
+)
+from tensorlink_tpu.models import ModelConfig, init_params
+from tensorlink_tpu.parallel.mesh import build_mesh
+from tensorlink_tpu.parallel.planner import (
+    MemoryEstimate,
+    ShardingPlan,
+    WorkerCapacity,
+    _per_device_bytes,
+    plan_sharding,
+    training_update_mode,
+)
+
+TINY = ModelConfig(
+    family="llama", vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+    n_kv_heads=2, head_dim=8, d_ff=64, max_seq_len=32, dtype=jnp.float32,
+)
+
+
+def _mesh(dp: int):
+    return build_mesh({"data": dp}, jax.devices()[:dp])
+
+
+def _batch(B=4, T=16, seed=0, masked=False):
+    rng = np.random.default_rng(seed)
+    out = {"tokens": jnp.asarray(
+        rng.integers(0, TINY.vocab_size, (B, T)).astype(np.int32)
+    )}
+    if masked:
+        m = np.ones((B, T), bool)
+        m[:, T // 2:] = rng.integers(0, 2, (B, T - T // 2)).astype(bool)
+        out["loss_mask"] = jnp.asarray(m)
+    return out
+
+
+def _tree_equal(a, b) -> bool:
+    return all(jax.tree.leaves(
+        jax.tree.map(lambda x, y: bool(jnp.array_equal(x, y)), a, b)
+    ))
+
+
+# ---------------------------------------------------------------------------
+# the bitwise pin (ISSUE 15 acceptance bar)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow  # compiles two train steps; CI engine job runs unfiltered
+@pytest.mark.parametrize("masked", [False, True])
+def test_zero1_step_bitwise_identical_to_unsharded(masked):
+    """dp=2 zero1 == n_micro=2 unsharded, bit for bit, across steps —
+    loss, grad_norm, AND every param leaf (grad_clip active, so the
+    global-norm clip stage is exercised on the full gradient)."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    opt = make_optimizer("adamw", lr=5e-3, grad_clip=1.0)
+    base = make_train_step(TINY, opt, n_micro=2, donate=False)
+    z1 = make_train_step(
+        TINY, opt, n_micro=2, donate=False, zero1=True, mesh=_mesh(2),
+    )
+    assert z1.mode == "zero1" and base.mode == "unsharded"
+    p1, s1 = params, base.init_state(params)
+    p2, s2 = params, z1.init_state(params)
+    for i in range(3):
+        batch = _batch(seed=i, masked=masked)
+        p1, s1, m1 = base.step_fn(p1, s1, batch)
+        p2, s2, m2 = z1.step_fn(p2, s2, batch)
+        assert float(m1["loss"]) == float(m2["loss"]), i
+        assert float(m1["grad_norm"]) == float(m2["grad_norm"]), i
+    assert _tree_equal(p1, p2), "zero1 params diverged from unsharded"
+
+
+@pytest.mark.slow
+def test_zero1_opt_state_bytes_one_over_dp():
+    """The memory claim: each replica's addressable optimizer-state
+    shard holds ~1/dp of the full state bytes (scalars replicate)."""
+    dp = 2
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    opt = make_optimizer("adamw", lr=1e-3)
+    z1 = make_train_step(
+        TINY, opt, n_micro=dp, donate=False, zero1=True, mesh=_mesh(dp),
+    )
+    state = z1.init_state(params)
+    full = sum(leaf.nbytes for leaf in jax.tree.leaves(state))
+    dev0 = jax.devices()[0]
+    per = sum(
+        sh.data.nbytes
+        for leaf in jax.tree.leaves(state)
+        for sh in leaf.addressable_shards if sh.device == dev0
+    )
+    ratio = per / full
+    assert ratio <= 1.0 / dp + 0.05, ratio
+
+
+@pytest.mark.slow
+def test_zero1_compile_set_is_bounded():
+    """Cold-entry + steady-state layouts = at most TWO programs; more
+    steps (and fresh host batches) add ZERO."""
+    params = init_params(TINY, jax.random.PRNGKey(1))
+    opt = make_optimizer("adamw", lr=1e-3)
+    z1 = make_train_step(
+        TINY, opt, n_micro=2, donate=True, zero1=True, mesh=_mesh(2),
+    )
+    p, s = params, z1.init_state(params)
+    for i in range(2):
+        p, s, _ = z1.step_fn(p, s, _batch(seed=i))
+    warm = z1.n_programs()
+    assert warm <= 2, warm
+    for i in range(3):
+        p, s, _ = z1.step_fn(p, s, _batch(seed=10 + i))
+    assert z1.n_programs() == warm
+
+
+@pytest.mark.slow
+def test_zero1_bf16_params_train():
+    """bf16 params through the zero1 step: finite, descending, dtype
+    preserved (the fp32 scan carry under the dp split)."""
+    cfg = TINY.with_(dtype=jnp.bfloat16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = make_optimizer("adamw", lr=5e-3)
+    z1 = make_train_step(
+        cfg, opt, n_micro=2, donate=False, zero1=True, mesh=_mesh(2),
+    )
+    p, s = params, z1.init_state(params)
+    losses = []
+    for i in range(6):
+        p, s, m = z1.step_fn(p, s, _batch(seed=0))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    assert jax.tree.leaves(p)[0].dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# refusals + factory metadata (fast, zero-compile)
+# ---------------------------------------------------------------------------
+def test_zero1_refusals():
+    opt = make_optimizer("adamw", lr=1e-3)
+    with pytest.raises(ValueError, match="mesh"):
+        make_train_step(TINY, opt, n_micro=2, zero1=True, mesh=None)
+    with pytest.raises(ValueError, match="divisible"):
+        make_train_step(TINY, opt, n_micro=3, zero1=True, mesh=_mesh(2))
+    with pytest.raises(ValueError, match="> 1"):
+        make_train_step(TINY, opt, n_micro=1, zero1=True, mesh=_mesh(1))
+    with pytest.raises(ValueError, match="adafactor"):
+        make_train_step(
+            TINY, make_optimizer("adafactor", lr=1e-3),
+            n_micro=2, zero1=True, mesh=_mesh(2),
+        )
+
+
+def test_make_optimizer_carries_chain_metadata():
+    """ChainedOptimizer duck-types optax.GradientTransformation while
+    exposing the clip/inner split the zero1 step needs."""
+    opt = make_optimizer("adamw", lr=1e-3, grad_clip=0.5)
+    assert isinstance(opt, ChainedOptimizer)
+    assert opt.grad_clip == 0.5 and opt.name == "adamw"
+    params = {"w": jnp.ones((4, 2))}
+    state = opt.init(params)  # the full chain's init
+    updates, _ = opt.update(jax.tree.map(jnp.ones_like, params), state, params)
+    assert jax.tree.structure(updates) == jax.tree.structure(params)
+    # inner is the post-clip transformation: its state is the chain's [1]
+    inner_state = opt.inner.init(params)
+    assert jax.tree.structure(state[1]) == jax.tree.structure(inner_state)
+    no_clip = make_optimizer("sgd", lr=1e-3, grad_clip=None)
+    assert no_clip.grad_clip is None
+
+
+# ---------------------------------------------------------------------------
+# optimizer_state_specs hardening (fast, zero-compile)
+# ---------------------------------------------------------------------------
+def _params():
+    return {
+        "big": jnp.zeros((8, 4)),  # dp-shardable at dp=4
+        "odd": jnp.zeros((3,)),    # not divisible — replicates
+        "scalar": jnp.zeros(()),
+    }
+
+
+def _pspecs(params):
+    return jax.tree.map(lambda _: P(), params)
+
+
+def test_specs_mirror_subtree_gets_dp_axis():
+    params = _params()
+    opt = make_optimizer("adamw", lr=1e-3)
+    specs = optimizer_state_specs(
+        opt, params, _pspecs(params), dp_axis="data", dp_size=4,
+    )
+    # structure round-trips against the real state
+    state = opt.init(params)
+    jax.tree.map(lambda leaf, sp: None, state, specs)
+    flat = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert P("data", None) in flat  # the moment buffers shard
+    # count scalar + odd/scalar leaves replicate
+    assert P() in flat
+
+
+def test_specs_masked_state_moments_still_shard():
+    """optax.masked: the moment trees carry MaskedNode placeholders, so
+    they do NOT mirror the param structure — the hardened derivation
+    must still shard the real moment buffers instead of silently
+    replicating them (the ISSUE 15 satellite)."""
+    params = _params()
+    mopt = optax.masked(
+        optax.adam(1e-3), {"big": True, "odd": False, "scalar": False}
+    )
+    specs = optimizer_state_specs(
+        mopt, params, _pspecs(params), dp_axis="data", dp_size=4,
+    )
+    jax.tree.map(lambda leaf, sp: None, jax.eval_shape(mopt.init, params), specs)
+    flat = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    # mu["big"] and nu["big"] both shard over dp
+    assert flat.count(P("data", None)) == 2, flat
+
+
+def test_specs_chained_and_empty_states_round_trip():
+    params = _params()
+    chain = optax.chain(
+        optax.clip_by_global_norm(1.0), optax.adamw(1e-3), optax.scale(0.5),
+    )
+    specs = optimizer_state_specs(
+        chain, params, _pspecs(params), dp_axis="data", dp_size=4,
+    )
+    jax.tree.map(lambda leaf, sp: None, jax.eval_shape(chain.init, params), specs)
+    # identity (EmptyState all the way down) must not crash or grow specs
+    ident = optax.identity()
+    out = optimizer_state_specs(
+        ident, params, _pspecs(params), dp_axis="data", dp_size=4,
+    )
+    assert jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, P)) == []
+
+
+def test_specs_without_dp_axis_keep_legacy_behavior():
+    params = _params()
+    opt = make_optimizer("adamw", lr=1e-3)
+    specs = optimizer_state_specs(opt, params, _pspecs(params))
+    flat = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert all(sp == P() for sp in flat), flat
+
+
+def test_specs_inherit_nontrivial_param_layout_by_shape():
+    """A non-mirroring state leaf with exactly one same-shape param twin
+    inherits that param's spec (then dp-extends on a FREE leading dim
+    only — dim 0 already sharded passes through unchanged)."""
+    params = {"w": jnp.zeros((8, 4))}
+    pspecs = {"w": P("tensor", None)}
+    mopt = optax.masked(optax.adam(1e-3), {"w": True})
+    specs = optimizer_state_specs(
+        mopt, params, pspecs, dp_axis="data", dp_size=4,
+    )
+    flat = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert P("tensor", None) in flat, flat
+
+
+# ---------------------------------------------------------------------------
+# planner: picks zero1 whenever dp > 1 (fast, zero-compile)
+# ---------------------------------------------------------------------------
+def test_training_update_mode_predicate():
+    assert training_update_mode({"data": 2}, True) == "zero1"
+    assert training_update_mode({"data": 1}, True) == "unsharded"
+    assert training_update_mode({"fsdp": 4}, True) == "unsharded"
+    assert training_update_mode({"data": 4}, False) == "unsharded"
+    assert training_update_mode({}, True) == "unsharded"
+
+
+def test_plan_sharding_picks_zero1_and_defaults_n_micro():
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=48, n_layers=4, n_heads=4,
+        n_kv_heads=2, head_dim=12, d_ff=96, max_seq_len=64,
+    )
+    w = WorkerCapacity("w1", hbm_bytes=1e9, n_devices=4)
+    plan = plan_sharding(
+        cfg, [w], training=True, batch=4, seq_len=32,
+        mesh_hints={"data": 2, "tensor": 2},
+    )
+    assert plan.update_mode == "zero1"
+    assert plan.n_micro == 2  # one micro per replica — the bitwise config
+    # the auto path keeps fsdp for training — unsharded update
+    auto = plan_sharding(cfg, [w], training=True, batch=4, seq_len=32)
+    assert auto.update_mode == "unsharded"
+    # serving plans (data axis, not training) stay unsharded
+    serve = plan_sharding(cfg, [w], training=False, batch=4, seq_len=32)
+    assert serve.update_mode == "unsharded"
+    # wire round-trip, incl. pre-zero1 stored plans without the field
+    assert ShardingPlan.from_json(plan.to_json()).update_mode == "zero1"
+    legacy = plan.to_json()
+    legacy.pop("update_mode")
+    assert ShardingPlan.from_json(legacy).update_mode == "unsharded"
+
+
+def test_capacity_model_shards_optimizer_over_data_for_zero1():
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=48, n_layers=4, n_heads=4,
+        n_kv_heads=2, head_dim=12, d_ff=96, max_seq_len=64,
+    )
+    est = MemoryEstimate.build(cfg, batch=4, seq_len=32, training=True)
+    replicated = _per_device_bytes(est, {"data": 4}, training=False)
+    zero1 = _per_device_bytes(est, {"data": 4}, training=True)
+    assert zero1 < replicated
+    # the saving is exactly the optimizer share: (dp-1)/dp of opt bytes
+    expected = replicated - est.optimizer * (1 - 1 / 4) * 1.1
+    assert abs(zero1 - expected) < 1e-6 * replicated
