@@ -336,13 +336,25 @@ class ResponseFormatter:
             }
         return {"token": delta_text, "model": self.model}
 
+    def stream_prelude(self, meta: dict) -> dict:
+        """First SSE event of a stream, carrying the journal re-attach
+        handle (``jrid``, docs/FAILURE_MODEL.md "Control plane") before
+        any token — a client can only resume a crash-interrupted stream
+        if it learned the jrid ahead of the crash. Shaped as an empty
+        delta chunk so strict OpenAI stream parsers pass through it."""
+        body = self.stream_chunk("")
+        body.update(meta)
+        return body
+
     def stream_final(
         self, *, prompt_tokens: int, completion_tokens: int,
-        finish_reason: str = "stop",
+        finish_reason: str = "stop", extra: dict | None = None,
     ) -> dict:
-        """Final SSE chunk with usage (reference formatter.py:452-509)."""
+        """Final SSE chunk with usage (reference formatter.py:452-509).
+        ``extra`` merges server-side annotations (e.g. ``jrid``) into the
+        body top level, like :meth:`complete`."""
         if self.fmt == "openai":
-            return {
+            body = {
                 "id": self.id,
                 "object": "chat.completion.chunk",
                 "created": self.created,
@@ -352,12 +364,16 @@ class ResponseFormatter:
                 ],
                 "usage": self._usage(prompt_tokens, completion_tokens),
             }
-        return {
-            "done": True,
-            "model": self.model,
-            "usage": self._usage(prompt_tokens, completion_tokens),
-            "finish_reason": finish_reason,
-        }
+        else:
+            body = {
+                "done": True,
+                "model": self.model,
+                "usage": self._usage(prompt_tokens, completion_tokens),
+                "finish_reason": finish_reason,
+            }
+        if extra:
+            body.update(extra)
+        return body
 
     def error(self, message: str, *, status: int = 500, kind: str = "server_error") -> dict:
         """Error body (reference formatter.py:512-549)."""

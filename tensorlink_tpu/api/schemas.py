@@ -91,6 +91,15 @@ class GenerationRequest:
     # an interactive request may preempt a lower-class slot) and selects
     # the 429 backpressure queue the request is judged against.
     priority: str = ""
+    # journal rid of a stream a LOST validator was serving (the client
+    # re-attach ladder, docs/FAILURE_MODEL.md "Control plane"). Repeat
+    # the ORIGINAL request body plus this field against the recovered
+    # validator: the stream resumes from the worker's orphan buffer and
+    # the response carries the COMPLETE stream from token 0 — clients
+    # REPLACE any partial pre-crash text with it (exactly-once by
+    # replacement). The jrid itself rides every response body and, on
+    # SSE, a prelude event before the first delta.
+    reattach: str = ""
 
     _PRIORITIES = ("interactive", "batch", "best_effort")
 
@@ -141,6 +150,7 @@ class GenerationRequest:
                 num_beams=int(d.get("num_beams", 1)),
                 stop=cls._parse_stop(d.get("stop")),
                 priority=cls._parse_priority(d.get("priority")),
+                reattach=str(d.get("reattach", "") or ""),
             )
         except ValidationError:
             raise
@@ -148,6 +158,11 @@ class GenerationRequest:
             # null / non-numeric values in numeric fields must be a 400,
             # not an int()/float() TypeError surfacing as a 500
             raise ValidationError(f"invalid field value: {e}")
+        _require(len(req.reattach) <= 64, "reattach rid too long")
+        _require(
+            not (req.reattach and req.num_beams > 1),
+            "reattach cannot combine with num_beams",
+        )
         _require(req.max_new_tokens > 0, "max_new_tokens must be positive")
         _require(0.0 <= req.temperature <= 2.0, "temperature must be in [0, 2]")
         _require(0.0 < req.top_p <= 1.0, "top_p must be in (0, 1]")

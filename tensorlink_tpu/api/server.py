@@ -522,6 +522,17 @@ class TensorlinkAPI:
         from tensorlink_tpu.ml.validator import ModelNotReady
 
         rid = self._req_ids.get(writer, "")
+        if getattr(self.executor, "recovering", False):
+            # the validator is replaying its control journal (crash
+            # recovery, docs/FAILURE_MODEL.md "Control plane") — a finite
+            # window during which placements are still re-attaching.
+            # Clients hold off and retry; /healthz shows the same flag so
+            # LBs stop routing new placements here meanwhile.
+            raise HTTPError(
+                503, "validator is recovering — retry shortly",
+                {"recovering": True, "retry_after": 2},
+                headers={"Retry-After": "2"},
+            )
         job = self.executor.hosted.get(gen.hf_name)
         if job is None or job.status != "ready":
             # 503 + auto-load trigger (reference api/node.py:143-155)
@@ -597,9 +608,11 @@ class TensorlinkAPI:
                         # only this path can carry the beam-clamp note:
                         # num_beams>1 + stream is rejected at parse time
                         # (schemas.py), and n>1 is a chat-completions-only
-                        # field while num_beams is /v1/generate-only
+                        # field while num_beams is /v1/generate-only.
+                        # jrid is the journal re-attach handle
+                        # (docs/FAILURE_MODEL.md "Control plane")
                         extra={
-                            k: result[k] for k in ("num_beams_used",)
+                            k: result[k] for k in ("num_beams_used", "jrid")
                             if k in result
                         } or None,
                     ),
@@ -616,10 +629,15 @@ class TensorlinkAPI:
         def on_delta(piece: str) -> None:
             loop.call_soon_threadsafe(q.put_nowait, ("delta", piece))
 
+        def on_meta(meta: dict) -> None:
+            # admission metadata — the journal re-attach handle (jrid)
+            # must reach the client BEFORE any crash can cut the stream
+            loop.call_soon_threadsafe(q.put_nowait, ("meta", meta))
+
         def work():
             try:
                 res = self.executor.generate_api(
-                    gen, on_delta=on_delta, trace_id=rid
+                    gen, on_delta=on_delta, trace_id=rid, meta_cb=on_meta
                 )
                 loop.call_soon_threadsafe(q.put_nowait, ("done", res))
             except Exception as e:
@@ -644,12 +662,18 @@ class TensorlinkAPI:
             if kind == "delta":
                 writer.write(sse_event(fmt.stream_chunk(item)))
                 await writer.drain()
+            elif kind == "meta":
+                writer.write(sse_event(fmt.stream_prelude(item)))
+                await writer.drain()
             elif kind == "done":
                 writer.write(
                     sse_event(fmt.stream_final(
                         prompt_tokens=item["prompt_tokens"],
                         completion_tokens=item["completion_tokens"],
                         finish_reason=item["finish_reason"],
+                        extra={
+                            k: item[k] for k in ("jrid",) if k in item
+                        } or None,
                     ))
                 )
                 writer.write(SSE_DONE)

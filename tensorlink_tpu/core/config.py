@@ -228,6 +228,27 @@ class MLConfig:
     # auto-loads popular/default models, ml/validator.py:169-365); off by
     # default so local tests never pull multi-GB checkpoints
     autoload_default_models: bool = False
+    # -- control-plane crash safety (core/journal.py, docs/FAILURE_MODEL.md
+    # "Control plane"): the validator's write-ahead journal of hosting,
+    # admissions, delivered-token high-water marks, migration tickets and
+    # autopilot intents. Restart + DistributedValidator.recover() replays
+    # it, re-attaches live replicas and expires stranded tickets.
+    journal: bool = True
+    # plain (non-intent) records are fsync-batched: flush when this many
+    # buffered or when the window elapses, whichever first. Intents always
+    # fsync write-ahead regardless.
+    journal_flush_every: int = 16
+    journal_flush_s: float = 0.05
+    # delivered-token high-water marks are journaled every N streamed
+    # tokens per request (chunk granularity — the journal is an audit
+    # floor; the worker's live count is authoritative at recovery)
+    journal_hwm_every: int = 16
+    # workers: finished orphaned streams (client/validator gone before the
+    # final response was delivered) are kept for re-attach up to this many
+    # entries / this long, whichever trips first. Live orphans aren't
+    # bounded here — allocator pressure sheds them via preemption as usual.
+    orphan_keep: int = 64
+    orphan_ttl_s: float = 180.0
 
 
 @dataclass

@@ -38,6 +38,19 @@ Wired sites:
   supports error (the slot takes the re-prefill redirect rung) / crash
   (a prefill worker dying at the prefill→decode boundary). The wire
   transfer itself shares ``migrate.wire`` with the drain path.
+- ``validator.crash``     — the validator control plane dying at a chosen
+  point (ml/validator.py admission / recovery paths, tools/soak.py crash
+  schedule); supports crash / error. The soak harness keys this site on
+  the epoch so a seeded schedule kills the control plane at the same
+  instant every run.
+- ``control.frame``       — a validator control verb crossing the net
+  process (nodes/roles.py: drain_worker / create_job / set_replica_set /
+  set_handoff_pool / expire_migrations); supports error / delay / crash
+  (drop is mapped to error: a request/reply verb that vanishes surfaces
+  to the caller as a loud failure, not a silent hang).
+- ``journal.write``       — a control-journal append
+  (core/journal.py::ControlJournal.append); supports drop (the record is
+  silently lost — replay-tolerance case) / error / delay.
 
 Site names are REGISTERED (:data:`SITES`): a rule naming an unknown site
 fails loudly at plan construction instead of silently never firing — a
@@ -84,6 +97,9 @@ SITES = (
     "migrate.wire",
     "migrate.import",
     "worker.handoff",
+    "validator.crash",
+    "control.frame",
+    "journal.write",
 )
 
 

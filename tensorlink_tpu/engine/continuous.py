@@ -1809,6 +1809,12 @@ class ContinuousEngine:
         if self.prefix is not None:
             self.prefix.release(ticket["nodes"])
 
+    def staged_migrations(self) -> list[str]:
+        """Ticket ids currently staged and awaiting adoption — the set a
+        recovering source validator expires deterministically (MIGRATE
+        op="expire") instead of leaving to the destination's TTL GC."""
+        return list(self._migrations)
+
     def _gc_staged_migrations(self) -> None:
         """Free staged tickets whose resume request never arrived (the
         draining source or its client died mid-handoff) so abandoned

@@ -409,9 +409,18 @@ class FleetAutopilot:
         decode_high_water: float = 0.75,
         dry_run: bool = False,
         metrics: MetricsRegistry | None = None,
+        on_action=None,
     ):
         self.router = router
         self.actions = actions
+        # control-plane crash safety hook: on_action(phase, kind, rid,
+        # token=None) -> token. Called with phase="intent" BEFORE a
+        # mutating action starts (the return value is the intent token),
+        # then phase="commit"/"abort" with that token when it resolves —
+        # the validator wires its write-ahead journal here so a crash
+        # mid-deploy is resumed or rolled back at recovery, never
+        # forgotten. Must never raise into the control loop (wrapped).
+        self.on_action = on_action
         self.interval_s = float(interval_s)
         self.rebalance_spread = float(rebalance_spread)
         self.max_moves_per_tick = max(int(max_moves_per_tick), 1)
@@ -703,6 +712,19 @@ class FleetAutopilot:
             spread=round(loads[hot] - loads[cold], 3),
         )
 
+    def _note_action(self, phase: str, kind: str, rid: str,
+                     token=None):
+        """Fire the on_action journal hook; a hook failure must never
+        take down the control loop (journal trouble degrades to
+        un-journaled actions, same as running without one)."""
+        if self.on_action is None:
+            return None
+        try:
+            return self.on_action(phase, kind, str(rid), token)
+        except Exception:
+            self.log.exception("on_action hook (%s %s %s)", phase, kind, rid)
+            return token
+
     # -- rolling deploy --------------------------------------------------
     def _start_deploy(self, views: dict) -> dict | None:
         eligible = self._eligible(views)
@@ -733,6 +755,12 @@ class FleetAutopilot:
                 return None
             self._deploy_queue.popleft()
             self._deploying = {"rid": rid, "phase": "draining"}
+        # write-ahead: the intent is durable BEFORE the drain starts, so
+        # a validator crash mid-deploy finds an open intent at replay
+        token = self._note_action("intent", "deploy", rid)
+        with self._lock:
+            if self._deploying is not None and self._deploying["rid"] == rid:
+                self._deploying["token"] = token
         if not self.dry_run:
             self.actions.drain(rid)
             self._last_action_t = time.monotonic()
@@ -749,7 +777,9 @@ class FleetAutopilot:
         except Exception:
             self.log.exception("undrain of %s after failed deploy", rid)
         with self._lock:
+            token = (self._deploying or {}).get("token")
             self._deploying = None
+        self._note_action("abort", "deploy", rid, token)
         return self._record("deploy_aborted", rid=rid, reason=reason)
 
     def _deploy_step(self, deploying: dict, views: dict) -> dict | None:
@@ -757,6 +787,7 @@ class FleetAutopilot:
         if self.dry_run:
             with self._lock:
                 self._deploying = None
+            self._note_action("commit", "deploy", rid, deploying.get("token"))
             return self._record("deploy_done", rid=rid, dry_run=True)
         deploying["ticks"] = deploying.get("ticks", 0) + 1
         if deploying["ticks"] > self.MAX_DEPLOY_TICKS:
@@ -795,6 +826,7 @@ class FleetAutopilot:
         self._last_action_t = time.monotonic()
         with self._lock:
             self._deploying = None
+        self._note_action("commit", "deploy", rid, deploying.get("token"))
         return self._record("deploy_done", rid=rid, dst=dst)
 
     # -- decode-pool scaling ---------------------------------------------
@@ -819,9 +851,14 @@ class FleetAutopilot:
                 "scale_decode", up=up, free_frac=round(frac, 3),
                 dry_run=True,
             )
+        direction = "up" if up else "down"
+        token = self._note_action("intent", "scale_decode", direction)
         acted = self.actions.scale_decode(up)
         if not acted:
-            return None  # the actions layer declined (no pool to resize)
+            # the actions layer declined (no pool to resize)
+            self._note_action("abort", "scale_decode", direction, token)
+            return None
+        self._note_action("commit", "scale_decode", direction, token)
         self._last_action_t = time.monotonic()
         self._m_actions["scale_up" if up else "scale_down"].inc()
         return self._record(

@@ -158,6 +158,36 @@ class FleetRouter:
             rep = self._replicas.pop(str(rid), None)
         return rep.batcher if rep is not None else None
 
+    # -- state carry-over (control-plane crash safety) -------------------
+    def export_state(self) -> dict:
+        """Routing state worth surviving a validator restart: per-replica
+        routed counts and deploy generations. Snapshot-shaped so it can
+        ride the journal or /stats."""
+        with self._lock:
+            return {
+                "routed": {r.rid: int(r.routed.value)
+                           for r in self._replicas.values()},
+                "generation": {r.rid: int(r.generation)
+                               for r in self._replicas.values()},
+            }
+
+    def seed_state(self, state: dict) -> None:
+        """Re-seed a freshly-built router from journal replay (validator
+        recovery): per-replica routed counters resume from the journaled
+        admission counts instead of cold-starting at zero, so routing
+        telemetry and any count-derived policy stay continuous across the
+        restart. Unknown rids are ignored (their replicas didn't
+        re-attach); counters only ever move FORWARD (inc by the gap)."""
+        routed = dict(state.get("routed") or {})
+        gens = dict(state.get("generation") or {})
+        with self._lock:
+            for rep in self._replicas.values():
+                gap = int(routed.get(rep.rid, 0)) - int(rep.routed.value)
+                if gap > 0:
+                    rep.routed.inc(gap)
+                if int(gens.get(rep.rid, 0)) > rep.generation:
+                    rep.generation = int(gens[rep.rid])
+
     def replica_ids(self) -> list[str]:
         with self._lock:
             return list(self._replicas)
@@ -361,6 +391,7 @@ class FleetRouter:
         stream_cb: Callable | None = None,
         priority: str | None = None,
         trace_id: str = "",
+        on_route: Callable[[str], None] | None = None,
         **kw,
     ) -> list[int]:
         """Route then ``generate`` on the chosen replica's batcher, with
@@ -395,6 +426,13 @@ class FleetRouter:
             if rep is None:
                 tried.add(rid)
                 continue
+            if on_route is not None:
+                # placement telemetry (the control journal's "place"
+                # record); observers must never fail a dispatch
+                try:
+                    on_route(rid)
+                except Exception:  # tlint: disable=TL005(placement telemetry is best-effort)
+                    pass
             skip = [len(delivered)]
 
             def counting_cb(toks, _inner=stream_cb, _skip=skip):

@@ -147,14 +147,16 @@ class GenBatcher:
         priority: str | None = None,
         trace_id: str | None = None,
         handoff: bool = True,
+        jrid: str = "",
     ) -> list[int]:
         """Blocking submit; returns this request's generated ids.
         ``stream_cb`` receives this request's new tokens as they decode.
-        ``priority``, ``speculative``, and ``handoff`` are accepted for
-        API symmetry with the continuous scheduler; the windowed batcher
-        itself stays FCFS and decodes vanilla (speculation and the
-        prefill→decode handoff are paged-engine features — all three are
-        pure hints, streams identical either way).
+        ``priority``, ``speculative``, ``handoff``, and ``jrid`` are
+        accepted for API symmetry with the continuous scheduler; the
+        windowed batcher itself stays FCFS and decodes vanilla
+        (speculation, the prefill→decode handoff, and journal re-attach
+        are paged-engine features — all pure hints, streams identical
+        either way).
         ``trace_id`` (core/trace.py) records the window-wait +
         batched-decode span."""
         req = _Pending(
@@ -767,6 +769,9 @@ class ContinuousBatcher:
         self.model = model
         self.eos_ids = list(eos_ids or [])
         self.seed = int(seed)
+        # control-plane journal hook: (jrid, seed) called write-ahead per
+        # jrid-tagged admission (the validator wires its journal here)
+        self.on_admit: Callable[[str, int], None] | None = None
         self.default_priority = normalize_priority(default_priority)
         self.max_slots = int(max_slots)
         self.sched_queue_cap = int(sched_queue_cap)
@@ -1090,6 +1095,7 @@ class ContinuousBatcher:
         priority: str | None = None,
         trace_id: str | None = None,
         handoff: bool = True,
+        jrid: str = "",
     ) -> list[int]:
         with self._submit_lock:
             if self._closed:
@@ -1098,6 +1104,15 @@ class ContinuousBatcher:
         priority = normalize_priority(priority or self.default_priority)
         penalized = bool(presence_penalty or frequency_penalty)
         trace_id = str(trace_id or "")
+        if jrid and self.on_admit is not None:
+            # crash safety (core/journal.py): tell the journal the seed
+            # this admission will decode with BEFORE dispatch — with the
+            # journaled prompt digest it makes the admission replayable
+            try:
+                self.on_admit(str(jrid), int(req_seed))
+            # tlint: disable=TL005(journal telemetry must never fail an admission)
+            except Exception:
+                pass
         if self.mode == "remote":
             # drain accounting for close(): unhost must not tear the job
             # down under requests the worker is still decoding. Per-class
@@ -1115,7 +1130,7 @@ class ContinuousBatcher:
                     presence_penalty=presence_penalty,
                     frequency_penalty=frequency_penalty, seed=req_seed,
                     priority=priority, trace_id=trace_id,
-                    handoff=handoff,
+                    handoff=handoff, jrid=str(jrid or ""),
                 )
             finally:
                 with self._idle:
@@ -1188,6 +1203,7 @@ class ContinuousBatcher:
         self, ids, *, max_new_tokens, temperature, top_k, top_p, stream_cb,
         lookahead, presence_penalty, frequency_penalty, seed,
         speculative=False, priority=None, trace_id="", handoff=True,
+        jrid="",
     ) -> list[int]:
         """Single-stage pass-through: the worker's slot engine is the
         scheduler, so each request ships immediately — concurrency comes
@@ -1218,6 +1234,9 @@ class ContinuousBatcher:
             # per-request opt-out of the prefill→decode handoff on a
             # disaggregated pool (docs/SERVING.md)
             handoff=handoff,
+            # the journal rid (control-plane crash safety): the worker
+            # keys its live/orphan stream ledgers on it for re-attach
+            jrid=str(jrid or ""),
             # legacy lookahead runs the solo engine path; everything else
             # joins the worker's slot batch
             continuous=not spec,

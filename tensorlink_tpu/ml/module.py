@@ -187,17 +187,24 @@ class DistributedModel:
     # module.py:987-1021,699)
     # ------------------------------------------------------------------
     @classmethod
-    def from_job(cls, node, job_result: dict, **kw) -> "DistributedModel":
+    def from_job(cls, node, job_result: dict, *, attach_only: bool = False,
+                 **kw) -> "DistributedModel":
         """Attach to an already-created job (validator-hosted models: the
         validator plans + recruits itself — reference _initialize_hosted_job,
-        ml/validator.py:901 — then drives the job through its own node)."""
+        ml/validator.py:901 — then drives the job through its own node).
+
+        ``attach_only=True`` is the control-plane recovery handshake: the
+        MODULE frames tell each worker to ACK an already-live stage
+        instead of rebuilding it (a rebuild would kill every live slot),
+        and the acks re-announce live/orphaned streams into
+        ``self.attach_report`` for journal reconciliation."""
         model = cls(
             job_result["model"].get("name", "hosted"),
             node=node,
             start_session=False,
             **kw,
         )
-        model._attach(job_result)
+        model._attach(job_result, attach_only=attach_only)
         return model
 
     def _initialize_distribution(self) -> None:
@@ -208,10 +215,13 @@ class DistributedModel:
             raise JobDeclinedError(str(reply.get("error", reply)))
         self._attach(reply)
 
-    def _attach(self, reply: dict) -> None:
+    def _attach(self, reply: dict, attach_only: bool = False) -> None:
         from tensorlink_tpu.models.base import ModelConfig
         from tensorlink_tpu.parallel.planner import ShardingPlan
 
+        #: wid -> {"attached", "live_slots", "orphans"} from attach_only
+        #: re-handshakes (empty on a normal attach)
+        self.attach_report: dict[str, dict] = {}
         self.job_id = reply["job_id"]
         self.plan = ShardingPlan.from_json(reply["plan"])
         self.model_spec = reply.get("model", self.model_spec)
@@ -239,19 +249,25 @@ class DistributedModel:
                 # transit)
                 self.worker_addrs[wid] = [host, int(port)]
         for stage in self.plan.stages:
+            body = {
+                "job_id": self.job_id,
+                "model": self.model_spec,
+                "stage": _stage_dict(stage),
+                "training": self.training,
+            }
+            if attach_only:
+                body["attach_only"] = True
             resp = self._request_mirrored(
-                stage,
-                proto.MODULE,
-                {
-                    "job_id": self.job_id,
-                    "model": self.model_spec,
-                    "stage": _stage_dict(stage),
-                    "training": self.training,
-                },
-                timeout=MAX_WAIT_TIME,
+                stage, proto.MODULE, body, timeout=MAX_WAIT_TIME,
             )
             if not resp.get("ok"):
                 raise RuntimeError(f"stage load failed: {resp}")
+            if attach_only:
+                self.attach_report[stage.worker_id] = {
+                    "attached": bool(resp.get("attached", False)),
+                    "live_slots": int(resp.get("live_slots", 0) or 0),
+                    "orphans": list(resp.get("orphans", []) or []),
+                }
         self.log.info(
             "job %s distributed over %d stage(s)",
             self.job_id[:8], self.plan.n_stages,
@@ -756,6 +772,7 @@ class DistributedModel:
         trace_id: str | None = None,
         speculative: bool = False,
         handoff: bool = True,
+        jrid: str = "",
     ) -> list[list[int]]:
         """``reuse_prefix`` (B=1, single-stage): the worker's engine seeds
         the cache from the longest stored prompt prefix and prefills only
@@ -805,6 +822,7 @@ class DistributedModel:
                     trace_id=str(trace_id or ""),
                     speculative=bool(speculative),
                     handoff=bool(handoff),
+                    jrid=str(jrid or ""),
                 )
             return self._generate_remote(
                 prompts, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -1131,12 +1149,45 @@ class DistributedModel:
         follow = is_handoff or off_plan
         return adopt, (str(mig["worker"]) if follow else None), False
 
+    def reattach_continuous(
+        self, jrid: str, *, prompt, delivered=(), max_new_tokens: int,
+        temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+        eos_ids=(), seed: int = 0, stream_cb=None,
+        presence_penalty: float = 0.0, frequency_penalty: float = 0.0,
+        priority: str | None = None, trace_id: str = "",
+    ) -> list[int]:
+        """Client half of the re-attach ladder (validator loss mid-decode,
+        docs/FAILURE_MODEL.md "Control plane"). ``jrid`` is the journal
+        rid the original request carried; ``delivered`` is every token the
+        pre-crash client consumed (its high-water mark); the sampling
+        knobs and ``max_new_tokens`` must repeat the ORIGINAL request's
+        values. Rung 1 rebinds the worker's still-decoding slot (or
+        replays its finished-orphan ledger) and tops up past the
+        high-water mark exactly-once; a miss falls through on the worker
+        to rung 2, the PR 8 re-prefill resume — both rungs bit-identical
+        to the uninterrupted stream by the fold_in sampling contract."""
+        out = self._generate_continuous_remote(
+            [int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), eos_ids=eos_ids, seed=int(seed),
+            stream_cb=stream_cb,
+            presence_penalty=float(presence_penalty or 0.0),
+            frequency_penalty=float(frequency_penalty or 0.0),
+            priority=priority, trace_id=str(trace_id or ""),
+            jrid=str(jrid), reattach=str(jrid),
+            _delivered=[int(t) for t in delivered],
+        )
+        return out[0]
+
     def _generate_continuous_remote(
         self, prompt: list[int], *, max_new_tokens: int, temperature: float,
         top_k: int, top_p: float, eos_ids, seed: int, stream_cb,
         presence_penalty: float, frequency_penalty: float,
         priority: str | None = None, trace_id: str = "",
         speculative: bool = False, handoff: bool = True,
+        jrid: str = "", reattach: str = "",
+        _delivered: list[int] | None = None,
     ) -> list[list[int]]:
         """One request through the worker's continuous slot engine
         (B=1 per RPC; the worker co-batches concurrent requests into its
@@ -1150,7 +1201,9 @@ class DistributedModel:
         stream continues bit-identically: no duplicated, no missing
         tokens, and the replacement worker's fresh page allocator can't
         hand this session another session's KV blocks."""
-        delivered: list[int] = []
+        # a re-attach (validator recovery) pre-seeds delivered with what
+        # the pre-crash client already consumed — its high-water mark
+        delivered: list[int] = [int(t) for t in (_delivered or [])]
         recoveries = 0
         MAX_RECOVERIES = 3
         adopt: str | None = None  # staged-migration ticket on the dest
@@ -1184,6 +1237,21 @@ class DistributedModel:
                 "frequency_penalty": frequency_penalty,
                 "eos_ids": list(eos_ids), "seed": int(seed),
             }
+            if jrid:
+                # the journal rid rides every attempt: the worker keys its
+                # live-stream / orphan ledgers on it, which is what makes
+                # the re-attach ladder (and validator-recovery
+                # reconciliation) possible at all
+                body["jrid"] = jrid
+            if reattach:
+                # re-attach ladder rung 1: ask the worker to rebind the
+                # still-decoding (or finished-orphaned) stream and top up
+                # past our high-water mark. A MISS falls through to plain
+                # admission of THIS body — which already carries
+                # prompt+delivered / start_step, i.e. rung 2 (re-prefill
+                # resume) — on the worker, with no extra round trip.
+                body["reattach"] = reattach
+                body["hwm"] = len(delivered)
             if priority:
                 # the worker's scheduler reads the class off the wire; an
                 # old worker simply ignores the extra key (FCFS for it)
@@ -1240,10 +1308,16 @@ class DistributedModel:
                             recoveries += 1
                             handoff = False
                         continue
-                    return [
-                        delivered
-                        + [int(t) for t in resp["sequences"][0]]
-                    ]
+                    seq = [int(t) for t in resp["sequences"][0]]
+                    if resp.get("reattached"):
+                        # a re-attach HIT: sequences is the ORIGINAL
+                        # submission's full token list (everything since
+                        # its start_step = resume_base) — merge it onto
+                        # the prefix delivered BEFORE that submission, or
+                        # the overlap would be double-counted
+                        base = int(resp.get("resume_base", 0))
+                        return [delivered[:base] + seq]
+                    return [delivered + seq]
                 out, finished, mig = self._drain_continuous_stream(
                     wid, body, delivered, stream_cb
                 )
@@ -1400,12 +1474,15 @@ class DistributedModel:
                 # relay delivered so far (the migrated body's
                 # tokens_so_far is the authoritative top-up source)
                 return toks, False, mig
-            return (
-                delivered
-                + [int(x) for x in result["resp"]["sequences"][0]],
-                True,
-                None,
-            )
+            resp = result["resp"]
+            seq = [int(x) for x in resp["sequences"][0]]
+            if resp.get("reattached"):
+                # re-attach HIT: sequences spans the ORIGINAL submission
+                # (since resume_base) — merge onto the prefix delivered
+                # before it, not onto everything we've seen (overlap)
+                base = int(resp.get("resume_base", 0))
+                return delivered[:base] + seq, True, None
+            return delivered + seq, True, None
         err = result.get("err")
         if err is not None and "no connection" not in str(err):
             # compute errors and plain timeouts surface to the caller —

@@ -10,6 +10,7 @@ worker capacities, then hand the job back to the network process to recruit
 
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
@@ -66,6 +67,30 @@ class DistributedValidator:
         # (ROADMAP item 3): a draining validator keeps serving in-flight
         # work but should stop receiving new placements
         self.draining = False
+        # control-plane crash safety (core/journal.py, docs/FAILURE_MODEL
+        # "Control plane"): the write-ahead journal this validator records
+        # hosting / admissions / tickets / autopilot intents into, and the
+        # recovery-window flag /healthz + the API surface while recover()
+        # replays it (api/server.py answers 503 + Retry-After meanwhile)
+        self.recovering = False
+        self._journal_errors = 0
+        self.journal = None
+        ml_cfg = node.config.ml
+        if getattr(ml_cfg, "journal", True):
+            try:
+                from tensorlink_tpu.core.journal import ControlJournal
+
+                self.journal = ControlJournal(
+                    Path(node.config.log_dir) / "control_journal.jsonl",
+                    flush_every=int(
+                        getattr(ml_cfg, "journal_flush_every", 16)
+                    ),
+                    flush_s=float(getattr(ml_cfg, "journal_flush_s", 0.05)),
+                )
+            except OSError as e:
+                # no journal ≠ no serving: run exactly as before PR 16,
+                # just without crash recovery — and say so loudly
+                self.log.warning("control journal unavailable: %s", e)
         if node.config.ml.autoload_default_models:
             threading.Thread(
                 target=self._autoload_defaults,
@@ -114,9 +139,84 @@ class DistributedValidator:
             except Exception:
                 self.log.exception("default model %s failed to host", name)
 
+    # -- control-plane journal (crash safety) ----------------------------
+    # every helper swallows journal failures: the journal is a durability
+    # layer, and a full disk / injected journal.write fault must degrade
+    # to "no crash recovery", never to a failed request
+    def _journal_rec(self, kind: str, data: dict | None = None, *,
+                     flush: bool = False):
+        j = self.journal
+        if j is None:
+            return None
+        try:
+            return j.append(kind, data, flush=flush)
+        except Exception as e:
+            self._journal_errors += 1
+            self.log.debug("journal write failed (%s): %s", kind, e)
+            return None
+
+    def _jintent(self, kind: str, data: dict | None = None):
+        j = self.journal
+        if j is None:
+            return None
+        try:
+            return j.intent(kind, data)
+        except Exception as e:
+            self._journal_errors += 1
+            self.log.debug("journal intent failed (%s): %s", kind, e)
+            return None
+
+    def _jcommit(self, iid, data: dict | None = None) -> None:
+        if self.journal is None or iid is None:
+            return
+        try:
+            self.journal.commit(iid, data)
+        except Exception as e:
+            self._journal_errors += 1
+            self.log.debug("journal commit failed: %s", e)
+
+    def _jabort(self, iid, data: dict | None = None) -> None:
+        if self.journal is None or iid is None:
+            return
+        try:
+            self.journal.abort(iid, data)
+        except Exception as e:
+            self._journal_errors += 1
+            self.log.debug("journal abort failed: %s", e)
+
+    def _journal_replica(self, job: HostedJob, rep: dict) -> None:
+        self._journal_rec("replica_up", {
+            "name": job.name, "rid": rep["rid"], "job_id": rep["job_id"],
+            "attach": rep.get("attach") or {},
+            "spec": rep.get("spec") or {},
+            "batch": rep.get("batch", 1), "seed": rep.get("seed", 0),
+            "seq_len": job.seq_len,
+        }, flush=True)
+
+    def _note_admit_seed(self, jrid: str, seed: int) -> None:
+        """ContinuousBatcher.on_admit hook: pair the admission record with
+        the decode seed the batcher assigned (write-ahead — called before
+        dispatch), completing the journal's replayable admission tuple."""
+        self._journal_rec("seed", {"jrid": jrid, "seed": int(seed)})
+
     def run(self) -> None:
+        # a RESTARTED validator replays its journal before serving (a
+        # fresh one no-ops in microseconds: empty journal, nothing live).
+        # Failures degrade to a cold start — recovery must never wedge
+        # the work loop.
+        try:
+            self.recover()
+        except Exception:
+            self.log.exception("startup recovery failed — cold start")
         while True:
-            item = self.bridge.get_work(timeout=1.0)
+            try:
+                item = self.bridge.get_work(timeout=1.0)
+            except EOFError:
+                # the bridge ring closed under us — a crashed/stopped node;
+                # exit the loop instead of dying with an unhandled thread
+                # exception (the chaos suite kills validators mid-decode)
+                self.log.info("work bridge closed — validator loop exiting")
+                return
             if item is None:
                 continue
             kind, payload = item
@@ -378,8 +478,11 @@ class DistributedValidator:
         self, job: HostedJob, model_spec: dict, cfg, *, batch, seed,
     ) -> tuple:
         """Plan, recruit, attach, and wrap ONE serving replica of
-        ``job``'s model: (model, batcher, job_id). Raises on failure
-        after releasing whatever recruiting reserved."""
+        ``job``'s model: (model, batcher, job_id, attach) — ``attach`` is
+        the JSON-safe job result a recovered validator replays to
+        re-attach this replica without rebuilding it
+        (DistributedModel.from_job(..., attach_only=True)). Raises on
+        failure after releasing whatever recruiting reserved."""
         from tensorlink_tpu.ml.module import DistributedModel
 
         result = self._plan_and_create(
@@ -402,6 +505,24 @@ class DistributedValidator:
             except Exception:
                 self.log.warning("rollback of job %s failed", result["job_id"][:8])
             raise
+        batcher = self._make_batcher(
+            job, model, cfg, result.get("serving_roles") or {},
+        )
+        self.log.info(
+            "replica of %s ready (%d stages, job %s)",
+            job.name, len(result["plan"]["stages"]), result["job_id"][:8],
+        )
+        attach = {
+            k: result[k]
+            for k in ("job_id", "plan", "model", "workers", "serving_roles")
+            if k in result
+        }
+        return model, batcher, result["job_id"], attach
+
+    def _make_batcher(self, job: HostedJob, model, cfg, serving_roles: dict):
+        """ONE construction site for a replica's batcher — first host and
+        crash-recovery re-attach must pick the same kind with the same
+        knobs or replayed replicas would silently change behavior."""
         from tensorlink_tpu.ml.batching import ContinuousBatcher, GenBatcher
 
         ml_cfg = self.node.config.ml
@@ -421,7 +542,7 @@ class DistributedValidator:
         entry_role = "mixed"
         if getattr(model, "plan", None) is not None:
             entry_role = str(
-                (result.get("serving_roles") or {}).get(
+                (serving_roles or {}).get(
                     model.plan.stages[0].worker_id
                 ) or "mixed"
             )
@@ -451,11 +572,11 @@ class DistributedValidator:
                 # a batch never exceeds what the engine's buckets compile for
                 max_batch=min(ml_cfg.max_serve_batch, ml_cfg.batch_buckets[-1]),
             )
-        self.log.info(
-            "replica of %s ready (%d stages, job %s)",
-            job.name, len(result["plan"]["stages"]), result["job_id"][:8],
-        )
-        return model, batcher, result["job_id"]
+        if hasattr(batcher, "on_admit"):
+            # write-ahead seed journaling: the batcher tells the journal
+            # each jrid-tagged admission's decode seed before dispatch
+            batcher.on_admit = self._note_admit_seed
+        return batcher
 
     def _do_host(
         self, job: HostedJob, *, batch, seq_len, config, seed, quant=None
@@ -481,20 +602,32 @@ class DistributedValidator:
         job.seq_len = min(seq_len or cfg.max_seq_len, cfg.max_seq_len)
         job.tokenizer = load_tokenizer(model_spec)
 
-        job.model, job.batcher, jid = self._build_replica(
-            job, model_spec, cfg, batch=batch, seed=seed,
-        )
-        job.replicas = [{
-            "rid": "r0", "model": job.model, "batcher": job.batcher,
-            "job_id": jid, "spec": dict(model_spec), "batch": batch,
-            "seed": seed,
-        }]
-        ml_cfg = self.node.config.ml
-        n_replicas = max(int(getattr(ml_cfg, "fleet_replicas", 1)), 1)
-        if n_replicas > 1:
-            self._grow_fleet(job, model_spec, cfg, n_replicas,
-                             batch=batch, seed=seed)
+        # write-ahead: the host intent (with everything needed to rebuild
+        # the job shell at recovery) is durable before recruiting starts
+        iid = self._jintent("host", {
+            "name": name, "spec": dict(model_spec), "batch": batch,
+            "seed": seed, "seq_len": job.seq_len,
+        })
+        try:
+            job.model, job.batcher, jid, attach = self._build_replica(
+                job, model_spec, cfg, batch=batch, seed=seed,
+            )
+            job.replicas = [{
+                "rid": "r0", "model": job.model, "batcher": job.batcher,
+                "job_id": jid, "spec": dict(model_spec), "batch": batch,
+                "seed": seed, "attach": attach,
+            }]
+            self._journal_replica(job, job.replicas[0])
+            ml_cfg = self.node.config.ml
+            n_replicas = max(int(getattr(ml_cfg, "fleet_replicas", 1)), 1)
+            if n_replicas > 1:
+                self._grow_fleet(job, model_spec, cfg, n_replicas,
+                                 batch=batch, seed=seed)
+        except Exception as e:
+            self._jabort(iid, {"error": f"{type(e).__name__}: {e}"[:200]})
+            raise
         job.status = "ready"
+        self._jcommit(iid, {"replicas": len(job.replicas)})
         self.log.info(
             "hosting %s ready (%d replica(s))", name, len(job.replicas)
         )
@@ -516,7 +649,7 @@ class DistributedValidator:
         router.register("r0", job.batcher)
         for i in range(1, n_replicas):
             try:
-                model, batcher, jid = self._build_replica(
+                model, batcher, jid, attach = self._build_replica(
                     job, model_spec, cfg, batch=batch, seed=seed,
                 )
             except Exception as e:
@@ -529,8 +662,9 @@ class DistributedValidator:
             job.replicas.append({
                 "rid": f"r{i}", "model": model, "batcher": batcher,
                 "job_id": jid, "spec": dict(model_spec), "batch": batch,
-                "seed": seed,
+                "seed": seed, "attach": attach,
             })
+            self._journal_replica(job, job.replicas[-1])
             router.register(f"r{i}", batcher)
         if len(job.replicas) < 2:
             return  # no fleet materialized: the single-replica path stands
@@ -552,7 +686,328 @@ class DistributedValidator:
             interval_s=float(
                 getattr(ml_cfg, "fleet_autopilot_interval_s", 2.0)
             ),
+            on_action=self._journal_action(job.name),
         ).start()
+
+    def _journal_action(self, name: str):
+        """The autopilot's on_action hook bound to one hosted model:
+        intent/commit/abort pairs land in the control journal so a crash
+        mid-deploy is resumed (open "action" intents at replay → re-queued
+        via request_deploy) or rolled back — never forgotten."""
+
+        def hook(phase: str, kind: str, rid: str, token=None):
+            if phase == "intent":
+                return self._jintent(
+                    "action", {"verb": kind, "rid": rid, "name": name},
+                )
+            if token is None:
+                return None
+            if phase == "commit":
+                self._jcommit(token)
+            else:
+                self._jabort(token)
+            return token
+
+        return hook
+
+    # ------------------------------------------------------------------
+    # crash recovery (PR 16 tentpole, docs/FAILURE_MODEL.md "Control
+    # plane"): a restarted validator replays its journal, re-handshakes
+    # the workers that kept serving through the crash, and reconciles the
+    # journal's view of in-flight streams against theirs
+    # ------------------------------------------------------------------
+    def recover(self) -> dict:
+        """Replay the control journal and re-attach to whatever the fleet
+        kept alive across this validator's crash/restart.
+
+        - hosted jobs with journaled replicas re-attach WITHOUT rebuilding
+          (``DistributedModel.from_job(..., attach_only=True)`` — a
+          rebuild would kill the live slots the workers preserved);
+        - open migration tickets (drains the crash interrupted) are
+          expired deterministically at both endpoints — staged pages drop,
+          page conservation re-checked;
+        - open autopilot action intents resolve: deploys re-queue,
+          everything else aborts (the control loop re-decides from live
+          state);
+        - in-flight admissions reconcile against the worker-reported
+          live/orphan streams — journal wins for PLACEMENT, worker wins
+          for TOKENS.
+
+        ``self.recovering`` is True for the duration; /healthz surfaces it
+        and the API answers 503 + Retry-After meanwhile. Safe to call on a
+        fresh validator (empty journal → fast no-op)."""
+        from tensorlink_tpu.core.journal import ControlJournal
+
+        if self.journal is None:
+            return {"recovered": False, "reason": "journal disabled"}
+        self.journal.flush()
+        st = ControlJournal.replay(self.journal.path)
+        live = {
+            name: jrec for name, jrec in st.live_jobs().items()
+            if name not in self.hosted
+        }
+        open_migs = st.open_intents("mig")
+        open_actions = st.open_intents("action")
+        if not live and not open_migs and not open_actions:
+            return {
+                "recovered": False, "reason": "nothing to recover",
+                "torn": st.torn,
+            }
+        self.recovering = True
+        info: dict = {
+            "recovered": True, "torn": st.torn, "jobs": {},
+            "streams": [], "expired_migrations": 0, "requeued_deploys": 0,
+        }
+        t0 = time.monotonic()
+        try:
+            for name, jrec in live.items():
+                try:
+                    job = self._recover_job(name, jrec, st, info)
+                    info["jobs"][name] = {
+                        "status": job.status, "replicas": len(job.replicas),
+                    }
+                except Exception as e:
+                    self.log.exception("recovery of %s failed", name)
+                    info["jobs"][name] = {
+                        "status": "failed",
+                        "error": f"{type(e).__name__}: {e}"[:200],
+                    }
+            self._expire_open_migrations(open_migs, info)
+            self._resume_open_actions(open_actions, info)
+            self._journal_rec("recovered", {
+                "jobs": {
+                    n: str(j.get("status", "")) for n, j in info["jobs"].items()
+                },
+                "streams": len(info["streams"]),
+                "expired_migrations": info["expired_migrations"],
+                "t_s": round(time.monotonic() - t0, 3),
+            }, flush=True)
+        finally:
+            self.recovering = False
+        self.log.info(
+            "control-plane recovery: %d job(s), %d in-flight stream(s) "
+            "reconciled, %d staged ticket(s) expired, %d deploy(s) "
+            "re-queued, %d torn record(s) skipped (%.2fs)",
+            len(info["jobs"]), len(info["streams"]),
+            info["expired_migrations"], info["requeued_deploys"], st.torn,
+            time.monotonic() - t0,
+        )
+        return info
+
+    def _recover_job(self, name: str, jrec: dict, st, info: dict) -> HostedJob:
+        """Rebuild one hosted job's shell from its journal record and
+        re-attach every journaled replica. A replica that fails to
+        re-attach (its worker died too) degrades the job instead of
+        failing the recovery — same posture as ``_grow_fleet``."""
+        from tensorlink_tpu.api.tokenizer import load_tokenizer
+        from tensorlink_tpu.fleet.router import FleetRouter
+
+        reps = jrec["replicas"]  # rid -> replica_up record
+        any_rep = next(iter(reps.values()))
+        spec = dict(
+            (jrec["data"] or {}).get("spec") or any_rep.get("spec") or {}
+        )
+        if not spec:
+            raise RuntimeError("journal carries no model spec to rebuild from")
+        cfg = self._resolve_config(spec)
+        seq_len = int(
+            (jrec["data"] or {}).get("seq_len")
+            or any_rep.get("seq_len") or cfg.max_seq_len
+        )
+        job = HostedJob(name=name)
+        job.cfg = cfg
+        job.seq_len = min(seq_len, cfg.max_seq_len)
+        job.tokenizer = load_tokenizer(spec)
+        with self._host_lock:
+            cur = self.hosted.get(name)
+            if cur is not None and cur.status in ("loading", "ready"):
+                return cur  # hosted since the replay snapshot — keep it
+            self.hosted[name] = job
+        recovered: list[dict] = []
+        for rid in sorted(reps, key=lambda r: (r != "r0", r)):
+            try:
+                recovered.append(
+                    self._reattach_replica(job, rid, reps[rid])
+                )
+            except Exception as e:
+                self.log.warning(
+                    "replica %s of %s did not re-attach (%s: %s) — "
+                    "recovering without it", rid, name, type(e).__name__, e,
+                )
+                self._journal_rec(
+                    "replica_down", {"name": name, "rid": rid}, flush=True,
+                )
+        if not recovered:
+            job.status = "failed"
+            job.error = "no replica re-attached"
+            raise RuntimeError(job.error)
+        job.replicas = recovered
+        job.model = recovered[0]["model"]
+        job.batcher = recovered[0]["batcher"]
+        self._reconcile_streams(job, recovered, st, info)
+        if len(recovered) > 1:
+            ml_cfg = self.node.config.ml
+            router = FleetRouter(
+                refresh_s=float(getattr(ml_cfg, "fleet_refresh_s", 0.5)),
+            )
+            for rep in recovered:
+                router.register(rep["rid"], rep["batcher"])
+            # journaled admission placements seed the routed counters so
+            # routing telemetry survives the restart (fleet/router.py)
+            router.seed_state({"routed": st.routed_counts()})
+            job.router = router
+            self._push_replica_sets(job)
+            if bool(getattr(ml_cfg, "fleet_autopilot", False)):
+                self._start_autopilot(job)
+        job.status = "ready"
+        return job
+
+    def _reattach_replica(self, job: HostedJob, rid: str, rdata: dict) -> dict:
+        """attach_only re-handshake of one journaled replica: the workers
+        ACK their already-live stage (no rebuild — a rebuild would kill
+        the slots that survived us) and re-announce live/orphan streams
+        into ``model.attach_report``."""
+        from tensorlink_tpu.ml.module import DistributedModel
+
+        attach = dict(rdata.get("attach") or {})
+        if not attach.get("plan"):
+            raise RuntimeError("replica_up record carries no attach payload")
+        model = DistributedModel.from_job(
+            self.node, attach, seq_len=job.seq_len,
+            seed=int(rdata.get("seed", 0) or 0), attach_only=True,
+        )
+        batcher = self._make_batcher(
+            job, model, job.cfg, attach.get("serving_roles") or {},
+        )
+        return {
+            "rid": rid, "model": model, "batcher": batcher,
+            "job_id": attach.get("job_id") or rdata.get("job_id", ""),
+            "spec": dict(rdata.get("spec") or {}),
+            "batch": int(rdata.get("batch", 1) or 1),
+            "seed": int(rdata.get("seed", 0) or 0),
+            "attach": attach,
+        }
+
+    def _reconcile_streams(
+        self, job: HostedJob, recovered: list, st, info: dict,
+    ) -> None:
+        """Merge the journal's in-flight admissions with the
+        worker-reported live/orphaned streams from the attach_only acks.
+        Contract (core/journal.py): the journal is authoritative for
+        PLACEMENT, the worker for TOKENS — its count can only be >= the
+        journaled high-water mark, so the mark is raised, never cut."""
+        worker_view: dict[str, dict] = {}
+        for rep in recovered:
+            report = getattr(rep["model"], "attach_report", None) or {}
+            for wid, ack in report.items():
+                for o in ack.get("orphans", []) or []:
+                    jrid = str(o.get("jrid", ""))
+                    if jrid:
+                        worker_view[jrid] = {
+                            "rid": rep["rid"], "worker": wid,
+                            "n": int(o.get("n", 0) or 0),
+                            "finished": bool(o.get("finished")),
+                        }
+        for jrid, adm in st.orphan_admissions():
+            if str(adm["data"].get("model", "")) != job.name:
+                continue
+            wv = worker_view.get(jrid)
+            if wv is not None and wv["n"] > int(adm["hwm"]):
+                # worker wins for tokens: raise the journaled mark to what
+                # actually decoded while the control plane was down
+                self._journal_rec("hwm", {"jrid": jrid, "n": int(wv["n"])})
+            info["streams"].append({
+                "jrid": jrid,
+                "journal_hwm": int(adm["hwm"]),
+                "worker_n": int(wv["n"]) if wv else None,
+                "live": bool(wv and not wv["finished"]),
+                # a stream the worker no longer holds is NOT resumable
+                # from the buffer — the client's re-attach falls through
+                # to a plain re-prefill resume (exactly-once regardless)
+                "resumable": wv is not None,
+            })
+
+    def _expire_open_migrations(self, open_migs: list, info: dict) -> None:
+        """Satellite fix: a drain in flight when the validator died may
+        have left page-carrying migration tickets STAGED (exported, never
+        committed). Expire them deterministically at replay — both
+        endpoints drop staged pages and re-check page conservation — then
+        abort the journal intent so the next replay sees it closed."""
+        for iid, ent in open_migs:
+            data = ent.get("data") or {}
+            wids = {
+                str(data.get("src") or ""), str(data.get("dest") or ""),
+            } - {""}
+            # dial the ticket's journaled endpoint addresses first: the
+            # drain DESTINATION is usually outside the re-attached plan,
+            # so this restarted validator holds no connection to it and
+            # the per-wid expiry below would fail as "unknown worker"
+            for addr_key in ("src_addr", "dest_addr"):
+                addr = data.get(addr_key) or []
+                if len(addr) == 2:
+                    try:
+                        self.bridge.request(
+                            "connect",
+                            {"host": str(addr[0]), "port": int(addr[1])},
+                            timeout=10.0,
+                        )
+                    except Exception as e:
+                        self.log.debug(
+                            "dial of %s for ticket expiry failed: %s",
+                            addr, e,
+                        )
+            if not data.get("dest"):
+                # dest-less drain: the net layer chose the destination and
+                # the choice died with it — sweep every worker (expire is
+                # a no-op where nothing is staged)
+                try:
+                    stats = self.bridge.request("stats_workers", timeout=15.0)
+                    wids |= {
+                        str(s.get("id")) for s in stats if s.get("id")
+                    }
+                except Exception as e:
+                    self.log.warning("worker sweep for expiry failed: %s", e)
+            expired = 0
+            for wid in sorted(wids):
+                try:
+                    r = self.bridge.request(
+                        "expire_migrations",
+                        {"worker": wid, "job_id": data.get("job_id", "")},
+                        timeout=30.0,
+                    )
+                    if isinstance(r, dict):
+                        expired += int(r.get("expired", 0) or 0)
+                except Exception as e:
+                    self.log.warning(
+                        "migration-ticket expiry on %s failed: %s",
+                        wid[:8], e,
+                    )
+            info["expired_migrations"] += expired
+            self._jabort(iid, {"recovery": "expired", "expired": expired})
+
+    def _resume_open_actions(self, open_actions: list, info: dict) -> None:
+        """Open autopilot intents — the crash interrupted a control
+        action. Deploys re-queue (rehost converges; repeating one is
+        idempotent), everything else aborts and the control loop
+        re-decides from live state."""
+        for iid, ent in open_actions:
+            data = ent.get("data") or {}
+            verb = str(data.get("verb", ""))
+            job = self.hosted.get(str(data.get("name", "")))
+            requeued = False
+            if verb == "deploy" and job is not None and job.autopilot is not None:
+                rid = str(data.get("rid", ""))
+                try:
+                    job.autopilot.request_deploy([rid] if rid else None)
+                    info["requeued_deploys"] += 1
+                    requeued = True
+                except Exception:
+                    self.log.exception(
+                        "deploy re-queue for %s failed", data.get("name"),
+                    )
+            self._jabort(
+                iid, {"recovery": "requeued" if requeued else "dropped"},
+            )
 
     def _replica_entry_worker(self, rep: dict) -> str:
         model = rep.get("model")
@@ -599,6 +1054,7 @@ class DistributedValidator:
             job = self.hosted.pop(name, None)
         if job is None:
             return False
+        self._journal_rec("unhost", {"name": name}, flush=True)
         if job.autopilot is not None:
             job.autopilot.stop()  # no control actions during teardown
         # fleet replicas beyond r0 (r0 IS job.model/job.batcher below)
@@ -687,6 +1143,10 @@ class DistributedValidator:
             # serving" — the external-LB routing fields)
             "headroom": headroom,
             "draining": bool(self.draining),
+            # recovery window (control-plane crash safety): True while
+            # recover() is replaying the journal — the API answers new
+            # generations 503 + Retry-After until it drops
+            "recovering": bool(self.recovering),
         }
 
     def metrics_groups(self) -> list[tuple[dict, Any]]:
@@ -790,10 +1250,15 @@ class DistributedValidator:
         req,  # schemas.GenerationRequest
         on_delta: Callable[[str], None] | None = None,
         trace_id: str | None = None,
+        meta_cb: Callable[[dict], None] | None = None,
     ) -> dict:
         """Run one generation on a hosted model. Returns
-        ``{text, reasoning, prompt_tokens, completion_tokens, finish_reason}``.
+        ``{text, reasoning, prompt_tokens, completion_tokens, finish_reason,
+        jrid}``.
         ``on_delta`` receives visible-answer text pieces as they decode.
+        ``meta_cb`` (streaming only) fires once at admission with
+        ``{"jrid": ...}`` so SSE clients hold their re-attach handle
+        BEFORE any crash can interrupt the stream.
         ``trace_id`` (minted by the API server) threads through the
         batcher to the engine so every hop's spans land under it, and is
         installed as the ACTIVE trace on this worker thread so json-mode
@@ -803,12 +1268,13 @@ class DistributedValidator:
         tid = str(trace_id or "")
         token = current_trace.set(tid)
         try:
-            return self._generate_api(req, on_delta, tid)
+            return self._generate_api(req, on_delta, tid, meta_cb)
         finally:
             # the pool thread serves many requests — never leak the id
             current_trace.reset(token)
 
-    def _generate_api(self, req, on_delta, trace_id: str) -> dict:
+    def _generate_api(self, req, on_delta, trace_id: str,
+                      meta_cb=None) -> dict:
         from tensorlink_tpu.api.formatter import (
             StopStream,
             ThinkStripStream,
@@ -839,6 +1305,36 @@ class DistributedValidator:
         if len(ids) > max_ctx - reserve:
             ids = ids[-(max_ctx - reserve):]
         args = normalize_generate_args(req, prompt_len=len(ids), max_context=max_ctx)
+
+        # control-plane journal: write-ahead admission record. jrid is the
+        # durable re-attach handle — the worker keys its live-stream and
+        # orphan ledgers on it, so a restarted validator (or a client that
+        # outlived one) can resume this exact stream. The prompt travels
+        # as a digest only (the journal is an ops artifact, not a prompt
+        # store); the seed record pairs up via the batcher's on_admit hook.
+        # A re-attach request REUSES the pre-crash jrid: its admission is
+        # already journaled (and open — no finish record), so a second
+        # admit would reset the replayed high-water mark.
+        rjid = str(getattr(req, "reattach", "") or "")
+        jrid = rjid or uuid.uuid4().hex
+        if not rjid:
+            self._journal_rec(
+                "admit",
+                {
+                    "jrid": jrid,
+                    "model": req.hf_name,
+                    "prompt_sha": hashlib.sha256(
+                        ",".join(map(str, ids)).encode()
+                    ).hexdigest()[:16],
+                    "n_prompt": len(ids),
+                    "priority": str(getattr(req, "priority", None) or ""),
+                    "max_new_tokens": int(args["max_new_tokens"]),
+                    "placement": "router" if job.router is not None else "r0",
+                },
+                flush=True,
+            )
+        if meta_cb is not None:
+            meta_cb({"jrid": jrid})
 
         stripper = ThinkStripStream() if not req.enable_thinking else None
         # Incremental detokenization via the offset algorithm (HF
@@ -888,12 +1384,21 @@ class DistributedValidator:
                 _deliver(delta)
 
         use_cb = on_delta is not None or stream_stops is not None
+        # delivered-token high-water marks, journaled every N tokens at
+        # chunk granularity (streamed requests only — a non-streamed
+        # request has delivered nothing until it returns, so its whole
+        # outcome is the single finish record)
+        hwm_every = max(int(getattr(self.node.config.ml, "journal_hwm_every", 16)), 1)
+        hwm_next = [hwm_every]
 
         def stream_cb(new_tokens: list[int | None]):
             nonlocal prefix_offset, read_offset
             if not use_cb:
                 return None
             emitted_ids.extend(t for t in new_tokens if t is not None)
+            if len(emitted_ids) >= hwm_next[0]:
+                self._journal_rec("hwm", {"jrid": jrid, "n": len(emitted_ids)})
+                hwm_next[0] = len(emitted_ids) + hwm_every
             prefix_text = tok.decode(emitted_ids[prefix_offset:read_offset])
             new_text = tok.decode(emitted_ids[prefix_offset:])
             if len(new_text) > len(prefix_text) and not new_text.endswith("�"):
@@ -924,7 +1429,20 @@ class DistributedValidator:
         spec = bool(getattr(req, "lookahead", False)) and args["temperature"] == 0.0
         spec_cont = bool(getattr(req, "speculative", False))
         beams_used = None
-        if n_beams > 1:
+        if (
+            rjid
+            and n_beams == 1
+            and job.batcher is not None
+            and job.model is not None
+            and getattr(job.model, "plan", None) is not None
+            and job.model.plan.n_stages == 1
+        ):
+            out_ids = self._reattach_api(
+                job, rjid, ids, args, req,
+                stream_cb=stream_cb if use_cb else None,
+                trace_id=trace_id,
+            )
+        elif n_beams > 1:
             # deterministic beam decode: bypass the batcher (beams cannot
             # co-batch with other requests — they ARE the batch rows) and
             # serialize on the model lock like the non-batcher path; the
@@ -956,8 +1474,17 @@ class DistributedValidator:
                 job.router.dispatch if job.router is not None
                 else job.batcher.generate
             )
+            kw: dict = {}
+            if job.router is not None:
+                # journal the replica actually chosen (the admit record
+                # could only say "router") so replayed routed-counts seed
+                # the recovered router's real per-replica counters
+                kw["on_route"] = lambda rid: self._journal_rec(
+                    "place", {"jrid": jrid, "rid": rid}
+                )
             out_ids = gen(
                 ids,
+                jrid=jrid,
                 max_new_tokens=args["max_new_tokens"],
                 temperature=args["temperature"],
                 top_k=args["top_k"],
@@ -972,6 +1499,7 @@ class DistributedValidator:
                 # per-request opt-out of the disaggregated prefill→decode
                 # handoff ({"handoff": false}; default opted in)
                 handoff=bool(getattr(req, "handoff", True)),
+                **kw,
             )
         else:
             with job.lock:  # serialize per-model generation
@@ -1033,17 +1561,69 @@ class DistributedValidator:
                 else:
                     lo_k = mid + 1
             completion = lo_k
+        # finish closes the admission in the journal: replay no longer
+        # treats this jrid as an orphaned stream needing reconciliation
+        self._journal_rec("finish", {"jrid": jrid, "n": completion, "reason": finish})
         out = {
             "text": answer,
             "reasoning": reasoning,
             "prompt_tokens": len(ids),
             "completion_tokens": completion,
             "finish_reason": finish,
+            # the durable re-attach handle: a client that outlives this
+            # validator repeats its request with {"reattach": jrid} against
+            # the recovered one (docs/FAILURE_MODEL.md "Control plane")
+            "jrid": jrid,
         }
         if beams_used is not None and beams_used != n_beams:
             out["num_beams_used"] = int(beams_used)  # worker clamped
         return out
 
+    def _reattach_api(self, job, rjid: str, ids, args, req, *,
+                      stream_cb, trace_id: str):
+        """Serve a ``{"reattach": jrid}`` request: rung 1 of the client
+        re-attach ladder over REST. The journaled admission supplies the
+        decode seed and (fleet) the replica placement; the worker rebinds
+        its still-live slot or replays its finished-orphan buffer, and a
+        miss falls through to a plain re-prefill generate — every rung
+        returns the COMPLETE stream from token 0, so the client replaces
+        its partial pre-crash text (exactly-once by replacement)."""
+        from tensorlink_tpu.core.journal import ControlJournal
+
+        seed = 0
+        placement = ""
+        if self.journal is not None:
+            try:
+                adm = ControlJournal.replay(
+                    self.journal.path
+                ).admissions.get(rjid)
+                if adm is not None:
+                    if adm.get("seed") is not None:
+                        seed = int(adm["seed"])
+                    placement = str(adm["data"].get("placement", "") or "")
+            except Exception as e:
+                self.log.debug("journal lookup for re-attach failed: %s", e)
+        model = job.model
+        for rep in job.replicas or []:
+            if placement and rep.get("rid") == placement:
+                model = rep["model"]
+                break
+        return model.reattach_continuous(
+            rjid,
+            prompt=ids,
+            delivered=[],
+            max_new_tokens=args["max_new_tokens"],
+            temperature=args["temperature"],
+            top_k=args["top_k"],
+            top_p=args["top_p"],
+            presence_penalty=args["presence_penalty"],
+            frequency_penalty=args["frequency_penalty"],
+            eos_ids=job.tokenizer.eos_ids,
+            seed=seed,
+            stream_cb=stream_cb,
+            priority=getattr(req, "priority", None) or None,
+            trace_id=trace_id,
+        )
 
     # ------------------------------------------------------------------
     # fleet serving (tensorlink_tpu/fleet, docs/SERVING.md "Fleet
@@ -1088,6 +1668,16 @@ class DistributedValidator:
             autopilot = job.autopilot
         queued = autopilot.request_deploy(replicas)
         return {"ok": True, "queued": queued}
+
+
+def _attach_addr(rep: dict | None, wid: str) -> list:
+    """``[host, port]`` of ``wid`` from a replica's journaled attach
+    payload (the create_job worker map), ``[]`` when unknown — used to
+    make migration tickets self-contained for crash recovery."""
+    if not rep or not wid:
+        return []
+    addr = ((rep.get("attach") or {}).get("workers") or {}).get(wid)
+    return list(addr) if addr else []
 
 
 class ValidatorFleetActions:
@@ -1178,23 +1768,44 @@ class ValidatorFleetActions:
         # When no sibling runs on a different worker the verb goes out
         # dest-less: the net layer picks most-free, and the worker's own
         # REPLICA_SET fallback backstops a validator with no candidates.
-        dest = next(
+        dest, dest_rep = next(
             (
-                w for r2 in self.job.replicas
+                (w, r2) for r2 in self.job.replicas
                 if r2 is not rep
                 and (w := self.validator._replica_entry_worker(r2))
                 and w != wid
             ),
-            None,
+            (None, None),
         )
         req = {"worker": wid}
         if dest:
             req["dest"] = dest
-        summary = self.validator.bridge.request(
-            "drain_worker", req, timeout=600.0,
-        )
+        # write-ahead migration ticket: a validator that dies while this
+        # drain is in flight leaves an OPEN "mig" intent in the journal;
+        # recovery expires the staged pages at both endpoints
+        # deterministically (no half-staged tickets leak), then aborts it.
+        # The endpoint ADDRESSES ride the ticket: the recovered validator
+        # re-dials only the plan workers, and the drain destination is
+        # outside the source plan by construction — without its address
+        # the expiry could never reach the staged pages.
+        iid = self.validator._jintent("mig", {
+            "name": self.job.name, "rid": rid, "src": wid,
+            "dest": dest or "", "job_id": rep["job_id"],
+            "src_addr": _attach_addr(rep, wid),
+            "dest_addr": _attach_addr(dest_rep, dest or ""),
+        })
+        try:
+            summary = self.validator.bridge.request(
+                "drain_worker", req, timeout=600.0,
+            )
+        except Exception as e:
+            self.validator._jabort(iid, {"error": str(e)[:200]})
+            raise
         if isinstance(summary, dict) and summary.get("ok"):
             self._drained.add(rid)
+            self.validator._jcommit(iid, {"ok": True})
+        else:
+            self.validator._jabort(iid, {"summary": str(summary)[:200]})
         self.log.info(
             "autopilot drain of replica %s (worker %s → %s): %s",
             rid, wid[:8], (dest or "auto")[:8], summary,
@@ -1226,11 +1837,18 @@ class ValidatorFleetActions:
         if rep is None:
             return None
         old_batcher, old_model = rep["batcher"], rep["model"]
-        model, batcher, jid = self.validator._build_replica(
+        model, batcher, jid, attach = self.validator._build_replica(
             self.job, dict(rep["spec"]), self.job.cfg,
             batch=rep.get("batch", 1), seed=rep.get("seed", 0),
         )
-        rep.update({"model": model, "batcher": batcher, "job_id": jid})
+        self.validator._journal_rec(
+            "replica_down", {"name": self.job.name, "rid": rid}, flush=True,
+        )
+        rep.update({
+            "model": model, "batcher": batcher, "job_id": jid,
+            "attach": attach,
+        })
+        self.validator._journal_replica(self.job, rep)
         self._drained.discard(rid)  # the rebuilt replica serves again
         if rep is self.job.replicas[0]:
             self.job.model, self.job.batcher = model, batcher

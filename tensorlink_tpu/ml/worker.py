@@ -94,6 +94,17 @@ class StageRuntime:
     # the op's KV writes (ml/module.py drives retries on these seqs)
     session_seq: dict[str, int] = field(default_factory=dict)
     session_resp: dict[str, tuple] = field(default_factory=dict)
+    # control-plane crash safety (docs/FAILURE_MODEL.md "Control plane"):
+    # journal rid -> live ContinuousRequest for every continuous stream
+    # admitted with a jrid, so a recovered validator/client can re-attach
+    # to the still-decoding slot (the orphaned-stream survival half of
+    # the validator journal)...
+    jstreams: dict[str, Any] = field(default_factory=dict)
+    # ...and jrid -> {"tokens", "base", "finished", "t"} for streams that
+    # FINISHED while orphaned (their GENERATE_RESP went to a dead peer) —
+    # a bounded ledger (MLConfig.orphan_keep / orphan_ttl_s) the re-attach
+    # ladder drains exactly-once
+    orphans: dict[str, dict] = field(default_factory=dict)
 
     @property
     def n_layers(self) -> int:
@@ -294,11 +305,23 @@ class DistributedWorker:
                     # previous worker — route the error to it (it holds the
                     # rid future) and name the failing worker for repair
                     err_peer = payload.get("reply_to") or peer
-                    self._respond(
-                        err_peer, resp_tag, rid,
-                        {"error": f"{type(e).__name__}: {e}",
-                         "worker": self.node.node_id},
-                    )
+                    try:
+                        self._respond(
+                            err_peer, resp_tag, rid,
+                            {"error": f"{type(e).__name__}: {e}",
+                             "worker": self.node.node_id},
+                        )
+                    except Exception as e2:
+                        # the requester died too (the chaos suite's
+                        # validator kill lands here: the work item fails
+                        # BECAUSE the peer is gone, so the error reply
+                        # fails the same way) — an undeliverable reply
+                        # must never kill this loop; the worker keeps
+                        # serving and re-announces on the re-handshake
+                        self.log.warning(
+                            "error reply for %s to %s undeliverable: %s",
+                            kind, str(err_peer)[:8], e2,
+                        )
 
     def _handle(self, kind: str, p: dict) -> None:
         if kind == "load_stage":
@@ -375,6 +398,28 @@ class DistributedWorker:
 
         t0 = time.monotonic()
         job_id = p["job_id"]
+        if p.get("attach_only"):
+            # validator re-handshake after a control-plane restart
+            # (DistributedModel.from_job(..., attach_only=True)): if the
+            # stage is already live, ACK without rebuilding — a full load
+            # would swap the engine and kill every live slot, which is
+            # exactly what recovery must not do. The ack re-announces this
+            # worker's live/orphaned streams so the recovered validator
+            # can reconcile its journal (worker wins for tokens). A worker
+            # that ALSO restarted falls through to the normal full load.
+            with self._lock:
+                rt = self.jobs.get(job_id)
+            if rt is not None:
+                body = {
+                    "job_id": job_id, "ok": True, "attached": True,
+                    "n_layers": rt.n_layers,
+                    "live_slots": (
+                        rt.cont.live_slots if rt.cont is not None else 0
+                    ),
+                    "orphans": self._orphan_report(rt),
+                }
+                self._respond(p["peer"], proto.MODULE_LOADED, p["rid"], body)
+                return
         model = p["model"]
         stage = p["stage"]
         cfg = ModelConfig.from_json(model["config"])
@@ -1740,6 +1785,14 @@ class DistributedWorker:
         if cont is None:
             return False
         tid = str(p.get("trace") or "")
+        jrid = str(p.get("jrid") or "")
+        want = str(p.get("reattach") or "")
+        if want and self._reattach_continuous(rt, cont, p, want):
+            return True
+        # a re-attach MISS falls through here on purpose: the request body
+        # already carries prompt+delivered and start_step, so plain
+        # admission below IS the re-prefill resume rung (bit-identical by
+        # the fold_in sampling contract) — no extra round trip
         t, k, tp, pp, fp = knobs
         sampling = SamplingParams.make(
             temperature=float(t), top_k=int(k), top_p=float(tp),
@@ -1748,6 +1801,59 @@ class DistributedWorker:
         )
         stream_id = p.get("stream")
         peer = p["peer"]
+        stream_cb, on_finish = self._cont_channels(
+            rt, cont, peer=peer, rid=p["rid"], stream_id=stream_id,
+            tid=tid, jrid=jrid,
+        )
+        req = cont.submit(
+            prompts[0],
+            max_new_tokens=int(p.get("max_new_tokens", 128)),
+            sampling=sampling,
+            eos_ids=p.get("eos_ids", ()),
+            seed=int(p.get("seed", 0)),
+            start_step=int(p.get("start_step", 0)),
+            priority=p.get("priority"),
+            stream_cb=stream_cb if stream_id else None,
+            on_finish=on_finish,
+            # resume-after-migration: bind the staged KV pages instead of
+            # re-prefilling (engine falls back when the ticket is stale)
+            adopt=p.get("adopt") or None,
+            trace_id=tid,
+            # draft/verify opt-in (no-op unless this engine's spec_decode
+            # is on; streams bit-identical either way)
+            speculative=bool(p.get("speculative", False)),
+            # disaggregated prefill/decode: on a prefill-pool worker with
+            # a live decode pool, this admission freezes at its
+            # prefill→decode boundary and _run_handoffs ships it —
+            # unless the request opted out ({"handoff": false}) or is
+            # itself a migration resume (adopt) bouncing through
+            handoff=bool(
+                self._handoff_pool_for(rt.job_id)
+                and p.get("handoff", True) is not False
+                and not p.get("adopt")
+            ),
+        )
+        # transport context for live migration: a drain must redirect this
+        # stream mid-flight, which needs the original peer/rid/stream —
+        # the on_finish/stream closures are opaque, this is not
+        req.client_meta = {
+            "peer": peer, "rid": p["rid"], "stream": stream_id,
+            "trace": tid, "jrid": jrid,
+        }
+        if jrid:
+            rt.jstreams[jrid] = req
+        self._schedule_cont(rt)
+        return True
+
+    def _cont_channels(self, rt: "StageRuntime", cont, *, peer, rid,
+                       stream_id, tid, jrid="", resume_base=None):
+        """Build the (stream_cb, on_finish) transport-closure pair for a
+        continuous stream. Shared by first admission and by the re-attach
+        rebinding so both transports behave identically — the only
+        difference is ``resume_base``: set on a re-attach, the final
+        response carries {"reattached": True, "resume_base": base} so the
+        client merges sequences (this-submission tokens) onto its
+        delivered[:base] prefix exactly-once."""
         state = {"n": 0}
 
         def stream_cb(tok: int):
@@ -1783,12 +1889,27 @@ class DistributedWorker:
                         "stream %s done-marker push failed: %s",
                         stream_id, e,
                     )
+            if jrid:
+                rt.jstreams.pop(jrid, None)
+                if req.error is None:
+                    # the GENERATE_RESP below may be going to a dead
+                    # validator — keep the result in the bounded orphan
+                    # ledger so a re-attach can still drain it
+                    self._stash_orphan(rt, jrid, req)
             if req.error is not None:
-                self._respond(
-                    peer, proto.GENERATE_RESP, p["rid"],
-                    {"error": f"{type(req.error).__name__}: {req.error}",
-                     "worker": self.node.node_id},
-                )
+                try:
+                    self._respond(
+                        peer, proto.GENERATE_RESP, rid,
+                        {"error": f"{type(req.error).__name__}: {req.error}",
+                         "worker": self.node.node_id},
+                    )
+                except Exception as e:
+                    # the requester is gone — an undeliverable error reply
+                    # must not propagate into step_chunk and error the
+                    # ENGINE (closing it evicts every other live stream
+                    # that is decoding through the validator outage)
+                    self.log.warning(
+                        "error response for %s undeliverable: %s", rid, e)
                 return
             body = {
                 "sequences": [list(map(int, req.tokens))],
@@ -1799,6 +1920,9 @@ class DistributedWorker:
                 # without a dedicated polling RPC
                 "serving": cont.serving_snapshot(),
             }
+            if resume_base is not None:
+                body["reattached"] = True
+                body["resume_base"] = int(resume_base)
             if tid:
                 # this worker's spans for the request ride home the same
                 # way — the validator ingests them so /trace stitches a
@@ -1806,45 +1930,137 @@ class DistributedWorker:
                 body["trace"] = {
                     "id": tid, "spans": cont.tracer.collect(tid),
                 }
-            self._respond(peer, proto.GENERATE_RESP, p["rid"], body)
+            try:
+                self._respond(peer, proto.GENERATE_RESP, rid, body)
+            except Exception as e:
+                # dead validator (the crash-safety orphan path): the
+                # result is already stashed in the orphan ledger above —
+                # letting this propagate would error the ENGINE via
+                # step_chunk and evict every OTHER stream still decoding
+                # through the outage
+                self.log.warning(
+                    "final response for %s undeliverable (orphan %s kept): %s",
+                    rid, jrid or "-", e)
 
-        req = cont.submit(
-            prompts[0],
-            max_new_tokens=int(p.get("max_new_tokens", 128)),
-            sampling=sampling,
-            eos_ids=p.get("eos_ids", ()),
-            seed=int(p.get("seed", 0)),
-            start_step=int(p.get("start_step", 0)),
-            priority=p.get("priority"),
-            stream_cb=stream_cb if stream_id else None,
-            on_finish=on_finish,
-            # resume-after-migration: bind the staged KV pages instead of
-            # re-prefilling (engine falls back when the ticket is stale)
-            adopt=p.get("adopt") or None,
-            trace_id=tid,
-            # draft/verify opt-in (no-op unless this engine's spec_decode
-            # is on; streams bit-identical either way)
-            speculative=bool(p.get("speculative", False)),
-            # disaggregated prefill/decode: on a prefill-pool worker with
-            # a live decode pool, this admission freezes at its
-            # prefill→decode boundary and _run_handoffs ships it —
-            # unless the request opted out ({"handoff": false}) or is
-            # itself a migration resume (adopt) bouncing through
-            handoff=bool(
-                self._handoff_pool_for(rt.job_id)
-                and p.get("handoff", True) is not False
-                and not p.get("adopt")
-            ),
-        )
-        # transport context for live migration: a drain must redirect this
-        # stream mid-flight, which needs the original peer/rid/stream —
-        # the on_finish/stream closures are opaque, this is not
-        req.client_meta = {
-            "peer": peer, "rid": p["rid"], "stream": stream_id,
-            "trace": tid,
+        return stream_cb, on_finish
+
+    def _stash_orphan(self, rt: "StageRuntime", jrid: str, req) -> None:
+        """Record a finished continuous stream in the bounded orphan
+        ledger (MLConfig.orphan_keep / orphan_ttl_s). If the final
+        response reached a live client the entry just ages out; if the
+        validator was dead it is what the re-attach ladder drains
+        (popped on delivery — exactly-once)."""
+        ml = self.node.config.ml
+        keep = int(getattr(ml, "orphan_keep", 64))
+        if keep <= 0:
+            return
+        now = time.monotonic()
+        ttl = float(getattr(ml, "orphan_ttl_s", 180.0))
+        for k in [k for k, v in rt.orphans.items() if now - v["t"] > ttl]:
+            rt.orphans.pop(k, None)
+        while len(rt.orphans) >= keep:  # dict preserves insertion order
+            rt.orphans.pop(next(iter(rt.orphans)), None)
+        rt.orphans[jrid] = {
+            "tokens": [int(t) for t in req.tokens],
+            "base": int(req.start_step),
+            "t": now,
         }
-        self._schedule_cont(rt)
-        return True
+
+    def _orphan_report(self, rt: "StageRuntime") -> list[dict]:
+        """Per-jrid live/finished stream announcement riding the
+        attach_only MODULE_LOADED ack — the worker's half of journal
+        reconciliation (its token counts are authoritative; the journal's
+        high-water marks are only a floor)."""
+        out = []
+        for jrid, req in rt.jstreams.items():
+            out.append({
+                "jrid": jrid,
+                "n": int(req.start_step) + len(req.tokens),
+                "finished": bool(req.finished),
+            })
+        for jrid, o in rt.orphans.items():
+            out.append({
+                "jrid": jrid,
+                "n": int(o["base"]) + len(o["tokens"]),
+                "finished": True,
+            })
+        return out
+
+    def _reattach_continuous(self, rt: "StageRuntime", cont, p: dict,
+                             jrid: str) -> bool:
+        """Worker half of the re-attach ladder. Returns True when handled:
+        a LIVE orphaned stream is rebound to the new peer/rid/stream (its
+        backlog past the client's high-water mark topped up atomically —
+        this runs on the same serial ML thread as decode chunks), or a
+        FINISHED orphan is replayed from the ledger. False = miss; the
+        caller falls through to plain admission (re-prefill resume)."""
+        peer, rid = p["peer"], p["rid"]
+        stream_id = p.get("stream")
+        tid = str(p.get("trace") or "")
+        hwm = int(p.get("hwm", 0))
+        req = rt.jstreams.get(jrid)
+        if req is not None and not req.finished:
+            base = int(req.start_step)
+            stream_cb, on_finish = self._cont_channels(
+                rt, cont, peer=peer, rid=rid, stream_id=stream_id,
+                tid=tid, jrid=jrid, resume_base=base,
+            )
+            req.client_meta = {
+                "peer": peer, "rid": rid, "stream": stream_id,
+                "trace": tid, "jrid": jrid,
+            }
+            req.stream_cb = stream_cb if stream_id else None
+            req.on_finish = on_finish
+            if stream_id:
+                # top up the fresh relay with everything the slot emitted
+                # past the client's high-water mark while orphaned
+                backlog = req.tokens[max(hwm - base, 0):]
+                if backlog:
+                    self.bridge.notify(
+                        "send_token",
+                        {"peer": peer, "stream": stream_id,
+                         "tokens": [[0, int(t)] for t in backlog]},
+                    )
+            self.log.info(
+                "reattached live stream jrid=%s (slot tokens=%d, "
+                "client hwm=%d)", jrid, len(req.tokens), hwm,
+            )
+            self._schedule_cont(rt)
+            return True
+        orphan = rt.orphans.pop(jrid, None)
+        if orphan is not None:
+            toks = [int(t) for t in orphan["tokens"]]
+            base = int(orphan["base"])
+            if stream_id:
+                try:
+                    backlog = toks[max(hwm - base, 0):]
+                    if backlog:
+                        self.bridge.notify(
+                            "send_token",
+                            {"peer": peer, "stream": stream_id,
+                             "tokens": [[0, int(t)] for t in backlog]},
+                        )
+                    self.bridge.request(
+                        "send_token",
+                        {"peer": peer, "stream": stream_id, "tokens": [],
+                         "done": True},
+                    )
+                except Exception as e:
+                    self.log.debug(
+                        "orphan replay stream push failed: %s", e
+                    )
+            self._respond(
+                peer, proto.GENERATE_RESP, rid,
+                {"sequences": [toks], "finished": [True],
+                 "continuous": True, "reattached": True,
+                 "resume_base": base, "serving": cont.serving_snapshot()},
+            )
+            self.log.info(
+                "replayed finished orphan jrid=%s (%d tokens)",
+                jrid, len(toks),
+            )
+            return True
+        return False
 
     def _ensure_cont(self, rt: "StageRuntime"):
         """The job's slot engine, (re)built after load_stage swapped the
@@ -2675,6 +2891,26 @@ class DistributedWorker:
                 {"ok": False,
                  "error": "staging refused (mode mismatch, evicted "
                           "prefix, bad digest, or allocator dry)"},
+            )
+            return
+        if op == "expire":
+            # a recovered source validator expiring stranded tickets
+            # deterministically at journal replay — without this, a
+            # validator restart mid-drain left staged pages pinned until
+            # the destination's TTL GC happened to fire
+            n = 0
+            if rt is not None and rt.cont is not None:
+                cont = rt.cont
+                want = str(p.get("mig", "") or "")
+                for mig_id in cont.staged_migrations():
+                    if want and mig_id != want:
+                        continue
+                    cont.drop_staged_migration(mig_id)
+                    n += 1
+                cont.check_page_conservation()
+            self._respond(
+                p["peer"], proto.MIGRATE_RESP, p["rid"],
+                {"ok": True, "expired": n},
             )
             return
         raise ValueError(f"unknown migrate op {op!r}")

@@ -133,6 +133,25 @@ class RoleServer(TensorNode):
             raise ConnectionError(f"no connection to {peer[:12]}")
         return conn
 
+    async def _control_fault(self, verb: str) -> None:
+        """``control.frame`` fault site (core/faults.py): fires at the top
+        of the control verbs that mutate fleet state (drain / recruit /
+        pool- and replica-set pushes / ticket expiry). "drop" maps to a
+        raised error — a control frame that vanishes must surface to the
+        caller as a loud failure, never a silent hang; "crash"
+        (FaultCrash) propagates so the run loop takes the node down."""
+        from tensorlink_tpu.core import faults
+
+        if not faults.ENABLED:
+            return
+        act = faults.inject("control.frame", verb)
+        if act == "drop":
+            raise faults.FaultInjected(
+                f"injected control-frame drop at {verb}"
+            )
+        if isinstance(act, tuple) and act[0] == "delay":
+            await asyncio.sleep(act[1])
+
     async def cmd_status(self, p) -> dict:
         return self.status()
 
@@ -804,6 +823,7 @@ class ValidatorServer(RoleServer):
         streams. ``dest`` defaults to the connected worker with the most
         free capacity; the DRAIN body carries the destination's id and
         LISTEN address so the source can dial it worker-to-worker."""
+        await self._control_fault("drain_worker")
         src = self._resolve_worker(str(p.get("worker", "")))
         if src is None:
             return {"ok": False, "error": "unknown or ambiguous worker"}
@@ -897,6 +917,7 @@ class ValidatorServer(RoleServer):
         Recruiting = JOB_REQ to each stage's worker with a 3 s accept window
         (reference recruit_worker, validator_thread.py:845-887).
         """
+        await self._control_fault("create_job")
         job = p["job"]
         job_id = job["job_id"]
         plan = job["plan"]
@@ -1002,6 +1023,7 @@ class ValidatorServer(RoleServer):
         connected worker advertising ``serving_role == "decode"`` — the
         refresh an operator runs after decode workers join or leave, the
         same information recruit-time pushes carry automatically."""
+        await self._control_fault("set_handoff_pool")
         wid = self._resolve_worker(str(p.get("worker", "")))
         if wid is None:
             return {"ok": False, "error": "unknown or ambiguous worker"}
@@ -1026,6 +1048,7 @@ class ValidatorServer(RoleServer):
         drains a replica onto a sibling), scoped to the replica's own
         ``job_id``. ``peers`` is ``[{id, addr, job_id}, ...]`` naming the
         OTHER replicas' entry workers."""
+        await self._control_fault("set_replica_set")
         wid = self._resolve_worker(str(p.get("worker", "")))
         if wid is None:
             return {"ok": False, "error": "unknown or ambiguous worker"}
@@ -1049,6 +1072,29 @@ class ValidatorServer(RoleServer):
             {"job_id": str(p.get("job_id", "")), "peers": peers},
         )
         return {"ok": True, "peers": [e["id"][:16] for e in peers]}
+
+    async def cmd_expire_migrations(self, p) -> dict:
+        """Control-plane recovery (docs/FAILURE_MODEL.md "Control
+        plane"): tell ``worker`` to drop its STAGED — exported but never
+        committed — migration tickets for ``job_id``, the deterministic
+        expiry a restarted validator runs for every journal "mig" intent
+        the crash left open. The worker re-checks page conservation after
+        dropping; a worker with nothing staged answers ``expired: 0``.
+        ``mig`` narrows the expiry to one ticket id."""
+        await self._control_fault("expire_migrations")
+        wid = self._resolve_worker(str(p.get("worker", "")))
+        if wid is None:
+            return {"ok": False, "error": "unknown or ambiguous worker"}
+        body = {"op": "expire", "job_id": str(p.get("job_id", ""))}
+        if p.get("mig"):
+            body["mig"] = str(p["mig"])
+        reply = await self.request(
+            self._conn(wid), proto.MIGRATE, body,
+            timeout=float(p.get("timeout", 30.0)),
+        )
+        reply.pop("_rid", None)
+        reply.pop("_resp", None)
+        return reply
 
     async def cmd_decline_job(self, p) -> bool:
         """Planning failed (no capacity / unknown model)."""

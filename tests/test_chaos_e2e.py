@@ -1019,3 +1019,371 @@ def test_kill_prefill_worker_mid_handoff_streams_recover(tmp_path):
         model.shutdown()
     finally:
         _stop_all([user, *workers, validator])
+
+# ---------------------------------------------------------------------------
+# control-plane crash safety (PR 16, docs/FAILURE_MODEL.md "Control
+# plane"): the VALIDATOR dies and restarts — the workers keep decoding,
+# the journal replays, streams re-attach bit-identical and exactly-once
+# ---------------------------------------------------------------------------
+def _vcluster(tmp_path, n_workers=2, worker_faults=None):
+    """validator + workers, no user node: the validator ITSELF drives the
+    streams (validator-hosted API serving), which is the control-plane
+    kill surface. Its journal lives at log_dir/control_journal.jsonl, so
+    a second ValidatorNode over the same log_dir IS the restart."""
+    from tensorlink_tpu.nodes.runners import ValidatorNode, WorkerNode
+
+    common = dict(
+        local_test=True,
+        key_dir=str(tmp_path / "keys"),
+        log_dir=str(tmp_path / "logs"),
+        env_file=str(tmp_path / ".env"),
+    )
+    validator = ValidatorNode(
+        ValidatorConfig(endpoint=False, monitor_interval=0.5,
+                        keeper_interval=5.0, proposal_interval=0.0, **common)
+    ).start()
+    seeds = [["127.0.0.1", validator.port]]
+    workers = []
+    for i in range(n_workers):
+        fl = (worker_faults or {}).get(i, {})
+        workers.append(WorkerNode(WorkerConfig(
+            seed_validators=seeds, duplicate=str(i) if i else "",
+            faults=fl, **common,
+        )).start())
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if len(validator.status()["peers"]) >= n_workers:
+            break
+        time.sleep(0.2)
+    return validator, workers
+
+
+def _restart_validator(tmp_path):
+    """A fresh ValidatorNode over the SAME key/log dirs: same identity,
+    same journal — the crash-recovery restart. Its executor replays the
+    journal at thread start (DistributedValidator.run)."""
+    from tensorlink_tpu.nodes.runners import ValidatorNode
+
+    return ValidatorNode(
+        ValidatorConfig(endpoint=False, monitor_interval=0.5,
+                        keeper_interval=5.0, proposal_interval=0.0,
+                        local_test=True,
+                        key_dir=str(tmp_path / "keys"),
+                        log_dir=str(tmp_path / "logs"),
+                        env_file=str(tmp_path / ".env"))
+    ).start()
+
+
+def _wait_recovered(validator, name, deadline_s=90):
+    """Journal replay re-attached ``name`` and the recovery window
+    closed (the API would have answered 503 + Retry-After meanwhile)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        job = validator.executor.hosted.get(name)
+        if (job is not None and job.status == "ready"
+                and not validator.executor.recovering):
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def _api_req(name, message, n, reattach=""):
+    """Greedy deterministic GenerationRequest — same body pre- and
+    post-crash; the re-attach rung only adds the journal rid."""
+    from tensorlink_tpu.api.schemas import GenerationRequest
+
+    body = {"hf_name": name, "message": message, "max_new_tokens": n,
+            "temperature": 0.0, "do_sample": False}
+    if reattach:
+        body["reattach"] = reattach
+    return GenerationRequest.parse(body)
+
+
+def _start_api_streams(validator, name, messages, n):
+    """One streamed generate_api per message on daemon threads; jrids
+    are captured from the admission meta callback — the handle an SSE
+    client would hold from the prelude event BEFORE any crash."""
+    import threading
+
+    k = len(messages)
+    texts: list[list[str]] = [[] for _ in range(k)]
+    jrids: list[str | None] = [None] * k
+    outs: list[dict | None] = [None] * k
+    errors: list[BaseException | None] = [None] * k
+
+    def go(i):
+        try:
+            outs[i] = validator.executor.generate_api(
+                _api_req(name, messages[i], n),
+                on_delta=lambda s, i=i: texts[i].append(s),
+                meta_cb=lambda m, i=i: jrids.__setitem__(
+                    i, str(m.get("jrid") or "")),
+            )
+        except BaseException as e:  # the validator dying under the
+            errors[i] = e           # request is this test's POINT
+
+    threads = [
+        threading.Thread(target=go, args=(i,), daemon=True)
+        for i in range(k)
+    ]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)
+    return threads, texts, jrids, outs, errors
+
+
+@pytest.mark.slow  # full multi-process cluster + validator restart — CI
+# chaos job runs this file unfiltered; excluded from tier-1 for wall-time
+def test_validator_kill_mid_decode_reattach_bit_identical(tmp_path):
+    """THE control-plane acceptance pin: the validator is killed
+    mid-decode with journaled streams in flight. The worker keeps
+    decoding (orphaned-stream survival), a restarted validator replays
+    the journal and re-attaches without rebuilding, and each client
+    re-attach by jrid returns the COMPLETE stream — bit-identical to the
+    fault-free run, zero streams dropped. Exactly-once: the first
+    re-attach drains the worker's orphan buffer; a second falls through
+    to plain regeneration and still matches (replacement semantics)."""
+    from pathlib import Path
+
+    from tensorlink_tpu.core.journal import ControlJournal
+
+    name = "chaos-kill"
+    validator, workers = _vcluster(tmp_path, n_workers=2)
+    restarted = None
+    try:
+        # single stage on workers[0]; workers[1] only pads the peer set
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        cfg = tiny_cfg(vocab_size=258, max_seq_len=256)  # byte tokenizer
+        job = validator.executor.host_model(
+            name, config=cfg.to_json(), seq_len=256, seed=0)
+        assert job.status == "ready", job.error
+        assert job.model.plan.n_stages == 1
+
+        msgs = ["alpha", "beta bravo"]
+        n = 96
+        # fault-free oracle through the SAME admission path (journal
+        # admit + finish records included)
+        base = [
+            validator.executor.generate_api(_api_req(name, m, n))["text"]
+            for m in msgs
+        ]
+
+        threads, texts, jrids, outs, errors = _start_api_streams(
+            validator, name, msgs, n)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(jrids) and all(texts):
+                break
+            time.sleep(0.02)
+        assert all(jrids), jrids  # handles delivered at admission
+        assert all(texts), "streams never reached steady decode"
+        validator.crash()  # the control plane dies mid-decode
+        for t in threads:
+            t.join(30)
+
+        restarted = _restart_validator(tmp_path)
+        assert _wait_recovered(restarted, name), \
+            "journal replay never re-attached the job"
+        st = ControlJournal.replay(
+            Path(restarted.config.log_dir) / "control_journal.jsonl")
+        assert st.recovered >= 1  # the replay itself is journaled
+
+        for i, m in enumerate(msgs):
+            deltas: list[str] = []
+            out = restarted.executor.generate_api(
+                _api_req(name, m, n, reattach=jrids[i]),
+                on_delta=deltas.append,
+            )
+            assert out["jrid"] == jrids[i]
+            # bit-identical AND complete from token 0 — the client
+            # REPLACES its partial pre-crash text with this
+            assert out["text"] == base[i], (i, out["text"], base[i])
+            assert "".join(deltas) == base[i], (i,)
+            again = restarted.executor.generate_api(
+                _api_req(name, m, n, reattach=jrids[i]))
+            assert again["text"] == base[i], (i, again["text"], base[i])
+    finally:
+        _stop_all([*workers,
+                   *(v for v in (restarted, validator) if v is not None)])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage
+def test_validator_kill_mid_prefill_stream_survives(tmp_path):
+    """Kill the validator BEFORE the first token reaches the client:
+    the admission is journaled (the jrid meta fired) but the stream is
+    still prefilling. Whichever rung applies — the worker admitted the
+    request and decodes it into the orphan buffer, or the GENERATE died
+    with the validator and re-attach falls through to plain
+    regeneration — the re-attached stream is the complete fault-free
+    one (zero dropped, exactly-once by replacement)."""
+    name = "chaos-prefill"
+    validator, workers = _vcluster(tmp_path, n_workers=2)
+    restarted = None
+    try:
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        cfg = tiny_cfg(vocab_size=258, max_seq_len=256)
+        job = validator.executor.host_model(
+            name, config=cfg.to_json(), seq_len=256, seed=0)
+        assert job.status == "ready", job.error
+
+        msgs = ["the quick brown fox jumps over the lazy dog " * 3]
+        n = 64
+        base = [
+            validator.executor.generate_api(_api_req(name, m, n))["text"]
+            for m in msgs
+        ]
+        threads, texts, jrids, outs, errors = _start_api_streams(
+            validator, name, msgs, n)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(jrids):
+                break
+            time.sleep(0.005)
+        assert all(jrids), jrids
+        validator.crash()  # admission journaled; first token not yet out
+        for t in threads:
+            t.join(30)
+
+        restarted = _restart_validator(tmp_path)
+        assert _wait_recovered(restarted, name), \
+            "journal replay never re-attached the job"
+        out = restarted.executor.generate_api(
+            _api_req(name, msgs[0], n, reattach=jrids[0]))
+        assert out["text"] == base[0], (out["text"], base[0])
+    finally:
+        _stop_all([*workers,
+                   *(v for v in (restarted, validator) if v is not None)])
+
+
+@pytest.mark.slow  # see above — CI chaos job coverage
+def test_validator_restart_mid_drain_expires_staged_tickets(tmp_path):
+    """Satellite regression pin: a drain whose validator dies mid-page-
+    transfer leaves its pages STAGED at the destination with a dead
+    client relay — nothing would ever adopt them. The write-ahead "mig"
+    ticket (with journaled endpoint ADDRESSES) makes the restarted
+    validator expire them deterministically at replay: the staged pages
+    return to the destination's free list (page conservation re-checked
+    inside the expiry op), the open intent closes as aborted/expired,
+    and an open autopilot "action" intent resolves instead of leaking."""
+    import threading
+    from pathlib import Path
+
+    from tensorlink_tpu.core.journal import ControlJournal
+
+    def _staged_ids(worker):
+        out = []
+        for rt in list(worker.executor.jobs.values()):
+            if rt.cont is not None:
+                out.extend(rt.cont.staged_migrations())
+        return out
+
+    name = "chaos-drain"
+    validator, workers = _vcluster(
+        tmp_path, n_workers=2,
+        # stretch EVERY page transfer so the validator dies inside one
+        # (prob=1 + unlimited fires: the default rule fires never — nth
+        # unset, prob 0 — and a drain that outruns the crash window
+        # commits before the kill, leaving nothing staged to expire)
+        worker_faults={0: {"seed": 9, "rules": [
+            {"site": "migrate.wire", "op": "delay", "delay_s": 4.0,
+             "prob": 1.0, "max_fires": None},
+        ]}},
+    )
+    restarted = None
+    try:
+        workers[0].send_request(
+            "set_capacity", {"hbm_bytes": 8e9, "n_devices": 1})
+        workers[1].send_request(
+            "set_capacity", {"hbm_bytes": 4e9, "n_devices": 1})
+        cfg = tiny_cfg(vocab_size=258, max_seq_len=256)
+        job = validator.executor.host_model(
+            name, config=cfg.to_json(), seq_len=256, seed=0)
+        assert job.status == "ready", job.error
+
+        threads, texts, jrids, outs, errors = _start_api_streams(
+            validator, name, ["gamma", "delta"], 160)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(jrids) and all(texts):
+                break
+            time.sleep(0.02)
+        assert all(texts), "streams never reached steady decode"
+
+        # the write-ahead ticket + action intent exactly as the fleet
+        # drain path records them (ValidatorFleetActions.drain / the
+        # autopilot journal hook) — then the validator dies mid-drain
+        rep = validator.executor.hosted[name].replicas[0]
+        addr = {
+            workers[0].node_id: ["127.0.0.1", workers[0].port],
+            workers[1].node_id: ["127.0.0.1", workers[1].port],
+        }
+        iid_mig = validator.executor._jintent("mig", {
+            "name": name, "rid": "r0", "src": workers[0].node_id,
+            "dest": workers[1].node_id, "job_id": rep["job_id"],
+            "src_addr": addr[workers[0].node_id],
+            "dest_addr": addr[workers[1].node_id],
+        })
+        iid_act = validator.executor._jintent("action", {
+            "verb": "deploy", "rid": "r0", "name": name,
+        })
+        assert iid_mig and iid_act
+
+        def issue_drain():
+            try:
+                validator.send_request(
+                    "drain_worker",
+                    {"worker": workers[0].node_id,
+                     "dest": workers[1].node_id},
+                    timeout=120.0,
+                )
+            except Exception:
+                pass  # the validator dies under this request — expected
+
+        drainer = threading.Thread(target=issue_drain, daemon=True)
+        drainer.start()
+        time.sleep(1.5)  # freeze + export done; transfer inside the delay
+        validator.crash()
+        for t in threads:
+            t.join(30)
+
+        # the worker-side drain outlives the validator: pages stage at
+        # the destination with nobody left to adopt them
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline and not _staged_ids(workers[1]):
+            time.sleep(0.25)
+        assert _staged_ids(workers[1]), \
+            "migration never staged at the destination"
+
+        restarted = _restart_validator(tmp_path)
+        assert _wait_recovered(restarted, name), \
+            "journal replay never re-attached the job"
+        jpath = Path(restarted.config.log_dir) / "control_journal.jsonl"
+        deadline = time.monotonic() + 60
+        st = ControlJournal.replay(jpath)
+        while (time.monotonic() < deadline
+               and st.intents[iid_mig]["state"] == "intent"):
+            time.sleep(0.5)
+            st = ControlJournal.replay(jpath)
+        # the ticket expired deterministically at replay, the action
+        # intent resolved (no autopilot on a 1-replica job → dropped)
+        assert st.intents[iid_mig]["state"] == "abort", st.intents[iid_mig]
+        close = st.intents[iid_mig]["close_data"] or {}
+        assert close.get("recovery") == "expired", close
+        assert int(close.get("expired", 0)) >= 1, close
+        assert st.intents[iid_act]["state"] == "abort", st.intents[iid_act]
+        # the staged pages really returned to the free list — page
+        # conservation holds at BOTH endpoints after the expiry
+        assert not _staged_ids(workers[1])
+        for w in workers:
+            for rt in list(w.executor.jobs.values()):
+                if rt.cont is not None:
+                    rt.cont.check_page_conservation()
+    finally:
+        _stop_all([*workers,
+                   *(v for v in (restarted, validator) if v is not None)])

@@ -95,6 +95,9 @@ def test_unknown_site_rejected_loudly():
         assert plan.rules[0].site == site
     assert {"migrate.export", "migrate.wire", "migrate.import",
             "worker.drain"} <= set(faults.SITES)
+    # PR 16 control-plane sites are registered too
+    assert {"validator.crash", "control.frame",
+            "journal.write"} <= set(faults.SITES)
 
 
 # ---------------------------------------------------------------------------

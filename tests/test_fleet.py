@@ -709,6 +709,7 @@ def _bare_validator():
     v._host_lock = threading.Lock()
     v.hosted = {}
     v.draining = False
+    v.recovering = False
     return v
 
 

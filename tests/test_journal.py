@@ -1,0 +1,218 @@
+"""ControlJournal unit tests (core/journal.py, PR 16) — fast tier-1:
+pure file I/O on tmp_path, no engines, no processes.
+
+The journal is the validator's crash-safety substrate, so these pin the
+exact replay semantics recovery depends on: write-ahead intents,
+batched-fsync plain records, torn-tail tolerance, monotone high-water
+marks, the worker-wins/journal-wins reconciliation queries, and the
+``journal.write`` fault site's drop/error contract.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tensorlink_tpu.core import faults
+from tensorlink_tpu.core.journal import ControlJournal, JournalState
+
+
+@pytest.fixture
+def jpath(tmp_path):
+    return tmp_path / "journal.jsonl"
+
+
+def test_append_assigns_sequential_seqs_and_replay_folds(jpath):
+    j = ControlJournal(jpath)
+    s1 = j.append("admit", {"jrid": "a"}, flush=True)
+    s2 = j.append("hwm", {"jrid": "a", "n": 3})
+    j.close()
+    assert (s1, s2) == (1, 2)
+    st = ControlJournal.replay(jpath)
+    assert st.records == 2
+    assert st.torn == 0
+    assert st.admissions["a"]["hwm"] == 3
+
+
+def test_replay_missing_file_is_empty_state(tmp_path):
+    st = ControlJournal.replay(tmp_path / "never-written.jsonl")
+    assert isinstance(st, JournalState)
+    assert st.records == 0
+    assert st.live_jobs() == {}
+    assert st.open_intents() == []
+
+
+def test_batched_records_not_on_disk_until_flush(jpath):
+    j = ControlJournal(jpath, flush_every=100, flush_s=3600.0)
+    j.append("hwm", {"jrid": "a", "n": 1})
+    assert ControlJournal.replay(jpath).records == 0  # still buffered
+    j.flush()
+    assert ControlJournal.replay(jpath).records == 1
+    j.close()
+
+
+def test_intents_are_write_ahead_durable_without_explicit_flush(jpath):
+    j = ControlJournal(jpath, flush_every=100, flush_s=3600.0)
+    iid = j.intent("mig", {"src": "w1"})
+    # no close, no flush: the intent must ALREADY be on disk (fsynced
+    # before the action it describes runs — that's the write-ahead half)
+    st = ControlJournal.replay(jpath)
+    assert [i for i, _ in st.open_intents("mig")] == [iid]
+    j.close()
+
+
+def test_commit_and_abort_close_intents(jpath):
+    j = ControlJournal(jpath)
+    i1 = j.intent("host", {"name": "m"})
+    i2 = j.intent("action", {"verb": "deploy", "rid": "r1"})
+    j.commit(i1, {"replicas": 1})
+    j.abort(i2, {"error": "crashed"})
+    j.close()
+    st = ControlJournal.replay(jpath)
+    assert st.open_intents() == []
+    assert st.intents[i1]["state"] == "commit"
+    assert st.intents[i2]["state"] == "abort"
+    assert st.intents[i2]["close_data"] == {"error": "crashed"}
+
+
+def test_torn_tail_is_counted_not_fatal(jpath):
+    j = ControlJournal(jpath)
+    j.append("admit", {"jrid": "a"}, flush=True)
+    j.close()
+    with open(jpath, "a", encoding="utf-8") as f:
+        f.write('{"seq": 2, "kind": "adm')  # crash landed mid-write
+    st = ControlJournal.replay(jpath)
+    assert st.torn == 1
+    assert "a" in st.admissions  # the intact prefix still folds
+
+
+def test_hwm_is_monotone_under_reordered_records(jpath):
+    j = ControlJournal(jpath)
+    j.append("admit", {"jrid": "a"}, flush=True)
+    j.append("hwm", {"jrid": "a", "n": 8})
+    j.append("hwm", {"jrid": "a", "n": 3})  # late/duplicated record
+    j.close()
+    st = ControlJournal.replay(jpath)
+    assert st.admissions["a"]["hwm"] == 8  # can only rise, never cut
+
+
+def test_finish_closes_admission_and_orphans_query(jpath):
+    j = ControlJournal(jpath)
+    j.append("admit", {"jrid": "a"}, flush=True)
+    j.append("admit", {"jrid": "b"}, flush=True)
+    j.append("finish", {"jrid": "a", "n": 5, "reason": "stop"})
+    j.close()
+    st = ControlJournal.replay(jpath)
+    assert st.admissions["a"]["finished"] is True
+    assert st.admissions["a"]["reason"] == "stop"
+    assert [r for r, _ in st.orphan_admissions()] == ["b"]
+
+
+def test_live_jobs_tracks_replicas_and_unhost(jpath):
+    j = ControlJournal(jpath)
+    iid = j.intent("host", {"name": "m1", "spec": {"name": "m1"}})
+    j.append("replica_up", {"name": "m1", "rid": "r0", "job_id": "j1"},
+             flush=True)
+    j.commit(iid)
+    # m2 crashed MID-host: intent open, but a replica came up — it must
+    # still count as live (the workers are holding real state for it)
+    j.intent("host", {"name": "m2", "spec": {"name": "m2"}})
+    j.append("replica_up", {"name": "m2", "rid": "r0", "job_id": "j2"},
+             flush=True)
+    # m3 was unhosted — gone regardless of its history
+    iid3 = j.intent("host", {"name": "m3", "spec": {"name": "m3"}})
+    j.append("replica_up", {"name": "m3", "rid": "r0", "job_id": "j3"},
+             flush=True)
+    j.commit(iid3)
+    j.append("unhost", {"name": "m3"}, flush=True)
+    j.close()
+    live = ControlJournal.replay(jpath).live_jobs()
+    assert set(live) == {"m1", "m2"}
+    assert live["m1"]["replicas"]["r0"]["job_id"] == "j1"
+
+
+def test_replica_down_removes_replica(jpath):
+    j = ControlJournal(jpath)
+    j.append("replica_up", {"name": "m", "rid": "r0", "job_id": "a"},
+             flush=True)
+    j.append("replica_up", {"name": "m", "rid": "r1", "job_id": "b"},
+             flush=True)
+    j.append("replica_down", {"name": "m", "rid": "r1"}, flush=True)
+    j.close()
+    st = ControlJournal.replay(jpath)
+    assert set(st.live_jobs()["m"]["replicas"]) == {"r0"}
+
+
+def test_routed_counts_follow_place_records(jpath):
+    j = ControlJournal(jpath)
+    j.append("admit", {"jrid": "a", "placement": "r0"}, flush=True)
+    j.append("admit", {"jrid": "b", "placement": "router"}, flush=True)
+    # fleet dispatch resolved the router placement to a real replica
+    j.append("place", {"jrid": "b", "rid": "r1"})
+    j.append("admit", {"jrid": "c", "placement": "r0"}, flush=True)
+    j.close()
+    assert ControlJournal.replay(jpath).routed_counts() == {"r0": 2, "r1": 1}
+
+
+def test_seed_record_pairs_with_admission(jpath):
+    j = ControlJournal(jpath)
+    j.append("admit", {"jrid": "a"}, flush=True)
+    j.append("seed", {"jrid": "a", "seed": 1234})
+    j.close()
+    assert ControlJournal.replay(jpath).admissions["a"]["seed"] == 1234
+
+
+def test_journal_write_fault_drop_loses_record_silently(jpath):
+    faults.install(faults.FaultPlan.from_dict({
+        "seed": 0,
+        "rules": [{"site": "journal.write", "op": "drop", "nth": 2}],
+    }))
+    try:
+        j = ControlJournal(jpath)
+        s1 = j.append("admit", {"jrid": "a"}, flush=True)
+        s2 = j.append("hwm", {"jrid": "a", "n": 4}, flush=True)  # dropped
+        j.append("hwm", {"jrid": "a", "n": 6}, flush=True)
+        j.close()
+    finally:
+        faults.uninstall()
+    assert s2 == s1 + 1  # the seq was consumed — replay sees a hole
+    st = ControlJournal.replay(jpath)
+    assert st.records == 2
+    assert st.admissions["a"]["hwm"] == 6
+
+
+def test_journal_write_fault_error_raises_to_caller(jpath):
+    faults.install(faults.FaultPlan.from_dict({
+        "seed": 0,
+        "rules": [{"site": "journal.write", "op": "error", "nth": 1}],
+    }))
+    try:
+        j = ControlJournal(jpath)
+        with pytest.raises(faults.FaultInjected):
+            j.append("admit", {"jrid": "a"})
+        j.append("admit", {"jrid": "b"}, flush=True)  # next write is fine
+        j.close()
+    finally:
+        faults.uninstall()
+    assert set(ControlJournal.replay(jpath).admissions) == {"b"}
+
+
+def test_closed_journal_refuses_appends(jpath):
+    j = ControlJournal(jpath)
+    j.close()
+    with pytest.raises(RuntimeError):
+        j.append("admit", {"jrid": "a"})
+    j.close()  # idempotent
+
+
+def test_records_are_one_json_object_per_line(jpath):
+    j = ControlJournal(jpath)
+    j.append("admit", {"jrid": "a"}, flush=True)
+    j.intent("mig", {"src": "w"})
+    j.close()
+    lines = jpath.read_text().splitlines()
+    assert len(lines) == 2
+    for ln in lines:
+        rec = json.loads(ln)
+        assert {"seq", "t", "kind"} <= set(rec)

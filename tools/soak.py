@@ -1,0 +1,428 @@
+"""Chaos-soak harness — seeded multi-fault schedules over the serving
+stack with invariants audited every epoch (PR 16, docs/FAILURE_MODEL.md
+"Control plane").
+
+In-process and CPU-sized: two tiny paged slot engines stand in for two
+workers, a :class:`~tensorlink_tpu.core.journal.ControlJournal` stands in
+for the validator's control plane, and a seeded
+:class:`~tensorlink_tpu.core.faults.FaultPlan` drives the fault schedule —
+``validator.crash`` keyed on the epoch (the control plane dies at the
+same instant every run), ``journal.write`` drops (records silently lost;
+replay must tolerate holes). Each epoch admits streamed requests,
+sometimes freezes/exports/stages a migration across the two engines, and
+sometimes crashes the control plane mid-everything: the journal is torn
+at a random tail, replayed, reconciled against the engines (worker wins
+for tokens), staged migration tickets expired deterministically, and a
+fresh journal reopened on the same file.
+
+Invariants audited EVERY epoch (first violation dumps state and exits
+nonzero, printing the seed so the schedule replays exactly):
+
+1. **page conservation** — free + slot-owned + cache-resident +
+   in-transit == total, both engines, including mid-migration;
+2. **exactly-once delivery** — every finished stream's tokens match its
+   solo greedy baseline bit-for-bit (no dropped, duplicated, or
+   divergent tokens through any crash/migration);
+3. **compile-set fixity** — ``jit_cache_sizes`` identical to the
+   post-warmup snapshot on both engines, including across every
+   crash/replay cycle (recovery must not compile new programs);
+4. **journal/engine reconciliation** — at every crash replay, each
+   journaled unfinished admission's delivered count is >= its journaled
+   high-water mark (the worker can only be AHEAD of the journal, never
+   behind), and replay itself is total (torn tails counted, not fatal).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tools.soak --seeds 1,2,3 --epochs 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+class Violation(Exception):
+    """An invariant broke; ``state`` carries the dump."""
+
+    def __init__(self, name: str, state: dict):
+        super().__init__(name)
+        self.name = name
+        self.state = state
+
+
+def _engines(seed: int):
+    """Two tiny slot engines over the SAME params (greedy decode is
+    engine-invariant, so either engine reproduces a stream bit-exactly)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorlink_tpu.engine.continuous import ContinuousEngine
+    from tensorlink_tpu.engine.generate import GenerationEngine
+    from tensorlink_tpu.models import ModelConfig, init_params
+
+    cfg = ModelConfig(
+        family="llama", vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=8, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def make():
+        eng = GenerationEngine(
+            cfg, params, seq_buckets=(16,), batch_buckets=(1,),
+            max_seq_len=64,
+        )
+        return ContinuousEngine(
+            eng, max_slots=4, page_size=8, chunk_steps=2,
+        )
+
+    return cfg, make(), make()
+
+
+def _decoding_slots(ce) -> list[int]:
+    """Slots in steady decode — freezable for migration export."""
+    return [
+        s for s in range(ce.max_slots)
+        if ce._slots[s] is not None and ce._active[s]
+        and s not in ce._prefilling and s not in ce._frozen
+    ]
+
+
+def _solo_baseline(ce, prompt: list[int], n: int, seed: int) -> list[int]:
+    """Greedy solo run on an idle engine — the bit-identical oracle."""
+    req = ce.submit(list(prompt), max_new_tokens=n, seed=seed)
+    ce.run_until_idle()
+    return [int(t) for t in req.tokens]
+
+
+def _audit_conservation(tag: str, engines: dict, state: dict) -> None:
+    for name, ce in engines.items():
+        try:
+            ce.check_page_conservation()
+        except AssertionError as e:
+            state["accounting"] = {
+                n: _safe_accounting(c) for n, c in engines.items()
+            }
+            raise Violation(f"page_conservation[{name}]@{tag}", {
+                **state, "error": str(e),
+            }) from e
+
+
+def _safe_accounting(ce) -> dict:
+    try:
+        acc = ce.page_accounting()
+        return {k: len(v) if isinstance(v, (list, set)) else v
+                for k, v in acc.items()}
+    except Exception as e:  # the dump itself must never mask the audit
+        return {"accounting_error": str(e)}
+
+
+def run_seed(seed: int, epochs: int, out_dir: Path) -> dict:
+    """One seeded soak. Returns a summary dict; raises Violation on the
+    first broken invariant (after dumping state to ``out_dir``)."""
+    import numpy as np
+
+    from tensorlink_tpu.core import faults
+    from tensorlink_tpu.core.journal import ControlJournal
+
+    rng = np.random.default_rng(seed)
+    t0 = time.monotonic()
+    tmp = Path(tempfile.mkdtemp(prefix=f"soak-{seed}-"))
+    jpath = tmp / "control_journal.jsonl"
+
+    cfg, ce_a, ce_b = _engines(seed)
+    engines = {"A": ce_a, "B": ce_b}
+
+    # warm BOTH engines (fixes the compile set), then snapshot it: the
+    # fixity invariant holds this exact shape through every fault
+    for ce in engines.values():
+        ce.submit([1, 2, 3], max_new_tokens=4, seed=0)
+        ce.run_until_idle()
+    # warm the migration path too: gather_page / scatter_page belong to
+    # the fixed compile set the fixity invariant pins — first use
+    # mid-soak would otherwise read as a "new program"
+    ce_a.submit([1, 2, 3, 4], max_new_tokens=6, seed=0)
+    while ce_a.step_chunk():
+        slots = _decoding_slots(ce_a)
+        if slots:
+            ce_a.freeze_slot(slots[0])
+            blob = ce_a.export_slot(slots[0])
+            if ce_b.stage_migration("warm-mig", blob):
+                ce_b.drop_staged_migration("warm-mig")
+            ce_a.abort_migration(slots[0])
+            break
+    ce_a.run_until_idle()
+    jit0 = {n: dict(ce.jit_cache_sizes()) for n, ce in engines.items()}
+
+    faults.install(faults.FaultPlan.from_dict({
+        "seed": seed,
+        "rules": [
+            # the control plane dies at seeded epochs — same epochs
+            # every run with the same seed
+            {"site": "validator.crash", "op": "crash", "prob": 0.35,
+             "max_fires": None},
+            # journal records silently lost — replay must tolerate holes
+            {"site": "journal.write", "op": "drop", "prob": 0.08,
+             "max_fires": None},
+        ],
+    }))
+    journal = ControlJournal(jpath, flush_every=4, flush_s=0.02)
+
+    # per-stream ground truth: rid -> {prompt, n, seed, delivered, done}
+    streams: dict[str, dict] = {}
+    baselines: dict[str, list[int]] = {}
+    counters = {"admitted": 0, "crashes": 0, "migrations": 0,
+                "expired": 0, "torn": 0, "finished": 0}
+
+    def _journal(kind: str, data: dict, flush: bool = False) -> None:
+        # the validator's posture: a journal fault degrades durability,
+        # never a request (FaultInjected from the journal.write site)
+        try:
+            journal.append(kind, data, flush=flush)
+        except faults.FaultInjected:
+            pass
+
+    def admit(ce_name: str) -> None:
+        i = counters["admitted"]
+        counters["admitted"] += 1
+        rid = f"s{seed}-{i}"
+        prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, 5)]
+        n = int(rng.integers(4, 9))
+        st = {"prompt": prompt, "n": n, "delivered": [], "done": False,
+              "engine": ce_name}
+        streams[rid] = st
+        _journal("admit", {
+            "jrid": rid, "model": "soak", "n_prompt": len(prompt),
+            "max_new_tokens": n, "placement": ce_name,
+        }, flush=True)
+
+        def stream_cb(tok, _st=st, _rid=rid):
+            _st["delivered"].append(int(tok))
+            _journal("hwm", {"jrid": _rid, "n": len(_st["delivered"])})
+            return None
+
+        def on_finish(req, _st=st, _rid=rid):
+            _st["done"] = True
+            _st["tokens"] = [int(t) for t in req.tokens]
+            counters["finished"] += 1
+            _journal("finish", {"jrid": _rid, "n": len(req.tokens),
+                                "reason": "length"})
+
+        engines[ce_name].submit(
+            prompt, max_new_tokens=n, seed=0,
+            stream_cb=stream_cb, on_finish=on_finish,
+        )
+
+    def try_migration() -> None:
+        """Freeze a decoding slot on A, export, stage on B — then either
+        abort (stream resumes on A) or leave it STAGED with an open
+        journal intent: the crash-mid-drain shape the next crash cycle
+        must expire deterministically (the PR 16 satellite fix)."""
+        ce = engines["A"]
+        # drive A until some submitted slot is steadily decoding
+        for _ in range(8):
+            if not ce.step_chunk():
+                break
+            decoding = _decoding_slots(ce)
+            if decoding:
+                slot = int(decoding[0])
+                mig_id = f"mig-{seed}-{counters['migrations']}"
+                counters["migrations"] += 1
+                iid = journal.intent("mig", {
+                    "src": "A", "dest": "B", "mig": mig_id,
+                })
+                ce.freeze_slot(slot)
+                blob = ce.export_slot(slot)
+                staged = engines["B"].stage_migration(mig_id, blob)
+                if staged and rng.random() < 0.5:
+                    # crash-mid-drain shape: ticket stays staged on B and
+                    # the slot frozen on A; the intent stays OPEN — the
+                    # next crash cycle owns the cleanup
+                    return
+                # abandoned migration: resume on A, drop B's staging
+                if staged:
+                    engines["B"].drop_staged_migration(mig_id)
+                ce.abort_migration(slot)
+                journal.abort(iid, {"resumed": True})
+                return
+
+    def crash_cycle(epoch: int) -> None:
+        """The validator dies and restarts: tear the journal tail
+        (sometimes), replay, reconcile vs the engines, expire staged
+        tickets, reopen."""
+        nonlocal journal
+        counters["crashes"] += 1
+        journal.flush()
+        journal.close()
+        if rng.random() < 0.4:
+            # torn tail: the crash landed mid-write — no trailing newline
+            with open(jpath, "a", encoding="utf-8") as f:
+                f.write('{"seq": -1, "kind": "torn-mid-wri')
+            counters["torn"] += 1
+        st = ControlJournal.replay(jpath)
+        # reconciliation: the worker is authoritative for tokens — its
+        # count can only be >= the journaled high-water mark
+        for jrid, adm in st.orphan_admissions():
+            live = streams.get(jrid)
+            if live is None:
+                continue  # admitted before a lost admit record — fine
+            if len(live["delivered"]) < adm["hwm"]:
+                raise Violation("journal_ahead_of_worker", {
+                    "seed": seed, "epoch": epoch, "jrid": jrid,
+                    "journal_hwm": adm["hwm"],
+                    "delivered": len(live["delivered"]),
+                })
+        # deterministic ticket expiry (satellite fix): every staged
+        # migration drops at replay — on BOTH engines (a dest-less drain's
+        # destination choice died with the validator) — then the frozen
+        # source slots resume (abort = re-prefill-free resume rung)
+        for ce in engines.values():
+            for mig_id in list(ce.staged_migrations()):
+                ce.drop_staged_migration(mig_id)
+                counters["expired"] += 1
+            for slot in list(ce._frozen):
+                ce.abort_migration(slot)
+        # conservation re-checked at the expiry point itself — staged
+        # pages must return to the free list, in-transit must empty
+        _audit_conservation(f"crash-{epoch}", engines,
+                            {"seed": seed, "epoch": epoch,
+                             "counters": dict(counters)})
+        journal = ControlJournal(jpath, flush_every=4, flush_s=0.02)
+        _journal("recovered", {"epoch": epoch, "torn": st.torn},
+                 flush=True)
+
+    violation_state = {"seed": seed}
+
+    def audit(tag: str) -> None:
+        _audit_conservation(tag, engines, dict(violation_state))
+        for name, ce in engines.items():
+            if ce.jit_cache_sizes() != jit0[name]:
+                raise Violation("compile_set_fixity", {
+                    **violation_state, "engine": name, "at": tag,
+                    "expected": jit0[name],
+                    "got": dict(ce.jit_cache_sizes()),
+                })
+        for rid, stv in streams.items():
+            if not stv["done"]:
+                continue
+            if stv["delivered"] != stv["tokens"]:
+                raise Violation("stream_cb_vs_tokens", {
+                    **violation_state, "rid": rid, "at": tag,
+                    "delivered": stv["delivered"],
+                    "tokens": stv["tokens"],
+                })
+            if rid not in baselines:
+                baselines[rid] = _solo_baseline(
+                    engines["B"], stv["prompt"], stv["n"], 0,
+                )
+            if stv["tokens"] != baselines[rid]:
+                raise Violation("exactly_once_bit_identical", {
+                    **violation_state, "rid": rid, "at": tag,
+                    "expected": baselines[rid],
+                    "got": stv["tokens"],
+                })
+
+    try:
+        for epoch in range(epochs):
+            violation_state = {"seed": seed, "epoch": epoch,
+                               "counters": dict(counters)}
+            for _ in range(int(rng.integers(1, 4))):
+                admit(str(rng.choice(["A", "B"])))
+            if rng.random() < 0.45:
+                try_migration()
+            # the seeded crash schedule: same epochs every run
+            try:
+                faults.inject("validator.crash", f"epoch-{epoch}")
+            except faults.FaultCrash:
+                crash_cycle(epoch)
+            for ce in engines.values():
+                ce.run_until_idle()
+            audit(f"epoch-{epoch}")
+        # final sweep: a crash-mid-drain shape still open when the
+        # schedule ends resolves exactly as a crash cycle would —
+        # staged tickets expire, frozen slots resume, engines drain —
+        # so the zero-dropped audit judges COMPLETED recovery, not an
+        # arbitrary epoch boundary
+        for ce in engines.values():
+            for mig_id in list(ce.staged_migrations()):
+                ce.drop_staged_migration(mig_id)
+                counters["expired"] += 1
+            for slot in list(ce._frozen):
+                ce.abort_migration(slot)
+            ce.run_until_idle()
+        audit("final")
+    except Violation as v:
+        dump = out_dir / f"soak-violation-seed{seed}.json"
+        dump.write_text(json.dumps(
+            {"invariant": v.name, **v.state}, indent=2, default=str,
+        ))
+        v.state["dump"] = str(dump)
+        raise
+    finally:
+        faults.uninstall()
+        try:
+            journal.close()
+        except Exception:
+            pass  # already closed by a crash cycle at exit time
+        for ce in engines.values():
+            ce.close()
+
+    undelivered = [
+        rid for rid, stv in streams.items() if not stv["done"]
+    ]
+    if undelivered:
+        # every admitted stream must FINISH — zero dropped across every
+        # crash and expired ticket
+        dump = out_dir / f"soak-violation-seed{seed}.json"
+        dump.write_text(json.dumps(
+            {"invariant": "zero_dropped_streams", "seed": seed,
+             "undelivered": undelivered}, indent=2,
+        ))
+        raise Violation("zero_dropped_streams",
+                        {"seed": seed, "undelivered": undelivered,
+                         "dump": str(dump)})
+    return {
+        "seed": seed, "epochs": epochs, **counters,
+        "t_s": round(time.monotonic() - t0, 1),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded chaos soak over the serving stack "
+                    "(invariants audited every epoch)",
+    )
+    ap.add_argument("--seeds", default="1,2,3",
+                    help="comma-separated seed list (default: 1,2,3)")
+    ap.add_argument("--epochs", type=int, default=6,
+                    help="epochs per seed (default: 6)")
+    ap.add_argument("--out", default="logs",
+                    help="violation-dump directory (default: logs/)")
+    args = ap.parse_args(argv)
+    seeds = [int(s) for s in str(args.seeds).split(",") if s.strip()]
+    out_dir = Path(args.out)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for seed in seeds:
+        try:
+            summary = run_seed(seed, args.epochs, out_dir)
+        except Violation as v:
+            print(f"SOAK VIOLATION: {v.name} — replay with "
+                  f"--seeds {seed} --epochs {args.epochs}")
+            print(json.dumps(v.state, indent=2, default=str))
+            return 1
+        print(f"soak seed {seed}: ok — {json.dumps(summary)}")
+    print(f"soak ok: {len(seeds)} seed(s) x {args.epochs} epoch(s), "
+          "zero violations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
