@@ -135,6 +135,16 @@ class MLConfig:
     # unaffected; ring.quantized_psum/quantized_all_gather are the
     # building blocks for explicit shard_map paths.
     collective_quant: bool = False
+    # -- explicit tensor parallelism (docs/SHARDING.md): shard the paged
+    # serving hot path over a tp mesh axis — attention heads and MLP
+    # columns as weight shards, KV pages by kv head, every control-state
+    # array replicated, per-chunk activation gathers in a fixed order so
+    # streams stay bit-identical to tp=1. The whole mesh is ONE
+    # placement unit to the fleet router. 1 = single-device (today's
+    # path, byte-identical programs). Models the specs can't shard
+    # (MoE, indivisible head counts) fall back to the static batcher
+    # through the worker's normal refusal seam.
+    tensor_parallel: int = 1
     # -- disaggregated prefill/decode pools (docs/SERVING.md
     # "Disaggregated prefill/decode"): the serving role this worker
     # advertises. "prefill" workers take new continuous admissions, fill

@@ -68,9 +68,12 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.metrics import MetricsRegistry
 from ..core.trace import FlightRecorder, get_tracer
+from ..models.transformer import tp_partition_specs, tp_shardable
+from ..parallel.mesh import serving_mesh
 from .generate import GenerationEngine
 from .paged import (
     PageAllocator,
@@ -81,10 +84,12 @@ from .paged import (
     clear_slot,
     copy_page,
     gather_page,
+    make_tp_ragged_step,
     paged_decode_step,
     paged_ragged_step,
     pages_needed,
     scatter_page,
+    tp_cache_specs,
 )
 from .sampling import SamplingParams, sample
 from .spec import SpecController
@@ -362,6 +367,7 @@ class ContinuousEngine:
         pool: SharedPagePool | None = None,
         model_id: str = "",
         page_quota: int = 0,
+        tensor_parallel: int = 1,
     ):
         if engine.cfg.sliding_window is not None:
             raise ValueError(
@@ -391,6 +397,37 @@ class ContinuousEngine:
         # the Pallas kernel needs a real TPU; CPU (tests, fallback serving)
         # runs the pure-jnp reference path — same math, one compiled program
         self.use_kernel = jax.default_backend() == "tpu"
+        # -- tensor parallelism (docs/SHARDING.md) -----------------------
+        # tp > 1 serves this model sharded over a tp mesh axis: weights
+        # as head-major column slices, KV pages by kv head, every
+        # control-state array replicated — streams stay bit-identical to
+        # tp=1 (tests/test_tp.py). ValueError here routes the worker's
+        # hosting seam to its static fallback, same as any other refusal.
+        self.tensor_parallel = max(int(tensor_parallel or 1), 1)
+        self._tp_mesh = None
+        self._tp_step = None
+        if self.tensor_parallel > 1:
+            if len(jax.devices()) < self.tensor_parallel:
+                raise ValueError(
+                    f"tensor_parallel={self.tensor_parallel} needs as many "
+                    f"devices, have {len(jax.devices())}"
+                )
+            reason = tp_shardable(self.cfg, self.tensor_parallel)
+            if reason is not None:
+                raise ValueError(
+                    f"tensor_parallel={self.tensor_parallel}: {reason}"
+                )
+            if pool is not None:
+                raise ValueError(
+                    "tensor parallelism does not compose with a shared "
+                    "page pool yet — the pool's page arrays are unsharded"
+                )
+            if getattr(engine, "quant", None):
+                raise ValueError(
+                    "weight-quantized engines cannot shard over a tp axis "
+                    "— QTensor scale layouts have no partition specs yet"
+                )
+            self._tp_mesh = serving_mesh(self.tensor_parallel)
         # -- co-hosting (docs/SERVING.md "Co-hosting multiple models") ---
         # with a shared pool the physical page arrays live in the pool
         # (one set for every tenant); this engine keeps only its OWN
@@ -451,6 +488,31 @@ class ContinuousEngine:
         # a co-resident prefill's grant either way
         self.spec_budget = int(spec_budget)
         self._spec_phase = 0  # round-robin origin for a draft budget
+        if self._tp_mesh is not None:
+            # shard weights + KV pages onto the mesh and build THE
+            # tensor-parallel chunk program. publish_weights re-places
+            # staged trees onto these committed leaf shardings, so the
+            # serve-and-train hot-swap keeps the layout with no extra
+            # seam. Donated outputs mirror the input specs — the cache
+            # keeps its sharding across chunks, steady-state.
+            engine.params = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self._tp_mesh, s)
+                ),
+                engine.params, tp_partition_specs(self.cfg),
+            )
+            self.cache = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self._tp_mesh, s)
+                ),
+                self.cache, tp_cache_specs(self.cache.quantized),
+            )
+            self._tp_step = make_tp_ragged_step(
+                self._tp_mesh, self.cfg,
+                n_steps=self.chunk_steps, spec_width=self.spec_width,
+                kernel=self.use_kernel,
+                tp_quant=bool(self.cfg.collective_quant),
+            )
         self._prefilling: dict[int, ContinuousRequest] = {}
         # -- live slot migration (docs/FAILURE_MODEL.md) -----------------
         # slots frozen for export: excluded from stepping, their pages
@@ -546,6 +608,18 @@ class ContinuousEngine:
             "model FLOPs utilization of the last background train step",
             fn=lambda: self._train_mfu,
         )
+        # host work on the decode critical path, per chunk: admission,
+        # grant assembly (_pack_ragged), draft lookup — everything
+        # between the previous chunk's sync and this chunk's dispatch.
+        # ROADMAP item 5 found ONE device sync per chunk but left this
+        # host span unbudgeted; now it's a gauge + FlightRecorder field
+        # (rot-guarded in tests/test_tp.py).
+        self._host_gap_ms = 0.0
+        self.metrics.gauge(
+            "tlink_engine_host_gap_ms",
+            "host work between chunk syncs (admission + grant assembly), ms",
+            fn=lambda: self._host_gap_ms,
+        )
         if pool is not None:
             # per-tenant pool occupancy: these render under the model's
             # label at /metrics (the registry-per-model grouping), which
@@ -590,6 +664,13 @@ class ContinuousEngine:
         self._counts = jnp.zeros(
             (self.max_slots, self.cfg.vocab_size), jnp.int32
         )
+        if self._tp_mesh is not None:
+            # commit the histograms to the mesh (replicated) so the TP
+            # step's donation keeps ONE steady-state program from the
+            # first chunk on
+            self._counts = jax.device_put(
+                self._counts, NamedSharding(self._tp_mesh, P())
+            )
         if pool is not None:
             # nothing fallible may follow: a registered-but-dead tenant
             # is unrecoverable without a worker restart (see above)
@@ -793,6 +874,13 @@ class ContinuousEngine:
             "sample_rows": _sample_rows._cache_size(),
             "row_keys": _row_keys._cache_size(),
             "ragged_step": paged_ragged_step._cache_size(),
+            # the sharded analogue: ONE ragged program per shard degree
+            # (the factory builds a plain/quant-cache pair, only the
+            # arity matching this engine's cache ever compiles)
+            "tp_ragged_step": (
+                self._tp_step._cache_size()
+                if self._tp_step is not None else 0
+            ),
             "copy_page": copy_page._cache_size(),
             # migration export/import move ONE page per dispatch (fixed
             # shape), so live slot migration adds exactly these two keys
@@ -1964,6 +2052,13 @@ class ContinuousEngine:
             "weights_version": self.weights_version,
             "train_step_ms": round(self._train_step_ms, 3),
             "train_mfu": round(self._train_mfu, 5),
+            # tensor parallelism (docs/SHARDING.md): shard degree of the
+            # hot path (1 = single device) — a router treats the whole
+            # mesh as one placement unit — and the host-side gap on the
+            # decode critical path (work between chunk syncs: admission,
+            # grant assembly, draft lookup, ragged packing)
+            "tensor_parallel": self.tensor_parallel,
+            "host_gap_ms": self._host_gap_ms,
         })
         if self.pool is not None:
             # co-hosting: the shared pool's occupancy plus THIS tenant's
@@ -2250,6 +2345,12 @@ class ContinuousEngine:
         own done-point, and evicts finished slots at the boundary.
         Returns True while any work (live slots or queued requests)
         remains — the driver's requeue signal."""
+        # host-gap budget (docs/SHARDING.md): everything between the
+        # previous chunk's boundary sync and this chunk's dispatch —
+        # admission, grant assembly, draft lookup, ragged packing — is
+        # host work the device waits behind. Timed here so the span is
+        # visible per chunk without adding a sync of its own.
+        t_host = time.monotonic()
         self._admit()
         if admit_only:
             return self.has_work()
@@ -2260,19 +2361,36 @@ class ContinuousEngine:
         blk, starts, n_valid, n_spec, emit, remaining, eos_arr, \
             completing, handoff_done, grants = pack
         t_chunk = time.monotonic()
-        tokens, n_tok, spec_m, n_exec, self.cache, _done, _steps_dev, \
-            self._counts, _rem = paged_ragged_step(
-                self.engine.params, jnp.asarray(blk), self.cache,
-                jnp.asarray(starts), jnp.asarray(n_valid),
-                jnp.asarray(n_spec), jnp.asarray(emit),
-                jnp.asarray(self._seeds), jnp.asarray(self._steps),
-                jnp.asarray(self._temp), jnp.asarray(self._topk),
-                jnp.asarray(self._topp), jnp.asarray(self._pres),
-                jnp.asarray(self._freq), self._counts,
-                jnp.asarray(remaining), jnp.asarray(eos_arr),
-                self.cfg, self.chunk_steps, self.spec_width,
-                self.use_kernel,
-            )
+        host_dur = t_chunk - t_host
+        self._host_gap_ms = round(host_dur * 1e3, 3)
+        if self._tp_step is not None:
+            # sharded hot path: same program semantics, weights/KV are
+            # device-local shards; control arrays stay host-replicated
+            tokens, n_tok, spec_m, n_exec, self.cache, _done, \
+                _steps_dev, self._counts, _rem = self._tp_step(
+                    self.engine.params, jnp.asarray(blk), self.cache,
+                    jnp.asarray(starts), jnp.asarray(n_valid),
+                    jnp.asarray(n_spec), jnp.asarray(emit),
+                    jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._pres),
+                    jnp.asarray(self._freq), self._counts,
+                    jnp.asarray(remaining), jnp.asarray(eos_arr),
+                )
+        else:
+            tokens, n_tok, spec_m, n_exec, self.cache, _done, \
+                _steps_dev, self._counts, _rem = paged_ragged_step(
+                    self.engine.params, jnp.asarray(blk), self.cache,
+                    jnp.asarray(starts), jnp.asarray(n_valid),
+                    jnp.asarray(n_spec), jnp.asarray(emit),
+                    jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._pres),
+                    jnp.asarray(self._freq), self._counts,
+                    jnp.asarray(remaining), jnp.asarray(eos_arr),
+                    self.cfg, self.chunk_steps, self.spec_width,
+                    self.use_kernel,
+                )
         n_exec = int(n_exec)
         toks_host = np.asarray(tokens)
         n_tok_host = np.asarray(n_tok)
@@ -2390,6 +2508,7 @@ class ContinuousEngine:
             pages_in_transit=self._pages_in_transit(),
             preemptions=int(self._stat["preemptions"].value),
             chunk_ms=round(chunk_dur * 1e3, 3),
+            host_ms=self._host_gap_ms,
         )
         self._refresh_prefix_digest()
         return self.has_work()

@@ -254,12 +254,19 @@ def load_params(
     mesh: jax.sharding.Mesh | None = None,
     specs: dict | None = None,
     dtype=None,
+    tensor_parallel: int = 0,
 ) -> tuple[ModelConfig, dict]:
     """Load a checkpoint into the stacked parameter tree.
 
     ``layer_range=(lo, hi)`` loads only layers ``lo..hi-1`` (a pipeline
     stage's slice) — IO (and, for a hub repo id, the download itself) is
     restricted to exactly those tensors. Returns ``(cfg, params)``.
+
+    ``tensor_parallel=N`` (N > 1) is the serving-path convenience: build
+    the ``serving_mesh(N)`` and place every tensor straight onto its
+    head/column shard (``tp_partition_specs`` — docs/SHARDING.md) as it
+    leaves the checkpoint, so the full weight never materializes on one
+    device. Mutually exclusive with an explicit ``mesh``/``specs`` pair.
     """
     reader = CheckpointReader(
         resolve_checkpoint(ckpt_dir, layer_range=layer_range)
@@ -268,6 +275,21 @@ def load_params(
         cfg = config_from_hf(reader.config())
     dt = dtype or cfg.dtype
     cfg = cfg.with_(dtype=dt)  # activations follow the loaded param dtype
+    if tensor_parallel and int(tensor_parallel) > 1:
+        if mesh is not None or specs is not None:
+            raise ValueError(
+                "tensor_parallel composes its own mesh/specs — pass one "
+                "or the other, not both"
+            )
+        from ..models.transformer import tp_partition_specs, tp_shardable
+        from ..parallel.mesh import serving_mesh
+
+        tp = int(tensor_parallel)
+        reason = tp_shardable(cfg, tp)
+        if reason is not None:
+            raise ValueError(f"tensor_parallel={tp}: {reason}")
+        mesh = serving_mesh(tp)
+        specs = tp_partition_specs(cfg)
     prefix = hf_prefix(cfg)
     nmap = hf_name_map(cfg)
     lo, hi = layer_range or (0, cfg.n_layers)

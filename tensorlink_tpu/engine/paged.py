@@ -55,6 +55,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from ..models.base import ModelConfig
 from ..models.transformer import (
@@ -63,9 +65,12 @@ from ..models.transformer import (
     _mlp,
     _norm,
     _rms_head_norm,
+    _tp_gather,
     apply_rope,
     _rope_dim,
     rope_tables,
+    tp_partition_specs,
+    tp_shardable,
 )
 from ..models.quant import matmul as _mm
 from ..models.quant import quantize_kv as _quant_kv
@@ -803,9 +808,12 @@ def _paged_qkv(h, lp, cfg: ModelConfig, cos, sin):
     if cfg.qk_norm_full:
         q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
         k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
-    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    # -1 head counts: under tensor parallelism the projections hold a
+    # head-major-contiguous LOCAL slice, so the head axis is n/tp there
+    # and the full n on the single-device path — same reshape either way
+    q = q.reshape(B, T, -1, cfg.head_dim)
+    k = k.reshape(B, T, -1, cfg.head_dim)
+    v = v.reshape(B, T, -1, cfg.head_dim)
     if cfg.qk_norm:
         q = _rms_head_norm(q, ap["q_norm"], cfg.norm_eps)
         k = _rms_head_norm(k, ap["k_norm"], cfg.norm_eps)
@@ -824,23 +832,37 @@ def _paged_qkv(h, lp, cfg: ModelConfig, cos, sin):
     return q, k, v
 
 
-def _paged_residual(x, attn_raw, lp, cfg: ModelConfig):
+def _paged_residual(
+    x, attn_raw, lp, cfg: ModelConfig,
+    tp_axis: str | None = None, tp_quant: bool = False,
+):
     """Shared epilogue: output projection (+bias) and the norm-position /
     parallel-residual wiring, identical to transformer.py::_block's
-    closing. ``attn_raw`` is the attention output ``[B, T, Hq, hd]``."""
+    closing. ``attn_raw`` is the attention output ``[B, T, Hq, hd]``.
+
+    Under tensor parallelism ``attn_raw`` holds the LOCAL heads; the
+    flattened head outputs gather to the full ``q_dim`` (head-major
+    contiguous slices, so the flattened-axis concat IS the head-axis
+    concat), wo produces LOCAL d_model columns (+ its local bias slice)
+    and gathers back — the residual stream ``x`` is always FULL, so
+    norms and residual adds are untouched by sharding."""
     B, T = attn_raw.shape[:2]
     ap = lp["attn"]
-    attn_out = _mm(attn_raw.reshape(B, T, cfg.q_dim), ap["wo"])
+    attn_flat = _tp_gather(attn_raw.reshape(B, T, -1), tp_axis, tp_quant)
+    attn_out = _mm(attn_flat, ap["wo"])
     if "bo" in ap:
         attn_out = attn_out + ap["bo"]
+    attn_out = _tp_gather(attn_out, tp_axis, tp_quant)
     if cfg.norm_position == "post":
         x = x + _norm(attn_out, lp["ln1"], cfg)
-        x = x + _norm(_mlp(x, lp["mlp"], cfg), lp["ln2"], cfg)
+        x = x + _norm(_mlp(x, lp["mlp"], cfg, tp_axis, tp_quant), lp["ln2"], cfg)
     elif cfg.parallel_residual:
-        x = x + attn_out + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+        x = x + attn_out + _mlp(
+            _norm(x, lp["ln2"], cfg), lp["mlp"], cfg, tp_axis, tp_quant
+        )
     else:
         x = x + attn_out
-        x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg)
+        x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg, tp_axis, tp_quant)
     return x
 
 
@@ -916,7 +938,8 @@ def _scatter_kv(cache_kv: tuple, write_pg, write_off, k, v) -> tuple:
 
 
 def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
-                 write_off, att_len, block_tables, kernel: bool):
+                 write_off, att_len, block_tables, kernel: bool,
+                 tp_axis: str | None = None, tp_quant: bool = False):
     """One transformer block over a slot batch of single tokens (T=1),
     reading/writing KV through pages. Mirrors transformer.py::_block's
     projection/norm/residual structure exactly (via the shared
@@ -940,30 +963,24 @@ def _paged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
             q[:, 0], kv[0].astype(q.dtype), kv[1].astype(q.dtype),
             block_tables, att_len, scale=_attn_scale(cfg),
         )[:, None]  # [S, 1, Hq, hd]
-    return _paged_residual(x, attn_raw, lp, cfg), kv
+    return _paged_residual(x, attn_raw, lp, cfg, tp_axis, tp_quant), kv
 
 
 # tlint: hot-path
-@partial(
-    jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
-)
-def paged_decode_step(
+def _decode_step_impl(
     params,
-    tok: jax.Array,  # int32 [S] — each slot's last token
+    tok: jax.Array,
     cache: PagedKVCache,
-    active: jax.Array,  # bool [S] — slots holding a live request
+    active: jax.Array,
     cfg: ModelConfig,
-    kernel: bool = False,
+    kernel: bool,
+    tp_axis: str | None = None,
+    tp_quant: bool = False,
 ):
-    """ONE fixed-shape decode step over every serving slot. Returns
-    ``(logits [S, V], cache)`` with each active slot's new KV written to
-    its pages and its length advanced by one.
-
-    This is the continuous-batching engine's only decode program: its
-    shape depends on (max_slots, model) alone — never on the request mix —
-    so the compiled set stays at exactly one entry per engine (asserted by
-    tests/test_continuous.py). Free slots write their masked token to the
-    scratch page and attend over nothing (length 0 → zero row)."""
+    """Unjitted body of :func:`paged_decode_step` — also traced inside
+    the tensor-parallel shard_map (:func:`make_tp_ragged_step`), where
+    ``tp_axis`` names the mesh axis the weights/KV-heads are split over
+    and the blocks gather activations back to full width."""
     S = tok.shape[0]
     lengths = cache.lengths
     page = cache.page_size
@@ -990,7 +1007,7 @@ def paged_decode_step(
         lp, ckv = xs[0], xs[1:]
         y, ckv = _paged_block(
             carry, lp, cfg, cos, sin, ckv, write_pg, write_off,
-            att_len, cache.block_tables, kernel,
+            att_len, cache.block_tables, kernel, tp_axis, tp_quant,
         )
         return y, ckv
 
@@ -998,15 +1015,40 @@ def paged_decode_step(
         scan_fn, x, (params["layers"], *_cache_kv(cache))
     )
     x = _norm(x, params["final_norm"], cfg)
-    logits = _logits(params, x, cfg)[:, 0]
+    logits = _logits(params, x, cfg, tp_axis, tp_quant)[:, 0]
     new_cache = _with_kv(
         cache, kv_new, lengths=jnp.where(active, lengths + 1, lengths)
     )
     return logits, new_cache
 
 
+# tlint: hot-path
+@partial(
+    jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
+)
+def paged_decode_step(
+    params,
+    tok: jax.Array,  # int32 [S] — each slot's last token
+    cache: PagedKVCache,
+    active: jax.Array,  # bool [S] — slots holding a live request
+    cfg: ModelConfig,
+    kernel: bool = False,
+):
+    """ONE fixed-shape decode step over every serving slot. Returns
+    ``(logits [S, V], cache)`` with each active slot's new KV written to
+    its pages and its length advanced by one.
+
+    This is the continuous-batching engine's only decode program: its
+    shape depends on (max_slots, model) alone — never on the request mix —
+    so the compiled set stays at exactly one entry per engine (asserted by
+    tests/test_continuous.py). Free slots write their masked token to the
+    scratch page and attend over nothing (length 0 → zero row)."""
+    return _decode_step_impl(params, tok, cache, active, cfg, kernel)
+
+
 def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
-                      cfg: ModelConfig, kernel: bool):
+                      cfg: ModelConfig, kernel: bool,
+                      tp_axis: str | None = None, tp_quant: bool = False):
     """The decode-continuation while_loop body of ``paged_ragged_step``
     (one fixed-shape slot decode step + in-program sampling per
     iteration). A slot that finishes mid-chunk (EOS / budget) freezes:
@@ -1026,9 +1068,14 @@ def _decode_loop_body(params, seeds, temp, top_k, top_p, pres, freq, eos,
 
     def body(st):
         i, tok, cache, done, steps, counts, remaining, col, tokens = st
-        logits, cache = paged_decode_step(
-            params, tok, cache, ~done, cfg, kernel
-        )
+        if tp_axis is None:
+            logits, cache = paged_decode_step(
+                params, tok, cache, ~done, cfg, kernel
+            )
+        else:  # already inside the TP shard_map — trace the body inline
+            logits, cache = _decode_step_impl(
+                params, tok, cache, ~done, cfg, kernel, tp_axis, tp_quant
+            )
         keys = _row_keys(seeds, steps)
         nxt = _sample_rows(
             logits, keys, temp, top_k, top_p, pres, freq, counts
@@ -1122,7 +1169,8 @@ def _verify_emit(blk, logits_v, base, n_spec, emit, seeds, steps, temp,
 
 
 def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
-                  write_off, block_tables, starts, n_valid, kernel: bool):
+                  write_off, block_tables, starts, n_valid, kernel: bool,
+                  tp_axis: str | None = None, tp_quant: bool = False):
     """One transformer block over the ragged ``[S, C]`` token block,
     reading/writing KV through every slot's pages at once. Shares
     ``_paged_block``'s prologue/epilogue (scatter-then-attend order
@@ -1149,7 +1197,108 @@ def _ragged_block(x, lp, cfg: ModelConfig, cos, sin, cache_kv, write_pg,
             q, kv[0].astype(q.dtype), kv[1].astype(q.dtype), block_tables,
             starts, n_valid, scale=_attn_scale(cfg),
         )  # [S, C, Hq, hd]
-    return _paged_residual(x, attn_raw, lp, cfg), kv
+    return _paged_residual(x, attn_raw, lp, cfg, tp_axis, tp_quant), kv
+
+
+# tlint: hot-path
+def _ragged_step_impl(
+    params, blk, cache, starts, n_valid, n_spec, emit, seeds, steps,
+    temp, top_k, top_p, pres, freq, counts, remaining, eos,
+    cfg: ModelConfig, n_steps: int, spec_width: int, kernel: bool,
+    tp_axis: str | None = None, tp_quant: bool = False,
+):
+    """Unjitted body of :func:`paged_ragged_step` — also traced inside
+    the tensor-parallel shard_map (:func:`make_tp_ragged_step`). There
+    ``params`` holds head-major column slices, the per-layer KV pages
+    hold the LOCAL kv heads (axis 2 of ``[L, P, n_kv, page, hd]``), and
+    every control-state array (block tables, starts/n_valid, sampling
+    knobs, histograms) is replicated — so the sampling epilogue sees
+    gathered full-width logits and draws the SAME token on every
+    shard."""
+    S, C = blk.shape
+    page = cache.page_size
+    n_pp = cache.pages_per_slot
+    bt = cache.block_tables
+    write_pg, write_off, pos, _valid = _ragged_write_indices(
+        bt, starts, n_valid, page, n_pp, C
+    )
+
+    x = _embed_tokens(params, blk, cfg)  # [S, C, d]
+    positions = pos
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
+
+    def scan_fn(carry, xs):
+        lp, ckv = xs[0], xs[1:]
+        y, ckv = _ragged_block(
+            carry, lp, cfg, cos, sin, ckv, write_pg, write_off,
+            bt, starts, n_valid, kernel, tp_axis, tp_quant,
+        )
+        return y, ckv
+
+    x, kv_new = jax.lax.scan(
+        scan_fn, x, (params["layers"], *_cache_kv(cache))
+    )
+    x = _norm(x, params["final_norm"], cfg)
+    # verification rows: the last spec_width rows of each slot's valid
+    # span — base = n_valid - 1 - n_spec, so a non-speculating slot
+    # (n_spec 0: plain decode, completing prefill, idle) gathers exactly
+    # its last valid row at walk index 0 and the epilogue reduces to the
+    # plain single draw. The vocab head runs over [S, W] rows only —
+    # never the whole [S, C] block (idle slots read row 0: garbage,
+    # masked out of sampling by `emit`).
+    W = int(spec_width)
+    base = jnp.maximum(n_valid - 1 - n_spec, 0)
+    gather = jnp.minimum(
+        base[:, None] + jnp.arange(W)[None, :],
+        jnp.maximum(n_valid - 1, 0)[:, None],
+    )  # [S, W]
+    h_v = x[jnp.arange(S)[:, None], gather]  # [S, W, d]
+    logits_v = _logits(params, h_v, cfg, tp_axis, tp_quant)  # [S, W, V]
+
+    toks0, nxt, spec_m, ended, counts, steps, remaining = _verify_emit(
+        blk, logits_v, base, n_spec, emit, seeds, steps, temp, top_k,
+        top_p, pres, freq, counts, remaining, eos,
+    )
+    done = ~emit | ended
+    # KV unwind at the write seam: a speculating slot's length advances
+    # only past its ACCEPTED tokens (spec_m includes the final
+    # bonus/correction draw, which — like a plain decode's draw — is not
+    # yet written); everything else keeps the full-block advance
+    adv = jnp.where((n_spec > 0) & emit, spec_m, n_valid)
+    cache = _with_kv(
+        cache, kv_new,
+        lengths=jnp.where(n_valid > 0, starts + adv, cache.lengths),
+    )
+    tokens = (
+        jnp.zeros((S, n_steps + W - 1), jnp.int32).at[:, :W].set(toks0)
+    )
+
+    # decode continuation, starting past the ragged block's step, each
+    # slot appending at its own column cursor (the verify pass emitted
+    # spec_m tokens there)
+    body = _decode_loop_body(
+        params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel,
+        tp_axis, tp_quant,
+    )
+
+    def cond(st):
+        return (st[0] < n_steps) & ~st[3].all()
+
+    init = (
+        jnp.int32(1), nxt, cache, done, steps, counts, remaining,
+        spec_m, tokens,
+    )
+    n_exec, _tok, cache, done, steps, counts, remaining, n_tok, tokens = (
+        jax.lax.while_loop(cond, body, init)
+    )
+    return (
+        tokens, n_tok, spec_m, n_exec, cache, done, steps, counts,
+        remaining,
+    )
 
 
 # tlint: hot-path
@@ -1226,89 +1375,129 @@ def paged_ragged_step(
     (column 0..n_tok[s]-1 hold slot ``s``'s draws), and ``spec_m`` is
     the ragged pass's emitted count (the tokens-per-verify-pass signal
     the engine's kill switch consumes)."""
-    S, C = blk.shape
-    page = cache.page_size
-    n_pp = cache.pages_per_slot
-    bt = cache.block_tables
-    write_pg, write_off, pos, _valid = _ragged_write_indices(
-        bt, starts, n_valid, page, n_pp, C
+    return _ragged_step_impl(
+        params, blk, cache, starts, n_valid, n_spec, emit, seeds, steps,
+        temp, top_k, top_p, pres, freq, counts, remaining, eos,
+        cfg, n_steps, spec_width, kernel,
     )
 
-    x = _embed_tokens(params, blk, cfg)  # [S, C, d]
-    positions = pos
-    if cfg.pos == "learned":
-        x = x + params["embed"]["pos"][positions].astype(cfg.dtype)
-    cos = sin = None
-    if cfg.pos == "rope":
-        cos, sin = rope_tables(positions, _rope_dim(cfg), cfg.rope_theta)
 
-    def scan_fn(carry, xs):
-        lp, ckv = xs[0], xs[1:]
-        y, ckv = _ragged_block(
-            carry, lp, cfg, cos, sin, ckv, write_pg, write_off,
-            bt, starts, n_valid, kernel,
+def tp_cache_specs(quantized: bool, axis: str = "tp") -> "PagedKVCache":
+    """PartitionSpec pytree for a tensor-parallel :class:`PagedKVCache`:
+    pages shard by kv head (axis 2 of ``[L, P, n_kv, page, hd]`` — the
+    per-row int8 scales ``[L, P, n_kv, page]`` shard with them), while
+    block tables and lengths REPLICATE. That replication is the
+    control-state invariant (docs/SHARDING.md): the host-side scheduler,
+    allocator, spec decode, and the export/stage/migrate path all read
+    and write page indices and lengths exactly as on one device."""
+    kv = P(None, None, axis)
+    rep = P()
+    return PagedKVCache(
+        k=kv, v=kv, block_tables=rep, lengths=rep,
+        k_scale=kv if quantized else None,
+        v_scale=kv if quantized else None,
+    )
+
+
+# Compiled tensor-parallel ragged-step programs, keyed by every static
+# that shapes the trace. Engines sharing (mesh, model, chunk geometry)
+# share ONE program — churn in slots/requests/spec mixes never adds
+# entries, which is what the per-shard-degree jit-cache guard in
+# tests/test_tp.py pins.
+# tlint: disable=TL006(append-only compiled-program registry, the TP analogue of a @jax.jit function's cache, bounded by hosted configs)
+_TP_RAGGED_CACHE: dict = {}
+
+
+def make_tp_ragged_step(
+    mesh,
+    cfg: ModelConfig,
+    *,
+    n_steps: int,
+    spec_width: int = 1,
+    kernel: bool = False,
+    tp_quant: bool = False,
+    axis: str = "tp",
+):
+    """Build (or fetch) THE tensor-parallel serving program: the ragged
+    step body shard_mapped over ``mesh[axis]`` and jitted with the same
+    donation discipline as :func:`paged_ragged_step`.
+
+    Weights enter as head-major column slices (tp_partition_specs), KV
+    pages as kv-head slices (:func:`tp_cache_specs`), everything else
+    replicated; outputs mirror that layout, so the donated cache keeps
+    its sharding across chunks. Call with the SAME positional arrays as
+    ``paged_ragged_step`` minus the trailing statics (closed over
+    here). ``tp_quant`` routes the per-chunk activation gathers through
+    the int8 quantized collective (bounded divergence, opt-in via
+    ModelConfig.collective_quant)."""
+    key = (mesh, cfg, int(n_steps), int(spec_width), bool(kernel),
+           bool(tp_quant), axis)
+    hit = _TP_RAGGED_CACHE.get(key)
+    if hit is not None:
+        return hit
+    from ..parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
+    pspecs = tp_partition_specs(cfg, axis=axis)
+    rep = P()
+
+    def body(params, blk, cache, starts, n_valid, n_spec, emit, seeds,
+             steps, temp, top_k, top_p, pres, freq, counts, remaining,
+             eos):
+        return _ragged_step_impl(
+            params, blk, cache, starts, n_valid, n_spec, emit, seeds,
+            steps, temp, top_k, top_p, pres, freq, counts, remaining,
+            eos, cfg, n_steps, spec_width, kernel, axis, tp_quant,
         )
-        return y, ckv
 
-    x, kv_new = jax.lax.scan(
-        scan_fn, x, (params["layers"], *_cache_kv(cache))
-    )
-    x = _norm(x, params["final_norm"], cfg)
-    # verification rows: the last spec_width rows of each slot's valid
-    # span — base = n_valid - 1 - n_spec, so a non-speculating slot
-    # (n_spec 0: plain decode, completing prefill, idle) gathers exactly
-    # its last valid row at walk index 0 and the epilogue reduces to the
-    # plain single draw. The vocab head runs over [S, W] rows only —
-    # never the whole [S, C] block (idle slots read row 0: garbage,
-    # masked out of sampling by `emit`).
-    W = int(spec_width)
-    base = jnp.maximum(n_valid - 1 - n_spec, 0)
-    gather = jnp.minimum(
-        base[:, None] + jnp.arange(W)[None, :],
-        jnp.maximum(n_valid - 1, 0)[:, None],
-    )  # [S, W]
-    h_v = x[jnp.arange(S)[:, None], gather]  # [S, W, d]
-    logits_v = _logits(params, h_v, cfg)  # [S, W, V]
+    def specs_for(quantized: bool):
+        cspecs = tp_cache_specs(quantized, axis)
+        in_specs = (pspecs, rep, cspecs) + (rep,) * 14
+        out_specs = (rep, rep, rep, rep, cspecs, rep, rep, rep, rep)
+        return in_specs, out_specs
 
-    toks0, nxt, spec_m, ended, counts, steps, remaining = _verify_emit(
-        blk, logits_v, base, n_spec, emit, seeds, steps, temp, top_k,
-        top_p, pres, freq, counts, remaining, eos,
-    )
-    done = ~emit | ended
-    # KV unwind at the write seam: a speculating slot's length advances
-    # only past its ACCEPTED tokens (spec_m includes the final
-    # bonus/correction draw, which — like a plain decode's draw — is not
-    # yet written); everything else keeps the full-block advance
-    adv = jnp.where((n_spec > 0) & emit, spec_m, n_valid)
-    cache = _with_kv(
-        cache, kv_new,
-        lengths=jnp.where(n_valid > 0, starts + adv, cache.lengths),
-    )
-    tokens = (
-        jnp.zeros((S, n_steps + W - 1), jnp.int32).at[:, :W].set(toks0)
-    )
+    def build(quantized: bool):
+        in_specs, out_specs = specs_for(quantized)
+        return jax.jit(
+            shard_map(
+                body, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            ),
+            donate_argnums=(2, 14),  # cache, counts — as the 1-dev step
+        )
 
-    # decode continuation, starting past the ragged block's step, each
-    # slot appending at its own column cursor (the verify pass emitted
-    # spec_m tokens there)
-    body = _decode_loop_body(
-        params, seeds, temp, top_k, top_p, pres, freq, eos, cfg, kernel
-    )
+    # int8-cache engines carry scale planes (a different cache pytree),
+    # so the spec tree is chosen at first call by the cache's own arity
+    plain, quant = build(False), build(True)
 
-    def cond(st):
-        return (st[0] < n_steps) & ~st[3].all()
+    def _canon(x):
+        # Replicated control arrays reach the dispatcher with two
+        # spellings of the same placement — P() from host-side
+        # device_puts and rank-expanded P(None, ...) from jit/shard_map
+        # outputs — and the jit cache keys on the spelling, not the
+        # placement. Pin ONE canonical form (the rank-expanded one the
+        # step's own outputs carry, so steady-state decode chunks pass
+        # through untouched) to keep the hot loop at one program.
+        want = NamedSharding(mesh, P(*([None] * x.ndim)))
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh == want:
+            return x
+        return jax.device_put(x, want)
 
-    init = (
-        jnp.int32(1), nxt, cache, done, steps, counts, remaining,
-        spec_m, tokens,
+    def step(params, blk, cache, *rest):
+        fn = plain if cache.k_scale is None else quant
+        bt = _canon(cache.block_tables)
+        ln = _canon(cache.lengths)
+        if bt is not cache.block_tables or ln is not cache.lengths:
+            cache = replace(cache, block_tables=bt, lengths=ln)
+        rest = list(rest)
+        rest[11] = _canon(rest[11])  # counts (donated, like the cache)
+        return fn(params, blk, cache, *rest)
+
+    step._cache_size = lambda: (  # compile-count guard hook, summed
+        plain._cache_size() + quant._cache_size()
     )
-    n_exec, _tok, cache, done, steps, counts, remaining, n_tok, tokens = (
-        jax.lax.while_loop(cond, body, init)
-    )
-    return (
-        tokens, n_tok, spec_m, n_exec, cache, done, steps, counts,
-        remaining,
-    )
+    _TP_RAGGED_CACHE[key] = step
+    return step
 
 
 # tlint: hot-path
@@ -1423,6 +1612,8 @@ __all__ = [
     "SharedPagePool",
     "paged_decode_step",
     "paged_ragged_step",
+    "make_tp_ragged_step",
+    "tp_cache_specs",
     "copy_page",
     "gather_page",
     "scatter_page",

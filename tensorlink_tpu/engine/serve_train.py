@@ -2,7 +2,10 @@
 
 The north-star loop closer: a hosted model fine-tunes WHILE it serves.
 :class:`ServeTrainLoop` owns a compiled train step (engine/training.py —
-the zero1 step when the mesh has a dp axis), its params/optimizer state,
+the zero1 step when the mesh has a dp axis; the zero1 × TP step when the
+serving engine is tensor-parallel, in which case params flow through
+training AS the serving shards and the publish below needs no relayout —
+docs/SHARDING.md), its params/optimizer state,
 and a data source; it attaches to a local :class:`ContinuousBatcher` as
 the driver's background hook, so every train step runs ON the serving
 driver thread BETWEEN engine chunks:

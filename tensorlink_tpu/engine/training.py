@@ -19,6 +19,19 @@ compiler's all-gather. With ``n_micro == dp`` the sharded step is
 bit-identical to the unsharded microbatched step (test-pinned in
 tests/test_zero1.py) while per-replica optimizer-state bytes drop to
 ~1/dp.
+
+``make_train_step(zero1=True, tp_axis="tp")`` composes that with the
+serving path's explicit tensor parallelism (docs/SHARDING.md) on a 2-D
+``(dp, tp)`` mesh: params enter and leave the step as the SAME
+head/column shards the paged engine serves (transformer.py
+``tp_partition_specs``), each device gathers them whole for
+forward/backward (grads land replicated over tp), the gradient
+reduction runs over the dp axis only, and the optimizer update is
+sliced over the FLATTENED ``dp·tp`` device grid — so resident
+optimizer+weight bytes drop to ~1/(dp·tp) while the step stays
+bit-identical to the unsharded reference (tests/test_tp.py). This is
+what lets ``ServeTrainLoop`` train and hot-swap the very tensors a
+tensor-parallel engine is serving without a relayout on either side.
 """
 
 from __future__ import annotations
@@ -113,6 +126,7 @@ class TrainStep:
     mode: str = "unsharded"  # "unsharded" | "zero1"
     mesh: Any = None  # zero1 only: the mesh carrying the dp axis
     dp_axis: str = "data"
+    tp_axis: str | None = None  # zero1 × TP: the mesh's tensor axis
 
     def init_state(self, params):
         state = self.optimizer.init(params)
@@ -121,13 +135,21 @@ class TrainStep:
         # ZeRO-1: the PERSISTENT optimizer state lives 1/dp per replica —
         # device_put with the dp-extended specs here, and every step's
         # output constraint keeps it there (the donated buffers round-trip
-        # sharded, so full state never materializes after this point)
+        # sharded, so full state never materializes after this point).
+        # Composed with TP the slice axis is the FLATTENED (dp, tp) grid:
+        # 1/(dp·tp) resident state per device.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        dp = int(self.mesh.shape[self.dp_axis])
+        if self.tp_axis:
+            axes: Any = (self.dp_axis, self.tp_axis)
+            size = dp * int(self.mesh.shape[self.tp_axis])
+        else:
+            axes, size = self.dp_axis, dp
         sspecs = optimizer_state_specs(
             self.optimizer, params,
             jax.tree.map(lambda _: P(), params),
-            dp_axis=self.dp_axis, dp_size=int(self.mesh.shape[self.dp_axis]),
+            dp_axis=axes, dp_size=size,
         )
         return jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
@@ -207,6 +229,7 @@ def make_train_step(
     zero1: bool = False,
     mesh: Any = None,
     dp_axis: str = "data",
+    tp_axis: str | None = None,
 ) -> TrainStep:
     """Build the compiled train step.
 
@@ -226,6 +249,13 @@ def make_train_step(
     with ``n_micro == dp`` the whole step is bit-identical to
     ``zero1=False`` (test-pinned). Requires ``n_micro % dp == 0`` so each
     replica scans whole micro-batches; buffer donation is preserved.
+
+    ``tp_axis`` (with ``zero1=True``) composes the update sharding with
+    the serving path's tensor parallelism: params flow through the step
+    AS the serving shards (``tp_partition_specs``), gathered whole
+    per-device for forward/backward, and the optimizer slice axis
+    becomes the flattened ``dp·tp`` grid — see the module docstring and
+    docs/SHARDING.md. The batch still shards over ``dp_axis`` only.
     """
     loss_fn = loss_fn or causal_lm_loss
 
@@ -253,10 +283,27 @@ def make_train_step(
         return nll_sum, aux["n_tokens"].astype(jnp.float32), grads
 
     if zero1:
+        tp_pspecs = None
+        if tp_axis is not None:
+            from ..models.transformer import tp_partition_specs, tp_shardable
+
+            if mesh is None:
+                raise ValueError("tp_axis requires a mesh")
+            if tp_axis not in dict(mesh.shape):
+                raise ValueError(
+                    f"mesh has no {tp_axis!r} axis: {dict(mesh.shape)}"
+                )
+            reason = tp_shardable(cfg, int(mesh.shape[tp_axis]))
+            if reason is not None:
+                raise ValueError(f"tp_axis={tp_axis!r}: {reason}")
+            tp_pspecs = tp_partition_specs(cfg, axis=tp_axis)
         return _make_zero1_step(
             optimizer, sum_grads, mesh=mesh, dp_axis=dp_axis,
             n_micro=n_micro, donate=donate,
+            tp_axis=tp_axis, tp_pspecs=tp_pspecs,
         )
+    if tp_axis is not None:
+        raise ValueError("tp_axis requires zero1=True (the sharded step)")
 
     def step(params, opt_state, batch):
         tokens = batch["tokens"]
@@ -307,6 +354,7 @@ def _dp_shardable(shape, dp: int) -> bool:
 
 def _make_zero1_step(
     optimizer, sum_grads, *, mesh, dp_axis, n_micro, donate,
+    tp_axis=None, tp_pspecs=None,
 ) -> TrainStep:
     """The ZeRO-1 step body (see make_train_step). Split out so the
     unsharded path above stays byte-identical to its pre-zero1 shape.
@@ -330,7 +378,20 @@ def _make_zero1_step(
     qualify; adafactor's factored second moments do not and are refused.
     A plain optax transformation (not from ``make_optimizer``) is trusted
     to be shard-local — wrap global-norm stages via ``make_optimizer`` so
-    the clip split applies."""
+    the clip split applies.
+
+    ``tp_axis`` composes the step with explicit tensor parallelism
+    (docs/SHARDING.md): params enter/leave the region as their LOCAL
+    serving shards (``tp_pspecs``), step 0.5 all-gathers each sharded
+    leaf whole along its own sharded dim (tiled — exact reassembly, so
+    the forward/backward sees bitwise the unsharded weights), the
+    reduction in step 2 runs over ``dp_axis`` only (grads land
+    replicated over tp for free: every tp peer saw the same batch
+    block and the same full params), and steps 4-5 slice by the
+    flattened ``data_idx · tp + tp_idx`` device index and re-gather
+    over BOTH axes in that order — optimizer state persists 1/(dp·tp)
+    per device. With ``tp_axis=None`` every helper degenerates to the
+    plain zero1 shape above."""
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -358,21 +419,47 @@ def _make_zero1_step(
             "zero1 requires a shard-local (elementwise) optimizer update; "
             "adafactor's factored second moments are not — use adamw/sgd"
         )
+    tp = int(mesh.shape[tp_axis]) if tp_axis else 1
+    world = dp * tp  # the flattened update-slice grid
     local_micro = n_micro // dp
     shard_map = get_shard_map()
     replicated = NamedSharding(mesh, P())
 
+    def _tp_dim(spec):
+        """Index of the tp-sharded dim in a weight's partition spec, or
+        None for replicated leaves (norms, embeddings)."""
+        for i, part in enumerate(tuple(spec)):
+            if part == tp_axis:
+                return i
+        return None
+
+    def gather_full(params):
+        """Reassemble whole weights from this device's serving shards —
+        tiled all_gather along each leaf's own sharded dim is EXACT
+        (concatenation of the original column blocks in axis order), so
+        downstream forward/backward math is bitwise the unsharded
+        step's."""
+        if tp_axis is None:
+            return params
+        return jax.tree.map(
+            lambda x, sp: x if _tp_dim(sp) is None else lax.all_gather(
+                x, tp_axis, axis=_tp_dim(sp), tiled=True
+            ),
+            params, tp_pspecs,
+        )
+
     def slice_leaf(x, idx):
         shape = tuple(x.shape)
-        if not _dp_shardable(shape, dp):
+        if not _dp_shardable(shape, world):
             return x
-        blk = shape[0] // dp
+        blk = shape[0] // world
         return lax.dynamic_slice_in_dim(x, idx * blk, blk, axis=0)
 
     def region(params, opt_state, tokens, loss_mask):
         # runs per replica inside shard_map: this replica's batch shard is
         # its contiguous block of the global micro sequence, scanned with
         # the SAME fp32 sum-form carry as the unsharded path
+        params = gather_full(params)
         mb = tokens.shape[0] // local_micro
         toks = tokens.reshape(local_micro, mb, -1)
         lm = (
@@ -421,23 +508,43 @@ def _make_zero1_step(
             grads_in = grads
             clip_state, inner_state = None, opt_state
 
-        # the sharded weight update: this replica's 1/dp slice of grads +
-        # params against its RESIDENT 1/dp optimizer-state shard (the
-        # in_specs delivered it as local blocks — state never
+        # the sharded weight update: this device's 1/world slice of grads
+        # + params against its RESIDENT 1/world optimizer-state shard
+        # (the in_specs delivered it as local blocks — state never
         # re-replicates); elementwise updates are slice-invariant, so the
-        # gathered result is bitwise the full update's
+        # gathered result is bitwise the full update's. Under TP the
+        # slice index is the FLATTENED (dp, tp) grid position — the
+        # device order serving_mesh documents.
         idx = lax.axis_index(dp_axis)
+        if tp_axis is not None:
+            idx = idx * tp + lax.axis_index(tp_axis)
         g_r = jax.tree.map(lambda x: slice_leaf(x, idx), grads_in)
         p_r = jax.tree.map(lambda x: slice_leaf(x, idx), params)
         u_r, new_inner = inner.update(g_r, inner_state, p_r)
         newp_r = optax.apply_updates(p_r, u_r)
 
         def unslice(full, piece):
-            if _dp_shardable(tuple(full.shape), dp):
-                return lax.all_gather(piece, dp_axis, axis=0, tiled=True)
+            if _dp_shardable(tuple(full.shape), world):
+                axes = (dp_axis, tp_axis) if tp_axis is not None else dp_axis
+                return lax.all_gather(piece, axes, axis=0, tiled=True)
             return piece
 
         new_params = jax.tree.map(unslice, params, newp_r)
+        if tp_axis is not None:
+            # hand the updated weights back as this device's SERVING
+            # shard (the out_specs layout): exact column re-slice of the
+            # full update — the serve-train hot-swap publishes these
+            # without any relayout
+            def reslice(x, sp):
+                d = _tp_dim(sp)
+                if d is None:
+                    return x
+                sz = x.shape[d] // tp
+                return lax.dynamic_slice_in_dim(
+                    x, lax.axis_index(tp_axis) * sz, sz, axis=d
+                )
+
+            new_params = jax.tree.map(reslice, new_params, tp_pspecs)
         new_state = (
             (clip_state, new_inner) if grad_clip is not None else new_inner
         )
@@ -452,9 +559,14 @@ def _make_zero1_step(
         mb = B // n_micro
         toks = tokens[: mb * n_micro]
         lm = loss_mask[: mb * n_micro] if loss_mask is not None else None
-        pspecs = jax.tree.map(lambda _: P(), params)
+        pspecs = (
+            tp_pspecs if tp_axis is not None
+            else jax.tree.map(lambda _: P(), params)
+        )
+        state_axes = (dp_axis, tp_axis) if tp_axis is not None else dp_axis
         sspecs = optimizer_state_specs(
-            optimizer, params, pspecs, dp_axis=dp_axis, dp_size=dp,
+            optimizer, params, jax.tree.map(lambda _: P(), params),
+            dp_axis=state_axes, dp_size=world,
         )
         out_sspecs = (
             (sspecs[0], sspecs[1]) if grad_clip is not None else sspecs
@@ -483,27 +595,37 @@ def _make_zero1_step(
     def step_fn(params, opt_state, batch):
         # bounded-compile discipline: entry params may arrive committed
         # anywhere (init_params: one device; a checkpoint restore: host)
-        # — normalize them to ONE replicated layout before the jit, so
-        # the cache holds at most the cold-entry program plus the
-        # steady-state program whose inputs are the previous step's
-        # outputs (tests pin n_programs() <= 2, churn-free)
-        params = jax.tree.map(
-            lambda x: x if getattr(x, "sharding", None) == replicated
-            else jax.device_put(x, replicated),
-            params,
-        )
+        # — normalize them to ONE layout before the jit (replicated, or
+        # the serving shards under TP), so the cache holds at most the
+        # cold-entry program plus the steady-state program whose inputs
+        # are the previous step's outputs (tests pin n_programs() <= 2,
+        # churn-free)
+        if tp_axis is not None:
+            params = jax.tree.map(
+                lambda x, sp: x
+                if getattr(x, "sharding", None) == NamedSharding(mesh, sp)
+                else jax.device_put(x, NamedSharding(mesh, sp)),
+                params, tp_pspecs,
+            )
+        else:
+            params = jax.tree.map(
+                lambda x: x if getattr(x, "sharding", None) == replicated
+                else jax.device_put(x, replicated),
+                params,
+            )
         return jit_step(params, opt_state, batch)
 
     step_fn._cache_size = jit_step._cache_size  # the compile-guard probe
     return TrainStep(
         step_fn=step_fn,
         optimizer=optimizer, mode="zero1", mesh=mesh, dp_axis=dp_axis,
+        tp_axis=tp_axis,
     )
 
 
 def optimizer_state_specs(
     optimizer: optax.GradientTransformation, params, param_specs,
-    *, dp_axis: str | None = None, dp_size: int = 0,
+    *, dp_axis: "str | tuple | None" = None, dp_size: int = 0,
 ):
     """PartitionSpec pytree for the optax state: any sub-tree that mirrors
     the param tree (adam moments, momentum buffers) shards like the params;
@@ -518,6 +640,9 @@ def optimizer_state_specs(
     than guessed), dropping persistent per-replica bytes to ~1/dp. Under
     GSPMD the dp sharding is pure LAYOUT: elementwise update math is
     partition-invariant, so this never changes a step's values.
+    ``dp_axis`` may be a TUPLE of mesh axes — the zero1 × TP step passes
+    ``(dp_axis, tp_axis)`` so state shards over the flattened device
+    grid (~1/(dp·tp) resident bytes).
 
     Hardened for optax states whose sub-trees DON'T mirror the param tree
     (``optax.masked`` moment trees carry ``MaskedNode`` placeholders,

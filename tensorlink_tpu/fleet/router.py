@@ -508,6 +508,13 @@ class FleetRouter:
                     "routed": int(r.routed.value),
                     "draining": bool(r.view.get("draining")),
                     "worker_role": r.view.get("worker_role", "mixed"),
+                    # explicit TP (docs/SHARDING.md): the replica's shard
+                    # degree — a tp=N replica is ONE placement unit over
+                    # N chips, so headroom (slots_free, kv_pages_free)
+                    # already describes the whole mesh, never per-chip
+                    "tensor_parallel": int(
+                        r.view.get("tensor_parallel", 1) or 1
+                    ),
                     "slots_free": r.view.get("slots_free"),
                     "kv_pages_free": r.view.get("kv_pages_free"),
                     "queue_depth": dict(r.view.get("queue_depth") or {}),

@@ -307,6 +307,27 @@ class DistributedValidator:
             s.get("id"): str(s.get("serving_role") or "mixed")
             for s in stats
         }
+        # explicit tensor parallelism (docs/SHARDING.md): each worker's
+        # advertised serving shard degree. A tp=N worker is ONE placement
+        # unit over N chips (its continuous engine runs a single sharded
+        # program), so the plan carries the degree through to consumers
+        # (router placement, /healthz) rather than splitting the mesh. A
+        # worker advertising more tp than devices would refuse TP at
+        # hosting time and serve static — surface that misfit here, at
+        # plan time, where the operator is looking.
+        tp_degrees = {
+            s.get("id"): int(s.get("tensor_parallel", 1) or 1)
+            for s in stats
+        }
+        for s in stats:
+            tp_adv = tp_degrees.get(s.get("id"), 1)
+            if tp_adv > 1 and tp_adv > int(s.get("n_devices", 1)):
+                self.log.warning(
+                    "worker %s advertises tensor_parallel=%d but only %d "
+                    "device(s) — its engines will fall back to static "
+                    "batching", s.get("id"), tp_adv,
+                    int(s.get("n_devices", 1)),
+                )
         decode_pool = [
             {"id": s["id"], "addr": list(s["addr"])}
             for s in stats
@@ -398,6 +419,13 @@ class DistributedValidator:
         # snapshot (/healthz serving_modes on a fresh replica)
         result["serving_roles"] = {
             s.worker_id: roles.get(s.worker_id, "mixed")
+            for s in plan.stages
+        }
+        # ...and their serving shard degrees, same reasoning: a router
+        # scoring this replica needs to know a tp=N worker is one engine
+        # over N chips before the first serving snapshot exists
+        result["tensor_parallel"] = {
+            s.worker_id: tp_degrees.get(s.worker_id, 1)
             for s in plan.stages
         }
         self.log.info(

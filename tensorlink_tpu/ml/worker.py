@@ -234,6 +234,14 @@ class DistributedWorker:
                 getattr(self.node.config.ml, "worker_role", "mixed")
                 or "mixed"
             ),
+            # explicit tensor parallelism (docs/SHARDING.md): the shard
+            # degree this worker's continuous engines run at — the
+            # planner/validator treat the whole tp mesh as ONE placement
+            # unit (a tp=4 worker is one engine over 4 chips, not 4
+            # engines)
+            "tensor_parallel": int(
+                getattr(self.node.config.ml, "tensor_parallel", 1) or 1
+            ),
         }
         # hosts of one TPU slice share an ICI domain: advertise the slice so
         # the planner can merge co-slice workers into one mesh
@@ -2115,6 +2123,13 @@ class DistributedWorker:
                 sched_preemption=bool(ml.sched_preemption),
                 sched_policy=str(ml.sched_policy),
                 sched_max_wait_s=float(ml.sched_max_wait_s),
+                # explicit TP (docs/SHARDING.md): shard the hot path over
+                # a tp mesh axis; engines that can't (MoE, indivisible
+                # heads, too few devices) refuse with ValueError and land
+                # in the static fallback below like any other refusal
+                tensor_parallel=int(
+                    getattr(ml, "tensor_parallel", 1) or 1
+                ),
             )
         except ValueError as e:
             # sliding window (or a bad knob): static batcher territory.

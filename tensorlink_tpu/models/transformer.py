@@ -18,6 +18,7 @@ ml/worker.py:297-357):
 
 from __future__ import annotations
 
+import os as _os
 from functools import partial
 import jax
 import jax.numpy as jnp
@@ -209,7 +210,38 @@ def attention(
     return out.reshape(B, T, Hq, hd)
 
 
-def _mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
+# tlint: hot-path
+def _tp_gather(h: jax.Array, tp_axis: str | None, quant: bool) -> jax.Array:
+    """Reassemble an activation whose LAST axis is split over ``tp_axis``.
+
+    Identity when ``tp_axis`` is None (the single-device trace is
+    unchanged). Inside shard_map, shards concatenate in axis-index order
+    — ``lax.all_gather(tiled=True)`` — so the full activation is bitwise
+    identical on every participant and to the unsharded compute.
+    ``quant`` swaps in the EQuARX-style int8 gather
+    (parallel/ring.py::quantized_all_gather): same fixed order, ≈½/¼ the
+    wire bytes, bounded divergence (opt-in via collective_quant)."""
+    if tp_axis is None:
+        return h
+    if quant:
+        from ..parallel.ring import quantized_all_gather
+
+        return quantized_all_gather(h, tp_axis, axis=h.ndim - 1, tiled=True)
+    return lax.all_gather(h, tp_axis, axis=h.ndim - 1, tiled=True)
+
+
+def _mlp(
+    h: jax.Array,
+    p: dict,
+    cfg: ModelConfig,
+    tp_axis: str | None = None,
+    tp_quant: bool = False,
+) -> jax.Array:
+    """MLP block. Under tensor parallelism (``tp_axis``) w_gate/w_up hold
+    LOCAL output columns and w_down holds the FULL hidden dim but LOCAL
+    output columns — biases are sliced to match, applied before each
+    gather (elementwise add commutes with concatenation), and the hidden
+    and output reassemble via :func:`_tp_gather`."""
     if cfg.moe:
         return _moe_mlp(h, p, cfg)
     if cfg.mlp == "gated":
@@ -218,12 +250,14 @@ def _mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
         if "b_gate" in p:
             g = g + p["b_gate"]
             u = u + p["b_up"]
-        out = _mm(_act(g, cfg.act) * u, p["w_down"])
+        mid = _tp_gather(_act(g, cfg.act) * u, tp_axis, tp_quant)
+        out = _mm(mid, p["w_down"])
         if "b_down" in p:
             out = out + p["b_down"]
-        return out
-    out = _mm(_act(_mm(h, p["w_up"]) + p["b_up"], cfg.act), p["w_down"]) + p["b_down"]
-    return out
+        return _tp_gather(out, tp_axis, tp_quant)
+    mid = _tp_gather(_act(_mm(h, p["w_up"]) + p["b_up"], cfg.act), tp_axis, tp_quant)
+    out = _mm(mid, p["w_down"]) + p["b_down"]
+    return _tp_gather(out, tp_axis, tp_quant)
 
 
 def _moe_mlp(h: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
@@ -430,11 +464,22 @@ def forward(
     )
 
 
-def _logits(params: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _logits(
+    params: dict,
+    x: jax.Array,
+    cfg: ModelConfig,
+    tp_axis: str | None = None,
+    tp_quant: bool = False,
+) -> jax.Array:
+    """LM head. Under tensor parallelism a tied head computes the full
+    vocab locally (the embedding is replicated — no collective); an
+    untied ``lm_head`` holds LOCAL vocab columns and the logits reassemble
+    via :func:`_tp_gather` so sampling sees the full distribution,
+    identical on every shard."""
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["tok"].T.astype(cfg.dtype)
     else:
-        logits = _mm(x, params["lm_head"])
+        logits = _tp_gather(_mm(x, params["lm_head"]), tp_axis, tp_quant)
     if cfg.logit_cap is not None:
         logits = cfg.logit_cap * jnp.tanh(logits / cfg.logit_cap)
     return logits
@@ -524,10 +569,17 @@ def _stage_impl(
         and T_in > 1
         and T_in % min(128, T_in) == 0  # irregular bucket -> einsum, not a
         and seq_mesh is None  # trace-time crash of serving
+        # off the TPU the kernel only runs in interpret mode, which
+        # BENCH_r10 measured at 0.99x the einsum (pure overhead) — fall
+        # through to einsum there unless a test opts in explicitly
+        and (
+            jax.default_backend() == "tpu"
+            or _os.environ.get("TLTPU_FLASH_INTERPRET") == "1"
+        )
     ):
         from ..ops.attention import flash_attention
 
-        interp = jax.default_backend() == "cpu"  # tests run interpret mode
+        interp = jax.default_backend() != "tpu"  # env opt-in: interpret mode
         T_flash = T_in
         win = cfg.sliding_window
 
@@ -802,6 +854,98 @@ def partition_specs(
         specs["embed"]["pos"] = spec(None, fs)
     if not cfg.tie_embeddings:
         specs["lm_head"] = spec(fs, t)
+    return specs
+
+
+def tp_shardable(cfg: ModelConfig, tp: int) -> str | None:
+    """Why ``cfg`` can NOT shard ``tp`` ways on the explicit serving TP
+    path, or ``None`` when it can.
+
+    The explicit path (``tp_partition_specs`` + shard_map in
+    engine/paged.py) slices heads/columns head-major-contiguously and
+    reassembles with exact tiled all_gathers, so the constraints are pure
+    divisibility plus two structural refusals: MoE (routing is global)
+    and ``qk_norm_full`` (its RMSNorm spans the FULL projection dim — a
+    local head slice would normalize over the wrong statistics)."""
+    tp = int(tp)
+    if tp <= 1:
+        return None
+    if cfg.moe:
+        return "MoE routing is not tensor-shardable on the serving path"
+    if cfg.qk_norm_full:
+        return "qk_norm_full normalizes over the full projection dim"
+    for name in ("n_heads", "n_kv_heads", "d_ff", "d_model"):
+        val = int(getattr(cfg, name))
+        if val % tp:
+            return f"{name}={val} is not divisible by tp={tp}"
+    if not cfg.tie_embeddings and cfg.vocab_size % tp:
+        return f"untied vocab_size={cfg.vocab_size} is not divisible by tp={tp}"
+    return None
+
+
+def tp_partition_specs(cfg: ModelConfig, axis: str = "tp") -> dict:
+    """PartitionSpec pytree for the EXPLICIT (shard_map) serving TP path
+    — matches :func:`init_params`, walkable by dot-path (engine/loader).
+
+    Unlike the GSPMD :func:`partition_specs` (where wo/w_down are
+    row-parallel and XLA inserts psums), every matmul weight here shards
+    its OUTPUT dim and activations reassemble with exact tiled
+    all_gathers — column-slice matmuls are bitwise equal to slicing the
+    full product, and a fixed-order concat is bitwise associative-free,
+    which is what keeps tp=N streams bit-identical to tp=1
+    (docs/SHARDING.md). Biases shard with the outputs they add onto;
+    embeddings/norms replicate; per-head qk_norm scales (``[L, hd]``)
+    replicate and apply to local heads unchanged. Gate with
+    :func:`tp_shardable` first."""
+    if cfg.moe:
+        raise ValueError("MoE params have no explicit-TP partition specs")
+    t = axis
+    rep2, rep1 = P(None, None), P(None)
+
+    ln = {"scale": rep2}
+    if cfg.norm == "layernorm":
+        ln["bias"] = rep2
+    attn = {
+        "wq": P(None, None, t),
+        "wk": P(None, None, t),
+        "wv": P(None, None, t),
+        "wo": P(None, None, t),  # output (d_model) columns — input q_dim FULL
+    }
+    if cfg.attn_bias:
+        attn |= {"bq": P(None, t), "bk": P(None, t), "bv": P(None, t)}
+    if cfg.attn_out_bias or cfg.family == "gpt2":  # must match init_params
+        attn["bo"] = P(None, t)
+    if cfg.qk_norm:
+        attn |= {"q_norm": rep2, "k_norm": rep2}
+    if cfg.qk_norm_full:  # refused by tp_shardable; specs stay replicated
+        attn |= {"q_norm": rep2, "k_norm": rep2}
+
+    if cfg.mlp == "gated":
+        mlp = {
+            "w_gate": P(None, None, t),
+            "w_up": P(None, None, t),
+            "w_down": P(None, None, t),  # output (d_model) columns — f FULL
+        }
+        if cfg.mlp_bias:
+            mlp |= {"b_gate": P(None, t), "b_up": P(None, t), "b_down": P(None, t)}
+    else:
+        mlp = {
+            "w_up": P(None, None, t),
+            "b_up": P(None, t),
+            "w_down": P(None, None, t),
+            "b_down": P(None, t),
+        }
+
+    specs = {
+        "embed": {"tok": rep2},
+        "layers": {"ln1": ln, "attn": attn, "ln2": dict(ln), "mlp": mlp},
+        "final_norm": {"scale": rep1}
+        | ({"bias": rep1} if cfg.norm == "layernorm" else {}),
+    }
+    if cfg.pos == "learned":
+        specs["embed"]["pos"] = rep2
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, t)
     return specs
 
 

@@ -6,13 +6,15 @@ Axis convention (scaling-book style):
 
 - ``data``    — batch sharding (DP); gradients psum over it
 - ``fsdp``    — parameter/optimizer sharding (ZeRO-3), usually same ICI links
-- ``tensor``  — megatron TP inside a layer
+- ``tensor``  — megatron TP inside a layer (legacy GSPMD dense path)
 - ``expert``  — MoE expert parallelism
 - ``seq``     — sequence/context parallelism (ring attention)
 - ``stage``   — pipeline stages
+- ``tp``      — explicit tensor parallelism for the paged serving path
+  (shard_map, bitwise-exact collectives — see docs/SHARDING.md)
 
-Meshes are built so axes that carry the most traffic (tensor) map to the
-innermost (fastest ICI) device dimension.
+Meshes are built so axes that carry the most traffic (tensor/tp) map to
+the innermost (fastest ICI) device dimension.
 """
 
 from __future__ import annotations
@@ -23,7 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("stage", "data", "fsdp", "expert", "seq", "tensor")
+AXIS_ORDER = ("stage", "data", "fsdp", "expert", "seq", "tensor", "tp")
 
 
 @dataclass(frozen=True)
@@ -72,6 +74,19 @@ def local_mesh(**axis_sizes: int) -> Mesh:
         known = int(np.prod([s for s in sizes.values() if s != -1]))
         sizes[wild[0]] = len(devs) // known
     return build_mesh(sizes, devs)
+
+
+def serving_mesh(
+    tp: int, dp: int = 1, devices: list | None = None
+) -> Mesh:
+    """The ``(dp, tp)`` mesh the paged serving/serve-train path runs on.
+
+    ``tp`` is innermost (fastest ICI links — it carries the per-chunk
+    activation gathers), ``data`` outermost (it only carries the zero1
+    gradient reduction). A pure-serving replica uses ``dp=1``; the
+    flattened device index is ``data_idx * tp + tp_idx``, which is the
+    order zero1 × TP slices optimizer state by (engine/training.py)."""
+    return build_mesh({"data": int(dp), "tp": int(tp)}, devices)
 
 
 def shard(mesh: Mesh, spec: P):

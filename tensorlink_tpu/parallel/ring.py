@@ -69,12 +69,27 @@ def _dequant_chunk(q, scale):
 
 
 # tlint: hot-path
-def quantized_all_gather(x, axis_name: str, *, axis: int = 0):
+def quantized_all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = False):
     """``lax.all_gather`` with int8 payload: each device quantizes its
     shard once, the gather moves int8 + per-row scales (≈½ the bf16
     bytes, ¼ of f32), and the result dequantizes locally to ``x.dtype``.
-    Must run inside shard_map over ``axis_name``."""
+    Must run inside shard_map over ``axis_name``.
+
+    ``tiled=True`` concatenates the shards along ``axis`` (like
+    ``lax.all_gather(..., tiled=True)``) instead of stacking a new
+    leading dim — the shape the tensor-parallel serving path needs when
+    reassembling activations split along a feature axis. The wire still
+    moves int8 + per-row scales; each shard is dequantized with ITS OWN
+    scales before the concatenation, and shards concatenate in axis-index
+    order, so the result is bitwise identical on every participant (the
+    fixed-order contract docs/SHARDING.md pins)."""
     q, s = _quant_chunk(x)
+    if tiled:
+        qg = lax.all_gather(q, axis_name, axis=0)  # [n, ...] stacked
+        sg = lax.all_gather(s, axis_name, axis=0)
+        chunks = _dequant_chunk(qg, sg).astype(x.dtype)
+        n = chunks.shape[0]
+        return jnp.concatenate([chunks[i] for i in range(n)], axis=axis)
     qg = lax.all_gather(q, axis_name, axis=axis)
     sg = lax.all_gather(s, axis_name, axis=axis)
     return _dequant_chunk(qg, sg).astype(x.dtype)

@@ -107,6 +107,14 @@ def test_bench_all_legs_cpu():
                 "zero1_dp", "zero1_bitwise_identical", "zero1_step_ms",
                 "zero1_unsharded_step_ms", "zero1_opt_state_ratio",
                 "zero1_opt_bytes_per_replica",
+                # tensor-parallel serving: 1-way vs 2-way on the same
+                # model (bitwise streams, per-chip KV bytes, gather bill)
+                "tp_degree", "tp_streams_bitwise_identical",
+                "tp_kv_bytes_per_chip", "tp_page_capacity_gain",
+                "tp_itl_ms", "tp_collective_bytes_per_token",
+                # host-gap budget on the decode critical path + its rot
+                # guard trajectory flag
+                "serving_host_gap_ms", "serving_host_gap_regressed",
                 # serve-and-train: background train steps + live weight
                 # publishes against a serving engine
                 "serve_train_steps", "serve_train_publishes",
@@ -229,6 +237,16 @@ def test_bench_all_legs_cpu():
     # slack); step-time parity is expected on CPU (zero1_note)
     assert extra["zero1_bitwise_identical"] is True
     assert extra["zero1_opt_state_ratio"] <= 1.0 / extra["zero1_dp"] + 0.05
+    # tensor parallelism: the deterministic bars — a tp=N engine's
+    # streams are BITWISE the 1-way engine's, and each chip resides
+    # ~1/tp of the KV page bytes (same page count); ITL improvement is
+    # the armed-on-TPU bar (tp_note)
+    assert extra["tp_streams_bitwise_identical"] is True
+    assert extra["tp_page_capacity_gain"] >= 0.9 * extra["tp_degree"]
+    # host-gap rot guard: host work between chunk syncs must not creep
+    # past 1.5x the best prior round (serving_host_gap_escalation
+    # carries the trajectory when it does)
+    assert not extra["serving_host_gap_regressed"], extra
     # serve-and-train: a best_effort stream spanning >=1 live weight
     # publish drops ZERO tokens and the publish compiles NOTHING; the
     # trainer yields to interactive at chunk granularity so armed-vs-off
