@@ -102,6 +102,15 @@ class MLConfig:
     # evict LRU when the allocator runs dry. Hits are bitwise the KV the
     # slot would have computed — streams are identical cache on or off.
     prefix_cache: bool = True
+    # tiered prefix cache (engine/kvtier.py, docs/SERVING.md "Tiered
+    # prefix cache"): > 0 arms a host-RAM tier of this many pages —
+    # refcount-0 prefix pages DEMOTE to host numpy at eviction instead
+    # of being destroyed, and admission promotes host residents back
+    # into HBM bitwise (device_put, zero new compiled programs). The
+    # tier also feeds the fleet digest map so siblings can pull
+    # prefixes cross-replica on a local miss. 0 keeps seed behavior
+    # (evicted pages die).
+    cont_host_tier_pages: int = 0
     # paged KV cache storage dtype (engine/paged.py, docs/SERVING.md
     # "Quantized KV"): "int8" stores KV pages int8 with per-(page,
     # position, head) symmetric scales, quantized at the one page-write

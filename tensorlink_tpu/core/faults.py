@@ -51,6 +51,13 @@ Wired sites:
 - ``journal.write``       — a control-journal append
   (core/journal.py::ControlJournal.append); supports drop (the record is
   silently lost — replay-tolerance case) / error / delay.
+- ``kvtier.demote``       — a refcount-0 prefix page demoting to the
+  host-RAM tier (engine/continuous.py::_demote_page); supports error
+  (the page is destroyed instead — seed behavior for that page) / crash.
+- ``kvtier.fetch``        — a host-tier promote or fleet prefix pull at
+  admission (engine/continuous.py promote/pull rungs); supports error
+  (the rung degrades to the next: fleet pull, then re-prefill) / crash
+  (a worker dying mid-pull — the chaos suite's tiered-cache kill case).
 
 Site names are REGISTERED (:data:`SITES`): a rule naming an unknown site
 fails loudly at plan construction instead of silently never firing — a
@@ -100,6 +107,8 @@ SITES = (
     "validator.crash",
     "control.frame",
     "journal.write",
+    "kvtier.demote",
+    "kvtier.fetch",
 )
 
 

@@ -286,7 +286,7 @@ def snapshot_gauges(
     *,
     prefix: str = "tlink_snapshot_",
     help: str = "remote serving-snapshot value",
-    skip: tuple = ("prefix_digest",),
+    skip: tuple = ("prefix_digest", "host_tier_digest"),
 ) -> None:
     """Flatten a remote engine's serving snapshot (the dict riding
     GENERATE_RESP) into gauges on ``registry`` — how /metrics exposes an

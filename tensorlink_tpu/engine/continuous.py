@@ -70,11 +70,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import faults
 from ..core.metrics import MetricsRegistry
 from ..core.trace import FlightRecorder, get_tracer
 from ..models.transformer import tp_partition_specs, tp_shardable
 from ..parallel.mesh import serving_mesh
 from .generate import GenerationEngine
+from .kvtier import HostPagePool
 from .paged import (
     PageAllocator,
     PagedKVCache,
@@ -252,6 +254,18 @@ _ENGINE_COUNTERS = (
      "weight versions hot-swapped into the serving engine"),
     ("train_steps", "tlink_engine_train_steps_total",
      "background train steps run between serving chunks"),
+    # tiered prefix cache (docs/SERVING.md "Tiered prefix cache"):
+    # evicted pages demote to host RAM instead of dying, admission
+    # promotes host-resident chains back, and a local miss may pull the
+    # prefix from a sibling replica through the MIGRATE wire
+    ("prefix_demotions", "tlink_engine_prefix_demotions_total",
+     "refcount-0 prefix pages demoted to the host-RAM tier at eviction"),
+    ("host_tier_hits", "tlink_engine_host_tier_hits_total",
+     "pages promoted from the host tier back into HBM at admission"),
+    ("fleet_pulls", "tlink_engine_fleet_pulls_total",
+     "admissions that attempted a cross-replica prefix pull"),
+    ("fleet_pull_fallbacks", "tlink_engine_fleet_pull_fallbacks_total",
+     "fleet pulls that degraded to the next rung (local prefill)"),
 )
 
 
@@ -313,6 +327,10 @@ class ContinuousRequest:
     # the engine skips every span-recording call for this request)
     trace_id: str = ""
     prefill_done_t: float = 0.0  # when the slot left the prefilling set
+    # deepest cache tier that contributed to this admission's hit region
+    # ("none" | "hbm" | "host" | "fleet") — rides the admission span so
+    # a trace shows WHERE a prefix came from, not just how much it saved
+    cache_tier: str = "none"
     # -- live weight publish (docs/TRAINING.md "Serve-and-train") --------
     # the engine weights version this request was ADMITTED under: its
     # prefill-written pages may promote into the prefix cache only while
@@ -347,6 +365,7 @@ class ContinuousEngine:
         chunk_steps: int = 8,
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
+        host_tier_pages: int = 0,
         kv_quant: str = "none",
         prefill_budget: int = 0,
         spec_decode: bool = False,
@@ -458,6 +477,34 @@ class ContinuousEngine:
         # long admission never stalls running slots at all
         self.prefill_chunk = min(int(prefill_chunk), self.max_seq_len)
         self.prefix = PrefixCache(self.page_size) if prefix_cache else None
+        # -- tiered prefix cache (docs/SERVING.md "Tiered prefix cache") -
+        # host_tier_pages > 0 arms the host-RAM tier: refcount-0 pages
+        # the trie evicts DEMOTE there (PrefixCache.spill) instead of
+        # being destroyed, and admission PROMOTES host-resident chains
+        # back into HBM — one existing scatter_page dispatch per page,
+        # zero new compiled programs
+        self.host_tier = None
+        if int(host_tier_pages) > 0 and self.prefix is not None:
+            self.host_tier = HostPagePool(
+                int(host_tier_pages), self.page_size
+            )
+            self.prefix.spill = self._demote_page
+        # rung 3 of the admission ladder: an optional fleet-layer hook
+        # ``(chain_tokens, limit, n_local_pages) -> blob | None`` that
+        # fetches the prefix pages from a sibling replica (the prefix
+        # map picks one by digest coverage, fleet/prefixmap.py); the
+        # returned blob feeds stage_prefix. Any failure inside the hook
+        # degrades to local prefill — never an admission error.
+        self.fetch_prefix = None
+        # device pages transiently pinned by an in-progress tier
+        # transfer (allocated, being byte-filled, not yet trie-resident)
+        # — the host_tier term of the page-conservation equation, so the
+        # invariant stays checkable mid-promote/mid-pull
+        self._tier_pinned: list[int] = []
+        # host-tier analogue of _prefix_digest: driver-refreshed swap
+        # copy of HostPagePool.digest() for the fleet prefix map
+        self._host_digest: dict = {}
+        self._host_digest_version = -1
         # fleet-router cache-affinity digest (docs/SERVING.md "Fleet
         # serving"): a compact {chain_hash: covered_tokens} view of the
         # resident trie, rebuilt by the DRIVER at chunk boundaries only
@@ -576,6 +623,23 @@ class ContinuousEngine:
             "tlink_engine_pages_in_transit",
             "pages held by in-flight migrations (either side)",
             fn=lambda: self._pages_in_transit(),
+        )
+        # tiered prefix cache: host-tier occupancy + per-fetch latency.
+        # DEFAULT_BUCKETS are seconds-scale; a promote is a host→device
+        # put (sub-ms to a few ms on real pages) and a fleet pull adds a
+        # wire round trip — hence the ms-scale bucket ladder
+        self.metrics.gauge(
+            "tlink_engine_host_tier_resident_pages",
+            "prefix pages resident in the host-RAM tier",
+            fn=lambda: (
+                self.host_tier.n_resident if self.host_tier else 0
+            ),
+        )
+        self._tier_hist = self.metrics.histogram(
+            "tlink_engine_tier_fetch_ms",
+            "host-tier promote / fleet prefix pull latency per page (ms)",
+            buckets=(0.05, 0.2, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                     100.0, 250.0, 1000.0),
         )
         # throughput-mode discovery for operators/routers: which modes a
         # replica actually runs rides /metrics (and /healthz) alongside
@@ -842,6 +906,11 @@ class ContinuousEngine:
             "service_ewma_s": float(ewma),
             "queue_depth": depth,
             "prefix_digest": self._prefix_digest,
+            # host-tier residency rides the same heartbeat: the router's
+            # affinity scoring and the fleet prefix map both read it —
+            # a replica whose HBM evicted a hot prefix but still holds
+            # it in host RAM remains a (cheaper-than-prefill) target
+            "host_tier_digest": self._host_digest,
         }
 
     def has_work(self) -> bool:
@@ -992,12 +1061,18 @@ class ContinuousEngine:
 
     def _admit_paged(self, req: ContinuousRequest, slot: int,
                      total: int) -> bool:
-        """Chunked-prefill admission: walk the prefix cache for the
-        longest resident chain of full pages (zero prefill compute for the
-        hit region), copy-on-write the first divergent page when its
-        cached sibling shares a partial token prefix, allocate private
-        pages for the rest, and queue the non-hit suffix for chunked
-        prefill at the coming step boundaries."""
+        """Chunked-prefill admission down the tiered-cache ladder
+        (docs/SERVING.md "Tiered prefix cache"): (1) walk the HBM trie
+        for the longest resident chain of full pages (zero prefill
+        compute for the hit region); (2) extend it with host-tier
+        promotes — demoted pages scattered back into fresh HBM pages;
+        (3) on a still-short chain, pull the prefix from a sibling
+        replica through the fleet hook; (4) copy-on-write the first
+        divergent page when a cached sibling shares a partial token
+        prefix; then allocate private pages for the rest and queue the
+        non-hit suffix for chunked prefill. Every rung fails safe to
+        the next — a dry allocator, a lost eviction race or a dead
+        sibling just means more tokens prefill locally."""
         seq = req.prefill_tokens
         T = len(seq)
         hit_nodes: list = []
@@ -1007,10 +1082,26 @@ class ContinuousEngine:
             # yields the last prompt position's logits for the first draw
             limit = T - 1
             hit_nodes = self.prefix.match(seq, limit)
-            cow = self.prefix.partial_match(hit_nodes, seq, limit)
-            # pin the hit chain (and the COW source) through the
-            # allocation below — eviction-on-demand must not free them
+            # pin the hit chain FIRST — the tier rungs below allocate
+            # pages, and eviction-on-demand must not free the chain
+            # we are standing on
             self.prefix.acquire(hit_nodes)
+            req.cache_tier = "hbm" if hit_nodes else "none"
+            if self.host_tier is not None:
+                n0 = len(hit_nodes)
+                hit_nodes = self._promote_chain(seq, limit, hit_nodes)
+                if len(hit_nodes) > n0:
+                    req.cache_tier = "host"
+            if (
+                self.fetch_prefix is not None
+                and limit - len(hit_nodes) * self.page_size
+                >= self.page_size
+            ):
+                n0 = len(hit_nodes)
+                hit_nodes = self._pull_chain(seq, limit, hit_nodes)
+                if len(hit_nodes) > n0:
+                    req.cache_tier = "fleet"
+            cow = self.prefix.partial_match(hit_nodes, seq, limit)
             if cow is not None:
                 self.prefix.acquire([cow[0]])
         n_hit = len(hit_nodes)
@@ -1071,6 +1162,321 @@ class ContinuousEngine:
                 self.prefix.stats["hits"] += 1
             self.prefix.stats["hit_tokens"] += hit_len
         return True
+
+    # -- tiered prefix cache (docs/SERVING.md "Tiered prefix cache") -----
+    # tlint: hot-path
+    def _demote_page(self, node) -> None:
+        """The demote seam (wired as ``PrefixCache.spill``): an evicted
+        refcount-0 page's bytes move to the host-RAM tier instead of
+        dying with the page id — the bytes are still intact in HBM when
+        the trie calls this, so one ``gather_page`` dispatch reads them
+        out. Best-effort by contract: an injected fault (or any torn
+        gather) degrades to the seed behavior — that page is destroyed —
+        and never blocks the eviction; an injected CRASH propagates (a
+        dying process does not demote)."""
+        if node.weights_version != self.prefix.weights_version:
+            return  # publish-fenced: stale-weights KV must not survive
+        try:
+            if faults.ENABLED:
+                faults.inject("kvtier.demote", "demote:" + node.key_hash)
+            got = gather_page(self.cache, jnp.int32(node.page))
+        except faults.FaultInjected:
+            return  # destroyed instead — exactly the pre-tier behavior
+        blocks: list[tuple] = []
+        walk = node
+        while walk is not None and walk.parent is not None:
+            blocks.append(walk.block)
+            walk = walk.parent
+        blocks.reverse()
+        self.host_tier.put(
+            tuple(blocks), got[0], got[1],
+            got[2] if len(got) == 4 else None,
+            got[3] if len(got) == 4 else None,
+            weights_version=node.weights_version,
+        )
+        self._count("prefix_demotions")
+
+    # tlint: hot-path
+    def _promote_chain(self, seq, limit: int, hit_nodes: list) -> list:
+        """Rung 2 of the admission ladder: extend the HBM hit chain with
+        host-tier residents. Each promoted page is a fresh allocation
+        byte-filled by the SAME fixed-shape ``scatter_page`` dispatch
+        migration staging uses (zero new compiled programs), inserted
+        into the trie, and pinned like any other hit node — so the hit
+        is bitwise what a cold re-prefill would compute, because the
+        demoted payload is the prefill's exact output bytes. Any
+        failure (allocator dry, injected fetch fault) stops the walk;
+        the remaining suffix takes the next rung."""
+        p = self.page_size
+        node = hit_nodes[-1] if hit_nodes else None
+        blocks = [
+            tuple(int(t) for t in seq[i * p : (i + 1) * p])
+            for i in range(limit // p)
+        ]
+        while len(hit_nodes) < len(blocks):
+            depth = len(hit_nodes) + 1
+            entry = self.host_tier.lookup(
+                tuple(blocks[:depth]), self.prefix.weights_version
+            )
+            if entry is None:
+                break
+            t0 = time.monotonic()
+            pages = self._alloc_pages(1)
+            if pages is None:
+                break  # allocator dry: the suffix prefills instead
+            pid = pages[0]
+            self._tier_pinned.append(pid)
+            try:
+                if faults.ENABLED:
+                    faults.inject(
+                        "kvtier.fetch", "promote:" + entry.key_hash
+                    )
+                if entry.k_scale is not None:
+                    self.cache = scatter_page(
+                        self.cache, jnp.int32(pid),
+                        jnp.asarray(entry.k), jnp.asarray(entry.v),
+                        jnp.asarray(entry.k_scale),
+                        jnp.asarray(entry.v_scale),
+                    )
+                else:
+                    self.cache = scatter_page(
+                        self.cache, jnp.int32(pid),
+                        jnp.asarray(entry.k), jnp.asarray(entry.v),
+                    )
+            except faults.FaultInjected:
+                # failed promotion fails SAFE: the page returns to the
+                # free list and the suffix takes the next rung
+                self._tier_pinned.remove(pid)
+                self.alloc.free([pid])
+                break
+            except BaseException:
+                # even a crash path must not leak the pinned page —
+                # conservation holds on every exit (chaos-pinned)
+                self._tier_pinned.remove(pid)
+                self.alloc.free([pid])
+                raise
+            self._tier_pinned.remove(pid)
+            freed: list[int] = []
+            new_node, adopted = self.prefix.insert(
+                node, blocks[depth - 1], pid, freed=freed
+            )
+            self.alloc.free(freed)
+            if not adopted:
+                # an identical chain is already resident (it can appear
+                # mid-walk via our own alloc's eviction cascade): keep
+                # the resident page, return ours
+                self.alloc.free([pid])
+            self.prefix.acquire([new_node])
+            hit_nodes.append(new_node)
+            node = new_node
+            self._count("host_tier_hits")
+            self._tier_hist.observe((time.monotonic() - t0) * 1e3)
+        return hit_nodes
+
+    def _pull_chain(self, seq, limit: int, hit_nodes: list) -> list:
+        """Rung 3 of the admission ladder: on a still-short chain, ask
+        the fleet hook for the prefix pages of a sibling replica and
+        stage them into our trie, then re-walk the match. Everything
+        here degrades — a dead sibling, a mid-pull source eviction, a
+        refused staging or an injected fault all just fall through to
+        local prefill (fleet_pull_fallbacks counts them)."""
+        p = self.page_size
+        n_local = len(hit_nodes)
+        chain = [int(t) for t in seq[: (limit // p) * p]]
+        self._count("fleet_pulls")
+        t0 = time.monotonic()
+        staged = 0
+        try:
+            if faults.ENABLED:
+                faults.inject("kvtier.fetch", f"pull:{len(chain)}")
+            blob = self.fetch_prefix(chain, limit, n_local)
+            if blob is not None:
+                staged = self.stage_prefix(blob)
+        except faults.FaultInjected:
+            staged = 0
+        except Exception as e:
+            from ..core.logging import get_logger
+
+            get_logger("engine.kvtier").debug(
+                "fleet prefix pull failed (falling back to prefill): %s", e
+            )
+            staged = 0
+        if staged > n_local * p:
+            ext = self.prefix.match(seq, limit)
+            if len(ext) > n_local and ext[:n_local] == hit_nodes:
+                self.prefix.acquire(ext[n_local:])
+                self._tier_hist.observe((time.monotonic() - t0) * 1e3)
+                return ext
+        self._count("fleet_pull_fallbacks")
+        return hit_nodes
+
+    def export_prefix_pages(
+        self, chain, limit: int, *, n_skip: int = 0
+    ) -> dict | None:
+        """Source side of a fleet prefix pull: the resident prefix pages
+        of ``chain`` past the first ``n_skip``, as a blob shaped like a
+        migration export (same storage-mode triple, same sha256 payload
+        digest, same per-page ``gather_page`` dispatch) so the MIGRATE
+        wire carries it unchanged. READ-ONLY — the chain is pinned only
+        for the gather, nothing moves or frees — so a puller can never
+        corrupt the source. Returns None when nothing useful is
+        resident (the prefix lost the race to eviction since the digest
+        was published): the puller degrades to its next rung."""
+        if self.prefix is None:
+            return None
+        chain = [int(t) for t in chain]
+        limit = min(int(limit), (len(chain) // self.page_size)
+                    * self.page_size)
+        nodes = self.prefix.match(chain, limit)
+        n_skip = max(0, int(n_skip))
+        if len(nodes) <= n_skip:
+            return None
+        self.prefix.acquire(nodes)
+        try:
+            if faults.ENABLED:
+                faults.inject("kvtier.fetch", f"export:{len(nodes)}")
+            payload: dict[str, list] = {"k": [], "v": [], "ks": [], "vs": []}
+            for n in nodes[n_skip:]:
+                got = gather_page(self.cache, jnp.int32(n.page))
+                payload["k"].append(np.asarray(got[0]))
+                payload["v"].append(np.asarray(got[1]))
+                if len(got) == 4:
+                    payload["ks"].append(np.asarray(got[2]))
+                    payload["vs"].append(np.asarray(got[3]))
+        finally:
+            self.prefix.release(nodes)
+        blob = {
+            "blob_v": 2,
+            "chain": np.asarray(
+                chain[: len(nodes) * self.page_size], np.int32
+            ),
+            "n_skip": int(n_skip),
+            "page_size": int(self.page_size),
+            "kv_quant": self.kv_quant,
+            "dtype": str(np.dtype(self.cache.k.dtype)),
+            # match() only returns current-version nodes, so the chain's
+            # KV was computed under THIS version — the importer's
+            # per-tier publish fence compares against it
+            "weights_version": int(self.weights_version),
+            "k": np.stack(payload["k"]),
+            "v": np.stack(payload["v"]),
+        }
+        if payload["ks"]:
+            blob["k_scale"] = np.stack(payload["ks"])
+            blob["v_scale"] = np.stack(payload["vs"])
+        from ..core.serialization import content_digest
+
+        blob["digest"] = content_digest(
+            {f: blob[f] for f in ("k", "v", "k_scale", "v_scale")
+             if f in blob}
+        )
+        return blob
+
+    def stage_prefix(self, blob: dict) -> int:
+        """Destination side of a fleet prefix pull: verify a sibling's
+        exported prefix blob (storage-mode triple, weights version,
+        payload digest — the same gates migration staging runs) and
+        adopt its pages directly into the trie as refcount-0 residents.
+        The calling admission re-walks the match and pins them in the
+        same driver turn. Returns the leading chain tokens now resident
+        (0 = refused — the puller falls through to local prefill).
+        Partial success is success: an allocator that dries up mid-blob
+        keeps what it staged."""
+        if self.prefix is None:
+            return 0
+        ours = self.migration_mode()
+        theirs = (
+            str(blob.get("kv_quant", "none")),
+            int(blob["page_size"]),
+            str(blob.get("dtype") or ours[2]),
+        )
+        if theirs != ours:
+            from ..core.logging import get_logger
+
+            get_logger("engine.kvtier").warning(
+                "refusing pulled prefix: storage mode %r does not match "
+                "ours %r — falling back to prefill", theirs, ours,
+            )
+            return 0
+        if int(blob.get("weights_version", 0)) != self.weights_version:
+            # per-tier version fence (docs/TRAINING.md): a prefix
+            # computed under any other weights version must not enter
+            # this trie — mid-rolling-deploy pulls degrade to prefill
+            return 0
+        chain = [int(t) for t in np.asarray(blob["chain"]).reshape(-1)]
+        p = self.page_size
+        n_total = len(chain) // p
+        n_skip = int(blob.get("n_skip", 0))
+        k = np.asarray(blob["k"])
+        v = np.asarray(blob["v"])
+        n_ship = int(k.shape[0]) if k.ndim > 1 else 0
+        if n_total == 0 or n_skip + n_ship != n_total:
+            return 0
+        if n_ship and k.dtype != np.dtype(self.cache.k.dtype):
+            return 0
+        if blob.get("digest"):
+            from ..core.serialization import content_digest
+
+            got = content_digest(
+                {f: np.asarray(blob[f])
+                 for f in ("k", "v", "k_scale", "v_scale") if f in blob}
+            )
+            if got != blob["digest"]:
+                return 0  # corrupted transfer → prefill rung
+        nodes = self.prefix.match(chain, n_total * p)
+        if len(nodes) < n_skip:
+            # the local prefix we promised the source has been evicted
+            # mid-pull; the shipped payload starts past what we hold
+            return 0
+        node = nodes[-1] if nodes else None
+        self.prefix.acquire(nodes)
+        try:
+            for i in range(len(nodes), n_total):
+                pages = self._alloc_pages(1)
+                if pages is None:
+                    break  # keep what we staged; the rest prefills
+                pid = pages[0]
+                self._tier_pinned.append(pid)
+                try:
+                    j = i - n_skip  # index into the shipped payload
+                    if self.cache.quantized:
+                        self.cache = scatter_page(
+                            self.cache, jnp.int32(pid),
+                            jnp.asarray(k[j]), jnp.asarray(v[j]),
+                            jnp.asarray(blob["k_scale"][j]),
+                            jnp.asarray(blob["v_scale"][j]),
+                        )
+                    else:
+                        self.cache = scatter_page(
+                            self.cache, jnp.int32(pid),
+                            jnp.asarray(k[j]), jnp.asarray(v[j]),
+                        )
+                except BaseException:
+                    # failed staging must not leak mid-pull: the pinned
+                    # page returns before the error surfaces, so the
+                    # conservation equation holds on BOTH sides of a
+                    # pull killed anywhere (chaos-pinned)
+                    self._tier_pinned.remove(pid)
+                    self.alloc.free([pid])
+                    raise
+                self._tier_pinned.remove(pid)
+                freed: list[int] = []
+                block = tuple(chain[i * p : (i + 1) * p])
+                new_node, adopted = self.prefix.insert(
+                    node, block, pid, freed=freed
+                )
+                self.alloc.free(freed)
+                if not adopted:
+                    self.alloc.free([pid])
+                # pin through OUR OWN later allocations in this loop —
+                # a fresh refcount-0 node must not lose an eviction race
+                # to the very pull that created it
+                self.prefix.acquire([new_node])
+                nodes.append(new_node)
+                node = new_node
+        finally:
+            self.prefix.release(nodes)
+        return len(nodes) * p
 
     # -- live slot migration (adopt side) --------------------------------
     def _drop_ticket(self, req: ContinuousRequest) -> None:
@@ -1635,6 +2041,13 @@ class ContinuousEngine:
             # frees right now (referenced pages free as their slots do)
             self.prefix.weights_version = new_version
             self.alloc.free(self.prefix.drop_all())
+            if self.host_tier is not None:
+                # the publish fence extends PER TIER: entries demoted
+                # under older weights can never match again — reap them
+                # now instead of letting them squat on host RAM (the
+                # drop_all above ran with prefix.weights_version already
+                # bumped, so none of ITS victims demoted either)
+                self.host_tier.drop_stale(new_version)
             self._refresh_prefix_digest()
         self._count("weights_published")
         return new_version
@@ -1936,50 +2349,73 @@ class ContinuousEngine:
             "cached": self.prefix.resident_pages if self.prefix else set(),
             "slots": slot_pages,
             "in_transit": in_transit,
+            # pages pinned by an in-progress tier transfer (allocated,
+            # being byte-filled, not yet trie-resident) — empty at every
+            # quiet boundary, non-empty exactly while a promote or a
+            # fleet pull is staging a page
+            "host_tier": list(self._tier_pinned),
         }
 
     def check_page_conservation(self) -> None:
         """The hardened free-list invariant: free + slot-owned +
-        cache-resident + in-transit == total usable pages, pairwise
-        disjoint, scratch page 0 in none of them. Raises AssertionError
-        on violation — asserted at engine teardown (close) and by the
-        engine/chaos tests after recovery AND mid-migration (the
-        in-transit term is what keeps the invariant checkable while a
-        migration is in flight on either side). On a shared pool the
-        invariant is GLOBAL — this delegates to the pool's per-tenant
-        check (free + Σ tenants' (slots + cached + in-transit) ==
-        total, pairwise disjoint ACROSS tenants, quota counters
-        honest)."""
+        cache-resident + host-tier-pinned + in-transit == total usable
+        pages, pairwise disjoint, scratch page 0 in none of them. Raises
+        AssertionError on violation — asserted at engine teardown
+        (close) and by the engine/chaos tests after recovery,
+        mid-migration AND mid-pull (the in-transit and host-tier terms
+        are what keep the invariant checkable while pages are between
+        owners on either side). Every failure message carries the full
+        per-term breakdown — a regression should name its numbers, not
+        cost a debug round-trip to get them. On a shared pool the
+        device-page invariant is GLOBAL — this delegates to the pool's
+        per-tenant check (free + Σ tenants' (slots + cached +
+        in-transit) == total, pairwise disjoint ACROSS tenants, quota
+        counters honest). The host tier's own ledger (bounded residency,
+        structural keys, paired scales) is checked alongside either
+        way."""
         if self.pool is not None:
             self.pool.check_page_conservation()
+            if self.host_tier is not None:
+                self.host_tier.check_conservation()
             return
         acc = self.page_accounting()
         free, cached = acc["free"], acc["cached"]
         slots, transit = acc["slots"], acc["in_transit"]
+        tier = acc["host_tier"]
         total = self.cache.n_pages - 1
         problems = []
         if len(slots) != len(set(slots)):
             problems.append("a page is owned by two slots")
         if len(transit) != len(set(transit)):
             problems.append("a page is in transit twice")
+        if len(tier) != len(set(tier)):
+            problems.append("a page is tier-pinned twice")
         if free & cached:
             problems.append("free-list and cache overlap")
         if set(slots) & (free | cached):
             problems.append("slot-owned page also free or cached")
         if set(transit) & (free | cached | set(slots)):
             problems.append("in-transit page also free, cached, or owned")
-        if 0 in (free | cached | set(slots) | set(transit)):
-            problems.append("scratch page 0 entered an ownership set")
-        if len(free) + len(cached) + len(slots) + len(transit) != total:
+        if set(tier) & (free | cached | set(slots) | set(transit)):
             problems.append(
-                f"leak: free={len(free)} + cached={len(cached)} + "
-                f"slots={len(slots)} + in_transit={len(transit)} != "
-                f"total={total}"
+                "tier-pinned page also free, cached, owned, or in transit"
             )
+        if 0 in (free | cached | set(slots) | set(transit) | set(tier)):
+            problems.append("scratch page 0 entered an ownership set")
+        if (
+            len(free) + len(cached) + len(slots) + len(transit)
+            + len(tier) != total
+        ):
+            problems.append("leak: the ownership terms do not sum to the pool")
         if problems:
             raise AssertionError(
                 "page conservation violated: " + "; ".join(problems)
+                + f" [free={len(free)} slots={len(slots)} "
+                f"cached={len(cached)} host_tier={len(tier)} "
+                f"in_transit={len(transit)} vs total={total}]"
             )
+        if self.host_tier is not None:
+            self.host_tier.check_conservation()
 
     def _pages_in_transit(self) -> int:
         """Pages currently held by an in-flight migration on either side:
@@ -2082,6 +2518,23 @@ class ContinuousEngine:
                 # scoring: the driver-refreshed swap copy, never the trie
                 "prefix_digest": self._prefix_digest,
             })
+        # tiered prefix cache (docs/SERVING.md "Tiered prefix cache"):
+        # enablement + host-tier occupancy + per-fetch latency roll-up
+        # (the tier counters themselves ride self.stats above)
+        out["host_tier"] = self.host_tier is not None
+        if self.host_tier is not None:
+            out.update({
+                "host_tier_capacity": self.host_tier.capacity,
+                "host_tier_resident_pages": self.host_tier.n_resident,
+                "host_tier_evictions": self.host_tier.stats["evictions"],
+                # host-tier chain digest for the fleet prefix map — the
+                # driver-refreshed swap copy, like prefix_digest (and
+                # skipped by snapshot_gauges for the same unbounded-
+                # metric-family reason)
+                "host_tier_digest": self._host_digest,
+                "tier_fetch_ms_count": self._tier_hist.count,
+                "tier_fetch_ms_sum": round(self._tier_hist.sum, 3),
+            })
         return out
 
     def _admit(self) -> None:
@@ -2169,6 +2622,10 @@ class ContinuousEngine:
                 self._trace(
                     req, "admission", dur_s=req.admit_t - t_adm,
                     slot=req.slot, cache_hit_tokens=req.prefill_pos,
+                    # deepest tier that fed the hit region — "hbm",
+                    # "host", "fleet", or "none" (adopted migrations
+                    # keep their own "adopt" span instead)
+                    tier=req.cache_tier,
                 )
 
     def _preemptable(self) -> list:
@@ -2514,13 +2971,20 @@ class ContinuousEngine:
         return self.has_work()
 
     def _refresh_prefix_digest(self) -> None:
-        """Rebuild the fleet digest when trie membership changed since
-        the last chunk. Driver-thread only (the trie is driver state);
-        the swap is atomic so snapshot readers never see a torn dict."""
-        if self.prefix is None or self.prefix.version == self._digest_version:
+        """Rebuild the fleet digests (both tiers) when membership
+        changed since the last chunk. Driver-thread only (the trie and
+        host pool are driver state); each swap is atomic so snapshot
+        readers never see a torn dict."""
+        if self.prefix is None:
             return
-        self._digest_version = self.prefix.version
-        self._prefix_digest = self.prefix.digest()
+        if self.prefix.version != self._digest_version:
+            self._digest_version = self.prefix.version
+            self._prefix_digest = self.prefix.digest()
+        if self.host_tier is not None and (
+            self.host_tier.version != self._host_digest_version
+        ):
+            self._host_digest_version = self.host_tier.version
+            self._host_digest = self.host_tier.digest()
 
     def run_until_idle(self) -> None:
         """Drive the loop to quiescence (tests, bench, local serving)."""

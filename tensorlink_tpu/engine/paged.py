@@ -575,6 +575,14 @@ class PrefixCache:
         # hot-swap. Stale refcount-0 chains are evicted at publish time;
         # still-referenced ones free as their slots do.
         self.weights_version = 1
+        # the demote seam (docs/SERVING.md "Tiered prefix cache"): when
+        # set, evict() hands each victim node to this callable BEFORE the
+        # page id returns to the free-list — the engine wires it to the
+        # host-RAM tier so the bytes survive the eviction. Best-effort by
+        # contract: the spill contains its own failures (a page that
+        # fails to demote is simply destroyed, the pre-tier behavior),
+        # so eviction itself can never be blocked by the tier below.
+        self.spill = None
         self.stats = {
             "lookups": 0,
             "hits": 0,
@@ -763,6 +771,11 @@ class PrefixCache:
         freed: list[int] = []
         while heap and len(freed) < k:
             _, _, victim = heapq.heappop(heap)
+            if self.spill is not None:
+                # tiered demotion: the victim's bytes are still intact in
+                # HBM (its page id hasn't been reused yet) — offer them
+                # to the tier below before the trie forgets the chain
+                self.spill(victim)
             del victim.parent.children[victim.block]
             del self._by_page[victim.page]
             self.stats["evictions"] += 1

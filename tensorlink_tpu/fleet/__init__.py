@@ -4,6 +4,12 @@
 prefix-cache affinity (the compact trie digest each replica exports),
 per-class queue depth / service EWMA, and replica role/drain state.
 
+:mod:`tensorlink_tpu.fleet.prefixmap` — the fleet-wide prefix digest
+map behind the tiered cache's fleet-pull rung: which sibling replica
+holds a prompt's prefix (either tier), so a local miss pulls pages over
+the MIGRATE wire instead of re-prefilling (docs/SERVING.md "Tiered
+prefix cache").
+
 :mod:`tensorlink_tpu.fleet.autopilot` — the drain-driven control loop:
 rebalance live streams off hot replicas, scale the decode pool, and run
 zero-dropped-token rolling deploys, every action through the existing
@@ -14,11 +20,14 @@ from tensorlink_tpu.fleet.autopilot import (
     EngineFleetActions,
     FleetAutopilot,
 )
+from tensorlink_tpu.fleet.prefixmap import FleetPrefixMap, make_fleet_fetcher
 from tensorlink_tpu.fleet.router import FleetRouter, NoReplicaAvailable
 
 __all__ = [
     "EngineFleetActions",
     "FleetAutopilot",
+    "FleetPrefixMap",
     "FleetRouter",
     "NoReplicaAvailable",
+    "make_fleet_fetcher",
 ]

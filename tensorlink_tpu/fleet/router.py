@@ -242,10 +242,21 @@ class FleetRouter:
         deepest leading block chain of ``prompt_ids`` whose rolling hash
         appears in the digest. 0 on no digest / no full-page prefix.
         ``_hash_memo`` (page_size → hash list) lets route() hash the
-        prompt ONCE per request instead of once per replica."""
+        prompt ONCE per request instead of once per replica. Both tiers
+        count: a chain demoted to the replica's host RAM still scores
+        (promote is far cheaper than re-prefill), with HBM precedence
+        when a hash appears in both digests."""
         dig = view.get("prefix_digest") or {}
         chains = dig.get("chains") or {}
         page = int(dig.get("page_size") or 0)
+        host = view.get("host_tier_digest") or {}
+        if int(host.get("page_size") or 0) == page or not chains:
+            host_chains = host.get("chains") or {}
+            if host_chains and not chains:
+                page = int(host.get("page_size") or 0)
+                chains = host_chains
+            elif host_chains:
+                chains = {**host_chains, **chains}
         if not chains or page <= 0:
             return 0
         hs = _hash_memo.get(page) if _hash_memo is not None else None

@@ -747,6 +747,7 @@ class ContinuousBatcher:
         chunk_steps: int = 8,
         prefill_chunk: int = 128,
         prefix_cache: bool = True,
+        host_tier_pages: int = 0,
         kv_quant: str = "none",
         spec_decode: bool = False,
         spec_draft: int = 8,
@@ -811,7 +812,8 @@ class ContinuousBatcher:
                 else ContinuousEngine(
                     engine, max_slots=max_slots, page_size=page_size,
                     chunk_steps=chunk_steps, prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache, kv_quant=kv_quant,
+                    prefix_cache=prefix_cache,
+                    host_tier_pages=host_tier_pages, kv_quant=kv_quant,
                     spec_decode=spec_decode, spec_draft=spec_draft,
                     spec_budget=spec_budget,
                     default_priority=self.default_priority,
@@ -842,6 +844,10 @@ class ContinuousBatcher:
                 or "none"
             ),
             "spec_decode": bool(spec_decode),
+            # tiered prefix cache: whether evicted prefix pages demote
+            # to host RAM instead of being destroyed (docs/SERVING.md
+            # "Tiered prefix cache")
+            "host_tier": int(host_tier_pages) > 0,
             # the ENTRY worker's advertised pool role (the validator read
             # it off the placement stats) — what serving_modes reports
             # for a remote engine before any traffic produces a snapshot
@@ -874,6 +880,9 @@ class ContinuousBatcher:
                     getattr(self._cont.engine, "quant", None) or "none"
                 ),
                 "spec_decode": bool(self._cont.spec_decode),
+                # tiered prefix cache: /healthz shows whether this
+                # replica keeps evicted prefixes warm in host RAM
+                "host_tier": self._cont.host_tier is not None,
                 # disaggregated prefill/decode: which pool the serving
                 # engine runs in — a fleet router reads the pool shape
                 # off /healthz before placing traffic (docs/SERVING.md)
@@ -1056,6 +1065,20 @@ class ContinuousBatcher:
         if "error" in box:
             raise box["error"]
         return box.get("result")
+
+    def pull_prefix(self, chain, limit: int, n_skip: int = 0):
+        """Source side of a fleet prefix pull (docs/SERVING.md "Tiered
+        prefix cache"): export this replica's resident pages covering
+        ``chain`` as a stageable blob, or None when the chain already
+        fell out of both tiers (the puller degrades to its next rung).
+        Routed through the dispatcher because the trie walk + page
+        gather are driver-thread-only; read-only, so it composes with a
+        drain (unlike probe/put, which the drain fence refuses)."""
+        return self.run_on_driver(
+            lambda cont: cont.export_prefix_pages(
+                chain, int(limit), n_skip=int(n_skip)
+            )
+        )
 
     def _run_ctl(self, cont) -> None:
         """Drain the control queue on the driver (or fail it when the

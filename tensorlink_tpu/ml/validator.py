@@ -584,6 +584,9 @@ class DistributedValidator:
                 max_slots=min(ml_cfg.cont_max_slots, ml_cfg.max_serve_batch),
                 chunk_steps=ml_cfg.cont_chunk_steps,
                 kv_quant=ml_cfg.kv_quant,
+                host_tier_pages=int(
+                    getattr(ml_cfg, "cont_host_tier_pages", 0)
+                ),
                 spec_decode=bool(getattr(ml_cfg, "spec_decode", False)),
                 spec_draft=int(getattr(ml_cfg, "spec_draft", 8)),
                 spec_budget=int(getattr(ml_cfg, "spec_budget", 0)),
@@ -1132,8 +1135,8 @@ class DistributedValidator:
                 # windowed batcher (or no batcher yet): vanilla decode
                 modes[name] = {
                     "kv_quant": "none", "weight_quant": "none",
-                    "spec_decode": False, "worker_role": "mixed",
-                    "weights_version": 1,
+                    "spec_decode": False, "host_tier": False,
+                    "worker_role": "mixed", "weights_version": 1,
                 }
             # per-replica headroom (kv_pages_free, slots_free, per-class
             # queue depth): enough for an EXTERNAL load balancer to

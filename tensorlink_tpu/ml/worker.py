@@ -2111,6 +2111,14 @@ class DistributedWorker:
                 chunk_steps=int(ml.cont_chunk_steps),
                 prefill_chunk=int(ml.prefill_chunk),
                 prefix_cache=bool(ml.prefix_cache),
+                # tiered prefix cache (engine/kvtier.py): arm the
+                # host-RAM spill tier on the worker's slot engine too —
+                # single-stage jobs decode here, and an unarmed worker
+                # would silently destroy evicted pages while the
+                # validator-side batcher advertises host_tier=True
+                host_tier_pages=int(
+                    getattr(ml, "cont_host_tier_pages", 0)
+                ),
                 # `or` before str(): a null kv_quant in an operator
                 # config must read as "none", not the string "None"
                 kv_quant=str(ml.kv_quant or "none"),
@@ -2906,6 +2914,45 @@ class DistributedWorker:
                 {"ok": False,
                  "error": "staging refused (mode mismatch, evicted "
                           "prefix, bad digest, or allocator dry)"},
+            )
+            return
+        if op == "pull":
+            # fleet prefix pull (docs/SERVING.md "Tiered prefix cache"):
+            # a sibling replica on a local cache miss asks for our
+            # resident pages covering its prompt's leading chain. READ-
+            # ONLY on this side (gather, never alloc/scatter), so it is
+            # deliberately outside the draining fence above — a draining
+            # worker's cache is exactly the one worth raiding before its
+            # pages die with the drain.
+            if self.faults is not None:
+                # fault site "kvtier.fetch": error refuses the export
+                # (the puller degrades to re-prefill), crash kills this
+                # SOURCE mid-pull — the chaos suite's tiered-cache case
+                self.faults.inject(
+                    "kvtier.fetch", f"pull-src:{p.get('job_id', '')}"
+                )
+            cont = self._ensure_cont(rt) if (
+                rt is not None and rt.engine is not None
+            ) else None
+            if cont is None:
+                self._respond(
+                    p["peer"], proto.MIGRATE_RESP, p["rid"],
+                    {"ok": False, "error": "job not loaded"},
+                )
+                return
+            chain = [
+                int(t) for t in np.asarray(p.get("chain", [])).reshape(-1)
+            ]
+            blob = cont.export_prefix_pages(
+                chain, int(p.get("limit", 0)),
+                n_skip=int(p.get("n_skip", 0)),
+            )
+            # blob=None (chain fell out of both tiers since the digest
+            # was published) is ok:True with no blob — losing the race
+            # to eviction is a degrade rung, never an error
+            self._respond(
+                p["peer"], proto.MIGRATE_RESP, p["rid"],
+                {"ok": True, "blob": blob},
             )
             return
         if op == "expire":

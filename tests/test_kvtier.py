@@ -1,0 +1,624 @@
+"""Tiered prefix cache (engine/kvtier.py, fleet/prefixmap.py,
+docs/SERVING.md "Tiered prefix cache").
+
+The contract under test: an evicted refcount-0 prefix page DEMOTES to
+host RAM instead of dying, admission PROMOTES host-tier hits back into
+HBM, and on a local miss the fleet-pull rung stages the pages from a
+sibling replica over the export/stage path — and every rung of the
+ladder (HBM hit → host promote → fleet pull → re-prefill) produces a
+stream BITWISE identical to a cold prefill of the same request (the
+PR 3 cache contract: pages are exact byte blobs, gather/scatter move
+bytes, not math). Every rung fails SAFE to the next: a version fence, a
+lost eviction race, an injected fault, or a killed source each cost a
+re-prefill, never an error and never a conservation leak.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.core import faults
+from tensorlink_tpu.engine.continuous import ContinuousEngine
+from tensorlink_tpu.engine.generate import GenerationEngine
+from tensorlink_tpu.engine.kvtier import HostPagePool
+from tensorlink_tpu.engine.sampling import SamplingParams
+from tensorlink_tpu.fleet.prefixmap import FleetPrefixMap, make_fleet_fetcher
+from tensorlink_tpu.models import ModelConfig, init_params
+
+needs4 = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >= 4 (virtual) devices"
+)
+
+PAGE = 8
+# a 3-page prompt: 2 full pages survive into the cache (the last page is
+# never cached — prefill_target caps at len(prompt)-1), so a tiered hit
+# skips 16 prefill tokens
+# tlint: disable=TL006(read-only shared-prompt fixture data)
+PROMPT = list(range(1, 25))
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        n_kv_heads=2, head_dim=16, d_ff=64, max_seq_len=64,
+        dtype=jnp.float32, tie_embeddings=False,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = GenerationEngine(
+        cfg, params, seq_buckets=(8, 32), batch_buckets=(1,), max_seq_len=64
+    )
+    return cfg, params, eng
+
+
+def _cont(eng, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("chunk_steps", 4)
+    return ContinuousEngine(eng, **kw)
+
+
+def _serve_one(ce, prompt=PROMPT, n=8, **kw):
+    req = ce.submit(prompt, max_new_tokens=n, **kw)
+    ce.run_until_idle()
+    assert req.finished and req.error is None
+    return req
+
+
+def _evict_all(ce):
+    """Drain the trie of every evictable page — with a host tier armed
+    this is the demotion firehose (what HBM pressure does organically)."""
+    while ce.prefix.n_evictable():
+        ce.alloc.free(ce.prefix.evict(ce.prefix.n_evictable()))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: every tier's hit is bitwise the cold prefill
+# ---------------------------------------------------------------------------
+def test_host_tier_hit_bitwise_solo(tiny_engine):
+    """Demote → promote round-trips byte-exactly: after the prefix is
+    evicted INTO the host tier, the re-admitted request streams bitwise
+    what a cold engine computes, and the admission ladder tags it as a
+    host-tier hit that actually skipped prefill work."""
+    _, _, eng = tiny_engine
+    cold = _serve_one(_cont(eng)).tokens
+
+    ce = _cont(eng, host_tier_pages=8)
+    _serve_one(ce)  # populate the trie
+    _evict_all(ce)
+    assert ce.prefix.n_resident == 0
+    assert ce.host_tier.n_resident >= 2
+    assert ce.stats["prefix_demotions"] >= 2
+    skipped0 = ce.stats["prefill_tokens_skipped"]
+    req = _serve_one(ce)
+    assert req.tokens == cold
+    assert req.cache_tier == "host"
+    assert ce.stats["host_tier_hits"] >= 1
+    assert ce.stats["prefill_tokens_skipped"] - skipped0 == 2 * PAGE
+    ce.check_page_conservation()
+    ce.close()
+
+
+def test_host_tier_hit_bitwise_cobatched(tiny_engine):
+    """The promoted prefix serves correctly while OTHER requests are
+    co-resident in the same chunk — promotion happens at admission into
+    a live mix, not into an idle engine."""
+    _, _, eng = tiny_engine
+    sp = SamplingParams.make(temperature=0.8, top_k=5)
+    ref = _cont(eng)
+    cold = _serve_one(ref).tokens
+    cold_n = _serve_one(ref, prompt=[4, 5, 6], n=6, sampling=sp,
+                        seed=3).tokens
+    ref.close()
+
+    ce = _cont(eng, host_tier_pages=8)
+    _serve_one(ce)
+    _evict_all(ce)
+    r1 = ce.submit([4, 5, 6], max_new_tokens=6, sampling=sp, seed=3)
+    ce.step_chunk()  # r1 is mid-flight when the tiered hit admits
+    r2 = ce.submit(PROMPT, max_new_tokens=8)
+    ce.run_until_idle()
+    assert r2.cache_tier == "host"
+    assert r2.tokens == cold and r1.tokens == cold_n
+    ce.check_page_conservation()
+    ce.close()
+
+
+def test_host_tier_hit_bitwise_int8(tiny_engine):
+    """Quantized KV round-trips through the host tier byte-exactly —
+    the scales ride the demoted payload (a page is self-describing),
+    so int8 promote is as bitwise as fp32."""
+    _, _, eng = tiny_engine
+    cold = _serve_one(_cont(eng, kv_quant="int8")).tokens
+    ce = _cont(eng, kv_quant="int8", host_tier_pages=8)
+    _serve_one(ce)
+    _evict_all(ce)
+    req = _serve_one(ce)
+    assert req.tokens == cold
+    assert req.cache_tier == "host"
+    ce.check_page_conservation()
+    ce.close()
+
+
+@pytest.mark.slow
+@needs4
+def test_tp2_host_tier_and_fleet_pull_bitwise(tiny_engine):
+    """The tier round trip under tensor parallelism: gather/scatter on
+    the tp=2 sharded cache reassemble/re-place the page exactly, so a
+    host-tier promote AND a fleet pull both stream bitwise the tp=2
+    cold serve (which is itself bitwise tp=1 — test_tp.py's pin)."""
+    cfg, params, _ = tiny_engine
+
+    def tp_engine():
+        # fresh GenerationEngine per ContinuousEngine: TP re-places
+        # params onto its mesh (test_tp.py's isolation note)
+        return GenerationEngine(
+            cfg, params, seq_buckets=(8, 32), batch_buckets=(1,),
+            max_seq_len=64,
+        )
+
+    cold = _serve_one(
+        _cont(tp_engine(), tensor_parallel=2)
+    ).tokens
+    ce = _cont(tp_engine(), tensor_parallel=2, host_tier_pages=8)
+    _serve_one(ce)
+    _evict_all(ce)
+    req = _serve_one(ce)
+    assert req.cache_tier == "host"
+    assert req.tokens == cold
+    # fleet rung: a cold tp=2 sibling pulls from this replica
+    sib = _cont(tp_engine(), tensor_parallel=2)
+    sib.fetch_prefix = lambda ch, lim, nl: ce.export_prefix_pages(
+        ch, lim, n_skip=nl
+    )
+    rp = _serve_one(sib)
+    assert rp.cache_tier == "fleet"
+    assert rp.tokens == cold
+    ce.check_page_conservation()
+    sib.check_page_conservation()
+    ce.close()
+    sib.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet pull: happy path + every mid-pull failure rung
+# ---------------------------------------------------------------------------
+def test_fleet_pull_bitwise_and_skips_prefill(tiny_engine):
+    """A replica that never saw the prompt pulls the prefix pages from
+    the sibling that did: bitwise stream, prefill tokens skipped, both
+    sides conserve."""
+    _, _, eng = tiny_engine
+    src = _cont(eng)
+    cold = _serve_one(src).tokens
+    dst = _cont(eng)
+    dst.fetch_prefix = lambda ch, lim, nl: src.export_prefix_pages(
+        ch, lim, n_skip=nl
+    )
+    req = _serve_one(dst)
+    assert req.tokens == cold
+    assert req.cache_tier == "fleet"
+    assert dst.stats["fleet_pulls"] == 1
+    assert dst.stats["fleet_pull_fallbacks"] == 0
+    assert dst.stats["prefill_tokens_skipped"] >= 2 * PAGE
+    src.check_page_conservation()
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+def test_fleet_pull_mid_pull_source_eviction_degrades(tiny_engine):
+    """The pull loses the race to eviction on the source (its digest
+    promised pages that died before the export): the puller degrades to
+    re-prefill — counted as a fallback, never an error — and both sides
+    still conserve."""
+    _, _, eng = tiny_engine
+    src = _cont(eng)
+    cold = _serve_one(src).tokens
+    dst = _cont(eng)
+
+    def racing_fetch(chain, limit, n_local):
+        src.alloc.free(src.prefix.drop_all())  # the race, lost
+        return src.export_prefix_pages(chain, limit, n_skip=n_local)
+
+    dst.fetch_prefix = racing_fetch
+    req = _serve_one(dst)
+    assert req.tokens == cold
+    assert req.cache_tier == "none"  # re-prefilled
+    assert dst.stats["fleet_pull_fallbacks"] == 1
+    src.check_page_conservation()
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+def test_fleet_pull_stale_weights_version_refused(tiny_engine):
+    """The per-tier version fence: a blob exported under other weights
+    is REFUSED at staging (a hot-swap must never serve a stale-weights
+    prefix), and the puller re-prefills."""
+    _, _, eng = tiny_engine
+    src = _cont(eng)
+    cold = _serve_one(src).tokens
+    dst = _cont(eng)
+
+    def stale_fetch(chain, limit, n_local):
+        blob = src.export_prefix_pages(chain, limit, n_skip=n_local)
+        assert blob is not None
+        blob["weights_version"] = 99  # exported under different weights
+        return blob
+
+    dst.fetch_prefix = stale_fetch
+    req = _serve_one(dst)
+    assert req.tokens == cold
+    assert req.cache_tier == "none"
+    assert dst.stats["fleet_pull_fallbacks"] == 1
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: injected faults and kills at the registered kvtier sites
+# ---------------------------------------------------------------------------
+def test_kvtier_fault_sites_registered():
+    assert "kvtier.demote" in faults.SITES
+    assert "kvtier.fetch" in faults.SITES
+
+
+def test_failed_demotion_is_seed_behavior(tiny_engine):
+    """kvtier.demote op=error: the page is destroyed instead of demoted
+    (exactly the pre-tier behavior for that page) — the next request
+    re-prefills bitwise and nothing leaks."""
+    _, _, eng = tiny_engine
+    cold = _serve_one(_cont(eng)).tokens
+    ce = _cont(eng, host_tier_pages=8)
+    _serve_one(ce)
+    faults.install(faults.FaultPlan.from_dict({
+        "rules": [{"site": "kvtier.demote", "op": "error", "prob": 1.0,
+                   "max_fires": None}],
+    }))
+    try:
+        _evict_all(ce)
+    finally:
+        faults.uninstall()
+    assert ce.host_tier.n_resident == 0  # every demotion failed
+    req = _serve_one(ce)
+    assert req.tokens == cold
+    assert req.cache_tier == "none"  # re-prefilled, no error surfaced
+    ce.check_page_conservation()
+    ce.close()
+
+
+def test_failed_promotion_degrades_and_conserves(tiny_engine):
+    """kvtier.fetch op=error on the promote rung: the freshly allocated
+    destination page goes BACK to the allocator (no leak through the
+    fault) and the request re-prefills bitwise."""
+    _, _, eng = tiny_engine
+    cold = _serve_one(_cont(eng)).tokens
+    ce = _cont(eng, host_tier_pages=8)
+    _serve_one(ce)
+    _evict_all(ce)
+    free_before = ce.alloc.n_free
+    faults.install(faults.FaultPlan.from_dict({
+        "rules": [{"site": "kvtier.fetch", "op": "error", "prob": 1.0,
+                   "key_substr": "promote", "max_fires": None}],
+    }))
+    try:
+        req = _serve_one(ce)
+    finally:
+        faults.uninstall()
+    assert req.tokens == cold
+    assert req.cache_tier == "none"
+    assert ce.alloc.n_free >= free_before - 3  # pages re-cached, not leaked
+    ce.check_page_conservation()
+    ce.close()
+
+
+def test_fleet_pull_source_kill_degrades(tiny_engine):
+    """Mid-pull SOURCE death (the chaos kill case, source side): the
+    worker hosting the pages dies while answering — the puller sees a
+    transport error, degrades to re-prefill, and the source's engine
+    state (refs released in the export's finally) still conserves."""
+    _, _, eng = tiny_engine
+    src = _cont(eng)
+    cold = _serve_one(src).tokens
+    dst = _cont(eng)
+    faults.install(faults.FaultPlan.from_dict({
+        "rules": [{"site": "kvtier.fetch", "op": "crash",
+                   "key_substr": "export", "nth": 1}],
+    }))
+
+    def fetch_from_dying_source(chain, limit, n_local):
+        try:
+            return src.export_prefix_pages(chain, limit, n_skip=n_local)
+        except faults.FaultCrash as e:
+            # the wire surfaces a dead peer as a transport error — the
+            # run loop on the source took the node down, the PULLER
+            # must only see a failed RPC
+            raise ConnectionError(str(e)) from None
+
+    dst.fetch_prefix = fetch_from_dying_source
+    try:
+        req = _serve_one(dst)
+    finally:
+        faults.uninstall()
+    assert req.tokens == cold
+    assert req.cache_tier == "none"
+    assert dst.stats["fleet_pull_fallbacks"] == 1
+    src.check_page_conservation()  # export released its pins on the way down
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+def test_fleet_pull_puller_kill_conserves(tiny_engine):
+    """Mid-pull PULLER death (the chaos kill case, destination side):
+    the crash escapes admission as FaultCrash — BaseException, so no
+    error-reply path can swallow it and the run loop takes the node
+    down — and the engine it leaves behind still satisfies page
+    conservation (nothing was pinned when the kill fired)."""
+    _, _, eng = tiny_engine
+    src = _cont(eng)
+    _serve_one(src)
+    dst = _cont(eng)
+    dst.fetch_prefix = lambda ch, lim, nl: src.export_prefix_pages(
+        ch, lim, n_skip=nl
+    )
+    faults.install(faults.FaultPlan.from_dict({
+        "rules": [{"site": "kvtier.fetch", "op": "crash",
+                   "key_substr": "pull", "nth": 1}],
+    }))
+    try:
+        with pytest.raises(faults.FaultCrash):
+            dst.submit(PROMPT, max_new_tokens=8)
+            dst.run_until_idle()
+    finally:
+        faults.uninstall()
+    dst.check_page_conservation()  # both sides conserve mid-pull
+    src.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+# ---------------------------------------------------------------------------
+# conservation: the host-tier term, churn, and the breakdown message
+# ---------------------------------------------------------------------------
+def test_host_tier_conservation_under_churn(tiny_engine):
+    """Sustained churn over a host tier SMALLER than the working set:
+    demotions, host-tier LRU evictions, promotions and re-prefills all
+    interleave, and conservation (device equation + host-tier
+    invariants) holds at every quiesce and at close."""
+    _, _, eng = tiny_engine
+    ce = _cont(eng, host_tier_pages=3)
+    prompts = [
+        [b] * 17 for b in (3, 7, 11, 13)
+    ] + [PROMPT, [9, 8, 7, 6] * 5]
+    for round_ in range(3):
+        for i, p in enumerate(prompts):
+            _serve_one(ce, prompt=p, n=4, seed=round_ * 10 + i)
+            if i % 2:
+                _evict_all(ce)
+            ce.check_page_conservation()
+    assert ce.stats["prefix_demotions"] > 0
+    assert ce.host_tier.stats["evictions"] > 0  # tier LRU actually turned
+    assert ce.host_tier.n_resident <= 3
+    ce.close()  # close() re-checks conservation
+
+
+def test_conservation_failure_prints_breakdown(tiny_engine):
+    """The satellite: a conservation failure names every term (free /
+    slots / cached / host_tier / in_transit vs total) instead of a bare
+    inequality — the numbers every past regression had to be re-run to
+    collect."""
+    _, _, eng = tiny_engine
+    ce = _cont(eng, host_tier_pages=4)
+    _serve_one(ce)
+    ce._tier_pinned.append(1)  # a transfer pin that never unpinned
+    with pytest.raises(AssertionError) as ei:
+        ce.check_page_conservation()
+    msg = str(ei.value)
+    for term in ("free=", "slots=", "cached=", "host_tier=", "in_transit=",
+                 "vs total="):
+        assert term in msg, (term, msg)
+    ce._tier_pinned.clear()
+    ce.check_page_conservation()
+    ce.close()
+
+
+# ---------------------------------------------------------------------------
+# compile-set guard: tiering adds ZERO new programs
+# ---------------------------------------------------------------------------
+def test_tiering_adds_zero_new_programs(tiny_engine):
+    """Demote, promote and fleet pull ride the EXISTING gather_page /
+    scatter_page programs (the migration pair): after one migration-
+    shaped warmup, a full tier churn — demotions, host promotes, fleet
+    pulls, degrades — compiles NOTHING."""
+    _, _, eng = tiny_engine
+    src = _cont(eng, host_tier_pages=8)
+    dst = _cont(eng, host_tier_pages=8)
+    # warmup: every program the tier path uses fires once (serve compiles
+    # the step set; one export+stage fires gather_page and scatter_page)
+    _serve_one(src)
+    blob = src.export_prefix_pages(PROMPT, len(PROMPT))
+    assert blob is not None and dst.stage_prefix(blob) > 0
+    base = src.jit_cache_sizes()
+    # churn: demote + promote on src, fleet pull + degrade on dst
+    _evict_all(src)
+    req = _serve_one(src)
+    assert req.cache_tier == "host"
+    dst.fetch_prefix = lambda ch, lim, nl: src.export_prefix_pages(
+        ch, lim, n_skip=nl
+    )
+    _serve_one(dst, prompt=PROMPT + [1], n=6)
+    _serve_one(dst, prompt=[2] * 20, n=4)  # miss: plain re-prefill
+    after = src.jit_cache_sizes()
+    assert after == base, (base, after)
+    src.check_page_conservation()
+    dst.check_page_conservation()
+    src.close()
+    dst.close()
+
+
+# ---------------------------------------------------------------------------
+# HostPagePool unit discipline (no engine, no device)
+# ---------------------------------------------------------------------------
+def _blocks(*vals, page=PAGE):
+    return tuple(tuple(range(v, v + page)) for v in vals)
+
+
+def test_host_pool_lru_capacity_and_version_fence():
+    pool = HostPagePool(capacity=2, page_size=PAGE)
+    k = np.zeros((2, 2, PAGE, 4), np.float32)
+    pool.put(_blocks(0), k, k, weights_version=1)
+    pool.put(_blocks(0, 100), k, k, weights_version=1)
+    assert pool.lookup(_blocks(0), 1) is not None  # touches: now MRU
+    pool.put(_blocks(200), k, k, weights_version=1)  # evicts LRU chain
+    assert pool.n_resident == 2
+    assert pool.lookup(_blocks(0, 100), 1) is None  # the evicted one
+    assert pool.lookup(_blocks(0), 1) is not None
+    assert pool.stats["evictions"] == 1
+    # the per-tier publish fence: wrong version is as good as absent
+    assert pool.lookup(_blocks(0), 2) is None
+    assert pool.drop_stale(2) == 2
+    assert pool.n_resident == 0
+    with pytest.raises(ValueError):
+        HostPagePool(capacity=0, page_size=PAGE)
+
+
+def test_host_pool_digest_and_conservation():
+    pool = HostPagePool(capacity=4, page_size=PAGE)
+    k = np.zeros((2, 2, PAGE, 4), np.float32)
+    pool.put(_blocks(0), k, k)
+    pool.put(_blocks(0, 100), k, k)
+    dig = pool.digest()
+    assert dig["page_size"] == PAGE
+    assert sorted(dig["chains"].values()) == [PAGE, 2 * PAGE]
+    pool.check_conservation()
+    # corrupt: one-sided scales must be named in the failure
+    entry = next(iter(pool._entries.values()))
+    entry.k_scale = np.ones(1)
+    with pytest.raises(AssertionError, match="one-sided scales"):
+        pool.check_conservation()
+
+
+# ---------------------------------------------------------------------------
+# the fleet map: locate + fetcher ladder (pure host, synthetic views)
+# ---------------------------------------------------------------------------
+def _digest_for(tokens, page=PAGE):
+    from tensorlink_tpu.engine.paged import prompt_chain_hashes
+    hs = prompt_chain_hashes(tokens, page, 8)
+    return {"page_size": page,
+            "chains": {h: (i + 1) * page for i, h in enumerate(hs)}}
+
+
+def test_prefixmap_locates_deepest_sibling():
+    m = FleetPrefixMap(PAGE)
+    views = {
+        "a": {"prefix_digest": _digest_for(PROMPT[:PAGE])},
+        "b": {"host_tier_digest": _digest_for(PROMPT[:2 * PAGE])},
+        "c": {"prefix_digest": _digest_for([99] * 2 * PAGE)},
+        "dead": {"ok": False, "prefix_digest": _digest_for(PROMPT)},
+    }
+    got = m.locate(views, PROMPT)
+    assert got[0] == ("b", 2 * PAGE)  # deepest coverage wins, tier-blind
+    assert ("a", PAGE) in got
+    assert all(rid != "dead" for rid, _ in got)  # unhealthy views skipped
+    # min_tokens: a sibling must BEAT the puller's local coverage
+    assert m.locate(views, PROMPT, min_tokens=2 * PAGE) == []
+    assert m.locate(views, PROMPT, exclude=("b",))[0][0] == "a"
+
+
+def test_fleet_fetcher_ladder_and_self_exclusion():
+    views = {
+        "me": {"prefix_digest": _digest_for(PROMPT)},
+        "sib": {"prefix_digest": _digest_for(PROMPT[:2 * PAGE])},
+        "bad": {"prefix_digest": _digest_for(PROMPT[:2 * PAGE])},
+    }
+    calls = []
+
+    def pull_ok(chain, limit, n_skip):
+        calls.append("sib")
+        return {"blob_v": 2}
+
+    def pull_err(chain, limit, n_skip):
+        calls.append("bad")
+        raise ConnectionError("peer died")
+
+    fetch = make_fleet_fetcher(
+        "me", PAGE, lambda: views,
+        {"sib": pull_ok, "bad": pull_err}, max_candidates=2,
+    )
+    blob = fetch(PROMPT, len(PROMPT), 0)
+    assert blob == {"blob_v": 2}
+    assert "me" not in calls  # never pulls from itself
+    # a candidate error degrades to the next candidate, not an exception
+    views["bad"]["prefix_digest"] = _digest_for(PROMPT[:3 * PAGE])
+    calls.clear()
+    assert fetch(PROMPT, len(PROMPT), 0) == {"blob_v": 2}
+    assert calls == ["bad", "sib"]
+    # nothing beats local coverage -> None -> the re-prefill rung
+    assert fetch(PROMPT, len(PROMPT), n_local_pages=3) is None
+
+
+def test_router_affinity_scores_host_tier():
+    """cache_affinity counts host-tier residency (a promote beats a
+    re-prefill), with HBM precedence when both tiers hold a chain."""
+    from tensorlink_tpu.fleet.router import FleetRouter
+    r = FleetRouter()
+    hbm_only = {"prefix_digest": _digest_for(PROMPT[:PAGE])}
+    host_only = {"host_tier_digest": _digest_for(PROMPT[:2 * PAGE])}
+    both = {"prefix_digest": _digest_for(PROMPT[:PAGE]),
+            "host_tier_digest": _digest_for(PROMPT[:2 * PAGE])}
+    assert r.cache_affinity(hbm_only, PROMPT) == PAGE
+    assert r.cache_affinity(host_only, PROMPT) == 2 * PAGE
+    assert r.cache_affinity(both, PROMPT) == 2 * PAGE
+
+
+# ---------------------------------------------------------------------------
+# telemetry: snapshot keys, /metrics exposure, digest never a gauge
+# ---------------------------------------------------------------------------
+def test_tier_telemetry_surfaces(tiny_engine):
+    _, _, eng = tiny_engine
+    ce = _cont(eng, host_tier_pages=8)
+    _serve_one(ce)
+    _evict_all(ce)
+    _serve_one(ce)
+    snap = ce.serving_snapshot()
+    assert snap["host_tier"] is True
+    assert snap["host_tier_capacity"] == 8
+    assert snap["host_tier_resident_pages"] == ce.host_tier.n_resident
+    assert snap["prefix_demotions"] >= 2
+    assert snap["host_tier_hits"] >= 1
+    assert snap["tier_fetch_ms_count"] >= 1
+    assert isinstance(snap["host_tier_digest"], dict)
+    assert "host_tier_digest" in ce.router_snapshot()
+    text = ce.metrics.render()
+    for fam in ("tlink_engine_prefix_demotions_total",
+                "tlink_engine_host_tier_hits_total",
+                "tlink_engine_fleet_pulls_total",
+                "tlink_engine_fleet_pull_fallbacks_total",
+                "tlink_engine_host_tier_resident_pages",
+                "tlink_engine_tier_fetch_ms"):
+        assert fam in text, fam
+    # an engine WITHOUT the tier still says so (the /healthz contract)
+    plain = _cont(eng)
+    assert plain.serving_snapshot()["host_tier"] is False
+    plain.close()
+    ce.close()
+
+
+def test_snapshot_gauges_skips_host_tier_digest(tiny_engine):
+    """Flattening a remote snapshot must not mint one gauge per chain
+    hash — host_tier_digest joins prefix_digest on the skip list."""
+    from tensorlink_tpu.core.metrics import MetricsRegistry, snapshot_gauges
+    _, _, eng = tiny_engine
+    ce = _cont(eng, host_tier_pages=8)
+    _serve_one(ce)
+    _evict_all(ce)
+    reg = MetricsRegistry()
+    snapshot_gauges(reg, ce.serving_snapshot())
+    text = reg.render()
+    assert "host_tier_digest" not in text
+    assert "prefix_digest" not in text
+    assert "tlink_snapshot_host_tier_resident_pages" in text
+    ce.close()

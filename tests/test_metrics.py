@@ -221,6 +221,10 @@ LEGACY_ENGINE_KEYS = (
     # serve-and-train (docs/TRAINING.md): live weight publishes +
     # background train steps between serving chunks
     "weights_published", "train_steps",
+    # tiered prefix cache (engine/kvtier.py): host-RAM demotions,
+    # host-tier promotions, and cross-replica prefix pulls
+    "prefix_demotions", "host_tier_hits",
+    "fleet_pulls", "fleet_pull_fallbacks",
 )
 
 
