@@ -731,9 +731,13 @@ class ContinuousEngine:
         if self._tp_mesh is not None:
             # commit the histograms to the mesh (replicated) so the TP
             # step's donation keeps ONE steady-state program from the
-            # first chunk on
+            # first chunk on — rank-expanded spelling, the canonical
+            # cache key the step's own outputs carry (TL101)
             self._counts = jax.device_put(
-                self._counts, NamedSharding(self._tp_mesh, P())
+                self._counts,
+                NamedSharding(
+                    self._tp_mesh, P(*([None] * self._counts.ndim))
+                ),
             )
         if pool is not None:
             # nothing fallible may follow: a registered-but-dead tenant

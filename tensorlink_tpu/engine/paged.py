@@ -1035,7 +1035,7 @@ def _decode_step_impl(
     return logits, new_cache
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @partial(
     jax.jit, static_argnames=("cfg", "kernel"), donate_argnames=("cache",)
 )
@@ -1314,7 +1314,7 @@ def _ragged_step_impl(
     )
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_steps", "spec_width", "kernel"),
@@ -1513,7 +1513,7 @@ def make_tp_ragged_step(
     return step
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @partial(jax.jit, donate_argnames=("cache",))
 def copy_page(
     cache: PagedKVCache, src: jax.Array, dst: jax.Array
@@ -1537,7 +1537,7 @@ def copy_page(
     return out
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @jax.jit
 def gather_page(cache: PagedKVCache, page: jax.Array) -> tuple:
     """Read one physical page's KV across every layer — the migration
@@ -1554,7 +1554,7 @@ def gather_page(cache: PagedKVCache, page: jax.Array) -> tuple:
     )
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @partial(jax.jit, donate_argnames=("cache",))
 def scatter_page(
     cache: PagedKVCache,
@@ -1582,7 +1582,7 @@ def scatter_page(
     return out
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @partial(jax.jit, donate_argnames=("cache",))
 def bind_slot(
     cache: PagedKVCache, slot: jax.Array, bt_row: jax.Array, length: jax.Array
@@ -1595,7 +1595,7 @@ def bind_slot(
     )
 
 
-# tlint: hot-path
+# tlint: hot-path  # tlint: one-program
 @partial(jax.jit, donate_argnames=("cache",))
 def clear_slot(cache: PagedKVCache, slot: jax.Array) -> PagedKVCache:
     """Detach an evicted slot: zero its table row (→ scratch page) and its

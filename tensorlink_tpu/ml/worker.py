@@ -681,9 +681,12 @@ class DistributedWorker:
         if self._spans_processes(rt.mesh):
             from jax.sharding import NamedSharding, PartitionSpec
 
-            return jax.device_put(
-                np.asarray(arr), NamedSharding(rt.mesh, PartitionSpec())
-            )
+            host = np.asarray(arr)
+            # rank-expanded replicated spec — the canonical jit cache-key
+            # spelling (PartitionSpec() is the same placement but a
+            # DIFFERENT key, the PR 17 recompile class; TL101)
+            spec = PartitionSpec(*([None] * host.ndim))
+            return jax.device_put(host, NamedSharding(rt.mesh, spec))
         return jnp.asarray(np.asarray(arr))
 
     def _stage_fwd_fn(

@@ -176,15 +176,15 @@ def test_sparse_dispatch_flops_scale_with_k_not_E():
     cfg = moe_cfg().with_(
         d_model=64, d_ff=512, n_experts=8, n_experts_per_tok=2
     )
-    key = jax.random.PRNGKey(0)
     d, f, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+    kr, kg, ku, kd, kh = jax.random.split(jax.random.PRNGKey(0), 5)
     p = {
-        "router": jax.random.normal(key, (d, E), jnp.float32) * 0.02,
-        "w_gate": jax.random.normal(key, (E, d, f), jnp.float32) * 0.02,
-        "w_up": jax.random.normal(key, (E, d, f), jnp.float32) * 0.02,
-        "w_down": jax.random.normal(key, (E, f, d), jnp.float32) * 0.02,
+        "router": jax.random.normal(kr, (d, E), jnp.float32) * 0.02,
+        "w_gate": jax.random.normal(kg, (E, d, f), jnp.float32) * 0.02,
+        "w_up": jax.random.normal(ku, (E, d, f), jnp.float32) * 0.02,
+        "w_down": jax.random.normal(kd, (E, f, d), jnp.float32) * 0.02,
     }
-    h = jax.random.normal(key, (1, 256, d), jnp.float32)
+    h = jax.random.normal(kh, (1, 256, d), jnp.float32)
 
     def flops(c):
         fn = jax.jit(lambda x: _moe_mlp(x, p, c))

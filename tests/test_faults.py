@@ -83,9 +83,11 @@ def test_unknown_site_rejected_loudly():
     validated against the registered set at plan construction."""
     with pytest.raises(ValueError, match="unknown fault site"):
         FaultPlan.from_dict({"rules": [
-            {"site": "worker.sesion_step", "op": "crash", "nth": 1},  # typo
+            # tlint: disable=TL105(deliberate typo: the negative test)
+            {"site": "worker.sesion_step", "op": "crash", "nth": 1},
         ]})
     with pytest.raises(ValueError, match="unknown fault site"):
+        # tlint: disable=TL105(deliberate empty site: the negative test)
         FaultPlan.from_dict({"rules": [{"site": "", "op": "drop", "nth": 1}]})
     # every registered site constructs — incl. the migration/drain sites
     for site in faults.SITES:

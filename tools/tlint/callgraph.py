@@ -1,0 +1,516 @@
+"""Project-level analysis: the intra-project call graph.
+
+``FileContext`` sees one file; the guard markers it carries (``hot-path``,
+``holds-lock``, ``one-program``) describe contracts that hold ACROSS
+calls — a function called from a hot-path function is on the hot path,
+a function called under a held lock runs locked. This module builds the
+best-effort static call graph that lets rules propagate those contexts:
+
+- direct calls to same-module functions (``pack(...)``),
+- ``self.method(...)`` calls resolved within the lexical class,
+- calls through intra-project imports (``from .paged import copy_page``,
+  ``from ..core import faults`` + ``faults.inject(...)``).
+
+Anything dynamic — attributes of non-``self`` objects, callables passed
+as values, nested defs called by closure name — stays UNRESOLVED on
+purpose: a nested def may run later on another thread, so guard contexts
+must not leak into it (the same isolation TL001 enforces lexically).
+
+``Project`` also carries the cross-module facts single-file rules can't
+see: the ``faults.SITES`` registry (TL105), ``jax.jit`` donation
+signatures (TL103), and the ``one-program`` callable index (TL101).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from functools import lru_cache
+from pathlib import Path
+
+from .context import FileContext, Marker, scope_name
+from .rules import _LOCKISH, _func_defs, _self_attr
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def project_rule(fn):
+    """Mark a rule function as taking ``(ctx, project)`` — the driver
+    passes the cross-file :class:`Project` as the second argument."""
+    fn.needs_project = True
+    return fn
+
+
+# -- module / import resolution ---------------------------------------------
+
+
+def _module_name(rel: str) -> str | None:
+    """``tensorlink_tpu/engine/paged.py`` -> ``tensorlink_tpu.engine.paged``."""
+    if not rel.endswith(".py"):
+        return None
+    mod = rel[:-3]
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+@dataclass
+class _Imports:
+    modules: dict[str, str] = field(default_factory=dict)  # name -> module
+    symbols: dict[str, tuple[str, str]] = field(default_factory=dict)
+
+
+def _resolve_relative(
+    base: str, level: int, module: str | None, is_pkg: bool
+) -> str | None:
+    parts = base.split(".")
+    if not is_pkg:
+        parts = parts[:-1]
+    drop = level - 1
+    if drop > len(parts):
+        return None
+    if drop:
+        parts = parts[: len(parts) - drop]
+    if module:
+        parts = parts + module.split(".")
+    return ".".join(parts) if parts else None
+
+
+def _imports_for(rel: str, tree: ast.Module) -> _Imports:
+    base = _module_name(rel)
+    is_pkg = rel.endswith("__init__.py")
+    imps = _Imports()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    imps.modules[alias.asname] = alias.name
+                elif "." not in alias.name:
+                    imps.modules[alias.name] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                if base is None:
+                    continue
+                mod = _resolve_relative(base, node.level, node.module, is_pkg)
+            else:
+                mod = node.module
+            if mod is None:
+                continue
+            for alias in node.names:
+                name = alias.asname or alias.name
+                # "from pkg import x": x may be a symbol OR a submodule —
+                # record both readings; resolution consults the indexes.
+                imps.symbols[name] = (mod, alias.name)
+                imps.modules.setdefault(name, f"{mod}.{alias.name}")
+    return imps
+
+
+# -- function index ----------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    rel: str
+    scope: str  # dotted scope name ("Class.method", "fn", "fn.inner")
+    name: str
+    node: ast.AST
+    cls: str | None  # enclosing class when this is a direct method
+    nested: bool  # defined inside another function
+    markers: list[Marker]
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.rel, self.scope)
+
+
+def _call_sites(func: ast.AST):
+    """``(call, locks_held)`` for every call in ``func``'s own scope —
+    nested def/lambda bodies excluded (their calls belong to them) — with
+    the lock names lexically held at the site (TL002's ``with`` grammar;
+    ``async with`` yields the loop, so it never counts as held)."""
+    out: list[tuple[ast.Call, tuple[str, ...]]] = []
+
+    def walk(node: ast.AST, held: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.With):
+                acquired = []
+                for item in child.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func
+                    attr = _self_attr(expr)
+                    if attr and _LOCKISH.search(attr):
+                        acquired.append(f"self.{attr}")
+                    elif isinstance(expr, ast.Name) and _LOCKISH.search(
+                        expr.id
+                    ):
+                        acquired.append(expr.id)
+                for item in child.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        out.append((item.context_expr, tuple(held)))
+                    walk(item.context_expr, held)
+                for stmt in child.body:
+                    walk(stmt, held + acquired)
+                continue
+            if isinstance(child, ast.Call):
+                out.append((child, tuple(held)))
+            walk(child, held)
+
+    walk(func, [])
+    return out
+
+
+# -- donation signatures -----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Donor:
+    """A module-level callable that is a ``jax.jit`` program donating some
+    of its arguments: calling it invalidates those buffers."""
+
+    rel: str
+    name: str
+    line: int
+    positions: frozenset[int]
+    argnames: frozenset[str]
+
+
+def _is_jit_func(f: ast.AST) -> bool:
+    return (isinstance(f, ast.Attribute) and f.attr == "jit") or (
+        isinstance(f, ast.Name) and f.id == "jit"
+    )
+
+
+def _const_ints(node: ast.AST) -> list[int]:
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [
+        e.value
+        for e in elts
+        if isinstance(e, ast.Constant) and isinstance(e.value, int)
+    ]
+
+
+def _const_strs(node: ast.AST) -> list[str]:
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    return [
+        e.value
+        for e in elts
+        if isinstance(e, ast.Constant) and isinstance(e.value, str)
+    ]
+
+
+def _donation_kwargs(call: ast.Call) -> tuple[set[int], set[str]]:
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums.update(_const_ints(kw.value))
+        elif kw.arg == "donate_argnames":
+            names.update(_const_strs(kw.value))
+    return nums, names
+
+
+def _positional_params(func: ast.AST) -> list[str]:
+    a = func.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _file_donors(rel: str, tree: ast.Module) -> dict[str, Donor]:
+    donors: dict[str, Donor] = {}
+    top_defs = {
+        n.name: n
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    # module-level `step = jax.jit(impl, donate_arg...=...)` bindings
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+            continue
+        call = stmt.value
+        if not _is_jit_func(call.func):
+            continue
+        nums, names = _donation_kwargs(call)
+        if not nums and not names:
+            continue
+        wrapped = call.args[0] if call.args else None
+        if isinstance(wrapped, ast.Name) and wrapped.id in top_defs:
+            params = _positional_params(top_defs[wrapped.id])
+            names.update(params[i] for i in nums if i < len(params))
+            nums.update(params.index(nm) for nm in names if nm in params)
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                donors[t.id] = Donor(
+                    rel, t.id, stmt.lineno, frozenset(nums), frozenset(names)
+                )
+    # `@partial(jax.jit, donate_arg...=...)` / `@jax.jit(...)` decorated defs
+    for name, func in top_defs.items():
+        for dec in func.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            jit_call = None
+            if _is_jit_func(dec.func):
+                jit_call = dec
+            elif (
+                dec.args
+                and _is_jit_func(dec.args[0])
+                and (
+                    (isinstance(dec.func, ast.Name) and dec.func.id == "partial")
+                    or (
+                        isinstance(dec.func, ast.Attribute)
+                        and dec.func.attr == "partial"
+                    )
+                )
+            ):
+                jit_call = dec
+            if jit_call is None:
+                continue
+            nums, names = _donation_kwargs(jit_call)
+            if not nums and not names:
+                continue
+            params = _positional_params(func)
+            names.update(params[i] for i in nums if i < len(params))
+            nums.update(params.index(nm) for nm in names if nm in params)
+            donors[name] = Donor(
+                rel, name, func.lineno, frozenset(nums), frozenset(names)
+            )
+    return donors
+
+
+# -- the fault-site registry (TL105's cross-module fact) ---------------------
+
+
+def _sites_from_tree(tree: ast.Module) -> frozenset[str] | None:
+    for stmt in tree.body:
+        targets = (
+            stmt.targets
+            if isinstance(stmt, ast.Assign)
+            else [stmt.target]
+            if isinstance(stmt, ast.AnnAssign)
+            else []
+        )
+        if not any(isinstance(t, ast.Name) and t.id == "SITES" for t in targets):
+            continue
+        value = getattr(stmt, "value", None)
+        if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            return frozenset(_const_strs(value))
+    return None
+
+
+@lru_cache(maxsize=1)
+def _repo_fault_sites() -> frozenset[str] | None:
+    path = _REPO_ROOT / "tensorlink_tpu" / "core" / "faults.py"
+    try:
+        return _sites_from_tree(ast.parse(path.read_text()))
+    except (OSError, SyntaxError):
+        return None
+
+
+# -- the project -------------------------------------------------------------
+
+
+@dataclass
+class Project:
+    """Everything the cross-file rules need, built once per lint run."""
+
+    contexts: dict[str, FileContext]
+    funcs: dict[tuple[str, str], FuncInfo] = field(default_factory=dict)
+    # caller key -> [(callee key, call node, locks held at the site)]
+    edges: dict[tuple[str, str], list] = field(default_factory=dict)
+    donors: dict[tuple[str, str], Donor] = field(default_factory=dict)
+    one_program: dict[tuple[str, str], int] = field(default_factory=dict)
+    _imports: dict[str, _Imports] = field(default_factory=dict)
+    _module_names: dict[str, set[str]] = field(default_factory=dict)
+    _methods: dict[tuple[str, str, str], str] = field(default_factory=dict)
+    _mod_to_rel: dict[str, str] = field(default_factory=dict)
+    _hot: dict | None = None
+    _locks: dict | None = None
+    _sites: object = False  # sentinel: not yet resolved
+
+    @classmethod
+    def build(cls, contexts: dict[str, FileContext]) -> "Project":
+        p = cls(contexts=dict(contexts))
+        for rel, ctx in p.contexts.items():
+            mod = _module_name(rel)
+            if mod:
+                p._mod_to_rel[mod] = rel
+            p._imports[rel] = _imports_for(rel, ctx.tree)
+            names = {
+                n.name
+                for n in ctx.tree.body
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+            }
+            for stmt in ctx.tree.body:
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                    if isinstance(stmt, ast.AnnAssign)
+                    else []
+                )
+                names.update(
+                    t.id for t in targets if isinstance(t, ast.Name)
+                )
+            p._module_names[rel] = names
+            for name, donor in _file_donors(rel, ctx.tree).items():
+                p.donors[(rel, name)] = donor
+            # one-program markers on module-level jit assignments
+            for stmt in ctx.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if any(
+                    m.kind == "one-program"
+                    for m in ctx.markers_at(stmt.lineno)
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            p.one_program[(rel, t.id)] = stmt.lineno
+            for func, stack in _func_defs(ctx.tree):
+                scope = scope_name(stack)
+                cls_name = (
+                    stack[-2].name
+                    if len(stack) >= 2 and isinstance(stack[-2], ast.ClassDef)
+                    else None
+                )
+                nested = any(
+                    isinstance(
+                        n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    for n in stack[:-1]
+                )
+                info = FuncInfo(
+                    rel=rel,
+                    scope=scope,
+                    name=func.name,
+                    node=func,
+                    cls=cls_name,
+                    nested=nested,
+                    markers=ctx.markers_for_def(func),
+                )
+                p.funcs[info.key] = info
+                if cls_name and not nested:
+                    p._methods[(rel, cls_name, func.name)] = scope
+                if any(m.kind == "one-program" for m in info.markers):
+                    p.one_program[(rel, scope)] = func.lineno
+        for key, info in p.funcs.items():
+            sites = []
+            for call, held in _call_sites(info.node):
+                callee = p.resolve_call(info.rel, info, call)
+                if callee is not None and callee in p.funcs:
+                    sites.append((callee, call, held))
+            if sites:
+                p.edges[key] = sites
+        return p
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_call(
+        self, rel: str, caller: FuncInfo | None, call: ast.Call
+    ) -> tuple[str, str] | None:
+        """Resolve a call to ``(rel, identity)`` where identity is a scope
+        name for defs/methods or a module-level binding name; ``None``
+        for anything dynamic."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self":
+                if caller is not None and caller.cls is not None:
+                    scope = self._methods.get((rel, caller.cls, f.attr))
+                    if scope is not None:
+                        return (rel, scope)
+                return None
+            mod = self._imports.get(rel, _Imports()).modules.get(f.value.id)
+            if mod is not None:
+                target = self._mod_to_rel.get(mod)
+                if target and f.attr in self._module_names.get(target, ()):
+                    return (target, f.attr)
+            return None
+        if isinstance(f, ast.Name):
+            if f.id in self._module_names.get(rel, ()):
+                return (rel, f.id)
+            sym = self._imports.get(rel, _Imports()).symbols.get(f.id)
+            if sym is not None:
+                target = self._mod_to_rel.get(sym[0])
+                if target and sym[1] in self._module_names.get(target, ()):
+                    return (target, sym[1])
+        return None
+
+    # -- guard-context propagation ------------------------------------------
+
+    def hot_context(self) -> dict[tuple[str, str], tuple[str, ...]]:
+        """``func key -> call chain`` (root-first scope names) for every
+        function reachable from a ``# tlint: hot-path`` function through
+        resolved calls. Marked functions map to an empty chain. BFS with
+        a visited set, so recursion and call cycles terminate."""
+        if self._hot is None:
+            hot: dict[tuple[str, str], tuple[str, ...]] = {}
+            roots = [
+                k
+                for k in sorted(self.funcs)
+                if any(m.kind == "hot-path" for m in self.funcs[k].markers)
+            ]
+            for k in roots:
+                hot[k] = ()
+            queue = list(roots)
+            while queue:
+                k = queue.pop(0)
+                chain = hot[k] + (self.funcs[k].scope,)
+                for callee, _call, _held in self.edges.get(k, ()):
+                    if callee not in hot:
+                        hot[callee] = chain
+                        queue.append(callee)
+            self._hot = hot
+        return self._hot
+
+    def lock_context(self) -> dict[tuple[str, str], dict[str, str]]:
+        """``func key -> {lock -> caller scope}``: locks held across SOME
+        call to the function — its own ``holds-lock`` markers plus locks
+        lexically held at a resolved call site, propagated transitively
+        (fixpoint over a monotone set, so cycles terminate)."""
+        if self._locks is None:
+            own = {
+                k: frozenset(
+                    m.arg
+                    for m in fi.markers
+                    if m.kind == "holds-lock" and m.arg
+                )
+                for k, fi in self.funcs.items()
+            }
+            ctx: dict[tuple[str, str], dict[str, str]] = {
+                k: {} for k in self.funcs
+            }
+            changed = True
+            while changed:
+                changed = False
+                for k in sorted(self.funcs):
+                    eff = set(own[k]) | set(ctx[k])
+                    for callee, _call, held in self.edges.get(k, ()):
+                        for lock in sorted(eff | set(held)):
+                            if (
+                                lock not in own[callee]
+                                and lock not in ctx[callee]
+                            ):
+                                ctx[callee][lock] = self.funcs[k].scope
+                                changed = True
+            self._locks = ctx
+        return self._locks
+
+    # -- cross-module facts ---------------------------------------------------
+
+    def fault_sites(self) -> frozenset[str] | None:
+        """The ``faults.SITES`` registry: parsed from a linted faults.py
+        when the run covers it, else from the repo checkout (so single-
+        file runs still resolve cross-module)."""
+        if self._sites is False:
+            sites = None
+            for rel, ctx in sorted(self.contexts.items()):
+                if rel.endswith("faults.py"):
+                    sites = _sites_from_tree(ctx.tree)
+                    if sites is not None:
+                        break
+            self._sites = sites if sites is not None else _repo_fault_sites()
+        return self._sites

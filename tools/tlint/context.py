@@ -26,8 +26,11 @@ _SUPPRESS_ITEM_RE = re.compile(r"(?P<rule>TL\d{3})(?:\((?P<reason>[^)]*)\))?")
 #   # tlint: holds-lock(self._lock)   -> caller holds the lock (TL001 ok,
 #                                        TL002 treats the body as locked)
 #   # tlint: on-loop                  -> runs on the owning event loop
+#   # tlint: one-program              -> a fixed-shape jitted program:
+#                                        TL101 checks its call sites for
+#                                        cache-key-churning arguments
 _MARKER_RE = re.compile(
-    r"#\s*tlint:\s*(?P<kind>hot-path|on-loop|holds-lock)"
+    r"#\s*tlint:\s*(?P<kind>hot-path|on-loop|holds-lock|one-program)"
     r"(?:\((?P<arg>[^)]*)\))?"
 )
 
@@ -49,7 +52,7 @@ class Suppression:
 
 @dataclass
 class Marker:
-    kind: str  # hot-path | on-loop | holds-lock
+    kind: str  # hot-path | on-loop | holds-lock | one-program
     arg: str  # holds-lock's lock expression, e.g. "self._lock"
     line: int
 
@@ -93,7 +96,8 @@ class FileContext:
             for tok in toks:
                 if tok.type == tokenize.COMMENT:
                     ctx.comments[tok.start[0]] = tok.string
-        except tokenize.TokenError:  # unterminated constructs: best effort
+        # tlint: disable=TL005(unterminated constructs: comments stay best-effort)
+        except tokenize.TokenError:
             pass
         for line, text in ctx.comments.items():
             m = _SUPPRESS_RE.search(text)
@@ -132,6 +136,28 @@ class FileContext:
         return self.lines[line - 1].lstrip().startswith("#")
 
     # -- markers ------------------------------------------------------------
+    def markers_at(self, lineno: int) -> list[Marker]:
+        """``# tlint:`` markers on ``lineno``'s own trailing comment or on
+        the standalone comment line directly above it — the grammar for
+        statements that are not defs (e.g. ``step = jax.jit(impl, ...)``
+        marked ``# tlint: one-program``)."""
+        out: list[Marker] = []
+        for ln in (lineno - 1, lineno):
+            text = self.comments.get(ln)
+            if not text:
+                continue
+            if ln == lineno - 1 and not self._standalone_comment(ln):
+                continue
+            for m in _MARKER_RE.finditer(text):
+                out.append(
+                    Marker(
+                        kind=m.group("kind"),
+                        arg=(m.group("arg") or "").strip(),
+                        line=ln,
+                    )
+                )
+        return out
+
     def markers_for_def(self, node: ast.AST) -> list[Marker]:
         """``# tlint:`` markers attached to a function: on any decorator
         line, the ``def`` line, or the standalone comment line above."""

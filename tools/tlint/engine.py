@@ -5,6 +5,12 @@ suppressed with a reason or matched by a baseline entry, and no
 suppression is missing its reason. Stale baseline entries (matching
 nothing anymore) are warnings — they mean a deferred violation got
 fixed and the entry should be deleted.
+
+The run is two-pass: parse every file first, build the cross-file
+:class:`~tools.tlint.callgraph.Project` (call graph, donation
+signatures, fault-site registry, one-program index), then run the rules
+— single-file rules get ``(ctx)``, rules marked ``needs_project`` get
+``(ctx, project)``.
 """
 
 from __future__ import annotations
@@ -14,12 +20,19 @@ import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from . import jaxrules, rules as _rules_mod
+from .callgraph import Project
 from .context import FileContext
-from .rules import RULES, Violation
+from .rules import Violation
+
+# the full rule table: thread rules (TL0xx) + JAX trace rules (TL1xx)
+# tlint: disable=TL006(read-only rule table, never mutated after import)
+RULES = {**_rules_mod.RULES, **jaxrules.JAX_RULES}
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
+# tlint: disable=TL006(read-only constant table)
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv"}
 
 
@@ -55,21 +68,55 @@ def _relpath(path: Path) -> str:
         return path.as_posix()
 
 
+def _rule_violations(
+    ctx: FileContext, project: Project, rules: dict
+) -> list[Violation]:
+    out: list[Violation] = []
+    for rule_fn in rules.values():
+        if getattr(rule_fn, "needs_project", False):
+            out.extend(rule_fn(ctx, project))
+        else:
+            out.extend(rule_fn(ctx))
+    return out
+
+
 def check_source(
     source: str, rel: str, rules: dict | None = None
 ) -> tuple[list[Violation], FileContext]:
-    """Run the rules over one in-memory file. Returns violations that are
-    NOT inline-suppressed (baseline is the caller's business) plus the
-    context (for suppression bookkeeping). The unit the fixture tests
-    drive."""
+    """Run the rules over one in-memory file (its own one-file project, so
+    cross-file rules still work same-module). Returns violations that
+    are NOT inline-suppressed (baseline is the caller's business) plus
+    the context (for suppression bookkeeping). The unit the fixture
+    tests drive."""
     ctx = FileContext.parse(rel, source)
-    out: list[Violation] = []
-    for rule_fn in (rules or RULES).values():
-        for v in rule_fn(ctx):
-            if not ctx.suppressed(v.rule, v.line):
-                out.append(v)
+    project = Project.build({rel: ctx})
+    out = [
+        v
+        for v in _rule_violations(ctx, project, rules or RULES)
+        if not ctx.suppressed(v.rule, v.line)
+    ]
     out.sort(key=lambda v: (v.rel, v.line, v.col, v.rule))
     return out, ctx
+
+
+def check_project(
+    files: dict[str, str], rules: dict | None = None
+) -> list[Violation]:
+    """Run the rules over a dict of in-memory files ``{rel: source}`` —
+    the multi-file unit the call-graph propagation tests drive. Inline
+    suppressions apply; no baseline."""
+    contexts = {rel: FileContext.parse(rel, src) for rel, src in files.items()}
+    project = Project.build(contexts)
+    out: list[Violation] = []
+    for rel in sorted(contexts):
+        ctx = contexts[rel]
+        out.extend(
+            v
+            for v in _rule_violations(ctx, project, rules or RULES)
+            if not ctx.suppressed(v.rule, v.line)
+        )
+    out.sort(key=lambda v: (v.rel, v.line, v.col, v.rule))
+    return out
 
 
 def load_baseline(path: Path) -> list[dict]:
@@ -114,26 +161,29 @@ def run(
     rep = Report()
     entries = load_baseline(baseline_path) if baseline_path else []
     matched_entries: set[int] = set()
+    contexts: dict[str, FileContext] = {}
     for f in iter_py_files(paths):
         rel = _relpath(f)
+        if rel in contexts:
+            continue
         try:
-            source = f.read_text()
-            ctx = FileContext.parse(rel, source)
+            contexts[rel] = FileContext.parse(rel, f.read_text())
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             rep.parse_errors.append((rel, str(e)))
-            continue
+    project = Project.build(contexts)
+    for rel in sorted(contexts):
+        ctx = contexts[rel]
         rep.files_checked += 1
-        for rule_fn in (rules or RULES).values():
-            for v in rule_fn(ctx):
-                if ctx.suppressed(v.rule, v.line):
-                    rep.suppressed_count += 1
-                    continue
-                entry = _baseline_match(v, entries)
-                if entry is not None:
-                    matched_entries.add(id(entry))
-                    rep.baselined.append(v)
-                    continue
-                rep.violations.append(v)
+        for v in _rule_violations(ctx, project, rules or RULES):
+            if ctx.suppressed(v.rule, v.line):
+                rep.suppressed_count += 1
+                continue
+            entry = _baseline_match(v, entries)
+            if entry is not None:
+                matched_entries.add(id(entry))
+                rep.baselined.append(v)
+                continue
+            rep.violations.append(v)
         for sup in ctx.bad_suppressions:
             rep.bad_suppressions.append(
                 (
@@ -178,6 +228,41 @@ def format_report(rep: Report, *, verbose: bool = False) -> str:
     return "\n".join(lines)
 
 
+def _gh_data(s: str) -> str:
+    """Escape a workflow-command message per GitHub's grammar."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _gh_prop(s: str) -> str:
+    """Escape a workflow-command property value (also , and :)."""
+    return _gh_data(s).replace(":", "%3A").replace(",", "%2C")
+
+
+def format_report_github(rep: Report) -> str:
+    """GitHub Actions ``::error`` annotations — one per finding, so they
+    render inline on the PR diff — followed by the plain report (the
+    annotation grammar swallows everything after ``::``, so the human-
+    readable block stays separate)."""
+    lines: list[str] = []
+    for rel, err in rep.parse_errors:
+        lines.append(
+            f"::error file={_gh_prop(rel)},title=tlint parse error"
+            f"::{_gh_data(err)}"
+        )
+    for v in rep.violations:
+        lines.append(
+            f"::error file={_gh_prop(v.rel)},line={v.line},col={v.col + 1},"
+            f"title={_gh_prop(v.rule)}::{_gh_data(v.message)}"
+        )
+    for rel, line, msg in rep.bad_suppressions:
+        lines.append(
+            f"::error file={_gh_prop(rel)},line={line},title=TL000"
+            f"::{_gh_data(msg)}"
+        )
+    lines.append(format_report(rep))
+    return "\n".join(lines)
+
+
 def write_baseline(rep: Report, path: Path) -> int:
     """Record every current actionable violation as a deferred baseline
     entry (reason = TODO placeholder the author must fill in — the
@@ -208,9 +293,14 @@ def main(argv: list[str] | None = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="python -m tools.tlint",
-        description="project-native static analysis (TL001-TL007)",
+        description="project-native static analysis "
+        "(thread rules TL001-TL007, JAX trace rules TL101-TL106)",
     )
-    ap.add_argument("paths", nargs="*", default=["tensorlink_tpu", "tests"])
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["tensorlink_tpu", "tests", "tools", "bench.py"],
+    )
     ap.add_argument(
         "--baseline",
         default=str(DEFAULT_BASELINE),
@@ -232,6 +322,13 @@ def main(argv: list[str] | None = None) -> int:
         "--select",
         default="",
         help="comma-separated rule codes to run (default: all)",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("plain", "github"),
+        default="plain",
+        help="output format: plain (default) or GitHub Actions ::error "
+        "annotations",
     )
     args = ap.parse_args(argv)
 
@@ -257,7 +354,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:  # malformed baseline
         print(f"tlint: {e}")
         return 2
-    print(format_report(rep, verbose=args.verbose))
+    if args.format == "github":
+        print(format_report_github(rep))
+    else:
+        print(format_report(rep, verbose=args.verbose))
     return 1 if rep.failed else 0
 
 

@@ -1,0 +1,829 @@
+"""The TL1xx JAX rule family: trace-time hazards (docs/STATIC_ANALYSIS.md).
+
+The thread rules (TL001-TL007) defend the host side of the engine; these
+defend the XLA side — the invariants the compile-count guards, bit-
+identity pins, and chaos suites check at RUNTIME, front-run at lint
+time:
+
+- TL101 jit cache-key hygiene: nothing shape-derived flows into a
+  ``# tlint: one-program`` call, and no ``NamedSharding`` is built from
+  the empty ``P()`` spelling (the PR-17 three-programs bug).
+- TL102 RNG discipline: ``jax.random`` keys derive via ``fold_in`` /
+  ``split`` — no key reused across two draws, no draw keyed on a raw
+  seed (the premise of every bit-identity pin).
+- TL103 donation safety: a buffer passed at a donated position of a
+  jitted program is INVALID afterwards — reading it again only works on
+  CPU, where donation is a no-op, so tests never catch it.
+- TL104 implicit host syncs: ``bool()``/``int()``/``float()``/truth
+  tests/``np.*`` ops on traced arrays in hot-path-REACHABLE code — the
+  syncs TL003's explicit call list cannot see.
+- TL105 fault-site literals: every injection-site string exists in
+  ``faults.SITES`` (resolved cross-module), so a typo fails lint instead
+  of silently no-opping a chaos test.
+- TL106 ad-hoc counters: dict-literal ``self.stats`` counters belong in
+  the core.metrics registry (the old scripts/check_adhoc_counters.sh
+  grep, as a real AST rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Iterator
+
+from .callgraph import Project, project_rule
+from .context import FileContext, scope_name
+from .rules import (
+    Violation,
+    _func_defs,
+    _own_nodes,
+    _self_attr,
+    _unparse,
+)
+
+# ---------------------------------------------------------------------------
+# shared statement-level walkers
+# ---------------------------------------------------------------------------
+
+
+def _own_stmts(root: ast.AST) -> list[ast.stmt]:
+    """Statements of ``root``'s own scope, flattened in document order
+    (block bodies inline after their header); nested def/lambda bodies
+    excluded — they are their own scopes."""
+    out: list[ast.stmt] = []
+
+    def walk(n: ast.AST) -> None:
+        for c in ast.iter_child_nodes(n):
+            if isinstance(
+                c, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(c, ast.stmt):
+                out.append(c)
+            walk(c)
+
+    walk(root)
+    return out
+
+
+def _stmt_parts(stmt: ast.stmt) -> tuple[list[ast.expr], list[ast.expr]]:
+    """``(reads, writes)``: the expressions a statement evaluates and the
+    assignment-target trees it (re)binds — statement granularity, bodies
+    excluded (they are separate statements in ``_own_stmts`` order)."""
+    reads: list[ast.expr] = []
+    writes: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        reads.append(stmt.value)
+        writes.extend(stmt.targets)
+    elif isinstance(stmt, ast.AnnAssign):
+        if stmt.value is not None:
+            reads.append(stmt.value)
+        writes.append(stmt.target)
+    elif isinstance(stmt, ast.AugAssign):
+        reads.extend((stmt.value, stmt.target))
+        writes.append(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        reads.append(stmt.iter)
+        writes.append(stmt.target)
+    elif isinstance(stmt, (ast.If, ast.While)):
+        reads.append(stmt.test)
+    elif isinstance(stmt, (ast.Return, ast.Expr)):
+        if stmt.value is not None:
+            reads.append(stmt.value)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            reads.append(item.context_expr)
+            if item.optional_vars is not None:
+                writes.append(item.optional_vars)
+    elif isinstance(stmt, ast.Raise):
+        reads.extend(e for e in (stmt.exc, stmt.cause) if e is not None)
+    elif isinstance(stmt, ast.Assert):
+        reads.append(stmt.test)
+        if stmt.msg is not None:
+            reads.append(stmt.msg)
+    elif isinstance(stmt, ast.Delete):
+        writes.extend(stmt.targets)
+    return reads, writes
+
+
+def _expr_walk(e: ast.AST) -> Iterator[ast.AST]:
+    """Every node of an expression, lambda subtrees excluded."""
+    stack = [e]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Lambda):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _attr_string(node: ast.AST) -> str | None:
+    """``x`` / ``self.cache`` / ``a.b.c`` as a dotted string, None for
+    anything not a plain name/attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _target_names(t: ast.AST) -> list[str]:
+    """Dotted names (re)bound by an assignment target. An attribute
+    target rebinds the full chain only — ``self.cache = ...`` rebinds
+    ``self.cache``, not ``self``."""
+    out = []
+    stack = [t]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Attribute, ast.Name)):
+            s = _attr_string(n)
+            if s is not None:
+                out.append(s)
+            if isinstance(n, ast.Attribute):
+                continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _scope_roots(tree: ast.Module):
+    """``(scope name, root node)`` for the module and every def."""
+    yield "<module>", tree
+    for func, stack in _func_defs(tree):
+        yield scope_name(stack), func
+
+
+def _scopes(tree: ast.Module):
+    yield "<module>", _own_nodes(tree)
+    for func, stack in _func_defs(tree):
+        yield scope_name(stack), _own_nodes(func)
+
+
+def _call_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _jax_random_fn(call: ast.Call) -> str | None:
+    """``jax.random.X(...)`` -> ``X``, else None."""
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Attribute)
+        and f.value.attr == "random"
+        and isinstance(f.value.value, ast.Name)
+        and f.value.value.id == "jax"
+    ):
+        return f.attr
+    return None
+
+
+def _np_fn(call: ast.Call) -> str | None:
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id in ("np", "numpy")
+    ):
+        return f.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# TL101 — jit cache-key hygiene
+# ---------------------------------------------------------------------------
+
+
+def _shape_derived(expr: ast.AST, tainted: set[str]) -> str | None:
+    # an arg wrapped into an array (jnp.int32(n), jnp.asarray(row),
+    # np.zeros(...)) reaches the jit as a TRACED value — the cache keys
+    # on its shape/dtype, not its value; only a BARE Python scalar can
+    # re-specialize the program (it lands in a static arg or a shape)
+    if isinstance(expr, ast.Call):
+        root = expr.func
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in ("jnp", "jax", "np", "numpy"):
+            return None
+    for n in _expr_walk(expr):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id == "len"
+        ):
+            return "len(...)"
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+            return f".{n.attr}"
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return f"`{n.id}`"
+    return None
+
+
+def _pspec_empty(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call) or node.args or node.keywords:
+        return False
+    return _call_name(node) in ("P", "PartitionSpec")
+
+
+@project_rule
+def tl101_jit_cache_keys(
+    ctx: FileContext, project: Project
+) -> Iterator[Violation]:
+    """Two spellings of the same recompile hazard. (a) A call to a
+    ``# tlint: one-program`` callable must not take shape-derived Python
+    values (``len(...)``, ``.shape`` arithmetic) as arguments — the jit
+    cache keys on them, so every distinct value compiles another program
+    and the one-program contract dies by a thousand specializations.
+    (b) ``NamedSharding`` built from the EMPTY spec ``P()``: ``P()`` and
+    the rank-expanded ``P(None, ...)`` are different cache keys for the
+    same replicated placement — the spelling split behind PR 17's three
+    tp programs (engine/paged.py ``_canon`` is the runtime backstop)."""
+    if not ctx.rel.startswith("tests/"):
+        for scope, root in _scope_roots(ctx.tree):
+            caller = project.funcs.get((ctx.rel, scope))
+            tainted: set[str] = set()
+            for stmt in _own_stmts(root):
+                reads, writes = _stmt_parts(stmt)
+                for r in reads:
+                    for n in _expr_walk(r):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        target = project.resolve_call(ctx.rel, caller, n)
+                        if target is None or target not in project.one_program:
+                            continue
+                        args = list(n.args) + [kw.value for kw in n.keywords]
+                        for arg in args:
+                            bad = _shape_derived(arg, tainted)
+                            if bad is None:
+                                continue
+                            yield Violation(
+                                rule="TL101",
+                                rel=ctx.rel,
+                                line=arg.lineno,
+                                col=arg.col_offset,
+                                scope=scope,
+                                symbol=f"{target[1]}:{bad}",
+                                message=(
+                                    f"one-program call `{target[1]}` takes "
+                                    f"shape-derived argument {bad} — the jit "
+                                    "cache keys on it, so each distinct "
+                                    "value compiles ANOTHER program (the "
+                                    "recompile class the compile-count "
+                                    "guards catch only at runtime); pass "
+                                    "fixed-shape arrays / static config"
+                                ),
+                            )
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    value = stmt.value
+                    if value is not None and _shape_derived(value, tainted):
+                        for w in writes:
+                            tainted.update(_target_names(w))
+    if not ctx.rel.startswith("tensorlink_tpu/"):
+        return
+    for scope, nodes in _scopes(ctx.tree):
+        for node in nodes:
+            if not (
+                isinstance(node, ast.Call)
+                and _call_name(node) == "NamedSharding"
+            ):
+                continue
+            args = list(node.args[1:]) + [kw.value for kw in node.keywords]
+            for arg in args:
+                if not _pspec_empty(arg):
+                    continue
+                yield Violation(
+                    rule="TL101",
+                    rel=ctx.rel,
+                    line=arg.lineno,
+                    col=arg.col_offset,
+                    scope=scope,
+                    symbol="NamedSharding(P())",
+                    message=(
+                        "NamedSharding built from the empty spec P() — "
+                        "P() and rank-expanded P(None, ...) are DIFFERENT "
+                        "jit cache keys for the same replicated placement "
+                        "(the spelling split that silently compiled 3 tp "
+                        "programs instead of 1); spell it rank-expanded, "
+                        "e.g. P(*[None] * x.ndim), or suppress where the "
+                        "empty spelling IS the pinned canonical form"
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# TL102 — jax.random key discipline
+# ---------------------------------------------------------------------------
+
+_JAX_DRAWS = frozenset(
+    {
+        "ball",
+        "bernoulli",
+        "beta",
+        "binomial",
+        "bits",
+        "categorical",
+        "cauchy",
+        "chisquare",
+        "choice",
+        "dirichlet",
+        "exponential",
+        "gamma",
+        "geometric",
+        "gumbel",
+        "laplace",
+        "logistic",
+        "loggamma",
+        "maxwell",
+        "multivariate_normal",
+        "normal",
+        "orthogonal",
+        "permutation",
+        "poisson",
+        "rademacher",
+        "randint",
+        "rayleigh",
+        "t",
+        "truncated_normal",
+        "uniform",
+        "wald",
+        "weibull_min",
+    }
+)
+
+
+def tl102_rng_discipline(ctx: FileContext) -> Iterator[Violation]:
+    """Stateless RNG is the premise of every bit-identity pin: per-slot
+    streams are ``fold_in(seed, step)``-derived, so replays and shard
+    counts never change the bytes. Two hazards: a key CONSUMED twice
+    (two draws — or a draw and a ``split`` — from the same key produce
+    correlated streams), and, in ``engine/``/``ops/``, a draw keyed on a
+    raw ``PRNGKey(seed)`` that never went through ``fold_in``/``split``
+    (every draw from it repeats the same stream)."""
+    in_core = "/engine/" in f"/{ctx.rel}" or "/ops/" in f"/{ctx.rel}"
+    for scope, root in _scope_roots(ctx.tree):
+        consumed: dict[str, int] = {}  # key name -> consuming line
+        raw: set[str] = set()
+        for stmt in _own_stmts(root):
+            reads, writes = _stmt_parts(stmt)
+            for r in reads:
+                for n in _expr_walk(r):
+                    if isinstance(n, ast.NamedExpr):
+                        writes.append(n.target)
+                    if not isinstance(n, ast.Call):
+                        continue
+                    fn = _jax_random_fn(n)
+                    if fn is None or (fn not in _JAX_DRAWS and fn != "split"):
+                        continue
+                    key = n.args[0] if n.args else None
+                    if key is None:
+                        key = next(
+                            (
+                                kw.value
+                                for kw in n.keywords
+                                if kw.arg == "key"
+                            ),
+                            None,
+                        )
+                    if key is None:
+                        continue
+                    if (
+                        fn in _JAX_DRAWS
+                        and in_core
+                        and isinstance(key, ast.Call)
+                        and _jax_random_fn(key) == "PRNGKey"
+                    ):
+                        yield Violation(
+                            rule="TL102",
+                            rel=ctx.rel,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            scope=scope,
+                            symbol=f"jax.random.{fn}",
+                            message=(
+                                f"jax.random.{fn} keyed directly on "
+                                "PRNGKey(seed): every call replays the "
+                                "same stream — derive the key with "
+                                "fold_in(seed, step)/split first (the "
+                                "bit-identity contract's RNG discipline)"
+                            ),
+                        )
+                        continue
+                    kname = _attr_string(key)
+                    if kname is None:
+                        continue
+                    if kname in consumed:
+                        yield Violation(
+                            rule="TL102",
+                            rel=ctx.rel,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            scope=scope,
+                            symbol=kname,
+                            message=(
+                                f"key `{kname}` already consumed at line "
+                                f"{consumed[kname]} is used again by "
+                                f"jax.random.{fn} — reusing a key "
+                                "correlates the two streams; split/"
+                                "fold_in a fresh key per draw"
+                            ),
+                        )
+                    elif fn in _JAX_DRAWS and in_core and kname in raw:
+                        yield Violation(
+                            rule="TL102",
+                            rel=ctx.rel,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            scope=scope,
+                            symbol=kname,
+                            message=(
+                                f"key `{kname}` is a raw PRNGKey(seed) — "
+                                "draw from a fold_in/split-derived key "
+                                "instead, so per-slot/per-step streams "
+                                "stay independent and replayable"
+                            ),
+                        )
+                    consumed.setdefault(kname, n.lineno)
+            value = (
+                stmt.value
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+                else None
+            )
+            is_raw = (
+                value is not None
+                and isinstance(value, ast.Call)
+                and _jax_random_fn(value) == "PRNGKey"
+            )
+            for w in writes:
+                for nm in _target_names(w):
+                    consumed.pop(nm, None)
+                    if is_raw:
+                        raw.add(nm)
+                    else:
+                        raw.discard(nm)
+
+
+# ---------------------------------------------------------------------------
+# TL103 — donation safety
+# ---------------------------------------------------------------------------
+
+
+@project_rule
+def tl103_donation_safety(
+    ctx: FileContext, project: Project
+) -> Iterator[Violation]:
+    """A buffer passed at a ``donate_argnums``/``donate_argnames``
+    position of a jitted program is handed to XLA: the caller's
+    reference is INVALID after the call. On CPU donation is a no-op, so
+    a read-after-donate passes every CPU test and corrupts data on TPU —
+    the bug class only static analysis catches before hardware does.
+    Rebinding the name from the call's results (``cache = step(cache)``)
+    is the discipline; any later read without rebinding is flagged."""
+    for scope, root in _scope_roots(ctx.tree):
+        caller = project.funcs.get((ctx.rel, scope))
+        donated: dict[str, tuple[str, int]] = {}  # name -> (donor, line)
+        for stmt in _own_stmts(root):
+            reads, writes = _stmt_parts(stmt)
+            fresh: dict[str, tuple[str, int]] = {}
+            for r in reads:
+                for n in _expr_walk(r):
+                    if isinstance(n, ast.NamedExpr):
+                        writes.append(n.target)
+                    if donated:
+                        nm = _attr_string(n)
+                        if nm in donated and isinstance(
+                            getattr(n, "ctx", None), ast.Load
+                        ):
+                            donor_name, dline = donated.pop(nm)
+                            yield Violation(
+                                rule="TL103",
+                                rel=ctx.rel,
+                                line=n.lineno,
+                                col=n.col_offset,
+                                scope=scope,
+                                symbol=nm,
+                                message=(
+                                    f"`{nm}` was DONATED to "
+                                    f"`{donor_name}` at line {dline} — "
+                                    "its buffer is invalid after the "
+                                    "call (donation is a no-op on CPU, "
+                                    "so tests pass; TPU corrupts); "
+                                    "rebind the name from the call's "
+                                    "results before reading it"
+                                ),
+                            )
+                    if not isinstance(n, ast.Call):
+                        continue
+                    target = project.resolve_call(ctx.rel, caller, n)
+                    donor = project.donors.get(target) if target else None
+                    if donor is None:
+                        continue
+                    for i in sorted(donor.positions):
+                        if i < len(n.args):
+                            nm = _attr_string(n.args[i])
+                            if nm is not None:
+                                fresh[nm] = (donor.name, n.lineno)
+                    for kw in n.keywords:
+                        if kw.arg in donor.argnames:
+                            nm = _attr_string(kw.value)
+                            if nm is not None:
+                                fresh[nm] = (donor.name, n.lineno)
+            for w in writes:
+                for nm in _target_names(w):
+                    donated.pop(nm, None)
+                    fresh.pop(nm, None)
+            donated.update(fresh)
+
+
+# ---------------------------------------------------------------------------
+# TL104 — implicit host syncs in hot-path-reachable code
+# ---------------------------------------------------------------------------
+
+_COERCIONS = ("bool", "int", "float")
+
+
+@project_rule
+def tl104_implicit_host_sync(
+    ctx: FileContext, project: Project
+) -> Iterator[Violation]:
+    """TL003 flags the EXPLICIT sync calls in ``# tlint: hot-path``
+    bodies; this rule catches the implicit ones, through the call graph:
+    in any function REACHABLE from a hot-path function, ``bool()`` /
+    ``int()`` / ``float()`` coercion, ``if``/``while`` truth tests, and
+    ``np.*`` ops applied to TRACED values (results of ``jnp.*``/``jax.*``
+    calls or of the jitted one-program/donating callables) each block
+    the host on the device step — a serialization of the dispatch
+    pipeline that never shows up as a named sync call."""
+    hot = project.hot_context()
+    for func, stack in _func_defs(ctx.tree):
+        scope = scope_name(stack)
+        chain = hot.get((ctx.rel, scope))
+        if chain is None:
+            continue
+        caller = project.funcs.get((ctx.rel, scope))
+        via = (
+            f" (on the hot path via {' -> '.join(chain)})" if chain else ""
+        )
+        tainted: set[str] = set()
+
+        def _device_call(call: ast.Call) -> bool:
+            f = call.func
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("jnp", "jax"):
+                return True
+            target = project.resolve_call(ctx.rel, caller, call)
+            return target is not None and (
+                target in project.donors or target in project.one_program
+            )
+
+        def _traced(e: ast.AST) -> bool:
+            for n in _expr_walk(e):
+                s = _attr_string(n)
+                if s is not None and s in tainted:
+                    return True
+                if isinstance(n, ast.Call) and _device_call(n):
+                    return True
+            return False
+
+        for stmt in _own_stmts(func):
+            reads, writes = _stmt_parts(stmt)
+            for r in reads:
+                for n in _expr_walk(r):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    name = _call_name(n)
+                    if (
+                        isinstance(n.func, ast.Name)
+                        and name in _COERCIONS
+                        and n.args
+                        and _traced(n.args[0])
+                    ):
+                        yield Violation(
+                            rule="TL104",
+                            rel=ctx.rel,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            scope=scope,
+                            symbol=f"{name}()",
+                            message=(
+                                f"{name}() on a traced array blocks the "
+                                f"host until the device step finishes"
+                                f"{via} — an implicit sync TL003's call "
+                                "list can't see; keep the value in-"
+                                "program (jnp) or sync once at the "
+                                "chunk boundary"
+                            ),
+                        )
+                    elif (
+                        _np_fn(n) is not None
+                        and _np_fn(n) not in ("asarray", "array")
+                        and any(_traced(a) for a in n.args)
+                    ):
+                        yield Violation(
+                            rule="TL104",
+                            rel=ctx.rel,
+                            line=n.lineno,
+                            col=n.col_offset,
+                            scope=scope,
+                            symbol=f"np.{_np_fn(n)}",
+                            message=(
+                                f"np.{_np_fn(n)}() on a traced array "
+                                f"copies device data to host{via} — use "
+                                "the jnp equivalent inside the program, "
+                                "or sync once at the chunk boundary"
+                            ),
+                        )
+            if isinstance(stmt, (ast.If, ast.While)) and not (
+                isinstance(stmt.test, ast.Call)
+                and _call_name(stmt.test) in _COERCIONS
+            ):
+                if _traced(stmt.test):
+                    kw = "if" if isinstance(stmt, ast.If) else "while"
+                    yield Violation(
+                        rule="TL104",
+                        rel=ctx.rel,
+                        line=stmt.test.lineno,
+                        col=stmt.test.col_offset,
+                        scope=scope,
+                        symbol=kw,
+                        message=(
+                            f"`{kw}` truth-tests a traced array — an "
+                            f"implicit bool() device sync{via}; compute "
+                            "the predicate in-program or hoist it to "
+                            "the chunk boundary"
+                        ),
+                    )
+            value = (
+                stmt.value
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+                else None
+            )
+            if value is None:
+                continue
+            host_result = isinstance(value, ast.Call) and (
+                (
+                    isinstance(value.func, ast.Name)
+                    and _call_name(value) in _COERCIONS
+                )
+                or _np_fn(value) is not None
+            )
+            now_traced = not host_result and _traced(value)
+            for w in writes:
+                for nm in _target_names(w):
+                    if now_traced:
+                        tainted.add(nm)
+                    else:
+                        tainted.discard(nm)
+
+
+# ---------------------------------------------------------------------------
+# TL105 — fault-site literals
+# ---------------------------------------------------------------------------
+
+
+@project_rule
+def tl105_fault_sites(
+    ctx: FileContext, project: Project
+) -> Iterator[Violation]:
+    """Every fault-injection site string must exist in ``faults.SITES``
+    (resolved cross-module from core/faults.py): an unregistered site at
+    an ``inject(...)`` call or in a ``{"site": ..., "op": ...}`` plan
+    rule matches nothing at runtime — the chaos test silently no-ops,
+    which is exactly how PR 8's typo'd sites shipped. FaultRule's own
+    ``__post_init__`` raises at runtime; this front-runs it to lint."""
+    sites = project.fault_sites()
+    if sites is None or ctx.rel.rsplit("/", 1)[-1] == "faults.py":
+        return
+    for scope, nodes in _scopes(ctx.tree):
+        for node in nodes:
+            literal = None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "inject"
+            ):
+                arg = node.args[0] if node.args else None
+                if arg is None:
+                    arg = next(
+                        (
+                            kw.value
+                            for kw in node.keywords
+                            if kw.arg == "site"
+                        ),
+                        None,
+                    )
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    literal = arg
+            elif isinstance(node, ast.Dict):
+                keys = {
+                    k.value
+                    for k in node.keys
+                    if isinstance(k, ast.Constant)
+                }
+                if "site" in keys and "op" in keys:
+                    for k, v in zip(node.keys, node.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "site"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                        ):
+                            literal = v
+            if literal is None or literal.value in sites:
+                continue
+            close = difflib.get_close_matches(literal.value, sites, n=1)
+            hint = f" (did you mean '{close[0]}'?)" if close else ""
+            yield Violation(
+                rule="TL105",
+                rel=ctx.rel,
+                line=literal.lineno,
+                col=literal.col_offset,
+                scope=scope,
+                symbol=literal.value or "<empty>",
+                message=(
+                    f"fault site '{literal.value}' is not registered in "
+                    f"faults.SITES{hint} — an unknown site matches "
+                    "nothing, so the injection silently no-ops the "
+                    "chaos test; register it or fix the literal"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# TL106 — ad-hoc dict counters (ex scripts/check_adhoc_counters.sh)
+# ---------------------------------------------------------------------------
+
+
+def tl106_adhoc_counters(ctx: FileContext) -> Iterator[Violation]:
+    """Counters that feed ``/stats`` snapshots live in the core.metrics
+    registry (typed, labeled, one snapshot path) — not per-object
+    ``self.stats`` dicts, which drift out of the registry snapshot and
+    dodge the metric-name pins. The old shell grep
+    (``self.stats = {`` / ``self.stats[...] += ``) as an AST rule, now
+    tree-wide instead of four hand-listed files."""
+    if not ctx.rel.startswith("tensorlink_tpu/"):
+        return
+    for scope, nodes in _scopes(ctx.tree):
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Dict
+            ):
+                for t in node.targets:
+                    if _self_attr(t) == "stats":
+                        yield Violation(
+                            rule="TL106",
+                            rel=ctx.rel,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            scope=scope,
+                            symbol="self.stats",
+                            message=(
+                                "ad-hoc dict counter `self.stats = "
+                                "{...}` — counters on snapshot paths "
+                                "belong in the core.metrics registry "
+                                "(counter()/gauge()), which the /stats "
+                                "endpoint and the metric-name pins "
+                                "read; migrate or baseline with the "
+                                "exemption reason"
+                            ),
+                        )
+            elif (
+                isinstance(node, ast.AugAssign)
+                and isinstance(node.op, (ast.Add, ast.Sub))
+                and isinstance(node.target, ast.Subscript)
+                and _self_attr(node.target.value) == "stats"
+            ):
+                yield Violation(
+                    rule="TL106",
+                    rel=ctx.rel,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    scope=scope,
+                    symbol="self.stats[...]",
+                    message=(
+                        f"ad-hoc counter bump "
+                        f"`{_unparse(node)}` — use a core.metrics "
+                        "registry counter so the value reaches the "
+                        "/stats snapshot and the name pins"
+                    ),
+                )
+
+
+# tlint: disable=TL006(read-only rule table, never mutated after import)
+JAX_RULES = {
+    "TL101": tl101_jit_cache_keys,
+    "TL102": tl102_rng_discipline,
+    "TL103": tl103_donation_safety,
+    "TL104": tl104_implicit_host_sync,
+    "TL105": tl105_fault_sites,
+    "TL106": tl106_adhoc_counters,
+}

@@ -283,7 +283,9 @@ def _check_guarded_exprs(
 _LOCKISH = re.compile(r"lock|mutex|cond|idle|gate", re.IGNORECASE)
 _QUEUEISH = re.compile(r"(^|_)(q|queue|work|inbox|outbox)s?$")
 _THREADISH = re.compile(r"thread", re.IGNORECASE)
+# tlint: disable=TL006(read-only constant table)
 _BLOCKING_SOCKET = {"recv", "recv_into", "recvfrom", "sendall", "accept"}
+# tlint: disable=TL006(read-only constant table)
 _DEVICE_SYNC = {"block_until_ready", "device_get"}
 
 
@@ -324,21 +326,34 @@ def _blocking_reason(call: ast.Call) -> str | None:
     return None
 
 
-def tl002_no_blocking_under_lock(ctx: FileContext) -> Iterator[Violation]:
+def tl002_no_blocking_under_lock(
+    ctx: FileContext, project=None
+) -> Iterator[Violation]:
     """No blocking call (socket I/O, un-timed queue ops, ``time.sleep``,
     blocking RPC, host↔device sync) inside a held THREAD lock — every
     other thread contending on the lock stalls behind it. ``async with``
     is exempt (awaiting inside an asyncio lock yields the loop); methods
     marked ``# tlint: holds-lock(...)`` are checked as if locked, since
-    their callers hold the lock across the whole body."""
+    their callers hold the lock across the whole body. With a project
+    call graph, locks held at a resolved call SITE propagate into the
+    callee the same way (transitively)."""
+    lock_ctx = project.lock_context() if project is not None else {}
     for func, stack in _func_defs(ctx.tree):
+        scope = scope_name(stack)
         marks = ctx.markers_for_def(func)
         base_locks = [
             m.arg for m in marks if m.kind == "holds-lock" and m.arg
         ]
+        via = dict(lock_ctx.get((ctx.rel, scope), {}))
+        for lock in sorted(via):
+            if lock not in base_locks:
+                base_locks.append(lock)
         yield from _walk_lock_regions(
-            ctx, func, func, list(base_locks), scope_name(stack)
+            ctx, func, func, list(base_locks), scope, via=via
         )
+
+
+tl002_no_blocking_under_lock.needs_project = True
 
 
 def _walk_lock_regions(
@@ -347,6 +362,7 @@ def _walk_lock_regions(
     node: ast.AST,
     held: list[str],
     scope: str,
+    via: dict[str, str] | None = None,
 ) -> Iterator[Violation]:
     for child in ast.iter_child_nodes(node):
         if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -364,12 +380,18 @@ def _walk_lock_regions(
                     acquired.append(expr.id)
             for stmt in child.body:
                 yield from _walk_lock_regions(
-                    ctx, func, stmt, held + acquired, scope
+                    ctx, func, stmt, held + acquired, scope, via=via
                 )
             continue
         if held and isinstance(child, ast.Call):
             reason = _blocking_reason(child)
             if reason is not None and not _is_lock_method(child, held):
+                prov = [
+                    f"{lock} held by caller {(via or {})[lock]}"
+                    for lock in sorted(set(held))
+                    if via and lock in via
+                ]
+                suffix = f" [{'; '.join(prov)}]" if prov else ""
                 yield Violation(
                     rule="TL002",
                     rel=ctx.rel,
@@ -379,10 +401,10 @@ def _walk_lock_regions(
                     symbol=_unparse(child.func),
                     message=(
                         f"blocking call {_unparse(child)} while holding "
-                        f"{', '.join(sorted(set(held)))}: {reason}"
+                        f"{', '.join(sorted(set(held)))}: {reason}{suffix}"
                     ),
                 )
-        yield from _walk_lock_regions(ctx, func, child, held, scope)
+        yield from _walk_lock_regions(ctx, func, child, held, scope, via=via)
 
 
 def _is_lock_method(call: ast.Call, held: list[str]) -> bool:
@@ -397,6 +419,7 @@ def _is_lock_method(call: ast.Call, held: list[str]) -> bool:
 # TL003 — hot-path host-sync hygiene
 # ---------------------------------------------------------------------------
 
+# tlint: disable=TL006(read-only constant table)
 _HOT_SYNC_ATTRS = {
     "item": ".item() forces a device->host transfer",
     "tolist": ".tolist() forces a device->host transfer",
@@ -405,20 +428,39 @@ _HOT_SYNC_ATTRS = {
 }
 
 
-def tl003_hot_path_sync(ctx: FileContext) -> Iterator[Violation]:
+def tl003_hot_path_sync(
+    ctx: FileContext, project=None
+) -> Iterator[Violation]:
     """Functions marked ``# tlint: hot-path`` (the decode/prefill/
     admission paths) must not host-sync: no ``np.asarray``/``np.array``
     on device values, no ``.item()``/``.tolist()``, no
     ``block_until_ready``/``device_get``. A host round-trip mid-chunk
     serializes the dispatch pipeline — the hazard the fixed-shape chunk
-    programs exist to avoid (docs/SERVING.md)."""
+    programs exist to avoid (docs/SERVING.md). With a project call
+    graph, functions REACHABLE from a hot-path function are checked too
+    — but only for the definite syncs (``.item``/``.tolist``/
+    ``block_until_ready``/``device_get``): ``np.asarray`` in an unmarked
+    helper is routinely host-data packing, so it stays a marked-function
+    check only."""
+    hot = project.hot_context() if project is not None else {}
     for func, stack in _func_defs(ctx.tree):
-        if not any(
-            m.kind == "hot-path" for m in ctx.markers_for_def(func)
-        ):
-            continue
         scope = scope_name(stack)
-        for node in ast.walk(func):
+        marked = any(
+            m.kind == "hot-path" for m in ctx.markers_for_def(func)
+        )
+        chain = hot.get((ctx.rel, scope))
+        if not marked and chain is None:
+            continue
+        reach = (
+            f" (reachable from hot-path via {' -> '.join(chain)})"
+            if not marked and chain
+            else ""
+        )
+        # marked functions scan whole-body (a closure defined on a hot
+        # path usually IS the loop body); reachable-only functions scan
+        # own statements — their closures run later, off the chain
+        nodes = ast.walk(func) if marked else iter(_own_nodes(func))
+        for node in nodes:
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
@@ -426,7 +468,8 @@ def tl003_hot_path_sync(ctx: FileContext) -> Iterator[Violation]:
             msg = None
             if isinstance(f, ast.Attribute):
                 if (
-                    f.attr in ("asarray", "array")
+                    marked
+                    and f.attr in ("asarray", "array")
                     and isinstance(f.value, ast.Name)
                     and f.value.id in ("np", "numpy")
                 ):
@@ -448,8 +491,11 @@ def tl003_hot_path_sync(ctx: FileContext) -> Iterator[Violation]:
                 col=node.col_offset,
                 scope=scope,
                 symbol=sym,
-                message=f"host sync in hot-path function: {msg}",
+                message=f"host sync in hot-path function: {msg}{reach}",
             )
+
+
+tl003_hot_path_sync.needs_project = True
 
 
 # ---------------------------------------------------------------------------
@@ -577,6 +623,7 @@ def tl005_no_swallowed_exceptions(ctx: FileContext) -> Iterator[Violation]:
 # ---------------------------------------------------------------------------
 
 _CLASSISH = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+# tlint: disable=TL006(read-only constant table)
 _MUTABLE_CTORS = {
     "list",
     "dict",
@@ -720,7 +767,9 @@ def _is_mutable_value(value: ast.AST) -> bool:
 # TL007 — unseeded RNG
 # ---------------------------------------------------------------------------
 
+# tlint: disable=TL006(read-only constant table)
 _NP_SEEDED_OK = {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox"}
+# tlint: disable=TL006(read-only constant table)
 _PY_SEEDED_OK = {"Random", "SystemRandom"}
 
 
@@ -774,6 +823,7 @@ def tl007_unseeded_rng(ctx: FileContext) -> Iterator[Violation]:
             )
 
 
+# tlint: disable=TL006(read-only rule table, never mutated after import)
 RULES = {
     "TL001": tl001_guarded_by,
     "TL002": tl002_no_blocking_under_lock,
